@@ -1,0 +1,47 @@
+"""MLP training demo — user-style script through the public API.
+
+Mirrors the reference's examples/python/native/mnist_mlp.py shape:
+build layers, compile (strategy + jitted step), fit, print throughput.
+"""
+import numpy as np
+
+from flexflow_tpu import (
+    ActiMode,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+
+
+def main():
+    cfg = FFConfig.from_args()
+    cfg.batch_size = 64
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 64], name="x")
+    t = ff.dense(x, 256, activation=ActiMode.RELU)
+    t = ff.dense(t, 256, activation=ActiMode.RELU)
+    t = ff.dense(t, 10)
+    t = ff.softmax(t)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    import jax
+
+    print(f"devices: {jax.devices()}")
+    print(f"mesh: {ff.mesh}")
+    print(f"strategy: {ff.strategy.mesh_axes}")
+
+    rng = np.random.RandomState(42)
+    n = 4096
+    w_true = rng.randn(64, 10)
+    xs = rng.randn(n, 64).astype(np.float32)
+    ys = np.argmax(xs @ w_true + 0.1 * rng.randn(n, 10), axis=1).astype(np.int32)
+    ff.fit(xs, ys, epochs=5)
+
+
+if __name__ == "__main__":
+    main()

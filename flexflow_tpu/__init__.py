@@ -1,0 +1,37 @@
+"""flexflow_tpu — a TPU-native auto-parallelizing DNN training framework.
+
+Brand-new implementation of Unity-era FlexFlow's capabilities
+(reference: Yanivmd/FlexFlow, read-only at /root/reference) designed
+TPU-first: jax/XLA SPMD over a named device Mesh replaces the Legion
+runtime + mapper; Pallas kernels replace custom CUDA; ICI/DCN
+collectives replace NCCL; and the Unity/MCMC strategy search drives a
+TPU-pod machine model.  See SURVEY.md at the repo root.
+"""
+from .config import FFConfig, FFIterationConfig
+from .fftype import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OpBinary,
+    OperatorType,
+    OpUnary,
+    ParameterSyncType,
+)
+from .initializer import (
+    ConstantInitializer,
+    GlorotUniform,
+    NormInitializer,
+    UniformInitializer,
+    ZeroInitializer,
+)
+from .loss import Loss
+from .metrics import Metrics, PerfMetrics
+from .model import FFModel
+from .optimizer import AdamOptimizer, SGDOptimizer
+from .strategy import Strategy, data_parallel_strategy
+from .tensor import ParallelDim, ParallelTensor, ParallelTensorShape, Tensor
+
+__version__ = "0.1.0"

@@ -1,0 +1,153 @@
+"""Runtime configuration — TPU-native analogue of the reference FFConfig.
+
+Reference: /root/reference/include/flexflow/config.h:92-160 and the
+hand-rolled parse_args at src/runtime/model.cc:3556-3720 (~40 CLI flags:
+training -e/-b/--lr/--wd, Legion -ll:* resource flags, search flags,
+simulator/machine-model flags, --fusion, control replication).
+
+TPU translation: the Legion resource flags (-ll:gpu/-ll:fsize/-ll:zsize)
+become mesh/device-count + HBM-budget settings; NCCL vs PS becomes the
+ParameterSyncType hint consumed by the simulator; control replication is
+inherent to SPMD.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Dict, List, Optional
+
+from .fftype import ParameterSyncType
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # -- training (reference: -e, -b, --lr, --wd, parse_args model.cc:3560-3600)
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 1e-4
+    seed: int = 0
+
+    # -- machine resources (reference: -ll:gpu/-ll:cpu/-ll:fsize/-ll:zsize)
+    num_devices: int = -1  # -1 = all visible jax devices
+    num_nodes: int = 1
+    memory_per_device: int = 16 * 1024**3  # HBM budget (reference fsize, MB→bytes)
+
+    # -- strategy search (reference: --budget/--alpha/--enable-*-parallel/
+    #    --only-data-parallel/--search-num-nodes/--substitution-json/--memory-search)
+    search_budget: int = 0
+    search_alpha: float = 0.05
+    only_data_parallel: bool = False
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_sample_parallel: bool = False
+    enable_inplace_optimizations: bool = True
+    search_overlap_backward_update: bool = False
+    substitution_json: Optional[str] = None
+    memory_search: bool = False
+    memory_lambda: float = 1.0
+    export_strategy_file: Optional[str] = None
+    import_strategy_file: Optional[str] = None
+
+    # -- simulator / machine model (reference: --machine-model-version/-file,
+    #    --simulator-segment-size)
+    machine_model_version: int = 0
+    machine_model_file: Optional[str] = None
+    simulator_segment_size: int = 16777216
+
+    # -- execution
+    perform_fusion: bool = False  # reference --fusion; XLA fuses anyway
+    profiling: bool = False
+    parameter_sync: ParameterSyncType = ParameterSyncType.ALL_REDUCE
+    compute_dtype: str = "float32"  # bf16 on TPU for perf runs
+
+    # -- exports (reference: --taskgraph/--compgraph/--include-costs-dot-graph)
+    export_taskgraph_file: Optional[str] = None
+    export_compgraph_file: Optional[str] = None
+    include_costs_dot_graph: bool = False
+
+    def resolve_num_devices(self) -> int:
+        if self.num_devices > 0:
+            return self.num_devices
+        import jax
+
+        return len(jax.devices())
+
+    @property
+    def workers_per_node(self) -> int:
+        return max(1, self.resolve_num_devices() // max(1, self.num_nodes))
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_args(cls, argv: Optional[List[str]] = None) -> "FFConfig":
+        """Parse the reference's CLI flag set (model.cc:3556-3720 names kept)."""
+        p = argparse.ArgumentParser(add_help=False)
+        p.add_argument("-e", "--epochs", type=int, default=1)
+        p.add_argument("-b", "--batch-size", type=int, default=64)
+        p.add_argument("--lr", "--learning-rate", dest="lr", type=float, default=0.01)
+        p.add_argument("--wd", "--weight-decay", dest="wd", type=float, default=1e-4)
+        p.add_argument("--seed", type=int, default=0)
+        p.add_argument("-ll:gpu", "--num-devices", dest="num_devices", type=int, default=-1)
+        p.add_argument("--nodes", type=int, default=1)
+        p.add_argument("-ll:fsize", dest="fsize_mb", type=int, default=16384)
+        p.add_argument("--budget", "--search-budget", dest="budget", type=int, default=0)
+        p.add_argument("--alpha", "--search-alpha", dest="alpha", type=float, default=0.05)
+        p.add_argument("--only-data-parallel", action="store_true")
+        p.add_argument("--enable-parameter-parallel", action="store_true")
+        p.add_argument("--enable-attribute-parallel", action="store_true")
+        p.add_argument("--enable-sample-parallel", action="store_true")
+        p.add_argument("--substitution-json", type=str, default=None)
+        p.add_argument("--memory-search", action="store_true")
+        p.add_argument("--machine-model-version", type=int, default=0)
+        p.add_argument("--machine-model-file", type=str, default=None)
+        p.add_argument("--simulator-segment-size", type=int, default=16777216)
+        p.add_argument("--fusion", action="store_true")
+        p.add_argument("--profiling", action="store_true")
+        p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
+        p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
+        p.add_argument("--taskgraph", type=str, default=None)
+        p.add_argument("--compgraph", type=str, default=None)
+        p.add_argument("--include-costs-dot-graph", action="store_true")
+        args, _ = p.parse_known_args(argv)
+        return cls(
+            epochs=args.epochs,
+            batch_size=args.batch_size,
+            learning_rate=args.lr,
+            weight_decay=args.wd,
+            seed=args.seed,
+            num_devices=args.num_devices,
+            num_nodes=args.nodes,
+            memory_per_device=args.fsize_mb * 1024**2,
+            search_budget=args.budget,
+            search_alpha=args.alpha,
+            only_data_parallel=args.only_data_parallel,
+            enable_parameter_parallel=args.enable_parameter_parallel,
+            enable_attribute_parallel=args.enable_attribute_parallel,
+            enable_sample_parallel=args.enable_sample_parallel,
+            substitution_json=args.substitution_json,
+            memory_search=args.memory_search,
+            machine_model_version=args.machine_model_version,
+            machine_model_file=args.machine_model_file,
+            simulator_segment_size=args.simulator_segment_size,
+            perform_fusion=args.fusion,
+            profiling=args.profiling,
+            export_strategy_file=args.export_strategy,
+            import_strategy_file=args.import_strategy,
+            export_taskgraph_file=args.taskgraph,
+            export_compgraph_file=args.compgraph,
+            include_costs_dot_graph=args.include_costs_dot_graph,
+        )
+
+
+@dataclasses.dataclass
+class FFIterationConfig:
+    """Per-iteration config threaded through forward/backward.
+
+    Reference: config.h:162-167 — carries seq_length for early truncation
+    (consumed by BatchMatmul/attention; model.cc:2415-2419).
+    """
+
+    seq_length: int = -1
+
+    def reset(self):
+        self.seq_length = -1

@@ -1,0 +1,82 @@
+"""Weight initializers.
+
+Reference: /root/reference/src/runtime/initializer.cc (349 LoC) +
+initializer_kernel.cu — Glorot/Zero/Constant/Uniform/Normal run as Legion
+index tasks over sharded weights with curand.  TPU-native: initializers
+are pure functions of a jax PRNG key; under SPMD each device materializes
+only its shard of the (already-sharded) weight via jit + out_shardings.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, key: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Array:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConstantInitializer(Initializer):
+    value: float = 0.0
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class UniformInitializer(Initializer):
+    minv: float = -0.05
+    maxv: float = 0.05
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, jnp.float32, self.minv, self.maxv).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class NormInitializer(Initializer):
+    mean: float = 0.0
+    stddev: float = 0.05
+
+    def __call__(self, key, shape, dtype):
+        return (self.mean + self.stddev * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class GlorotUniform(Initializer):
+    """Glorot/Xavier uniform.
+
+    fan_in/fan_out default to the reference's convention (initializer.cc):
+    for a rank-N weight, fan_out = dim 0, fan_in = product of the rest —
+    override via the explicit fields for conv filters.
+    """
+
+    fan_in: Optional[int] = None
+    fan_out: Optional[int] = None
+
+    def __call__(self, key, shape, dtype):
+        if self.fan_in is not None and self.fan_out is not None:
+            fan_in, fan_out = self.fan_in, self.fan_out
+        elif len(shape) >= 2:
+            receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+            fan_out = shape[0] * receptive
+            fan_in = shape[1] * receptive
+        else:
+            fan_in = fan_out = int(np.prod(shape)) if shape else 1
+        scale = float(np.sqrt(6.0 / max(1, fan_in + fan_out)))
+        return jax.random.uniform(key, shape, jnp.float32, -scale, scale).astype(dtype)
+
+
+DEFAULT_WEIGHT_INIT = GlorotUniform()
+DEFAULT_BIAS_INIT = ZeroInitializer()

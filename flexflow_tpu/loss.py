@@ -1,0 +1,57 @@
+"""Loss functions.
+
+Reference: src/runtime/loss_functions.cc (backward-only Legion task — the
+reference never materializes the scalar loss, it writes logit gradients
+directly, loss_functions.cc:41-150, with a per-replica scale factor).
+TPU-first: the loss IS a scalar jnp expression and `jax.grad` produces
+exactly those gradients; the replica scale factor falls out of the mean.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .fftype import LossType
+
+
+def compute_loss(
+    loss_type: LossType,
+    logits: jax.Array,
+    labels: jax.Array,
+    from_logits: bool = True,
+) -> jax.Array:
+    """from_logits=False matches the reference convention: the model ends
+    in a Softmax op and the loss consumes probabilities
+    (loss_functions.cu's grad = prob - onehot)."""
+    if loss_type == LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+        if from_logits:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
+        labels = labels.reshape(labels.shape[0], -1)[:, 0] if labels.ndim > 1 else labels
+        nll = -jnp.take_along_axis(logp, labels.astype(jnp.int32)[:, None], axis=-1)
+        return jnp.mean(nll)
+    if loss_type == LossType.CATEGORICAL_CROSSENTROPY:
+        if from_logits:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+    if loss_type == LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+        return jnp.mean(jnp.square(logits - labels))
+    if loss_type == LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:
+        return jnp.mean(jnp.sum(jnp.square(logits - labels), axis=tuple(range(1, logits.ndim))))
+    if loss_type == LossType.IDENTITY:
+        return jnp.mean(logits)
+    raise ValueError(loss_type)
+
+
+class Loss:
+    def __init__(self, loss_type, from_logits: bool = True):
+        if isinstance(loss_type, str):
+            loss_type = LossType(loss_type)
+        self.loss_type = loss_type
+        self.from_logits = from_logits
+
+    def __call__(self, logits, labels):
+        return compute_loss(self.loss_type, logits, labels, self.from_logits)

@@ -1,0 +1,83 @@
+"""Training metrics.
+
+Reference: src/runtime/metrics_functions.cc — per-shard METRICS_COMP
+task + future-chain UPDATE_METRICS fold (model.cc:3387-3400), with
+`PerfMetrics` (metrics_functions.h:27-42) accumulating counts.  TPU-first:
+metrics are computed inside the jitted step as global reductions (SPMD
+does the cross-shard sum — the future chain collapses into a psum) and
+accumulated on host in a PerfMetrics dataclass.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .fftype import MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, other: Dict[str, float]):
+        self.train_all += int(other.get("train_all", 0))
+        self.train_correct += int(other.get("train_correct", 0))
+        for f in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
+            setattr(self, f, getattr(self, f) + float(other.get(f, 0.0)))
+
+    @property
+    def accuracy(self) -> float:
+        return self.train_correct / max(1, self.train_all)
+
+    def summary(self) -> str:
+        parts = [f"accuracy={self.accuracy*100:.2f}% ({self.train_correct}/{self.train_all})"]
+        for f in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
+            v = getattr(self, f)
+            if v:
+                parts.append(f"{f}={v:.4f}")
+        return " ".join(parts)
+
+
+class Metrics:
+    def __init__(self, loss_type, metrics: Sequence):
+        self.metrics = [MetricsType(m) if isinstance(m, str) else m for m in metrics]
+        self.loss_type = loss_type
+
+    def compute(self, logits: jax.Array, labels: jax.Array) -> Dict[str, jax.Array]:
+        """Jit-side metric computation; returns scalar sums per metric."""
+        out: Dict[str, jax.Array] = {"train_all": jnp.array(logits.shape[0], jnp.int32)}
+        sparse = labels.ndim < logits.ndim or labels.shape[-1] == 1
+        if sparse:
+            lab = labels.reshape(labels.shape[0], -1)[:, 0] if labels.ndim > 1 else labels
+            lab = lab.astype(jnp.int32)
+        for m in self.metrics:
+            if m == MetricsType.ACCURACY:
+                pred = jnp.argmax(logits, axis=-1)
+                tgt = lab if sparse else jnp.argmax(labels, axis=-1)
+                out["train_correct"] = jnp.sum(pred == tgt).astype(jnp.int32)
+            elif m == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                out["sparse_cce_loss"] = -jnp.sum(
+                    jnp.take_along_axis(logp, lab[:, None], axis=-1)
+                )
+            elif m == MetricsType.CATEGORICAL_CROSSENTROPY:
+                logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
+                out["cce_loss"] = -jnp.sum(labels * logp)
+            elif m == MetricsType.MEAN_SQUARED_ERROR:
+                out["mse_loss"] = jnp.sum(jnp.mean(jnp.square(logits - labels), axis=-1))
+            elif m == MetricsType.ROOT_MEAN_SQUARED_ERROR:
+                out["rmse_loss"] = jnp.sum(
+                    jnp.sqrt(jnp.mean(jnp.square(logits - labels), axis=-1))
+                )
+            elif m == MetricsType.MEAN_ABSOLUTE_ERROR:
+                out["mae_loss"] = jnp.sum(jnp.mean(jnp.abs(logits - labels), axis=-1))
+        return out

@@ -1,0 +1,694 @@
+"""FFModel — the model-construction and training API.
+
+TPU-native re-design of the reference's FFModel
+(/root/reference/include/flexflow/model.h:326-956,
+src/runtime/model.cc): the same ~50 layer-construction methods
+(`dense`, `conv2d`, `multihead_attention`, `moe`, `embedding`, …),
+`compile` (which here runs the strategy search and builds the jitted
+SPMD step instead of launching GRAPH_OPTIMIZE on GPU0), and the
+`fit`/`forward`/`backward`/`update`/`zero_gradients` training surface.
+
+Execution differences from the reference, by design (SURVEY §7):
+  * compile produces ONE jitted train-step over a `jax.sharding.Mesh`
+    (Legion task graph + tracing + mapper + NCCL all collapse into it);
+  * backward is `jax.grad` (no per-op backward launches);
+  * `update` is a functional sharded optimizer step (gradient psum is
+    emitted by SPMD, replacing optimizer_kernel.cu's ncclAllReduce).
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import FFConfig, FFIterationConfig
+from .executor import GraphExecutor
+from .fftype import (
+    ActiMode,
+    AggrMode,
+    CompMode,
+    DataType,
+    LossType,
+    MetricsType,
+    OpBinary,
+    OperatorType,
+    OpUnary,
+)
+from .initializer import Initializer
+from .loss import Loss
+from .metrics import Metrics, PerfMetrics
+from .ops.attention import MultiHeadAttention, MultiHeadAttentionParams
+from .ops.dense import (
+    BatchMatmul,
+    BatchMatmulParams,
+    Conv2D,
+    Conv2DParams,
+    Embedding,
+    EmbeddingParams,
+    Linear,
+    LinearParams,
+    Pool2D,
+    Pool2DParams,
+)
+from .ops.element import (
+    Cast,
+    CastParams,
+    Dropout,
+    DropoutParams,
+    ElementBinary,
+    ElementBinaryParams,
+    ElementUnary,
+    ElementUnaryParams,
+)
+from .ops.moe import (
+    Aggregate,
+    AggregateParams,
+    AggregateSpec,
+    Cache,
+    CacheParams,
+    GroupBy,
+    GroupByParams,
+    TopK,
+    TopKParams,
+)
+from .ops.norm import (
+    BatchNorm,
+    BatchNormParams,
+    LayerNorm,
+    LayerNormParams,
+    Softmax,
+    SoftmaxParams,
+)
+from .ops.op import Op, ShapeError, ShardConfig
+from .ops.shape import (
+    Concat,
+    ConcatParams,
+    Flat,
+    Gather,
+    GatherParams,
+    Mean,
+    Reduce,
+    ReduceParams,
+    Reshape,
+    ReshapeParams,
+    Reverse,
+    ReverseParams,
+    Split,
+    SplitParams,
+    Transpose,
+    TransposeParams,
+)
+from .ops.sources import InputOp, SourceParams
+from .optimizer import AdamOptimizer, Optimizer, SGDOptimizer
+from .parallel.machine import make_mesh
+from .pcg.graph import Graph
+from .strategy import (
+    Strategy,
+    apply_strategy,
+    assign_views,
+    data_parallel_strategy,
+)
+from .tensor import ParallelTensor, ParallelTensorShape
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.layers = Graph()  # frontend (degree-1) graph
+        self.operators: Optional[Graph] = None  # compiled strategy graph
+        self.strategy: Optional[Strategy] = None
+        self.mesh = None
+        self.executor: Optional[GraphExecutor] = None
+        self.optimizer: Optional[Optimizer] = None
+        self.loss: Optional[Loss] = None
+        self.metrics: Optional[Metrics] = None
+        self.iter_config = FFIterationConfig()
+        self._weights = None
+        self._opt_state = None
+        self._state = None
+        self._step_fn = None
+        self._eval_fn = None
+        self._rng = None
+        self._label_replication = 1
+        self._name_counts: Dict[str, int] = {}
+        self._used_names: set = set()
+        self._fwd_fn = None
+
+    # ------------------------------------------------------------------
+    # tensor / naming helpers
+    # ------------------------------------------------------------------
+    def _name(self, base: str, name: Optional[str]) -> str:
+        if name:
+            if name in self._used_names:
+                raise ValueError(f"duplicate layer name: {name!r}")
+            self._used_names.add(name)
+            return name
+        while True:
+            n = self._name_counts.get(base, 0)
+            self._name_counts[base] = n + 1
+            candidate = f"{base}_{n}"
+            if candidate not in self._used_names:
+                self._used_names.add(candidate)
+                return candidate
+
+    def create_tensor(
+        self,
+        dims: Sequence[int],
+        dtype: Union[DataType, str] = DataType.FLOAT,
+        name: Optional[str] = None,
+        create_grad: bool = True,
+    ) -> ParallelTensor:
+        shape = ParallelTensorShape.make(dims, DataType.from_any(
+            dtype.value if isinstance(dtype, DataType) else dtype))
+        op = InputOp(SourceParams(shape), [], name=self._name("input", name))
+        self.layers.add_op(op)
+        op.outputs[0].create_gradients = create_grad
+        return op.outputs[0]
+
+    def _add(self, op: Op):
+        self.layers.add_op(op)
+        if len(op.outputs) == 1:
+            return op.outputs[0]
+        return tuple(op.outputs)
+
+    # ------------------------------------------------------------------
+    # layer API (reference model.h:326-712)
+    # ------------------------------------------------------------------
+    def dense(
+        self,
+        input: ParallelTensor,
+        out_dim: int,
+        activation: ActiMode = ActiMode.NONE,
+        use_bias: bool = True,
+        dtype: Union[DataType, str] = DataType.FLOAT,
+        kernel_initializer: Optional[Initializer] = None,
+        bias_initializer: Optional[Initializer] = None,
+        name: Optional[str] = None,
+    ) -> ParallelTensor:
+        p = LinearParams(out_dim, use_bias, activation, DataType.from_any(
+            dtype.value if isinstance(dtype, DataType) else dtype))
+        op = Linear(p, [input], name=self._name("dense", name))
+        if kernel_initializer is not None:
+            op.weight_specs[0] = op.weight_specs[0].__class__(
+                "kernel", op.weight_specs[0].shape, kernel_initializer
+            )
+        if use_bias and bias_initializer is not None:
+            op.weight_specs[1] = op.weight_specs[1].__class__(
+                "bias", op.weight_specs[1].shape, bias_initializer
+            )
+        return self._add(op)
+
+    def conv2d(
+        self,
+        input: ParallelTensor,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int = 1,
+        stride_w: int = 1,
+        padding_h: int = 0,
+        padding_w: int = 0,
+        activation: ActiMode = ActiMode.NONE,
+        groups: int = 1,
+        use_bias: bool = True,
+        name: Optional[str] = None,
+    ) -> ParallelTensor:
+        p = Conv2DParams(
+            out_channels,
+            (kernel_h, kernel_w),
+            (stride_h, stride_w),
+            (padding_h, padding_w),
+            groups,
+            use_bias,
+            activation,
+        )
+        return self._add(Conv2D(p, [input], name=self._name("conv2d", name)))
+
+    def pool2d(
+        self,
+        input: ParallelTensor,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int,
+        stride_w: int,
+        padding_h: int = 0,
+        padding_w: int = 0,
+        pool_type: str = "max",
+        activation: ActiMode = ActiMode.NONE,
+        name: Optional[str] = None,
+    ) -> ParallelTensor:
+        p = Pool2DParams(
+            (kernel_h, kernel_w),
+            (stride_h, stride_w),
+            (padding_h, padding_w),
+            pool_type,
+            activation,
+        )
+        return self._add(Pool2D(p, [input], name=self._name("pool2d", name)))
+
+    def embedding(
+        self,
+        input: ParallelTensor,
+        num_entries: int,
+        out_dim: int,
+        aggr: AggrMode = AggrMode.NONE,
+        dtype: Union[DataType, str] = DataType.FLOAT,
+        kernel_initializer: Optional[Initializer] = None,
+        name: Optional[str] = None,
+    ) -> ParallelTensor:
+        p = EmbeddingParams(num_entries, out_dim, aggr, DataType.from_any(
+            dtype.value if isinstance(dtype, DataType) else dtype))
+        op = Embedding(p, [input], name=self._name("embedding", name))
+        if kernel_initializer is not None:
+            op.weight_specs[0] = op.weight_specs[0].__class__(
+                "weight", op.weight_specs[0].shape, kernel_initializer
+            )
+        return self._add(op)
+
+    def multihead_attention(
+        self,
+        query: ParallelTensor,
+        key: ParallelTensor,
+        value: ParallelTensor,
+        embed_dim: int,
+        num_heads: int,
+        kdim: int = 0,
+        vdim: int = 0,
+        dropout: float = 0.0,
+        bias: bool = False,
+        add_bias_kv: bool = False,
+        add_zero_attn: bool = False,
+        causal: bool = False,
+        name: Optional[str] = None,
+    ) -> ParallelTensor:
+        p = MultiHeadAttentionParams(
+            embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
+            add_zero_attn, causal,
+        )
+        return self._add(
+            MultiHeadAttention(p, [query, key, value], name=self._name("attention", name))
+        )
+
+    def batch_matmul(
+        self,
+        a: ParallelTensor,
+        b: ParallelTensor,
+        a_seq_length_dim: int = -1,
+        b_seq_length_dim: int = -1,
+        name: Optional[str] = None,
+    ) -> ParallelTensor:
+        p = BatchMatmulParams(a_seq_length_dim, b_seq_length_dim)
+        return self._add(BatchMatmul(p, [a, b], name=self._name("batch_matmul", name)))
+
+    # -- elementwise binary ---------------------------------------------
+    def _binary(self, kind: OpBinary, x, y, inplace_a=False, name=None):
+        p = ElementBinaryParams(kind, inplace_a)
+        return self._add(
+            ElementBinary(p, [x, y], name=self._name(kind.value, name))
+        )
+
+    def add(self, x, y, inplace_a=False, name=None):
+        return self._binary(OpBinary.ADD, x, y, inplace_a, name)
+
+    def subtract(self, x, y, inplace_a=False, name=None):
+        return self._binary(OpBinary.SUB, x, y, inplace_a, name)
+
+    def multiply(self, x, y, inplace_a=False, name=None):
+        return self._binary(OpBinary.MUL, x, y, inplace_a, name)
+
+    def divide(self, x, y, inplace_a=False, name=None):
+        return self._binary(OpBinary.DIV, x, y, inplace_a, name)
+
+    def max(self, x, y, name=None):
+        return self._binary(OpBinary.MAX, x, y, False, name)
+
+    def min(self, x, y, name=None):
+        return self._binary(OpBinary.MIN, x, y, False, name)
+
+    # -- elementwise unary ----------------------------------------------
+    def _unary(self, kind: OpUnary, x, scalar=0.0, inplace=False, name=None):
+        p = ElementUnaryParams(kind, inplace, scalar)
+        return self._add(ElementUnary(p, [x], name=self._name(kind.value, name)))
+
+    def exp(self, x, name=None):
+        return self._unary(OpUnary.EXP, x, name=name)
+
+    def log(self, x, name=None):
+        return self._unary(OpUnary.LOG, x, name=name)
+
+    def sin(self, x, name=None):
+        return self._unary(OpUnary.SIN, x, name=name)
+
+    def cos(self, x, name=None):
+        return self._unary(OpUnary.COS, x, name=name)
+
+    def relu(self, x, inplace=True, name=None):
+        return self._unary(OpUnary.RELU, x, inplace=inplace, name=name)
+
+    def gelu(self, x, name=None):
+        return self._unary(OpUnary.GELU, x, name=name)
+
+    def sigmoid(self, x, name=None):
+        return self._unary(OpUnary.SIGMOID, x, name=name)
+
+    def tanh(self, x, name=None):
+        return self._unary(OpUnary.TANH, x, name=name)
+
+    def elu(self, x, inplace=True, name=None):
+        return self._unary(OpUnary.ELU, x, inplace=inplace, name=name)
+
+    def identity(self, x, name=None):
+        return self._unary(OpUnary.IDENTITY, x, name=name)
+
+    def rsqrt(self, x, name=None):
+        return self._unary(OpUnary.RSQRT, x, name=name)
+
+    def pow(self, x, exponent: float, name=None):
+        return self._unary(OpUnary.POW, x, scalar=exponent, name=name)
+
+    def scalar_multiply(self, x, scalar: float, inplace=True, name=None):
+        return self._unary(OpUnary.SCALAR_MULTIPLY, x, scalar=scalar, name=name)
+
+    def scalar_add(self, x, scalar: float, inplace=True, name=None):
+        return self._unary(OpUnary.SCALAR_ADD, x, scalar=scalar, name=name)
+
+    def scalar_sub(self, x, scalar: float, inplace=True, name=None):
+        return self._unary(OpUnary.SCALAR_SUB, x, scalar=scalar, name=name)
+
+    def scalar_true_divide(self, x, scalar: float, inplace=True, name=None):
+        return self._unary(OpUnary.SCALAR_TRUE_DIV, x, scalar=scalar, name=name)
+
+    # -- norm / softmax --------------------------------------------------
+    def softmax(self, input, axis: int = -1, name=None):
+        return self._add(
+            Softmax(SoftmaxParams(axis), [input], name=self._name("softmax", name))
+        )
+
+    def layer_norm(
+        self,
+        input,
+        axes: Sequence[int],
+        elementwise_affine: bool = True,
+        eps: float = 1e-5,
+        name=None,
+    ):
+        p = LayerNormParams(tuple(axes), elementwise_affine, eps)
+        return self._add(LayerNorm(p, [input], name=self._name("layer_norm", name)))
+
+    def batch_norm(self, input, relu: bool = True, name=None):
+        p = BatchNormParams(relu)
+        return self._add(BatchNorm(p, [input], name=self._name("batch_norm", name)))
+
+    # -- shape ops -------------------------------------------------------
+    def concat(self, tensors: Sequence[ParallelTensor], axis: int, name=None):
+        return self._add(
+            Concat(ConcatParams(axis), list(tensors), name=self._name("concat", name))
+        )
+
+    def split(self, input, sizes: Union[int, Sequence[int]], axis: int, name=None):
+        if isinstance(sizes, int):
+            dim_size = input.shape.logical_shape[axis]
+            sizes = [dim_size // sizes] * sizes
+        p = SplitParams(tuple(sizes), axis)
+        return self._add(Split(p, [input], name=self._name("split", name)))
+
+    def flat(self, input, name=None):
+        return self._add(Flat(None, [input], name=self._name("flat", name)))
+
+    def reshape(self, input, shape: Sequence[int], name=None):
+        p = ReshapeParams(tuple(shape))
+        return self._add(Reshape(p, [input], name=self._name("reshape", name)))
+
+    def transpose(self, input, perm: Sequence[int], name=None):
+        p = TransposeParams(tuple(perm))
+        return self._add(Transpose(p, [input], name=self._name("transpose", name)))
+
+    def reverse(self, input, axis: int, name=None):
+        return self._add(
+            Reverse(ReverseParams(axis), [input], name=self._name("reverse", name))
+        )
+
+    def reduce_sum(self, input, axes: Sequence[int], keepdims: bool = False, name=None):
+        p = ReduceParams(tuple(axes), keepdims, "sum")
+        return self._add(Reduce(p, [input], name=self._name("reduce_sum", name)))
+
+    def mean(self, input, axes: Sequence[int], keepdims: bool = False, name=None):
+        p = ReduceParams(tuple(axes), keepdims, "mean")
+        return self._add(Mean(p, [input], name=self._name("mean", name)))
+
+    def cast(self, input, dtype: Union[DataType, str], name=None):
+        p = CastParams(DataType.from_any(
+            dtype.value if isinstance(dtype, DataType) else dtype))
+        return self._add(Cast(p, [input], name=self._name("cast", name)))
+
+    def dropout(self, input, rate: float, seed: int = 0, name=None):
+        p = DropoutParams(rate, seed)
+        return self._add(Dropout(p, [input], name=self._name("dropout", name)))
+
+    def gather(self, input, index, axis: int = 0, name=None):
+        p = GatherParams(axis)
+        return self._add(Gather(p, [input, index], name=self._name("gather", name)))
+
+    # -- MoE -------------------------------------------------------------
+    def top_k(self, input, k: int, sorted: bool = False, name=None):
+        return self._add(TopK(TopKParams(k, sorted), [input], name=self._name("topk", name)))
+
+    def group_by(self, data, assign, n: int, alpha: float, name=None):
+        return self._add(
+            GroupBy(GroupByParams(n, alpha), [data, assign], name=self._name("group_by", name))
+        )
+
+    def aggregate(self, gate_scores, assign, gate_full, expert_out, n: int,
+                  lambda_bal: float = 0.0, name=None):
+        p = AggregateParams(n, lambda_bal)
+        return self._add(
+            Aggregate(p, [gate_scores, assign, gate_full, expert_out],
+                      name=self._name("aggregate", name))
+        )
+
+    def aggregate_spec(self, gate_scores, assign, gate_full, expert_out, n: int,
+                       lambda_bal: float = 0.0, name=None):
+        p = AggregateParams(n, lambda_bal)
+        op = AggregateSpec(p, [gate_scores, assign, gate_full, expert_out],
+                           name=self._name("aggregate_spec", name))
+        out = self._add(op)
+        self._label_replication = op.inputs[1].shape.logical_shape[-1]
+        return out
+
+    def cache(self, input, num_batches: int, name=None):
+        return self._add(
+            Cache(CacheParams(num_batches), [input], name=self._name("cache", name))
+        )
+
+    def moe(
+        self,
+        input: ParallelTensor,
+        num_exp: int,
+        num_select: int,
+        expert_hidden_size: int,
+        alpha: float = 2.0,
+        lambda_bal: float = 0.0,
+        name=None,
+    ) -> ParallelTensor:
+        """MoE composite (reference src/ops/moe.cc:20-44): gate -> topk ->
+        group_by -> per-expert FFN -> aggregate.  The expert FFN here is a
+        batched dense over the stacked expert dim, so expert parallelism
+        is sharding that dim (ShardConfig.expert)."""
+        gate = self.dense(input, num_exp, ActiMode.NONE, name=self._name("moe_gate", None))
+        gate_sm = self.softmax(gate)
+        topk_out = self.top_k(gate_sm, num_select)
+        values, assign = topk_out
+        grouped = self.group_by(input, assign, num_exp, alpha)
+        # per-expert FFN: [n, cap, d] -> [n, cap, hidden]
+        hidden = self.experts_dense(grouped, expert_hidden_size, activation=ActiMode.RELU)
+        return self.aggregate(values, assign, gate_sm, hidden, num_exp, lambda_bal,
+                              name=name)
+
+    def experts_dense(self, grouped, out_dim: int, activation=ActiMode.NONE,
+                      use_bias: bool = True, name=None):
+        """Batched per-expert dense over stacked [n, cap, d] expert inputs."""
+        from .ops.experts import ExpertsDense, ExpertsDenseParams
+
+        p = ExpertsDenseParams(out_dim, use_bias, activation)
+        return self._add(
+            ExpertsDense(p, [grouped], name=self._name("experts_dense", name))
+        )
+
+    # ------------------------------------------------------------------
+    # compile (reference FFModel::compile model.cc:2487-3167)
+    # ------------------------------------------------------------------
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type: Union[LossType, str] = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics: Sequence[Union[MetricsType, str]] = (MetricsType.ACCURACY,),
+        comp_mode: CompMode = CompMode.TRAINING,
+        strategy: Optional[Strategy] = None,
+        devices: Optional[Sequence] = None,
+        seed: Optional[int] = None,
+    ):
+        cfg = self.config
+        self.optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
+        # Reference convention (loss_functions.cu): a model ending in
+        # Softmax feeds probabilities to the loss, not logits.
+        sink_is_softmax = self.layers.sink_op().op_type == OperatorType.SOFTMAX
+        self.loss = Loss(loss_type, from_logits=not sink_is_softmax)
+        self.metrics = Metrics(self.loss.loss_type, metrics)
+        self._fwd_fn = None
+
+        num_devices = len(devices) if devices is not None else cfg.resolve_num_devices()
+
+        if strategy is None and cfg.import_strategy_file:
+            strategy = Strategy.load(cfg.import_strategy_file)
+        if strategy is None:
+            if cfg.search_budget > 0 and not cfg.only_data_parallel:
+                from .pcg.search import mcmc_search
+
+                strategy = mcmc_search(self, num_devices)
+            else:
+                strategy = data_parallel_strategy(num_devices)
+        self.strategy = strategy
+        if cfg.export_strategy_file:
+            strategy.save(cfg.export_strategy_file)
+
+        self.operators = apply_strategy(self.layers, strategy)
+        assign_views(self.operators, strategy.mesh_axes)
+        self.mesh = make_mesh(strategy.mesh_axes, devices)
+
+        self.executor = GraphExecutor(
+            self.operators,
+            self.mesh,
+            self.loss,
+            self.metrics,
+            self.optimizer,
+            comp_mode,
+            label_replication=self._label_replication,
+        )
+        self._weights, self._state = self.executor.init_weights(
+            seed if seed is not None else cfg.seed
+        )
+        self._opt_state = self.optimizer.init_state(self._weights)
+        self._step_fn = self.executor.build_step()
+        self._eval_fn = self.executor.build_eval_step()
+        self._rng = jax.random.key(cfg.seed)
+        if cfg.export_compgraph_file:
+            self.layers.export_dot(cfg.export_compgraph_file)
+        if cfg.export_taskgraph_file:
+            self.operators.export_dot(cfg.export_taskgraph_file)
+        return self
+
+    # ------------------------------------------------------------------
+    # training surface
+    # ------------------------------------------------------------------
+    def _device_put_batch(self, inputs: Dict[str, np.ndarray], labels: np.ndarray):
+        in_sh = self.executor.input_shardings()
+        put_inputs = {
+            k: jax.device_put(v, in_sh[k]) for k, v in inputs.items()
+        }
+        put_labels = jax.device_put(labels, self.executor.label_sharding())
+        return put_inputs, put_labels
+
+    def train_step(self, inputs: Dict[str, np.ndarray], labels: np.ndarray):
+        """One jitted iteration: forward + loss + backward + metrics + update."""
+        put_inputs, put_labels = self._device_put_batch(inputs, labels)
+        self._rng, step_rng = jax.random.split(self._rng)
+        self._weights, self._opt_state, self._state, m = self._step_fn(
+            self._weights, self._opt_state, self._state, put_inputs, put_labels,
+            step_rng,
+        )
+        return m
+
+    def eval_step(self, inputs: Dict[str, np.ndarray], labels: np.ndarray):
+        put_inputs, put_labels = self._device_put_batch(inputs, labels)
+        return self._eval_fn(self._weights, self._state, put_inputs, put_labels)
+
+    def fit(
+        self,
+        x: Union[np.ndarray, Sequence[np.ndarray], Dict[str, np.ndarray]],
+        y: np.ndarray,
+        batch_size: Optional[int] = None,
+        epochs: Optional[int] = None,
+        callbacks: Sequence = (),
+        verbose: bool = True,
+    ) -> List[PerfMetrics]:
+        """Train over numpy data (reference fit loop flexflow_cffi.py:2044-2087)."""
+        assert self._step_fn is not None, "call compile() first"
+        batch_size = batch_size or self.config.batch_size
+        epochs = epochs or self.config.epochs
+        input_ops = self.layers.source_ops()
+        if isinstance(x, dict):
+            x_map = x
+        elif isinstance(x, (list, tuple)):
+            x_map = {op.name: arr for op, arr in zip(input_ops, x)}
+        else:
+            x_map = {input_ops[0].name: x}
+        n = len(y)
+        num_batches = n // batch_size
+        history: List[PerfMetrics] = []
+        for cb in callbacks:
+            cb.on_train_begin(self)
+        for epoch in range(epochs):
+            pm = PerfMetrics()
+            t0 = time.perf_counter()
+            for b in range(num_batches):
+                sl = slice(b * batch_size, (b + 1) * batch_size)
+                batch = {k: v[sl] for k, v in x_map.items()}
+                m = self.train_step(batch, y[sl])
+                pm.update({k: float(v) for k, v in m.items() if k != "loss"})
+            jax.block_until_ready(jax.tree.leaves(self._weights)[0])
+            dt = time.perf_counter() - t0
+            throughput = num_batches * batch_size / dt
+            if verbose:
+                print(
+                    f"epoch {epoch}: {pm.summary()} "
+                    f"ELAPSED TIME = {dt:.4f}s, THROUGHPUT = {throughput:.2f} samples/s"
+                )
+            history.append(pm)
+            for cb in callbacks:
+                cb.on_epoch_end(self, epoch, pm)
+        for cb in callbacks:
+            cb.on_train_end(self)
+        return history
+
+    # reference-parity step pieces (model.h:767-811) — all folded into the
+    # single jitted step; kept as explicit methods for API compatibility.
+    def init_operators(self):
+        return None
+
+    def forward(self, inputs: Dict[str, np.ndarray]):
+        if self._fwd_fn is None:
+            self._fwd_fn = self.executor.build_forward()
+        put = {
+            k: jax.device_put(v, self.executor.input_shardings()[k])
+            for k, v in inputs.items()
+        }
+        return self._fwd_fn(self._weights, self._state, put)
+
+    def zero_gradients(self):
+        return None  # gradients are functional; nothing to zero
+
+    def backward(self):
+        raise RuntimeError(
+            "backward is fused into train_step under jax.grad; call train_step"
+        )
+
+    def update(self):
+        return None
+
+    # -- weight access (reference get_tensor/set_tensor,
+    #    parallel_tensor.cc:650-750) -------------------------------------
+    def get_weights(self) -> Dict[str, Dict[str, np.ndarray]]:
+        return jax.tree.map(np.asarray, self._weights)
+
+    def set_weights(self, weights: Dict[str, Dict[str, np.ndarray]]):
+        shardings = self.executor.weight_shardings()
+        self._weights = jax.tree.map(
+            lambda v, s: jax.device_put(jnp.asarray(v), s), weights, shardings
+        )
+
+    def get_parameter(self, op_name: str, weight_name: str) -> np.ndarray:
+        return np.asarray(self._weights[op_name][weight_name])

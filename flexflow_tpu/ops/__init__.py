@@ -1,0 +1,1 @@
+from .op import Op, ShapeError, ShardConfig, WeightSpec

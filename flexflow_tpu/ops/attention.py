@@ -1,0 +1,177 @@
+"""Multi-head attention.
+
+Reference: src/ops/attention.cc (926 LoC) + attention.cu — one monolithic
+cudnnMultiHeadAttnForward call (attention.cu:35) with packed qkv/out
+weights; head-partition parallelism comes from the
+create_partition_attention_combine / create_replicate_attention_reduce
+substitutions (substitution.cc:1762-1770).
+
+TPU-first re-design: explicit per-projection weights shaped
+[embed, heads, head_dim] so the **heads dim is a first-class shardable
+dim** (ShardConfig.channel = head degree, the TP axis); the score/value
+matmuls are dot_generals on the MXU in bf16; output-projection
+contraction over heads yields a partial-sum output (replica degree =
+head degree) exactly like the reference's Reduction-consumed attention
+output.  Sequence parallelism for long context is handled by ring
+attention over the mesh's "seq" axis (flexflow_tpu/parallel/
+ring_attention.py) — a capability the reference lacks (SURVEY §5).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fftype import DataType, OperatorType
+from ..initializer import DEFAULT_WEIGHT_INIT, GlorotUniform
+from ..tensor import ParallelDim, ParallelTensorShape
+from .op import Op, ShapeError, WeightSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiHeadAttentionParams:
+    embed_dim: int
+    num_heads: int
+    kdim: int = 0  # 0 -> embed_dim
+    vdim: int = 0
+    dropout: float = 0.0
+    use_bias: bool = False
+    add_bias_kv: bool = False
+    add_zero_attn: bool = False
+    causal: bool = False
+
+    @property
+    def k_channels(self) -> int:
+        return (self.kdim or self.embed_dim) // self.num_heads
+
+    @property
+    def v_channels(self) -> int:
+        return (self.vdim or self.embed_dim) // self.num_heads
+
+
+class MultiHeadAttention(Op):
+    op_type = OperatorType.MULTIHEAD_ATTENTION
+
+    def infer_output_shapes(self, input_shapes):
+        q, k, v = input_shapes
+        p: MultiHeadAttentionParams = self.params
+        qd = [d for d in q.dims if not d.is_replica_dim]
+        kd = [d for d in k.dims if not d.is_replica_dim]
+        vd = [d for d in v.dims if not d.is_replica_dim]
+        if len(qd) != 3:
+            raise ShapeError(f"{self.name}: expect [batch, seq, embed] inputs")
+        if p.num_heads % self.shard.channel != 0:
+            raise ShapeError(f"{self.name}: heads {p.num_heads} not divisible by "
+                             f"degree {self.shard.channel}")
+        if kd[1].degree != 1 or vd[1].degree != 1:
+            # K/V seq partitioning requires ring attention — a dedicated
+            # lowering path, not plain SPMD propagation.
+            raise ShapeError(f"{self.name}: use ring attention for k/v seq sharding")
+        ri = q.replica_degree
+        c = self.shard.channel
+        if c > 1 and ri % c == 0:
+            ri //= c
+        dims = (
+            ParallelDim(qd[0].size, qd[0].degree),
+            ParallelDim(qd[1].size, qd[1].degree),
+            ParallelDim(p.embed_dim, 1),
+            ParallelDim(1, ri * c, is_replica_dim=True),  # head-contraction partials
+        )
+        return [ParallelTensorShape(dims, q.dtype)]
+
+    def make_weight_specs(self, input_shapes):
+        q, k, v = input_shapes
+        p: MultiHeadAttentionParams = self.params
+        qd = [d for d in q.dims if not d.is_replica_dim]
+        batch_degree = qd[0].degree * qd[1].degree
+        c = self.shard.channel
+        dt = q.dtype
+
+        def w(shape_sizes, head_axis):
+            dims = []
+            for i, s in enumerate(shape_sizes):
+                dims.append(ParallelDim(s, c if i == head_axis else 1))
+            extra = batch_degree if head_axis is not None else batch_degree * c
+            dims.append(ParallelDim(1, extra, is_replica_dim=True))
+            return ParallelTensorShape(tuple(dims), dt)
+
+        embed = p.embed_dim
+        init = GlorotUniform(fan_in=embed, fan_out=embed)
+        specs = [
+            WeightSpec("wq", w((embed, p.num_heads, p.k_channels), 1), init),
+            WeightSpec("wk", w((k.logical_shape[-1], p.num_heads, p.k_channels), 1), init),
+            WeightSpec("wv", w((v.logical_shape[-1], p.num_heads, p.v_channels), 1), init),
+            WeightSpec("wo", w((p.num_heads, p.v_channels, embed), 0), init),
+        ]
+        from ..initializer import ZeroInitializer
+
+        zero = ZeroInitializer()
+        if p.use_bias:
+            specs += [
+                WeightSpec("bq", w((p.num_heads, p.k_channels), 0), zero),
+                WeightSpec("bk", w((p.num_heads, p.k_channels), 0), zero),
+                WeightSpec("bv", w((p.num_heads, p.v_channels), 0), zero),
+                WeightSpec("bo", w((embed,), None), zero),
+            ]
+        if p.add_bias_kv:
+            # one learnable bias token appended to the k/v sequences
+            specs += [
+                WeightSpec("bias_k", w((1, p.num_heads, p.k_channels), 1), init),
+                WeightSpec("bias_v", w((1, p.num_heads, p.v_channels), 1), init),
+            ]
+        return specs
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        q, k, v = inputs
+        p: MultiHeadAttentionParams = self.params
+        wq, wk, wv, wo = weights[:4]
+        wi = 4
+        # [b, s, e] x [e, h, d] -> [b, s, h, d]
+        qh = jnp.einsum("bse,ehd->bshd", q, wq)
+        kh = jnp.einsum("bse,ehd->bshd", k, wk)
+        vh = jnp.einsum("bse,ehd->bshd", v, wv)
+        bo = None
+        if p.use_bias:
+            bq, bk, bv, bo = weights[wi : wi + 4]
+            wi += 4
+            qh = qh + bq[None, None]
+            kh = kh + bk[None, None]
+            vh = vh + bv[None, None]
+        if p.add_bias_kv:
+            bias_k, bias_v = weights[wi : wi + 2]
+            wi += 2
+            bsz = kh.shape[0]
+            kh = jnp.concatenate([kh, jnp.broadcast_to(bias_k[None], (bsz,) + bias_k.shape)], axis=1)
+            vh = jnp.concatenate([vh, jnp.broadcast_to(bias_v[None], (bsz,) + bias_v.shape)], axis=1)
+        if p.add_zero_attn:
+            bsz, _, h, dk = kh.shape
+            dv = vh.shape[-1]
+            kh = jnp.concatenate([kh, jnp.zeros((bsz, 1, h, dk), kh.dtype)], axis=1)
+            vh = jnp.concatenate([vh, jnp.zeros((bsz, 1, h, dv), vh.dtype)], axis=1)
+        scale = 1.0 / np.sqrt(p.k_channels)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+        if p.causal:
+            qlen, klen = scores.shape[-2], scores.shape[-1]
+            mask = jnp.tril(jnp.ones((qlen, klen), bool))
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if training and p.dropout > 0.0 and rng is not None:
+            keep = 1.0 - p.dropout
+            probs = probs * jax.random.bernoulli(rng, keep, probs.shape) / keep
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+        out = jnp.einsum("bqhd,hde->bqe", ctx, wo)
+        if bo is not None:
+            out = out + bo[None, None]
+        return [out.astype(q.dtype)]
+
+    def flops(self):
+        p: MultiHeadAttentionParams = self.params
+        b, s, e = self.inputs[0].shape.logical_shape
+        ks = self.inputs[1].shape.logical_shape[1]
+        proj = 2.0 * b * s * e * p.num_heads * p.k_channels * 3
+        proj += 2.0 * b * s * e * p.num_heads * p.v_channels
+        attn = 2.0 * b * p.num_heads * s * ks * (p.k_channels + p.v_channels)
+        return proj + attn

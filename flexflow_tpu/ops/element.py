@@ -1,0 +1,164 @@
+"""Elementwise ops: ElementUnary, ElementBinary, Cast, Dropout.
+
+Reference: src/ops/element_unary.cc (cuDNN activation + custom kernels,
+inplace-capable), src/ops/element_binary.cc (cuDNN OpTensor + custom
+broadcast), src/ops/cast.cc, src/ops/dropout.cc (cuDNN dropout, seeded).
+TPU-first: plain jnp ops — XLA fuses them into neighbouring matmuls so
+they are HBM-bandwidth-free in practice; dropout uses the functional
+jax PRNG (`threefry`) instead of cuDNN dropout state.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import DataType, OpBinary, OperatorType, OpUnary
+from ..tensor import ParallelDim, ParallelTensorShape
+from .op import Op, ShapeError
+
+
+@dataclasses.dataclass(frozen=True)
+class ElementUnaryParams:
+    op: OpUnary
+    inplace: bool = False
+    scalar: float = 0.0
+
+
+_UNARY_FNS = {
+    OpUnary.EXP: jnp.exp,
+    OpUnary.LOG: jnp.log,
+    OpUnary.SIN: jnp.sin,
+    OpUnary.COS: jnp.cos,
+    OpUnary.RELU: jax.nn.relu,
+    OpUnary.GELU: jax.nn.gelu,
+    OpUnary.SIGMOID: jax.nn.sigmoid,
+    OpUnary.TANH: jnp.tanh,
+    OpUnary.ELU: jax.nn.elu,
+    OpUnary.IDENTITY: lambda x: x,
+    OpUnary.RSQRT: jax.lax.rsqrt,
+    OpUnary.NEGATIVE: jnp.negative,
+}
+
+
+class ElementUnary(Op):
+    op_type = OperatorType.ELEMENT_UNARY
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        return [ishape]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        (x,) = inputs
+        p: ElementUnaryParams = self.params
+        if p.op in _UNARY_FNS:
+            return [_UNARY_FNS[p.op](x)]
+        if p.op == OpUnary.POW:
+            return [jnp.power(x, p.scalar)]
+        if p.op == OpUnary.SCALAR_MULTIPLY:
+            return [x * p.scalar]
+        if p.op == OpUnary.SCALAR_ADD:
+            return [x + p.scalar]
+        if p.op == OpUnary.SCALAR_SUB:
+            return [x - p.scalar]
+        if p.op == OpUnary.SCALAR_TRUE_DIV:
+            return [x / p.scalar]
+        raise ValueError(p.op)
+
+
+@dataclasses.dataclass(frozen=True)
+class ElementBinaryParams:
+    op: OpBinary
+    inplace_a: bool = False
+
+
+_BINARY_FNS = {
+    OpBinary.ADD: jnp.add,
+    OpBinary.SUB: jnp.subtract,
+    OpBinary.MUL: jnp.multiply,
+    OpBinary.DIV: jnp.divide,
+    OpBinary.MAX: jnp.maximum,
+    OpBinary.MIN: jnp.minimum,
+    OpBinary.POW: jnp.power,
+}
+
+
+class ElementBinary(Op):
+    """Numpy-broadcasting binary op (reference supports limited bcast;
+    we support full numpy rules — degrees must agree on matching dims)."""
+
+    op_type = OperatorType.ELEMENT_BINARY
+
+    def infer_output_shapes(self, input_shapes):
+        a, b = input_shapes
+        ad = [d for d in a.dims if not d.is_replica_dim]
+        bd = [d for d in b.dims if not d.is_replica_dim]
+        # align trailing dims
+        rank = max(len(ad), len(bd))
+        out = []
+        for i in range(1, rank + 1):
+            da = ad[-i] if i <= len(ad) else None
+            db = bd[-i] if i <= len(bd) else None
+            if da is None:
+                out.append(ParallelDim(db.size, db.degree))
+            elif db is None:
+                out.append(ParallelDim(da.size, da.degree))
+            else:
+                if da.size != db.size and 1 not in (da.size, db.size):
+                    raise ShapeError(f"{self.name}: cannot broadcast {da.size} vs {db.size}")
+                size = max(da.size, db.size)
+                deg = da.degree if da.size >= db.size else db.degree
+                other = db if da.size >= db.size else da
+                if other.size == size and other.degree != deg:
+                    raise ShapeError(f"{self.name}: degree mismatch on dim size {size}")
+                out.append(ParallelDim(size, deg))
+        out.reverse()
+        replica = max(a.replica_degree, b.replica_degree)
+        dims = tuple(out) + (ParallelDim(1, replica, is_replica_dim=True),)
+        return [ParallelTensorShape(dims, a.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        a, b = inputs
+        return [_BINARY_FNS[self.params.op](a, b)]
+
+
+@dataclasses.dataclass(frozen=True)
+class CastParams:
+    dtype: DataType
+
+
+class Cast(Op):
+    op_type = OperatorType.CAST
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        return [ParallelTensorShape(ishape.dims, self.params.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [inputs[0].astype(self.params.dtype.np_dtype)]
+
+
+@dataclasses.dataclass(frozen=True)
+class DropoutParams:
+    rate: float
+    seed: int = 0
+
+
+class Dropout(Op):
+    op_type = OperatorType.DROPOUT
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        (x,) = inputs
+        p: DropoutParams = self.params
+        if not training or p.rate <= 0.0:
+            return [x]
+        if rng is None:
+            rng = jax.random.key(p.seed)
+        keep = 1.0 - p.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]

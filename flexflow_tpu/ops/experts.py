@@ -1,0 +1,88 @@
+"""Batched per-expert dense layer.
+
+The reference builds one `dense` op per expert inside its MoE composite
+(src/ops/moe.cc:20-44) and relies on per-expert MachineViews for expert
+parallelism.  On TPU that shape (n small matmuls) wastes the MXU; the
+idiomatic form is ONE batched einsum over the stacked expert dim
+[n, cap, d] with weights [n, d, out], where sharding the expert dim over
+the "expert" mesh axis IS expert parallelism and XLA emits the all-to-all.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..fftype import ActiMode, OperatorType
+from ..initializer import DEFAULT_BIAS_INIT, DEFAULT_WEIGHT_INIT
+from ..tensor import ParallelDim, ParallelTensorShape
+from .dense import apply_activation
+from .op import Op, ShapeError, WeightSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpertsDenseParams:
+    out_dim: int
+    use_bias: bool = True
+    activation: ActiMode = ActiMode.NONE
+
+
+class ExpertsDense(Op):
+    op_type = OperatorType.LINEAR  # participates in search as a linear
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        dd = [d for d in ishape.dims if not d.is_replica_dim]
+        if len(dd) != 3:
+            raise ShapeError(f"{self.name}: expect [experts, cap, dim]")
+        n, cap, din = dd
+        expert_degree = max(n.degree, self.shard.expert)
+        if n.size % expert_degree != 0:
+            raise ShapeError(f"{self.name}: experts {n.size} not divisible")
+        dims = (
+            ParallelDim(n.size, expert_degree),
+            ParallelDim(cap.size, cap.degree),
+            ParallelDim(self.params.out_dim, self.shard.channel),
+            ParallelDim(1, ishape.replica_degree * din.degree, is_replica_dim=True),
+        )
+        return [ParallelTensorShape(dims, ishape.dtype)]
+
+    def make_weight_specs(self, input_shapes):
+        (ishape,) = input_shapes
+        dd = [d for d in ishape.dims if not d.is_replica_dim]
+        n, cap, din = dd
+        expert_degree = max(n.degree, self.shard.expert)
+        p: ExpertsDenseParams = self.params
+        kernel = ParallelTensorShape(
+            (
+                ParallelDim(n.size, expert_degree),
+                ParallelDim(din.size, din.degree),
+                ParallelDim(p.out_dim, self.shard.channel),
+                ParallelDim(1, cap.degree, is_replica_dim=True),
+            ),
+            ishape.dtype,
+        )
+        specs = [WeightSpec("kernel", kernel, DEFAULT_WEIGHT_INIT)]
+        if p.use_bias:
+            bias = ParallelTensorShape(
+                (
+                    ParallelDim(n.size, expert_degree),
+                    ParallelDim(p.out_dim, self.shard.channel),
+                    ParallelDim(1, cap.degree * din.degree, is_replica_dim=True),
+                ),
+                ishape.dtype,
+            )
+            specs.append(WeightSpec("bias", bias, DEFAULT_BIAS_INIT))
+        return specs
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        (x,) = inputs
+        p: ExpertsDenseParams = self.params
+        y = jnp.einsum("ncd,ndo->nco", x, weights[0])
+        if p.use_bias:
+            y = y + weights[1][:, None, :]
+        return [apply_activation(y, p.activation)]
+
+    def flops(self):
+        ishape = self.inputs[0].shape
+        return 2.0 * ishape.num_elements() * self.params.out_dim

@@ -1,0 +1,249 @@
+"""Mixture-of-Experts ops: TopK, GroupBy, Aggregate, AggregateSpec, Cache.
+
+Reference: src/ops/topk.cu (custom heap kernel), src/ops/group_by.cu
+(data-dependent scatter with capacity factor, group_by.cu:1-206),
+src/ops/aggregate.cu (combine with load-balance loss, lambda_bal),
+src/ops/aggregate_spec.cu (speculative variant — replicated labels,
+model.cc:2875), src/ops/cache.cc (expert-activation cache with score_f
+trigger driving recompilation).
+
+TPU-first re-design: the reference's scatter/gather dispatch is replaced
+by the standard TPU dense formulation — capacity-bounded **one-hot
+dispatch/combine einsums** (GShard/Switch style) that XLA maps onto the
+MXU with static shapes (no data-dependent control flow).  GroupBy emits
+a single stacked [experts, capacity, dim] tensor whose expert dim is the
+expert-parallel shardable dim (ShardConfig.expert); per-expert FFNs run
+as batched einsums over that dim, so expert parallelism = sharding dim 0
+over the "expert" mesh axis and the dispatch einsum lowers to an
+all-to-all over ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import DataType, OperatorType
+from ..tensor import ParallelDim, ParallelTensorShape
+from .op import Op, ShapeError
+
+
+def _data_dims(shape):
+    return [d for d in shape.dims if not d.is_replica_dim]
+
+
+@dataclasses.dataclass(frozen=True)
+class TopKParams:
+    k: int
+    sorted: bool = False
+
+
+class TopK(Op):
+    """values, indices = topk(x, k) along the last dim."""
+
+    op_type = OperatorType.TOPK
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        dd = _data_dims(ishape)
+        if dd[-1].degree != 1:
+            raise ShapeError(f"{self.name}: topk axis is partitioned")
+        if self.params.k > dd[-1].size:
+            raise ShapeError(f"{self.name}: k > dim size")
+        dims = tuple(ParallelDim(d.size, d.degree) for d in dd[:-1]) + (
+            ParallelDim(self.params.k, 1),
+            ParallelDim(1, ishape.replica_degree, is_replica_dim=True),
+        )
+        return [
+            ParallelTensorShape(dims, ishape.dtype),
+            ParallelTensorShape(dims, DataType.INT32),
+        ]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        values, indices = jax.lax.top_k(inputs[0], self.params.k)
+        return [values, indices.astype(jnp.int32)]
+
+
+def _capacity(batch: int, k: int, n: int, alpha: float) -> int:
+    return max(1, int(math.ceil(alpha * k * batch / n)))
+
+
+def _dispatch_mask(assign: jax.Array, n: int, capacity: int) -> jax.Array:
+    """[b, k] expert ids -> bool dispatch mask [b, n, capacity].
+
+    Flattens (b, k) in priority order, computes each token's position in
+    its expert's queue by cumsum, and drops tokens beyond capacity —
+    mirroring the reference's capacity-bounded scatter (group_by.cu) with
+    static shapes.
+    """
+    b, k = assign.shape
+    flat = assign.reshape(-1)  # [b*k], row-major: sample-major priority
+    onehot = jax.nn.one_hot(flat, n, dtype=jnp.int32)  # [bk, n]
+    pos = jnp.cumsum(onehot, axis=0) - 1  # position within expert queue
+    pos = jnp.sum(pos * onehot, axis=-1)  # [bk]
+    keep = pos < capacity
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [bk, cap]
+    disp = (
+        onehot.astype(jnp.float32)[:, :, None]
+        * pos_oh[:, None, :]
+        * keep.astype(jnp.float32)[:, None, None]
+    )  # [bk, n, cap]
+    return disp.reshape(b, k, n, capacity)
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupByParams:
+    n: int  # number of experts
+    alpha: float  # capacity factor
+
+
+class GroupBy(Op):
+    op_type = OperatorType.GROUP_BY
+
+    def infer_output_shapes(self, input_shapes):
+        data, assign = input_shapes
+        dd = _data_dims(data)
+        ad = _data_dims(assign)
+        if len(dd) != 2 or len(ad) != 2:
+            raise ShapeError(f"{self.name}: expect data [b,d], assign [b,k]")
+        if dd[0].size != ad[0].size:
+            raise ShapeError(f"{self.name}: batch mismatch")
+        cap = _capacity(dd[0].size, ad[1].size, self.params.n, self.params.alpha)
+        dims = (
+            ParallelDim(self.params.n, self.shard.expert),
+            ParallelDim(cap, 1),
+            ParallelDim(dd[1].size, dd[1].degree),
+            ParallelDim(1, data.replica_degree, is_replica_dim=True),
+        )
+        return [ParallelTensorShape(dims, data.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        data, assign = inputs
+        p: GroupByParams = self.params
+        b, k = assign.shape
+        cap = _capacity(b, k, p.n, p.alpha)
+        disp = _dispatch_mask(assign, p.n, cap)  # [b, k, n, cap]
+        expert_in = jnp.einsum("bknc,bd->ncd", disp, data)
+        return [expert_in.astype(data.dtype)]
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateParams:
+    n: int
+    lambda_bal: float = 0.0
+    alpha: float = 1.0
+
+
+class Aggregate(Op):
+    """Combine expert outputs weighted by (renormalized) gate scores.
+
+    Inputs: gate_scores [b,k], assign [b,k] (int), gate_logits_softmax
+    [b,n] (for the load-balance aux loss), expert_out [n,cap,e].
+    The aux loss (lambda_bal · n · Σ_e fraction_e · prob_e, Switch-style —
+    functional stand-in for the reference's lambda_bal gradient injection
+    in aggregate.cu) is exposed via `aux_loss` on the forward result.
+    """
+
+    op_type = OperatorType.AGGREGATE
+
+    def infer_output_shapes(self, input_shapes):
+        gate_scores, assign, gate_full, expert_out = input_shapes
+        ed = _data_dims(expert_out)
+        bd = _data_dims(gate_scores)
+        dims = (
+            ParallelDim(bd[0].size, bd[0].degree),
+            ParallelDim(ed[-1].size, ed[-1].degree),
+            ParallelDim(1, expert_out.replica_degree, is_replica_dim=True),
+        )
+        return [ParallelTensorShape(dims, expert_out.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        gate_scores, assign, gate_full, expert_out = inputs
+        p: AggregateParams = self.params
+        n, cap, e = expert_out.shape
+        b, k = assign.shape
+        disp = _dispatch_mask(assign, n, cap)  # [b, k, n, cap]
+        denom = jnp.sum(gate_scores, axis=-1, keepdims=True) + 1e-9
+        norm_scores = gate_scores / denom
+        combine = jnp.einsum("bknc,bk->bnc", disp, norm_scores)
+        y = jnp.einsum("bnc,nce->be", combine, expert_out)
+        self._last_aux = self._balance_loss(assign, gate_full, n, p.lambda_bal)
+        return [y.astype(expert_out.dtype)]
+
+    @staticmethod
+    def _balance_loss(assign, gate_full, n, lambda_bal):
+        if lambda_bal == 0.0:
+            return None
+        counts = jnp.sum(jax.nn.one_hot(assign[:, 0], n), axis=0)
+        frac = counts / assign.shape[0]
+        prob = jnp.mean(gate_full, axis=0)
+        return lambda_bal * n * jnp.sum(frac * prob)
+
+
+class AggregateSpec(Aggregate):
+    """Speculative aggregate: emit each assigned expert's prediction as a
+    separate sample — output [k·b, e]; the framework replicates labels k×
+    to match (reference model.cc:2875)."""
+
+    op_type = OperatorType.AGGREGATE_SPEC
+
+    def infer_output_shapes(self, input_shapes):
+        gate_scores, assign, gate_full, expert_out = input_shapes
+        ed = _data_dims(expert_out)
+        bd = _data_dims(assign)
+        dims = (
+            ParallelDim(bd[0].size * bd[1].size, bd[0].degree),
+            ParallelDim(ed[-1].size, ed[-1].degree),
+            ParallelDim(1, expert_out.replica_degree, is_replica_dim=True),
+        )
+        return [ParallelTensorShape(dims, expert_out.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        gate_scores, assign, gate_full, expert_out = inputs
+        p: AggregateParams = self.params
+        n, cap, e = expert_out.shape
+        b, k = assign.shape
+        disp = _dispatch_mask(assign, n, cap)  # [b, k, n, cap]
+        # per-(sample, slot) prediction: [b, k, e]
+        preds = jnp.einsum("bknc,nce->bke", disp, expert_out)
+        self._last_aux = self._balance_loss(assign, gate_full, n, p.lambda_bal)
+        return [preds.reshape(b * k, e).astype(expert_out.dtype)]
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheParams:
+    num_batches: int
+    seed: int = 0
+
+
+class Cache(Op):
+    """Expert-activation cache (reference src/ops/cache.cc): passes input
+    through while maintaining a host-side staleness score used by
+    recompile_on_condition (flexflow_tpu/recompile.py).  The jitted path
+    is identity; score accounting happens outside jit in FFModel.fit."""
+
+    op_type = OperatorType.CACHE
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.score_history = []
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [inputs[0]]
+
+    def update_score(self, score: float):
+        self.score_history.append(float(score))
+        if len(self.score_history) > self.params.num_batches:
+            self.score_history.pop(0)
+
+    @property
+    def trigger(self) -> float:
+        if not self.score_history:
+            return 0.0
+        return sum(self.score_history) / len(self.score_history)

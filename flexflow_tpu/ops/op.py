@@ -1,0 +1,177 @@
+"""Base operator class for the PCG.
+
+Fresh design replacing the reference's `Op` base
+(/root/reference/include/flexflow/operator.h:51-196) and its 4-part
+per-op pattern (params struct / graph-time ctor / Legion launches /
+CUDA task bodies — exemplar src/ops/linear.cc).  Here each op is:
+
+  1. a frozen **params dataclass** (hashable — node-dedup key for the
+     search, like linear_params.h + model.h:676-704 get_or_create_node);
+  2. a **shape rule** `infer_output_shapes` that propagates both logical
+     sizes and partition degrees (replacing the reference's
+     parallel-dim-mapping records, operator.h:53-121);
+  3. a pure **jax forward** `forward(...)` on logical (global) arrays —
+     XLA SPMD shards it according to the tensors' machine views, and
+     `jax.grad` supplies backward (no hand-written backward tasks);
+  4. **cost hooks** (`flops`, `memory_bytes`) consumed by the simulator
+     in place of cudaEvent timing (model.cu:38-75).
+
+Op-level parallelism choices that the reference expresses through each
+op's MachineView + weight replica dims (e.g. linear out-channel
+partition, attention head partition, embedding vocab partition) live in
+a per-op `ShardConfig`, mutated by the strategy search.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..fftype import DataType, OperatorType
+from ..initializer import Initializer
+from ..tensor import ParallelTensor, ParallelTensorShape
+
+_op_guid = [2000]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardConfig:
+    """Op-internal parallelism degrees (strategy-search mutable).
+
+    channel: shard the op's weight/output channel dim (linear out-channels,
+        attention heads via head_degree alias, conv out-channels).
+    reduction: shard the contraction dim (linear in-channels) — output
+        becomes partial-sum with replica degree = reduction; a Reduction
+        parallel op (or XLA's automatic all-reduce under SPMD) collapses it.
+    attribute: shard an attribute dim (embedding vocab, conv in-channel
+        attribute parallelism; reference --enable-attribute-parallel).
+    expert: expert parallelism degree for MoE ops.
+    """
+
+    channel: int = 1
+    reduction: int = 1
+    attribute: int = 1
+    expert: int = 1
+
+    def is_trivial(self) -> bool:
+        return self.channel == self.reduction == self.attribute == self.expert == 1
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightSpec:
+    name: str
+    shape: ParallelTensorShape
+    initializer: Optional[Initializer] = None
+
+
+class Op:
+    """A node in the parallel computation graph."""
+
+    op_type: OperatorType = OperatorType.NOOP
+
+    def __init__(
+        self,
+        params,
+        inputs: Sequence[ParallelTensor],
+        name: str = "",
+        shard: ShardConfig = ShardConfig(),
+    ):
+        _op_guid[0] += 1
+        self.guid = _op_guid[0]
+        self.params = params
+        self.inputs: List[ParallelTensor] = list(inputs)
+        self.shard = shard
+        self.name = name or f"{self.op_type.value}_{self.guid}"
+        self.machine_view = None  # assigned by strategy lowering
+        # Shape inference + weight/output creation
+        out_shapes = self.infer_output_shapes([t.shape for t in inputs])
+        self.outputs: List[ParallelTensor] = [
+            ParallelTensor(s, owner_op=self, owner_idx=i, name=f"{self.name}.out{i}")
+            for i, s in enumerate(out_shapes)
+        ]
+        self.weight_specs: List[WeightSpec] = self.make_weight_specs(
+            [t.shape for t in inputs]
+        )
+        self.weights: List[ParallelTensor] = [
+            ParallelTensor(ws.shape, owner_op=self, owner_idx=i,
+                           name=f"{self.name}.{ws.name}")
+            for i, ws in enumerate(self.weight_specs)
+        ]
+
+    # -- to override ----------------------------------------------------
+    def infer_output_shapes(
+        self, input_shapes: Sequence[ParallelTensorShape]
+    ) -> List[ParallelTensorShape]:
+        raise NotImplementedError
+
+    def make_weight_specs(
+        self, input_shapes: Sequence[ParallelTensorShape]
+    ) -> List[WeightSpec]:
+        return []
+
+    def forward(
+        self,
+        inputs: Sequence[jax.Array],
+        weights: Sequence[jax.Array],
+        *,
+        training: bool = False,
+        rng: Optional[jax.Array] = None,
+    ) -> List[jax.Array]:
+        raise NotImplementedError
+
+    # -- cost hooks (simulator) -----------------------------------------
+    def flops(self) -> float:
+        """Forward FLOPs for one full (unsharded) application."""
+        return 0.0
+
+    def memory_bytes(self) -> int:
+        total = sum(t.shape.size_bytes() for t in self.outputs)
+        total += sum(w.shape.size_bytes() for w in self.weights)
+        return total
+
+    def is_parallel_op(self) -> bool:
+        return self.op_type.is_parallel_op()
+
+    # -- search support --------------------------------------------------
+    def with_shard(self, shard: ShardConfig) -> "ShardConfig":
+        return shard
+
+    def node_key(self) -> Tuple:
+        """Hashable dedup key (reference get_or_create_node, model.h:676)."""
+        return (
+            self.op_type,
+            self.params,
+            self.shard,
+            tuple(t.shape for t in self.inputs),
+        )
+
+    def __repr__(self) -> str:
+        ins = ",".join(str(t.shape) for t in self.inputs)
+        outs = ",".join(str(t.shape) for t in self.outputs)
+        return f"{self.name}({ins} -> {outs})"
+
+
+# ---------------------------------------------------------------------------
+# Shared shape-rule helpers
+# ---------------------------------------------------------------------------
+
+def elementwise_shape(
+    shape: ParallelTensorShape, dtype: Optional[DataType] = None
+) -> ParallelTensorShape:
+    return ParallelTensorShape(shape.dims, dtype or shape.dtype)
+
+
+def check_no_partition(shape: ParallelTensorShape, dim_idx: int, opname: str):
+    dims = [d for d in shape.dims if not d.is_replica_dim]
+    if dims[dim_idx].degree != 1:
+        raise ShapeError(
+            f"{opname}: dim {dim_idx} (size {dims[dim_idx].size}) may not be "
+            f"partitioned (degree {dims[dim_idx].degree})"
+        )
+
+
+class ShapeError(ValueError):
+    """Raised when an op cannot accept the given input parallel shapes —
+    the search treats this as an illegal strategy candidate."""

@@ -1,0 +1,260 @@
+"""Shape/manipulation ops: Reshape, Flat, Transpose, Reverse, Concat,
+Split, Gather, ReduceSum, Mean.
+
+Reference: src/ops/{reshape,flat,transpose,reverse,concat,split,gather,
+reduce,mean}.cc — all custom-copy or cuDNN-reduce CUDA kernels.  TPU-first
+these are pure metadata ops or single XLA HLOs (reshape/transpose/rev/
+concatenate/slice/gather/reduce) that fuse with neighbours.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..fftype import OperatorType
+from ..tensor import ParallelDim, ParallelTensorShape
+from .op import Op, ShapeError
+
+
+def _data_dims(shape: ParallelTensorShape):
+    return [d for d in shape.dims if not d.is_replica_dim]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshapeParams:
+    shape: Tuple[int, ...]
+
+
+class Reshape(Op):
+    """Logical reshape.  Partitioned input dims must survive the reshape
+    (dim 0 degree is carried if sizes allow); otherwise the search must
+    insert a Combine first."""
+
+    op_type = OperatorType.RESHAPE
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        target = list(self.params.shape)
+        neg = [i for i, s in enumerate(target) if s == -1]
+        if len(neg) > 1:
+            raise ShapeError(f"{self.name}: multiple -1 in reshape")
+        numel = ishape.num_elements()
+        if neg:
+            rest = -int(np.prod(target))
+            target[neg[0]] = numel // rest
+        if int(np.prod(target)) != numel:
+            raise ShapeError(f"{self.name}: cannot reshape {ishape} to {target}")
+        ddims = _data_dims(ishape)
+        degrees = [1] * len(target)
+        # carry the leading (sample) dim's degree when its size is preserved
+        if ddims and target and ddims[0].size == target[0]:
+            degrees[0] = ddims[0].degree
+        elif any(d.degree > 1 for d in ddims):
+            raise ShapeError(f"{self.name}: reshape of partitioned dims unsupported")
+        dims = tuple(ParallelDim(s, g) for s, g in zip(target, degrees)) + (
+            ParallelDim(1, ishape.replica_degree, is_replica_dim=True),
+        )
+        return [ParallelTensorShape(dims, ishape.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        out_shape = self.outputs[0].shape.logical_shape
+        return [jnp.reshape(inputs[0], out_shape)]
+
+
+class Flat(Op):
+    """Flatten all but the sample dim (reference src/ops/flat.cc)."""
+
+    op_type = OperatorType.FLAT
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        ddims = _data_dims(ishape)
+        if any(d.degree > 1 for d in ddims[1:]):
+            raise ShapeError(f"{self.name}: flattened dims are partitioned")
+        rest = int(np.prod([d.size for d in ddims[1:]])) if len(ddims) > 1 else 1
+        dims = (
+            ParallelDim(ddims[0].size, ddims[0].degree),
+            ParallelDim(rest),
+            ParallelDim(1, ishape.replica_degree, is_replica_dim=True),
+        )
+        return [ParallelTensorShape(dims, ishape.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        x = inputs[0]
+        return [jnp.reshape(x, (x.shape[0], -1))]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransposeParams:
+    perm: Tuple[int, ...]
+
+
+class Transpose(Op):
+    op_type = OperatorType.TRANSPOSE
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        ddims = _data_dims(ishape)
+        perm = self.params.perm
+        if sorted(perm) != list(range(len(ddims))):
+            raise ShapeError(f"{self.name}: bad perm {perm}")
+        dims = tuple(ParallelDim(ddims[p].size, ddims[p].degree) for p in perm) + (
+            ParallelDim(1, ishape.replica_degree, is_replica_dim=True),
+        )
+        return [ParallelTensorShape(dims, ishape.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [jnp.transpose(inputs[0], self.params.perm)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReverseParams:
+    axis: int
+
+
+class Reverse(Op):
+    op_type = OperatorType.REVERSE
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        ax = self.params.axis % ishape.logical_rank
+        if _data_dims(ishape)[ax].degree != 1:
+            raise ShapeError(f"{self.name}: reversed axis is partitioned")
+        return [ishape]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [jnp.flip(inputs[0], self.params.axis)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcatParams:
+    axis: int
+
+
+class Concat(Op):
+    op_type = OperatorType.CONCAT
+
+    def infer_output_shapes(self, input_shapes):
+        first = input_shapes[0]
+        rank = first.logical_rank
+        ax = self.params.axis % rank
+        total = 0
+        for s in input_shapes:
+            dd = _data_dims(s)
+            if s.logical_rank != rank:
+                raise ShapeError(f"{self.name}: rank mismatch")
+            if dd[ax].degree != 1:
+                raise ShapeError(f"{self.name}: concat axis partitioned")
+            for i in range(rank):
+                if i != ax and (
+                    dd[i].size != _data_dims(first)[i].size
+                    or dd[i].degree != _data_dims(first)[i].degree
+                ):
+                    raise ShapeError(f"{self.name}: dim {i} mismatch")
+            total += dd[ax].size
+        dims = []
+        for i, d in enumerate(_data_dims(first)):
+            dims.append(ParallelDim(total if i == ax else d.size, d.degree if i != ax else 1))
+        dims.append(ParallelDim(1, first.replica_degree, is_replica_dim=True))
+        return [ParallelTensorShape(tuple(dims), first.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [jnp.concatenate(list(inputs), axis=self.params.axis)]
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitParams:
+    sizes: Tuple[int, ...]
+    axis: int
+
+
+class Split(Op):
+    op_type = OperatorType.SPLIT
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        ddims = _data_dims(ishape)
+        ax = self.params.axis % len(ddims)
+        if ddims[ax].degree != 1:
+            raise ShapeError(f"{self.name}: split axis partitioned")
+        if sum(self.params.sizes) != ddims[ax].size:
+            raise ShapeError(f"{self.name}: split sizes {self.params.sizes} != {ddims[ax].size}")
+        outs = []
+        for sz in self.params.sizes:
+            dims = tuple(
+                ParallelDim(sz if i == ax else d.size, d.degree)
+                for i, d in enumerate(ddims)
+            ) + (ParallelDim(1, ishape.replica_degree, is_replica_dim=True),)
+            outs.append(ParallelTensorShape(dims, ishape.dtype))
+        return outs
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        x = inputs[0]
+        idx = np.cumsum(self.params.sizes)[:-1]
+        return list(jnp.split(x, idx, axis=self.params.axis))
+
+
+@dataclasses.dataclass(frozen=True)
+class GatherParams:
+    axis: int
+
+
+class Gather(Op):
+    """Gather along axis with an index tensor of the same rank
+    (torch.gather semantics, reference src/ops/gather.cc)."""
+
+    op_type = OperatorType.GATHER
+
+    def infer_output_shapes(self, input_shapes):
+        data, index = input_shapes
+        ax = self.params.axis % data.logical_rank
+        if _data_dims(data)[ax].degree != 1:
+            raise ShapeError(f"{self.name}: gather axis partitioned")
+        dims = tuple(
+            ParallelDim(d.size, d.degree) for d in _data_dims(index)
+        ) + (ParallelDim(1, data.replica_degree, is_replica_dim=True),)
+        return [ParallelTensorShape(dims, data.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        data, index = inputs
+        return [jnp.take_along_axis(data, index, axis=self.params.axis)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceParams:
+    axes: Tuple[int, ...]
+    keepdims: bool = False
+    op: str = "sum"  # "sum" | "mean"
+
+
+class Reduce(Op):
+    op_type = OperatorType.REDUCE_SUM
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        ddims = _data_dims(ishape)
+        rank = len(ddims)
+        axes = {a % rank for a in self.params.axes}
+        dims = []
+        for i, d in enumerate(ddims):
+            if i in axes:
+                if d.degree != 1:
+                    raise ShapeError(f"{self.name}: reduced axis {i} partitioned")
+                if self.params.keepdims:
+                    dims.append(ParallelDim(1))
+            else:
+                dims.append(ParallelDim(d.size, d.degree))
+        dims.append(ParallelDim(1, ishape.replica_degree, is_replica_dim=True))
+        return [ParallelTensorShape(tuple(dims), ishape.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        p: ReduceParams = self.params
+        fn = jnp.sum if p.op == "sum" else jnp.mean
+        return [fn(inputs[0], axis=p.axes, keepdims=p.keepdims)]
+
+
+class Mean(Reduce):
+    op_type = OperatorType.MEAN

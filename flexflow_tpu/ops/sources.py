@@ -1,0 +1,38 @@
+"""PCG source nodes: Input, Weight, NoOp.
+
+Reference: src/ops/noop.cc (255 LoC) — OP_INPUT/OP_WEIGHT/OP_NOOP nodes
+created by get_or_create_input_node (model.h:707).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..fftype import OperatorType
+from ..tensor import ParallelTensorShape
+from .op import Op
+
+
+@dataclasses.dataclass(frozen=True)
+class SourceParams:
+    shape: ParallelTensorShape
+    kind: str = "input"  # "input" | "weight" | "noop"
+
+
+class InputOp(Op):
+    op_type = OperatorType.INPUT
+
+    def infer_output_shapes(self, input_shapes):
+        return [self.params.shape]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        raise RuntimeError("source ops are fed by the executor, not executed")
+
+
+class NoOp(Op):
+    op_type = OperatorType.NOOP
+
+    def infer_output_shapes(self, input_shapes):
+        return [input_shapes[0]]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [inputs[0]]

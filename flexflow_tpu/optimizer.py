@@ -1,0 +1,106 @@
+"""Optimizers: SGD (momentum/nesterov) and Adam, sharded-state.
+
+Reference: src/runtime/optimizer.cc + optimizer_kernel.cu — per-parameter
+Legion update tasks, with the NCCL variant doing ncclAllReduce(grad)
+inline before the update (optimizer_kernel.cu:88 SGD, :196 Adam), or a
+parameter-server task tree (ParameterSyncType::PS).
+
+TPU-first: gradients arrive already reduced — jax.grad of the SPMD step
+emits the psum over the data axes as part of backward — so the optimizer
+is a pure functional update over the weight pytree.  Optimizer slots
+(momentum/adam m,v) inherit each weight's NamedSharding, which is the
+sharded-optimizer-state ("ZeRO-esque") layout for free when weights are
+sharded.  API kept close to the reference (SGDOptimizer/AdamOptimizer
+names, optimizer.h:36-110) while the math is optax-compatible.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer:
+    def init_state(self, weights) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def next_step(self, state):
+        """Host-side per-iteration bookkeeping (reference Optimizer::next)."""
+        return state
+
+    def update(self, weights, grads, state):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class SGDOptimizer(Optimizer):
+    lr: float = 0.01
+    momentum: float = 0.0
+    nesterov: bool = False
+    weight_decay: float = 0.0
+
+    def init_state(self, weights):
+        if self.momentum == 0.0:
+            return {}
+        return {"v": jax.tree.map(jnp.zeros_like, weights)}
+
+    def update(self, weights, grads, state):
+        wd = self.weight_decay
+
+        if self.momentum == 0.0:
+            new_w = jax.tree.map(
+                lambda w, g: w - self.lr * (g + wd * w), weights, grads
+            )
+            return new_w, state
+
+        def upd(w, g, v):
+            g = g + wd * w
+            v = self.momentum * v + g
+            step = g + self.momentum * v if self.nesterov else v
+            return w - self.lr * step, v
+
+        flat = jax.tree.map(upd, weights, grads, state["v"])
+        new_w = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_w, {"v": new_v}
+
+
+@dataclasses.dataclass
+class AdamOptimizer(Optimizer):
+    alpha: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    weight_decay: float = 0.0
+    epsilon: float = 1e-8
+
+    def init_state(self, weights):
+        return {
+            "m": jax.tree.map(jnp.zeros_like, weights),
+            "v": jax.tree.map(jnp.zeros_like, weights),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, weights, grads, state):
+        t = state["t"] + 1
+        # bias-corrected alpha (reference Optimizer::next, optimizer.cc)
+        alpha_t = (
+            self.alpha
+            * jnp.sqrt(1.0 - jnp.power(self.beta2, t.astype(jnp.float32)))
+            / (1.0 - jnp.power(self.beta1, t.astype(jnp.float32)))
+        )
+
+        def upd(w, g, m, v):
+            g = g + self.weight_decay * w
+            m = self.beta1 * m + (1 - self.beta1) * g
+            v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+            w = w - alpha_t * m / (jnp.sqrt(v) + self.epsilon)
+            return w, m, v
+
+        flat = jax.tree.map(upd, weights, grads, state["m"], state["v"])
+        is_t = lambda t_: isinstance(t_, tuple)
+        new_w = jax.tree.map(lambda x: x[0], flat, is_leaf=is_t)
+        new_m = jax.tree.map(lambda x: x[1], flat, is_leaf=is_t)
+        new_v = jax.tree.map(lambda x: x[2], flat, is_leaf=is_t)
+        return new_w, {"m": new_m, "v": new_v, "t": t}

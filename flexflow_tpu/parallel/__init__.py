@@ -1,0 +1,1 @@
+from .machine import MachineView, assign_axes, make_mesh, view_to_sharding, view_to_spec

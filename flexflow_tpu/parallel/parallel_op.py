@@ -1,0 +1,199 @@
+"""Parallelization operators — the parallelism IR of the PCG.
+
+Reference: src/parallel_ops/{partition,combine,replicate,reduction,
+fused_parallel_op}.cc — four primitives whose Legion partition copies
+perform all inter-device data movement (SURVEY §2.3).  TPU-first: these
+ops are **semantic identities** on the global logical array; what they
+change is the tensor's parallel shape (degrees/replica dims).  Lowering
+(flexflow_tpu/parallel/lowering.py) realizes each as a
+`lax.with_sharding_constraint` boundary, so XLA SPMD emits the actual
+collective:
+
+  Repartition -> sharding change (slice/all-to-all as needed)
+  Combine     -> all-gather on the combined dim
+  Replicate   -> broadcast (all-gather of the replica axis)
+  Reduction   -> psum of partial sums (XLA inserts it when the producer's
+                 contraction dim was sharded)
+  AllToAll    -> degree moved between dims (Ulysses-style resharding) —
+                 a TPU-native addition the reference lacks; lowers to an
+                 ICI all-to-all.
+
+There is deliberately no per-op communication code here — that is the
+entire point of the XLA SPMD design (SURVEY §2.4 "TPU equivalent").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from ..fftype import OperatorType
+from ..ops.op import Op, ShapeError
+from ..tensor import ParallelDim, ParallelTensorShape
+
+
+def _replace_dim(shape: ParallelTensorShape, logical_idx: int, new: ParallelDim):
+    dims = []
+    li = 0
+    for d in shape.dims:
+        if d.is_replica_dim:
+            dims.append(d)
+        else:
+            dims.append(new if li == logical_idx else d)
+            li += 1
+    return ParallelTensorShape(tuple(dims), shape.dtype)
+
+
+def _with_replica(shape: ParallelTensorShape, degree: int):
+    dims = tuple(
+        dataclasses.replace(d, degree=degree) if d.is_replica_dim else d
+        for d in shape.dims
+    )
+    return ParallelTensorShape(dims, shape.dtype)
+
+
+class ParallelOpBase(Op):
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [inputs[0]]
+
+
+@dataclasses.dataclass(frozen=True)
+class RepartitionParams:
+    dim: int
+    degree: int
+
+
+class Repartition(ParallelOpBase):
+    op_type = OperatorType.REPARTITION
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        p: RepartitionParams = self.params
+        dd = [d for d in ishape.dims if not d.is_replica_dim]
+        dim = dd[p.dim % len(dd)]
+        new_degree = dim.degree * p.degree
+        if dim.size % new_degree != 0:
+            raise ShapeError(
+                f"{self.name}: dim size {dim.size} not divisible by {new_degree}"
+            )
+        return [_replace_dim(ishape, p.dim % len(dd), dim.with_degree(new_degree))]
+
+
+@dataclasses.dataclass(frozen=True)
+class CombineParams:
+    dim: int
+    degree: int
+
+
+class Combine(ParallelOpBase):
+    op_type = OperatorType.COMBINE
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        p: CombineParams = self.params
+        dd = [d for d in ishape.dims if not d.is_replica_dim]
+        dim = dd[p.dim % len(dd)]
+        if dim.degree % p.degree != 0:
+            raise ShapeError(f"{self.name}: degree {dim.degree} not divisible by {p.degree}")
+        return [
+            _replace_dim(ishape, p.dim % len(dd), dim.with_degree(dim.degree // p.degree))
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicateParams:
+    degree: int
+
+
+class Replicate(ParallelOpBase):
+    op_type = OperatorType.REPLICATE
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        return [_with_replica(ishape, ishape.replica_degree * self.params.degree)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReductionParams:
+    degree: int
+
+
+class Reduction(ParallelOpBase):
+    op_type = OperatorType.REDUCTION
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        rd = ishape.replica_degree
+        if rd % self.params.degree != 0:
+            raise ShapeError(f"{self.name}: replica degree {rd} not divisible")
+        return [_with_replica(ishape, rd // self.params.degree)]
+
+
+@dataclasses.dataclass(frozen=True)
+class AllToAllParams:
+    from_dim: int  # dim currently sharded
+    to_dim: int  # dim to move the degree onto
+    degree: int
+
+
+class AllToAll(ParallelOpBase):
+    """Move `degree` of parallelism from one dim to another in one
+    collective (Ulysses SP <-> TP head resharding; EP dispatch)."""
+
+    op_type = OperatorType.ALLTOALL
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        p: AllToAllParams = self.params
+        dd = [d for d in ishape.dims if not d.is_replica_dim]
+        src = dd[p.from_dim % len(dd)]
+        dst = dd[p.to_dim % len(dd)]
+        if src.degree % p.degree != 0:
+            raise ShapeError(f"{self.name}: from-dim degree {src.degree} not divisible")
+        new_dst_degree = dst.degree * p.degree
+        if dst.size % new_dst_degree != 0:
+            raise ShapeError(f"{self.name}: to-dim size not divisible")
+        s = _replace_dim(ishape, p.from_dim % len(dd), src.with_degree(src.degree // p.degree))
+        return [_replace_dim(s, p.to_dim % len(dd), dst.with_degree(new_dst_degree))]
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedParallelParams:
+    ops: Tuple = ()  # tuple of (kind, params) pairs
+
+
+class FusedParallelOp(ParallelOpBase):
+    """A chain of parallel ops collapsed into one resharding boundary
+    (reference fused_parallel_op.cc) — one constraint, one collective."""
+
+    op_type = OperatorType.FUSED_PARALLEL
+
+    _KINDS = None
+
+    def infer_output_shapes(self, input_shapes):
+        shape = input_shapes[0]
+        for kind, params in self.params.ops:
+            cls = PARALLEL_OP_KINDS[kind]
+            shape = cls.infer_output_shapes_static(shape, params)
+        return [shape]
+
+
+def _static(cls):
+    def fn(shape, params):
+        dummy = object.__new__(cls)
+        dummy.params = params
+        dummy.name = cls.__name__
+        return cls.infer_output_shapes(dummy, [shape])[0]
+
+    return fn
+
+
+for _cls in (Repartition, Combine, Replicate, Reduction, AllToAll):
+    _cls.infer_output_shapes_static = staticmethod(_static(_cls))
+
+PARALLEL_OP_KINDS = {
+    "repartition": Repartition,
+    "combine": Combine,
+    "replicate": Replicate,
+    "reduction": Reduction,
+    "all_to_all": AllToAll,
+}

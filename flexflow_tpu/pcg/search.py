@@ -1,0 +1,23 @@
+"""Strategy search entry points (MCMC + Unity DP).
+
+Reference: FFModel::mcmc_optimize (src/runtime/model.cc:3285-3356) and
+the Unity GraphSearchHelper (src/runtime/substitution.cc:1898-2320).
+The full implementations live in flexflow_tpu/pcg/mcmc.py and
+flexflow_tpu/pcg/unity.py as they land; this module is the stable entry
+point used by FFModel.compile.
+"""
+from __future__ import annotations
+
+from ..strategy import Strategy, data_parallel_strategy
+
+
+def mcmc_search(model, num_devices: int) -> Strategy:
+    from .mcmc import mcmc_optimize  # implemented in the search milestone
+
+    return mcmc_optimize(model, num_devices)
+
+
+def unity_search(model, num_devices: int) -> Strategy:
+    from .unity import graph_optimize
+
+    return graph_optimize(model, num_devices)

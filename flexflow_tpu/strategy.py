@@ -1,0 +1,172 @@
+"""Parallelization strategy: representation, application, (de)serialization.
+
+A Strategy says, for a frontend (degree-1) PCG:
+  * per-op ShardConfig (op-internal parallelism: channel/reduction/
+    attribute/expert degrees);
+  * parallel-op insertions on tensor edges (repartition/combine/
+    replicate/reduction/all_to_all chains);
+  * the mesh axis sizes the degrees map onto.
+
+Applying a strategy rebuilds the PCG with propagated parallel shapes and
+assigns every tensor a MachineView — replacing the reference's
+convert_graph_to_operators + per-op MachineView assignment
+(model.cc:2832-2940) and its Legion-serialized strategy export
+(graph.cc:2164-2400, --export-strategy/--import-strategy) with JSON.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .fftype import OperatorType
+from .ops.op import Op, ShardConfig
+from .parallel.machine import MachineView, assign_axes, validate_view
+from .parallel.parallel_op import (
+    PARALLEL_OP_KINDS,
+    AllToAllParams,
+    CombineParams,
+    ReductionParams,
+    RepartitionParams,
+    ReplicateParams,
+)
+from .pcg.graph import Graph
+
+_PARAM_CLASSES = {
+    "repartition": RepartitionParams,
+    "combine": CombineParams,
+    "replicate": ReplicateParams,
+    "reduction": ReductionParams,
+    "all_to_all": AllToAllParams,
+}
+
+
+@dataclasses.dataclass
+class Strategy:
+    """mesh_axes: ordered axis name -> size.
+    shard_configs: frontend op NAME -> ShardConfig.
+    edge_ops: frontend tensor NAME -> list of (kind, params-dict) chains
+        inserted after the producing tensor (applies to all consumers).
+    """
+
+    mesh_axes: Dict[str, int]
+    shard_configs: Dict[str, ShardConfig] = dataclasses.field(default_factory=dict)
+    edge_ops: Dict[str, List[Tuple[str, dict]]] = dataclasses.field(default_factory=dict)
+
+    # -- serialization ---------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "mesh_axes": self.mesh_axes,
+                "shard_configs": {
+                    k: dataclasses.asdict(v) for k, v in self.shard_configs.items()
+                },
+                "edge_ops": self.edge_ops,
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Strategy":
+        d = json.loads(text)
+        return cls(
+            mesh_axes=dict(d["mesh_axes"]),
+            shard_configs={
+                k: ShardConfig(**v) for k, v in d.get("shard_configs", {}).items()
+            },
+            edge_ops={
+                k: [(kind, dict(p)) for kind, p in v]
+                for k, v in d.get("edge_ops", {}).items()
+            },
+        )
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "Strategy":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    @property
+    def total_devices(self) -> int:
+        n = 1
+        for v in self.mesh_axes.values():
+            n *= v
+        return n
+
+
+def data_parallel_strategy(num_devices: int) -> Strategy:
+    """The reference's default / --only-data-parallel strategy
+    (get_basic_data_parallel_config model.h:250, model.cc:2638-2642):
+    Repartition every input's sample dim across all devices."""
+    s = Strategy(mesh_axes={"data": num_devices})
+    s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": num_devices})]
+    return s
+
+
+def apply_strategy(graph: Graph, strategy: Strategy) -> Graph:
+    """Rebuild the frontend PCG under a strategy.
+
+    Walks the graph in topo order; for each frontend op instantiates a
+    fresh op of the same class with the strategy's ShardConfig and
+    re-propagated input tensors, inserting the strategy's parallel-op
+    chains on edges.  Shape rules raise ShapeError on illegal combos —
+    the search catches that to prune candidates.
+    """
+    new_graph = Graph()
+    tensor_map: Dict[int, object] = {}  # old tensor guid -> new ParallelTensor
+
+    def apply_edge_chain(pt, chain):
+        for kind, pdict in chain:
+            cls = PARALLEL_OP_KINDS[kind]
+            params = _PARAM_CLASSES[kind](**pdict)
+            pop = cls(params, [pt], name=f"{kind}_{pt.name}")
+            new_graph.add_op(pop)
+            pt = pop.outputs[0]
+        return pt
+
+    input_chain = strategy.edge_ops.get("__inputs__", [])
+    for op in graph.topo_order():
+        if op.op_type == OperatorType.INPUT:
+            new_op = type(op)(op.params, [], name=op.name)
+            new_graph.add_op(new_op)
+            pt = new_op.outputs[0]
+            chain = strategy.edge_ops.get(op.outputs[0].name, input_chain)
+            pt = apply_edge_chain(pt, chain)
+            tensor_map[op.outputs[0].guid] = pt
+            continue
+        new_inputs = []
+        for t in op.inputs:
+            pt = tensor_map[t.guid]
+            new_inputs.append(pt)
+        shard = strategy.shard_configs.get(op.name, ShardConfig())
+        new_op = type(op)(op.params, new_inputs, name=op.name, shard=shard)
+        # carry user-supplied initializers and grad flags from the frontend op
+        old_by_name = {s.name: s for s in op.weight_specs}
+        new_op.weight_specs = [
+            dataclasses.replace(s, initializer=old_by_name[s.name].initializer)
+            if s.name in old_by_name
+            else s
+            for s in new_op.weight_specs
+        ]
+        for old_out, new_out in zip(op.outputs, new_op.outputs):
+            new_out.create_gradients = old_out.create_gradients
+        new_graph.add_op(new_op)
+        for old_out, new_out in zip(op.outputs, new_op.outputs):
+            chain = strategy.edge_ops.get(old_out.name, [])
+            tensor_map[old_out.guid] = apply_edge_chain(new_out, chain)
+            if not chain:
+                tensor_map[old_out.guid] = new_out
+    return new_graph
+
+
+def assign_views(graph: Graph, mesh_axes: Dict[str, int]):
+    """Assign a MachineView to every tensor by factoring its degrees onto
+    the mesh axes (the view normalizer; SURVEY §7 hard part 4)."""
+    for op in graph.topo_order():
+        for pt in list(op.outputs) + list(op.weights):
+            view = assign_axes(pt.shape, mesh_axes)
+            validate_view(view, pt.shape, mesh_axes)
+            pt.machine_view = view

@@ -1,0 +1,255 @@
+"""Parallel tensor representation — the core IR datatype.
+
+Fresh TPU-first re-design of the reference's parallel-tensor layer
+(/root/reference/include/flexflow/parallel_tensor.h:36-198): a logical
+tensor whose dims each carry a partition *degree*, plus an explicit
+trailing **replica dimension** so replication degree is itself a
+shardable dimension (the reference's trick at
+src/runtime/model.cc:2611-2633).  Unlike the reference there are no
+Legion regions: a ParallelTensor lowers to a `jax.sharding.NamedSharding`
+via its MachineView (see flexflow_tpu/parallel/machine.py), and XLA SPMD
+performs all data movement.
+
+Dims are stored in **row-major logical order** (numpy convention), not
+the reference's Legion column-major order.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .fftype import DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """One dimension of a parallel tensor.
+
+    size: global logical extent of the dim (1 for pure replica dims).
+    degree: number of shards this dim is split into.
+    is_replica_dim: if True the dim exists only to express replication
+        (size is ignored; degree = replication factor).
+    """
+
+    size: int
+    degree: int = 1
+    is_replica_dim: bool = False
+
+    def __post_init__(self):
+        if not self.is_replica_dim and self.degree > 1 and self.size % self.degree != 0:
+            raise ValueError(
+                f"dim size {self.size} not divisible by degree {self.degree}"
+            )
+
+    @property
+    def shard_size(self) -> int:
+        if self.is_replica_dim:
+            return 1
+        return self.size // self.degree
+
+    def with_degree(self, degree: int) -> "ParallelDim":
+        return dataclasses.replace(self, degree=degree)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTensorShape:
+    """Shape + dtype of a parallel tensor (hashable — used as search key).
+
+    Reference: ParallelTensorShape parallel_tensor.h:76-111.
+    """
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType
+
+    @classmethod
+    def make(
+        cls,
+        shape: Sequence[int],
+        dtype: DataType = DataType.FLOAT,
+        degrees: Optional[Sequence[int]] = None,
+        replica_degree: int = 1,
+    ) -> "ParallelTensorShape":
+        """Build from a plain logical shape, appending the replica dim."""
+        degrees = list(degrees) if degrees is not None else [1] * len(shape)
+        if len(degrees) != len(shape):
+            raise ValueError("degrees must match shape rank")
+        dims = tuple(ParallelDim(s, d) for s, d in zip(shape, degrees)) + (
+            ParallelDim(1, replica_degree, is_replica_dim=True),
+        )
+        return cls(dims, DataType.from_any(dtype))
+
+    # -- logical (user-facing) view -------------------------------------
+    @property
+    def logical_shape(self) -> Tuple[int, ...]:
+        return tuple(d.size for d in self.dims if not d.is_replica_dim)
+
+    @property
+    def logical_rank(self) -> int:
+        return len(self.logical_shape)
+
+    # -- parallel view ---------------------------------------------------
+    @property
+    def replica_degree(self) -> int:
+        deg = 1
+        for d in self.dims:
+            if d.is_replica_dim:
+                deg *= d.degree
+        return deg
+
+    @property
+    def degrees(self) -> Tuple[int, ...]:
+        """Partition degree per logical dim (replica dims excluded)."""
+        return tuple(d.degree for d in self.dims if not d.is_replica_dim)
+
+    @property
+    def total_degree(self) -> int:
+        deg = 1
+        for d in self.dims:
+            deg *= d.degree
+        return deg
+
+    @property
+    def shard_shape(self) -> Tuple[int, ...]:
+        return tuple(d.shard_size for d in self.dims if not d.is_replica_dim)
+
+    def num_elements(self) -> int:
+        return int(np.prod(self.logical_shape, dtype=np.int64)) if self.dims else 0
+
+    def shard_elements(self) -> int:
+        return int(np.prod(self.shard_shape, dtype=np.int64)) if self.dims else 0
+
+    def size_bytes(self) -> int:
+        return self.num_elements() * self.dtype.size_bytes
+
+    def shard_bytes(self) -> int:
+        return self.shard_elements() * self.dtype.size_bytes
+
+    def is_valid(self) -> bool:
+        return all(
+            d.is_replica_dim or (d.size > 0 and d.size % d.degree == 0)
+            for d in self.dims
+        )
+
+    # -- derivation helpers ----------------------------------------------
+    def with_degrees(
+        self, degrees: Sequence[int], replica_degree: Optional[int] = None
+    ) -> "ParallelTensorShape":
+        degrees = list(degrees)
+        new_dims = []
+        di = 0
+        for d in self.dims:
+            if d.is_replica_dim:
+                new_dims.append(
+                    d if replica_degree is None else d.with_degree(replica_degree)
+                )
+            else:
+                new_dims.append(d.with_degree(degrees[di]))
+                di += 1
+        if di != len(degrees):
+            raise ValueError("degrees length mismatch")
+        return ParallelTensorShape(tuple(new_dims), self.dtype)
+
+    def data_parallel(self, degree: int) -> "ParallelTensorShape":
+        """Shard dim 0 (the sample dim) by `degree`; everything else whole."""
+        degrees = [1] * self.logical_rank
+        if degrees:
+            degrees[0] = degree
+        return self.with_degrees(degrees, replica_degree=1)
+
+    def replicate_all(self, degree: int) -> "ParallelTensorShape":
+        return self.with_degrees([1] * self.logical_rank, replica_degree=degree)
+
+    def __str__(self) -> str:
+        parts = []
+        for d in self.dims:
+            if d.is_replica_dim:
+                if d.degree > 1:
+                    parts.append(f"r{d.degree}")
+            elif d.degree > 1:
+                parts.append(f"{d.size}/{d.degree}")
+            else:
+                parts.append(str(d.size))
+        return f"[{', '.join(parts)}]:{self.dtype.value}"
+
+
+_tensor_guid = [1000]
+
+
+class Tensor:
+    """Frontend tensor handle returned by FFModel layer methods.
+
+    Analogue of the reference's logical TensorBase (include/flexflow/tensor.h):
+    carries only the logical shape/dtype plus graph-edge info.  Parallel
+    degrees appear after compile, on ParallelTensor.
+    """
+
+    def __init__(
+        self,
+        shape: Sequence[int],
+        dtype: DataType = DataType.FLOAT,
+        owner_layer=None,
+        owner_idx: int = 0,
+        name: str = "",
+    ):
+        _tensor_guid[0] += 1
+        self.guid: int = _tensor_guid[0]
+        self.shape: Tuple[int, ...] = tuple(int(s) for s in shape)
+        self.dtype: DataType = DataType.from_any(dtype)
+        self.owner_layer = owner_layer
+        self.owner_idx = owner_idx
+        self.name = name or f"tensor_{self.guid}"
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def num_elements(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) if self.shape else 1
+
+    def __repr__(self) -> str:
+        return f"Tensor({self.name}, shape={self.shape}, dtype={self.dtype.value})"
+
+
+class ParallelTensor:
+    """A tensor inside the compiled PCG: shape + machine view + state.
+
+    Reference: ParallelTensorBase parallel_tensor.h:134-198.  The
+    `machine_view` (set during strategy assignment) names the mesh axes
+    each partitioned dim maps to; `sharding(mesh)` materializes the
+    corresponding NamedSharding.
+    """
+
+    def __init__(
+        self,
+        shape: ParallelTensorShape,
+        owner_op=None,
+        owner_idx: int = 0,
+        create_gradients: bool = True,
+        name: str = "",
+    ):
+        _tensor_guid[0] += 1
+        self.guid: int = _tensor_guid[0]
+        self.shape = shape
+        self.owner_op = owner_op
+        self.owner_idx = owner_idx
+        self.create_gradients = create_gradients
+        self.machine_view = None  # set by strategy assignment
+        self.name = name or f"ptensor_{self.guid}"
+
+    @property
+    def dims(self) -> Tuple[ParallelDim, ...]:
+        return self.shape.dims
+
+    @property
+    def dtype(self) -> DataType:
+        return self.shape.dtype
+
+    def sharding(self, mesh):
+        from .parallel.machine import view_to_sharding
+
+        return view_to_sharding(self, mesh)
+
+    def __repr__(self) -> str:
+        return f"ParallelTensor({self.name}, {self.shape}, view={self.machine_view})"

@@ -1,0 +1,35 @@
+"""Test configuration: hermetic 8-device CPU mesh.
+
+The reference cannot test distributed execution without real GPUs
+(SURVEY §4); we exploit jax's virtual CPU devices so every parallelism
+strategy test runs hermetically.
+
+IMPORTANT: tests must never initialize the `axon` TPU backend — the
+tunneled chip is single-tenant, and a second process touching it hangs
+until the first exits.  The axon sitecustomize hook registers the
+backend before conftest runs, so setting the env var alone is not
+enough; we also force jax_platforms=cpu through jax.config, which keeps
+`backends()` from ever creating the TPU client.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8
+    return devs[:8]

@@ -1,0 +1,70 @@
+"""Unit tests for the parallel-tensor IR (SURVEY §4 tier: C++ unit tests
+— machine-view hashing / parallel-config equivalents)."""
+import pytest
+
+from flexflow_tpu.fftype import DataType
+from flexflow_tpu.parallel.machine import MachineView, assign_axes, validate_view
+from flexflow_tpu.tensor import ParallelDim, ParallelTensorShape
+
+
+def test_shape_make_and_logical():
+    s = ParallelTensorShape.make([32, 64], DataType.FLOAT)
+    assert s.logical_shape == (32, 64)
+    assert s.replica_degree == 1
+    assert s.total_degree == 1
+    assert s.num_elements() == 32 * 64
+    assert s.size_bytes() == 32 * 64 * 4
+
+
+def test_data_parallel_shape():
+    s = ParallelTensorShape.make([32, 64]).data_parallel(4)
+    assert s.degrees == (4, 1)
+    assert s.shard_shape == (8, 64)
+    assert s.total_degree == 4
+
+
+def test_replica_dims():
+    s = ParallelTensorShape.make([10, 10], replica_degree=4)
+    assert s.replica_degree == 4
+    assert s.logical_shape == (10, 10)
+    assert s.total_degree == 4
+
+
+def test_invalid_degree():
+    with pytest.raises(ValueError):
+        ParallelDim(10, 3)
+
+
+def test_shape_hashable():
+    a = ParallelTensorShape.make([4, 4])
+    b = ParallelTensorShape.make([4, 4])
+    assert a == b and hash(a) == hash(b)
+    c = a.data_parallel(2)
+    assert a != c
+
+
+def test_assign_axes_dp():
+    s = ParallelTensorShape.make([32, 64]).data_parallel(8)
+    view = assign_axes(s, {"data": 8})
+    assert view.axes == (("data",), (), ())
+    validate_view(view, s, {"data": 8})
+
+
+def test_assign_axes_2d():
+    s = ParallelTensorShape.make([32, 64], degrees=[4, 2])
+    view = assign_axes(s, {"data": 4, "model": 2})
+    assert view.axes == (("data",), ("model",), ())
+    validate_view(view, s, {"data": 4, "model": 2})
+
+
+def test_assign_axes_factored():
+    # one dim of degree 8 over a 4x2 mesh consumes both axes
+    s = ParallelTensorShape.make([32, 64], degrees=[8, 1])
+    view = assign_axes(s, {"a": 4, "b": 2})
+    assert view.axes[0] == ("a", "b")
+
+
+def test_assign_axes_replica():
+    s = ParallelTensorShape.make([32], replica_degree=8)
+    view = assign_axes(s, {"data": 8})
+    assert view.axes == ((), ("data",))
