@@ -1,0 +1,85 @@
+"""Benchmark: BERT-base training throughput, samples/sec/chip.
+
+Run on the real TPU chip by the driver.  Measures steady-state jitted
+train-step time (forward + backward + optimizer) in bf16 on BERT-base
+(12L, hidden 768, 12 heads, seq 128) and prints ONE JSON line.
+
+vs_baseline anchors to BASELINE.md's north star — A100-NCCL per-GPU
+throughput for BERT-base at seq 128 in mixed precision, taken as
+~250 samples/s/GPU (A100 cards sustain roughly 230-280 samples/s on
+BERT-base seq-128 fine-tuning; the reference repo publishes no absolute
+number, BASELINE.md:3-5).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+A100_BERT_BASE_SEQ128_SAMPLES_PER_SEC = 250.0
+
+
+def main():
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_bert
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        batch, seq, hidden, layers, heads, inter = 32, 128, 768, 12, 12, 3072
+    else:  # CPU smoke config so the bench always produces a line
+        batch, seq, hidden, layers, heads, inter = 8, 32, 64, 2, 4, 128
+
+    cfg = FFConfig(batch_size=batch, num_devices=1,
+                   compute_dtype="bfloat16" if on_tpu else "float32")
+    ff = FFModel(cfg)
+    build_bert(ff, batch_size=batch, seq_length=seq, hidden_size=hidden,
+               num_layers=layers, num_heads=heads, intermediate_size=inter)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        devices=[dev],
+    )
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, seq, hidden).astype(np.float32)
+    y = rng.randint(0, 2, batch).astype(np.int32)
+
+    import sys
+
+    print(f"bench: compiled model graph, starting warmup", file=sys.stderr)
+    t_c = time.perf_counter()
+    # warmup (compile + cache)
+    for _ in range(3):
+        m = ff.train_step({"input": x}, y)
+    jax.block_until_ready(m["loss"])
+    print(f"bench: warmup done in {time.perf_counter()-t_c:.1f}s", file=sys.stderr)
+
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = ff.train_step({"input": x}, y)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = iters * batch / dt
+    result = {
+        "metric": f"samples/sec/chip (BERT-base seq{seq} b{batch} train, bf16)"
+        if on_tpu
+        else f"samples/sec/chip (tiny-BERT CPU smoke seq{seq} b{batch})",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(
+            samples_per_sec / A100_BERT_BASE_SEQ128_SAMPLES_PER_SEC, 4
+        )
+        if on_tpu
+        else 0.0,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,7 @@ class GraphExecutor:
         comp_mode: CompMode = CompMode.TRAINING,
         label_replication: int = 1,
         remat: bool = False,
+        compute_dtype=None,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -61,6 +62,11 @@ class GraphExecutor:
         self.comp_mode = comp_mode
         self.label_replication = label_replication
         self.remat = remat
+        # Mixed precision (TPU: bfloat16 on the MXU, f32 master weights
+        # and loss — replaces the reference's per-kernel DT_HALF support)
+        self.compute_dtype = (
+            jnp.dtype(compute_dtype) if compute_dtype is not None else None
+        )
         self.order = graph.topo_order()
         self.sink = graph.sink_op()
         self._use_constraints = mesh.devices.size > 1
@@ -148,16 +154,26 @@ class GraphExecutor:
         env: Dict[int, jax.Array] = {}
         new_state = {k: dict(v) for k, v in state.items()}
         aux_losses: List[jax.Array] = []
+
+        def to_compute(x):
+            if (
+                self.compute_dtype is not None
+                and jnp.issubdtype(x.dtype, jnp.floating)
+                and x.dtype != self.compute_dtype
+            ):
+                return x.astype(self.compute_dtype)
+            return x
+
         for op in self.order:
             if op.op_type == OperatorType.INPUT:
-                env[op.outputs[0].guid] = inputs[op.name]
+                env[op.outputs[0].guid] = to_compute(inputs[op.name])
                 continue
             ins = [env[t.guid] for t in op.inputs]
             nt = _num_trainable(op)
             ws: List[jax.Array] = []
             for i, spec in enumerate(op.weight_specs):
                 src = weights if i < nt else state
-                ws.append(src[op.name][spec.name])
+                ws.append(to_compute(src[op.name][spec.name]))
             op_rng = None
             if rng is not None:
                 op_rng = jax.random.fold_in(rng, op.guid)
@@ -166,7 +182,9 @@ class GraphExecutor:
             extra = results[len(op.outputs):]
             if extra:
                 for spec, val in zip(op.weight_specs[nt:], extra):
-                    new_state[op.name][spec.name] = val
+                    new_state[op.name][spec.name] = val.astype(
+                        state[op.name][spec.name].dtype
+                    )
             aux = getattr(op, "_last_aux", None)
             if aux is not None:
                 aux_losses.append(aux)
@@ -177,7 +195,10 @@ class GraphExecutor:
                         val, self.tensor_sharding(pt)
                     )
                 env[pt.guid] = val
-        return env[self.sink.outputs[0].guid], new_state, aux_losses, env
+        out = env[self.sink.outputs[0].guid]
+        if self.compute_dtype is not None and jnp.issubdtype(out.dtype, jnp.floating):
+            out = out.astype(jnp.float32)  # loss/metrics in full precision
+        return out, new_state, aux_losses, env
 
     # -- train step ------------------------------------------------------
     def build_step(self):

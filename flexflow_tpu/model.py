@@ -498,7 +498,7 @@ class FFModel:
         group_by -> per-expert FFN -> aggregate.  The expert FFN here is a
         batched dense over the stacked expert dim, so expert parallelism
         is sharding that dim (ShardConfig.expert)."""
-        gate = self.dense(input, num_exp, ActiMode.NONE, name=self._name("moe_gate", None))
+        gate = self.dense(input, num_exp, ActiMode.NONE)
         gate_sm = self.softmax(gate)
         topk_out = self.top_k(gate_sm, num_select)
         values, assign = topk_out
@@ -567,6 +567,9 @@ class FFModel:
             self.optimizer,
             comp_mode,
             label_replication=self._label_replication,
+            compute_dtype=(
+                cfg.compute_dtype if cfg.compute_dtype != "float32" else None
+            ),
         )
         self._weights, self._state = self.executor.init_weights(
             seed if seed is not None else cfg.seed

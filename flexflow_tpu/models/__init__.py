@@ -1,0 +1,2 @@
+from .alexnet import build_alexnet
+from .transformer import build_bert, build_transformer

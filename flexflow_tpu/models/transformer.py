@@ -1,0 +1,88 @@
+"""Transformer / BERT model builders.
+
+`build_transformer` mirrors the reference's Transformer example
+(/root/reference/examples/cpp/Transformer/transformer.cc:112-215 —
+create_attention_encoder: multihead_attention + two dense layers, no
+norm/residual; default cfg at transformer.cc:79-85).
+
+`build_bert` is the BERT-base north-star config (BASELINE.md): proper
+pre-LN encoder blocks (attention + residual + layernorm + 4x GELU FFN),
+which is both the real workload and the TP/SP search target.
+"""
+from __future__ import annotations
+
+from ..fftype import ActiMode
+from ..model import FFModel
+
+
+def build_transformer(
+    ff: FFModel,
+    batch_size: int = 8,
+    seq_length: int = 512,
+    hidden_size: int = 1024,
+    num_layers: int = 12,
+    num_heads: int = 16,
+):
+    """The reference example: N x (attention -> dense(relu) -> dense)."""
+    t = ff.create_tensor([batch_size, seq_length, hidden_size], name="input")
+    for i in range(num_layers):
+        a = ff.multihead_attention(
+            t, t, t, hidden_size, num_heads, name=f"attn_{i}"
+        )
+        h = ff.dense(a, hidden_size, activation=ActiMode.RELU, name=f"ffn1_{i}")
+        t = ff.dense(h, hidden_size, name=f"ffn2_{i}")
+    out = ff.dense(t, 1, name="lm_head")
+    return out
+
+
+def build_bert(
+    ff: FFModel,
+    batch_size: int = 32,
+    seq_length: int = 128,
+    hidden_size: int = 768,
+    num_layers: int = 12,
+    num_heads: int = 12,
+    intermediate_size: int = 3072,
+    vocab_size: int = 30522,
+    num_classes: int = 2,
+    dropout: float = 0.0,
+    from_token_ids: bool = False,
+):
+    """BERT-base encoder stack with a classification head."""
+    if from_token_ids:
+        ids = ff.create_tensor([batch_size, seq_length], dtype="int32", name="input")
+        t = ff.embedding(ids, vocab_size, hidden_size, name="tok_embed")
+    else:
+        t = ff.create_tensor([batch_size, seq_length, hidden_size], name="input")
+    for i in range(num_layers):
+        # attention block (post-LN, BERT style)
+        a = ff.multihead_attention(
+            t, t, t, hidden_size, num_heads, dropout=dropout, name=f"attn_{i}"
+        )
+        t = ff.add(t, a, name=f"attn_res_{i}")
+        t = ff.layer_norm(t, axes=[-1], name=f"attn_ln_{i}")
+        # FFN block
+        h = ff.dense(t, intermediate_size, activation=ActiMode.GELU, name=f"ffn1_{i}")
+        h = ff.dense(h, hidden_size, name=f"ffn2_{i}")
+        t = ff.add(t, h, name=f"ffn_res_{i}")
+        t = ff.layer_norm(t, axes=[-1], name=f"ffn_ln_{i}")
+    # classifier on mean-pooled sequence
+    pooled = ff.mean(t, axes=[1], name="pool")
+    logits = ff.dense(pooled, num_classes, name="classifier")
+    return logits
+
+
+def bert_tp_strategy(num_devices: int, tp: int = 2, num_layers: int = 12):
+    """Hybrid DP x TP strategy for build_bert: attention heads and FFN
+    out-channels column-parallel on the model axis, second FFN matmul
+    row-parallel automatically, batch data-parallel."""
+    from ..ops.op import ShardConfig
+    from ..strategy import Strategy
+
+    dp = num_devices // tp
+    s = Strategy(mesh_axes={"data": dp, "model": tp})
+    s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
+    for i in range(num_layers):
+        s.shard_configs[f"attn_{i}"] = ShardConfig(channel=tp)
+        s.shard_configs[f"ffn1_{i}"] = ShardConfig(channel=tp)
+    return s

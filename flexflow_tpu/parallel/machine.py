@@ -88,6 +88,15 @@ def assign_axes(shape, mesh_axis_sizes: Dict[str, int]) -> MachineView:
     decl_order = list(mesh_axis_sizes.keys())
 
     def take(need: int, order) -> Tuple[str, ...]:
+        order = list(order)
+        # pass 1: a single axis of exactly this size (most views are
+        # one-axis-per-dim; exact match avoids eating an axis another
+        # dim needs)
+        for ax in order:
+            if ax in available and available[ax] == need:
+                del available[ax]
+                return (ax,)
+        # pass 2: greedy multi-axis factoring
         chosen = []
         for ax in order:
             if ax not in available:
@@ -100,6 +109,8 @@ def assign_axes(shape, mesh_axis_sizes: Dict[str, int]) -> MachineView:
                 if need == 1:
                     break
         if need != 1:
+            for ax in chosen:
+                available[ax] = mesh_axis_sizes[ax]
             raise ValueError(
                 f"cannot factor degree onto mesh axes {mesh_axis_sizes} "
                 f"(remaining {available}, still need {need})"

@@ -1,0 +1,196 @@
+"""MCMC (simulated-annealing) strategy search.
+
+Reference: FFModel::mcmc_optimize (src/runtime/model.cc:3285-3356) —
+start from data-parallel, repeatedly `rewrite()` a random op's parallel
+config (model.cc:3260-3283), simulate, and Metropolis-accept with
+probability exp(-alpha * delta).
+
+TPU-native search space (mesh-realizable by construction, SURVEY §7
+hard part 4): a mesh factorization {data, model, expert} of the device
+count plus per-op ShardConfigs — channel (linear out-dim / attention
+heads / conv out-channels), attribute (embedding vocab), expert (MoE).
+Candidates that fail shape/degree propagation are pruned by the
+ShapeError the op shape rules raise.  Cost comes from the SPMD
+simulator; the memory-aware mode adds the reference's lambda-weighted
+memory objective (graph.cc:2056-2131 style) when the strategy exceeds
+the per-device HBM budget.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ..fftype import OperatorType
+from ..ops.op import ShardConfig
+from ..strategy import Strategy, apply_strategy, assign_views, data_parallel_strategy
+from .graph import Graph
+
+
+def _factorizations(n: int) -> List[Tuple[int, int, int]]:
+    """(data, model, expert) triples with product n."""
+    out = []
+    for d in range(1, n + 1):
+        if n % d:
+            continue
+        rest = n // d
+        for m in range(1, rest + 1):
+            if rest % m:
+                continue
+            out.append((d, m, rest // m))
+    return out
+
+
+class _Candidate:
+    """Ops whose ShardConfig the search may mutate, with legal degrees."""
+
+    def __init__(self, op, kind: str, max_sizes: Dict[str, int]):
+        self.name = op.name
+        self.kind = kind  # "channel" | "attribute" | "expert"
+        self.max_sizes = max_sizes  # e.g. {"channel": num_heads}
+
+
+def find_candidates(graph: Graph) -> List[_Candidate]:
+    cands = []
+    for op in graph.ops:
+        t = op.op_type
+        if t == OperatorType.LINEAR:
+            limit = getattr(op.params, "out_channels", None) or getattr(
+                op.params, "out_dim", 0
+            )
+            cands.append(_Candidate(op, "channel", {"channel": limit}))
+        elif t == OperatorType.CONV2D:
+            cands.append(_Candidate(op, "channel", {"channel": op.params.out_channels}))
+        elif t == OperatorType.MULTIHEAD_ATTENTION:
+            cands.append(_Candidate(op, "channel", {"channel": op.params.num_heads}))
+        elif t == OperatorType.EMBEDDING:
+            cands.append(
+                _Candidate(op, "attribute", {"attribute": op.params.num_entries})
+            )
+        elif t in (OperatorType.GROUP_BY,):
+            cands.append(_Candidate(op, "expert", {"expert": op.params.n}))
+    return cands
+
+
+class MCMCSearch:
+    def __init__(
+        self,
+        graph: Graph,
+        num_devices: int,
+        simulator_factory,
+        budget: int = 100,
+        alpha: float = 0.05,
+        memory_budget: Optional[int] = None,
+        memory_lambda: float = 1.0,
+        seed: int = 0,
+    ):
+        self.graph = graph
+        self.n = num_devices
+        self.simulator_factory = simulator_factory
+        self.budget = budget
+        self.alpha = alpha
+        self.memory_budget = memory_budget
+        self.memory_lambda = memory_lambda
+        self.rng = random.Random(seed)
+        self.candidates = find_candidates(graph)
+        self.factorizations = _factorizations(num_devices)
+        self.history: List[Tuple[int, float]] = []
+
+    # -- strategy construction ------------------------------------------
+    def _mesh_axes(self, dp: int, tp: int, ep: int) -> Dict[str, int]:
+        axes = {}
+        if dp > 1:
+            axes["data"] = dp
+        if tp > 1:
+            axes["model"] = tp
+        if ep > 1:
+            axes["expert"] = ep
+        if not axes:
+            axes["data"] = 1
+        return axes
+
+    def _build(self, dp: int, tp: int, ep: int,
+               flags: Dict[str, bool]) -> Strategy:
+        s = Strategy(mesh_axes=self._mesh_axes(dp, tp, ep))
+        if dp > 1:
+            s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
+        for c in self.candidates:
+            if not flags.get(c.name):
+                continue
+            if c.kind == "channel" and tp > 1 and c.max_sizes["channel"] % tp == 0:
+                s.shard_configs[c.name] = ShardConfig(channel=tp)
+            elif c.kind == "attribute" and tp > 1 and c.max_sizes["attribute"] % tp == 0:
+                s.shard_configs[c.name] = ShardConfig(attribute=tp)
+            elif c.kind == "expert" and ep > 1 and c.max_sizes["expert"] % ep == 0:
+                s.shard_configs[c.name] = ShardConfig(expert=ep)
+        return s
+
+    # -- cost ------------------------------------------------------------
+    def evaluate(self, strategy: Strategy) -> float:
+        try:
+            g = apply_strategy(self.graph, strategy)
+            assign_views(g, strategy.mesh_axes)
+        except ValueError:  # ShapeError / unfactorable view -> illegal
+            return math.inf
+        sim = self.simulator_factory()
+        res = sim.simulate(g, strategy.mesh_axes, training=True)
+        cost = res.total_time
+        if self.memory_budget is not None and res.per_device_memory > self.memory_budget:
+            over = res.per_device_memory / self.memory_budget - 1.0
+            cost *= 1.0 + self.memory_lambda * over
+        return cost
+
+    # -- main loop (reference model.cc:3285-3356) ------------------------
+    def optimize(self) -> Strategy:
+        dp, tp, ep = self.n, 1, 1
+        flags: Dict[str, bool] = {}
+        current = self._build(dp, tp, ep, flags)
+        current_cost = self.evaluate(current)
+        best, best_cost = current, current_cost
+        state = (dp, tp, ep, dict(flags))
+        for it in range(self.budget):
+            ndp, ntp, nep, nflags = state[0], state[1], state[2], dict(state[3])
+            move = self.rng.random()
+            if move < 0.25 or not self.candidates:
+                ndp, ntp, nep = self.rng.choice(self.factorizations)
+            else:
+                c = self.rng.choice(self.candidates)
+                nflags[c.name] = not nflags.get(c.name, False)
+            cand = self._build(ndp, ntp, nep, nflags)
+            cost = self.evaluate(cand)
+            self.history.append((it, cost))
+            if cost < current_cost or (
+                math.isfinite(cost)
+                and self.rng.random()
+                < math.exp(-self.alpha * (cost - current_cost) / max(1e-12, current_cost))
+            ):
+                current, current_cost = cand, cost
+                state = (ndp, ntp, nep, nflags)
+                if cost < best_cost:
+                    best, best_cost = cand, cost
+        return best
+
+
+def mcmc_optimize(model, num_devices: int) -> Strategy:
+    """Entry used by FFModel.compile (config-driven)."""
+    from ..sim.machine_model import make_machine_model
+    from ..sim.simulator import OpCostModel, Simulator
+
+    cfg = model.config
+    machine = make_machine_model(cfg, num_devices)
+
+    def sim_factory():
+        return Simulator(machine, OpCostModel(machine))
+
+    search = MCMCSearch(
+        model.layers,
+        num_devices,
+        sim_factory,
+        budget=max(1, cfg.search_budget),
+        alpha=cfg.search_alpha,
+        memory_budget=cfg.memory_per_device if cfg.memory_search else None,
+        memory_lambda=cfg.memory_lambda,
+        seed=cfg.seed,
+    )
+    best = search.optimize()
+    return best

@@ -1,0 +1,2 @@
+from .machine_model import MachineModel, SimpleMachineModel, TpuPodModel
+from .simulator import CostMetrics, Simulator

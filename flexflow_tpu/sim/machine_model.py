@@ -1,0 +1,261 @@
+"""Machine models: analytic cluster models feeding the strategy search.
+
+Reference: src/runtime/machine_model.cc — SimpleMachineModel (v0, flat
+inter-GPU/inter-node bandwidths, defaults at machine_model.cc:68-70),
+EnhancedMachineModel (v1, config file with membus/UPI/NIC/PCIe/NVLink),
+and the fork's NetworkedMachineModel (arbitrary topology matrix with
+routed transfers, simulator.h:515-605, network.cc).
+
+TPU-native redesign: `TpuPodModel` models what actually exists on a pod
+slice — a per-axis ICI torus (per-hop bandwidth/latency, wraparound
+links) and DCN between slices — and exposes *collective* costs
+(all-reduce, all-gather, reduce-scatter, all-to-all, ppermute) rather
+than point-to-point NCCL costs, because XLA emits collectives.  The
+same interface backs the event simulator and the search.
+
+All times in seconds, sizes in bytes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class DeviceSpec:
+    """Per-chip compute/memory capability (defaults: TPU v5p)."""
+
+    peak_flops: float = 459e12  # bf16 FLOP/s (v5p)
+    peak_flops_f32: float = 115e12
+    hbm_bandwidth: float = 2765e9  # bytes/s (v5p 2.77 TB/s)
+    hbm_capacity: float = 95e9  # bytes
+    vmem_bytes: float = 128 * 2**20
+
+
+V5E_DEVICE = DeviceSpec(
+    peak_flops=197e12, peak_flops_f32=49e12, hbm_bandwidth=819e9,
+    hbm_capacity=16e9,
+)
+V5P_DEVICE = DeviceSpec()
+
+
+class MachineModel:
+    """Interface consumed by the simulator/search."""
+
+    version: int = -1
+
+    def num_devices(self) -> int:
+        raise NotImplementedError
+
+    def device(self) -> DeviceSpec:
+        raise NotImplementedError
+
+    def p2p_time(self, size: int, src: int, dst: int) -> float:
+        raise NotImplementedError
+
+    # -- collective costs over a device group ---------------------------
+    def allreduce_time(self, size: int, group: Sequence[int]) -> float:
+        n = len(group)
+        if n <= 1:
+            return 0.0
+        # ring: 2 (n-1)/n * size over the slowest link in the group
+        bw, lat = self._group_link(group)
+        return 2.0 * (n - 1) / n * size / bw + 2 * (n - 1) * lat
+
+    def allgather_time(self, size: int, group: Sequence[int]) -> float:
+        n = len(group)
+        if n <= 1:
+            return 0.0
+        bw, lat = self._group_link(group)
+        return (n - 1) / n * size / bw + (n - 1) * lat
+
+    def reducescatter_time(self, size: int, group: Sequence[int]) -> float:
+        return self.allgather_time(size, group)
+
+    def alltoall_time(self, size: int, group: Sequence[int]) -> float:
+        n = len(group)
+        if n <= 1:
+            return 0.0
+        bw, lat = self._group_link(group)
+        return (n - 1) / n * size / bw + (n - 1) * lat
+
+    def _group_link(self, group: Sequence[int]) -> Tuple[float, float]:
+        """(bandwidth, latency) of the slowest link inside the group."""
+        raise NotImplementedError
+
+
+class SimpleMachineModel(MachineModel):
+    """Flat two-level model for parity with the reference's v0
+    (machine_model.cc:58: intra-node bw, inter-node bw/num_nodes)."""
+
+    version = 0
+
+    def __init__(self, num_nodes: int = 1, devices_per_node: int = 8,
+                 device: DeviceSpec = V5P_DEVICE,
+                 intra_bw: float = 100e9, inter_bw: float = 25e9,
+                 intra_lat: float = 1e-6, inter_lat: float = 10e-6):
+        self._num_nodes = num_nodes
+        self._per_node = devices_per_node
+        self._device = device
+        self.intra_bw, self.inter_bw = intra_bw, inter_bw
+        self.intra_lat, self.inter_lat = intra_lat, inter_lat
+
+    def num_devices(self) -> int:
+        return self._num_nodes * self._per_node
+
+    def device(self) -> DeviceSpec:
+        return self._device
+
+    def node_of(self, d: int) -> int:
+        return d // self._per_node
+
+    def p2p_time(self, size: int, src: int, dst: int) -> float:
+        if src == dst:
+            return 0.0
+        if self.node_of(src) == self.node_of(dst):
+            return self.intra_lat + size / self.intra_bw
+        return self.inter_lat + size / (self.inter_bw / max(1, self._num_nodes))
+
+    def _group_link(self, group: Sequence[int]) -> Tuple[float, float]:
+        nodes = {self.node_of(d) for d in group}
+        if len(nodes) > 1:
+            return self.inter_bw, self.inter_lat
+        return self.intra_bw, self.intra_lat
+
+
+class TpuPodModel(MachineModel):
+    """ICI torus + DCN machine model for TPU pod slices.
+
+    topology: per-axis chip counts of the slice, e.g. (4, 4) for v5p-32
+    (16 chips in a 4x4 torus), (2, 2, 1) etc.  Mesh axes of the strategy
+    map onto torus axes in order — the canonical layout the real
+    mesh_utils.create_device_mesh produces — so a collective over mesh
+    axis i rides the per-hop ICI bandwidth of torus axis i.
+
+    slices > 1 models multi-slice training: groups spanning slices pay
+    DCN cost per host.
+    """
+
+    version = 2
+
+    def __init__(
+        self,
+        topology: Tuple[int, ...] = (4, 4),
+        device: DeviceSpec = V5P_DEVICE,
+        ici_bw_per_link: float = 90e9,  # bytes/s each direction (v5p ~100GB/s)
+        ici_latency: float = 1e-6,
+        dcn_bw_per_host: float = 25e9,
+        dcn_latency: float = 10e-6,
+        slices: int = 1,
+    ):
+        self.topology = tuple(topology)
+        self._device = device
+        self.ici_bw = ici_bw_per_link
+        self.ici_lat = ici_latency
+        self.dcn_bw = dcn_bw_per_host
+        self.dcn_lat = dcn_latency
+        self.slices = slices
+
+    @classmethod
+    def from_file(cls, path: str) -> "TpuPodModel":
+        with open(path) as f:
+            d = json.load(f)
+        dev = DeviceSpec(**d.get("device", {}))
+        return cls(
+            topology=tuple(d.get("topology", (4, 4))),
+            device=dev,
+            ici_bw_per_link=d.get("ici_bw_per_link", 90e9),
+            ici_latency=d.get("ici_latency", 1e-6),
+            dcn_bw_per_host=d.get("dcn_bw_per_host", 25e9),
+            dcn_latency=d.get("dcn_latency", 10e-6),
+            slices=d.get("slices", 1),
+        )
+
+    def num_devices(self) -> int:
+        n = self.slices
+        for t in self.topology:
+            n *= t
+        return n
+
+    def device(self) -> DeviceSpec:
+        return self._device
+
+    def coords(self, d: int) -> Tuple[int, ...]:
+        out = []
+        for t in reversed(self.topology):
+            out.append(d % t)
+            d //= t
+        return tuple(reversed(out))
+
+    def p2p_time(self, size: int, src: int, dst: int) -> float:
+        if src == dst:
+            return 0.0
+        a, b = self.coords(src % self._chips_per_slice()), self.coords(
+            dst % self._chips_per_slice()
+        )
+        if src // self._chips_per_slice() != dst // self._chips_per_slice():
+            return self.dcn_lat + size / self.dcn_bw
+        hops = 0
+        for ai, bi, t in zip(a, b, self.topology):
+            d = abs(ai - bi)
+            hops += min(d, t - d)  # torus wraparound
+        return hops * self.ici_lat + size / self.ici_bw
+
+    def _chips_per_slice(self) -> int:
+        n = 1
+        for t in self.topology:
+            n *= t
+        return n
+
+    def _group_link(self, group: Sequence[int]) -> Tuple[float, float]:
+        per_slice = self._chips_per_slice()
+        slices = {d // per_slice for d in group}
+        if len(slices) > 1:
+            return self.dcn_bw, self.dcn_lat
+        return self.ici_bw, self.ici_lat
+
+    # -- axis-aware collective costs (preferred API) --------------------
+    def axis_allreduce_time(self, size: int, axis_len: int,
+                            over_dcn: bool = False) -> float:
+        """Bidirectional-ring all-reduce along one torus axis: each of
+        the two directions carries half the data, so the effective
+        bandwidth is 2 links."""
+        if axis_len <= 1:
+            return 0.0
+        bw = self.dcn_bw if over_dcn else 2.0 * self.ici_bw
+        lat = self.dcn_lat if over_dcn else self.ici_lat
+        return 2.0 * (axis_len - 1) / axis_len * size / bw + 2 * (axis_len - 1) * lat
+
+    def axis_allgather_time(self, size: int, axis_len: int,
+                            over_dcn: bool = False) -> float:
+        if axis_len <= 1:
+            return 0.0
+        bw = self.dcn_bw if over_dcn else 2.0 * self.ici_bw
+        lat = self.dcn_lat if over_dcn else self.ici_lat
+        return (axis_len - 1) / axis_len * size / bw + (axis_len - 1) * lat
+
+    def axis_alltoall_time(self, size: int, axis_len: int,
+                           over_dcn: bool = False) -> float:
+        if axis_len <= 1:
+            return 0.0
+        # all-to-all moves (n-1)/n of the data; on a torus the bisection
+        # limits throughput to ~axis_len/4 concurrent links
+        bw = self.dcn_bw if over_dcn else 2.0 * self.ici_bw
+        lat = self.dcn_lat if over_dcn else self.ici_lat
+        return (axis_len - 1) / axis_len * size / bw + (axis_len - 1) * lat
+
+
+def make_machine_model(config, num_devices: int) -> MachineModel:
+    """Build from FFConfig (--machine-model-version/-file parity)."""
+    if config.machine_model_file:
+        return TpuPodModel.from_file(config.machine_model_file)
+    if config.machine_model_version == 0:
+        return SimpleMachineModel(
+            num_nodes=max(1, config.num_nodes),
+            devices_per_node=max(1, num_devices // max(1, config.num_nodes)),
+        )
+    # default TPU pod: 1-D ring topology of the right size
+    return TpuPodModel(topology=(num_devices,), device=V5E_DEVICE
+                       if num_devices == 1 else V5P_DEVICE)

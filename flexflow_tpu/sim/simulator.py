@@ -1,0 +1,283 @@
+"""Simulator: predicts step time + memory for a strategy-applied PCG.
+
+Reference: src/runtime/simulator.cc — task-graph event simulation
+(simulate_runtime :822-1250), per-op cost measurement with a
+(params, view) cache (:537-578, model.cu:38-75 cudaEvent timing), comm
+cost estimators (estimate_xfer_cost :622-767, sync cost :786-813), and
+the fork's topology-routed variant (:1251-1800).
+
+TPU-native redesign: our execution model is SPMD — every device runs the
+same jitted program — so the per-device timeline is the SAME sequence of
+(sharded) compute ops and collectives.  The simulator therefore costs:
+
+  step = sum_ops max-shard compute (fwd [+ bwd])
+       + sum resharding collectives (the parallel ops)
+       + partial-sum reductions (contraction-dim sharding)
+       + gradient all-reduce over each weight's replica axes
+       - a compute/comm overlap credit (XLA latency hiding)
+
+Compute costs come from an analytic roofline (flops/peak, bytes/HBM-bw)
+calibrated by optional real measurements (measure_fn timing jitted ops
+on the actual chip — the analogue of inner_measure_operator_cost), with
+the same (node_key, view)->cost cache as the reference.  Memory is
+accounted per device: weight + optimizer-slot + gradient shards plus
+peak live activations — feeding the memory-aware search
+(memory_optimization.h:45-70 equivalent).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..fftype import OperatorType
+from ..ops.op import Op
+from ..pcg.graph import Graph
+from .machine_model import MachineModel, TpuPodModel
+
+
+@dataclasses.dataclass
+class CostMetrics:
+    """Per-op cost record (reference CostMetrics simulator.h)."""
+
+    forward_time: float = 0.0
+    backward_time: float = 0.0
+    sync_time: float = 0.0
+    inputs_memory: int = 0
+    outputs_memory: int = 0
+    weights_memory: int = 0
+
+
+@dataclasses.dataclass
+class SimResult:
+    total_time: float
+    compute_time: float
+    comm_time: float
+    sync_time: float
+    per_device_memory: int
+    breakdown: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+_KERNEL_OVERHEAD = 2e-6  # per-op dispatch/fusion overhead (XLA fuses, small)
+
+
+class OpCostModel:
+    """(node_key)->cost cache with analytic roofline + measured override.
+
+    measure_fn, when provided, times the real jitted op on hardware and
+    its result replaces the analytic estimate (reference
+    inner_measure_operator_cost); results persist in the cache dict which
+    can be JSON-dumped between runs.
+    """
+
+    def __init__(
+        self,
+        machine: MachineModel,
+        measure_fn: Optional[Callable[[Op], Optional[float]]] = None,
+        compute_dtype_bytes: int = 2,  # bf16
+    ):
+        self.machine = machine
+        self.measure_fn = measure_fn
+        self.cache: Dict[Tuple, CostMetrics] = {}
+        self.dtype_bytes = compute_dtype_bytes
+
+    def cost(self, op: Op) -> CostMetrics:
+        key = op.node_key()
+        hit = self.cache.get(key)
+        if hit is not None:
+            return hit
+        cm = self._analytic(op)
+        if self.measure_fn is not None and not op.is_parallel_op():
+            measured = self.measure_fn(op)
+            if measured is not None:
+                cm.forward_time = measured
+                cm.backward_time = 2.0 * measured
+        self.cache[key] = cm
+        return cm
+
+    def _shard_fraction(self, op: Op) -> float:
+        """Fraction of the op's total FLOPs done by one device."""
+        deg = 1
+        for t in op.outputs:
+            deg = max(deg, int(np.prod([d.degree for d in t.shape.dims
+                                        if not d.is_replica_dim])))
+        # contraction-dim sharding also divides flops
+        red = max(
+            (t.shape.replica_degree for t in op.outputs), default=1
+        )
+        return 1.0 / max(1, deg * red)
+
+    def _analytic(self, op: Op) -> CostMetrics:
+        dev = self.machine.device()
+        flops = op.flops() * self._shard_fraction(op)
+        in_bytes = sum(t.shape.shard_bytes() for t in op.inputs)
+        out_bytes = sum(t.shape.shard_bytes() for t in op.outputs)
+        w_bytes = sum(w.shape.shard_bytes() for w in op.weights)
+        bytes_moved = in_bytes + out_bytes + w_bytes
+        t_compute = flops / dev.peak_flops
+        t_mem = bytes_moved / dev.hbm_bandwidth
+        fwd = max(t_compute, t_mem) + _KERNEL_OVERHEAD
+        return CostMetrics(
+            forward_time=fwd,
+            backward_time=2.0 * fwd if op.weights or op.inputs else 0.0,
+            inputs_memory=in_bytes,
+            outputs_memory=out_bytes,
+            weights_memory=w_bytes,
+        )
+
+
+def _axis_sizes_of_view(pt, mesh_axes: Dict[str, int]) -> Dict[str, int]:
+    out = {}
+    if pt.machine_view is None:
+        return out
+    for axes in pt.machine_view.axes:
+        for ax in axes:
+            out[ax] = mesh_axes[ax]
+    return out
+
+
+class Simulator:
+    """Strategy cost evaluation (replaces simulate_runtime's event loop
+    for the SPMD execution model; see module docstring)."""
+
+    def __init__(
+        self,
+        machine: MachineModel,
+        cost_model: Optional[OpCostModel] = None,
+        overlap_fraction: float = 0.3,
+        optimizer_slots: int = 2,  # adam m+v
+    ):
+        self.machine = machine
+        self.cost_model = cost_model or OpCostModel(machine)
+        self.overlap_fraction = overlap_fraction
+        self.optimizer_slots = optimizer_slots
+
+    # -- comm costs ------------------------------------------------------
+    def _collective_time(self, kind: str, size: int, group_len: int,
+                         over_dcn: bool = False) -> float:
+        m = self.machine
+        if isinstance(m, TpuPodModel):
+            if kind == "allreduce":
+                return m.axis_allreduce_time(size, group_len, over_dcn)
+            if kind in ("allgather", "reducescatter"):
+                return m.axis_allgather_time(size, group_len, over_dcn)
+            if kind == "alltoall":
+                return m.axis_alltoall_time(size, group_len, over_dcn)
+        group = list(range(group_len))
+        if kind == "allreduce":
+            return m.allreduce_time(size, group)
+        if kind in ("allgather", "reducescatter"):
+            return m.allgather_time(size, group)
+        return m.alltoall_time(size, group)
+
+    def xfer_cost(self, op: Op, mesh_axes: Dict[str, int]) -> float:
+        """Cost of a parallel op's resharding collective (reference
+        estimate_xfer_cost per type, simulator.cc:622-767)."""
+        if not op.is_parallel_op():
+            return 0.0
+        inp, out = op.inputs[0].shape, op.outputs[0].shape
+        shard_bytes = out.shard_bytes()
+        t = op.op_type
+        if t == OperatorType.REPARTITION:
+            # slicing data already on-device under SPMD: near-free when
+            # coming from replicated, all-to-all otherwise
+            degree = op.params.degree
+            if inp.total_degree == 1 or inp.replica_degree >= degree:
+                return _KERNEL_OVERHEAD
+            return self._collective_time("alltoall", shard_bytes, degree)
+        if t == OperatorType.COMBINE:
+            return self._collective_time(
+                "allgather", inp.shard_bytes() * op.params.degree, op.params.degree
+            )
+        if t == OperatorType.REPLICATE:
+            return self._collective_time(
+                "allgather", shard_bytes, op.params.degree
+            )
+        if t == OperatorType.REDUCTION:
+            return self._collective_time(
+                "allreduce", shard_bytes, op.params.degree
+            )
+        if t == OperatorType.ALLTOALL:
+            return self._collective_time("alltoall", shard_bytes, op.params.degree)
+        return _KERNEL_OVERHEAD
+
+    def partial_sum_cost(self, op: Op, mesh_axes: Dict[str, int]) -> float:
+        """An op whose output replica degree exceeds its inputs' implies
+        a contraction-dim partial sum -> all-reduce inserted by SPMD."""
+        if op.is_parallel_op() or not op.outputs:
+            return 0.0
+        out_rep = op.outputs[0].shape.replica_degree
+        in_rep = max((t.shape.replica_degree for t in op.inputs), default=1)
+        if out_rep > in_rep:
+            k = out_rep // max(1, in_rep)
+            return self._collective_time(
+                "allreduce", op.outputs[0].shape.shard_bytes(), k
+            )
+        return 0.0
+
+    def grad_sync_cost(self, graph: Graph, mesh_axes: Dict[str, int]) -> float:
+        """Gradient all-reduce over each weight's replica axes (SPMD's
+        psum in backward == reference optimizer ncclAllReduce)."""
+        total = 0.0
+        for op in graph.ops:
+            for w in op.weights:
+                rep = w.shape.replica_degree
+                if rep > 1 and w.create_gradients:
+                    total += self._collective_time(
+                        "allreduce", w.shape.shard_bytes(), rep
+                    )
+        return total
+
+    # -- memory ----------------------------------------------------------
+    def per_device_memory(self, graph: Graph, training: bool = True) -> int:
+        weights = 0
+        acts = 0
+        for op in graph.ops:
+            for w in op.weights:
+                weights += w.shape.shard_bytes()
+            for t in op.outputs:
+                acts += t.shape.shard_bytes()
+        if training:
+            # grads + optimizer slots for weights; activations live for bwd
+            weights = weights * (2 + self.optimizer_slots)
+        return weights + acts
+
+    # -- top level -------------------------------------------------------
+    def simulate(
+        self,
+        graph: Graph,
+        mesh_axes: Dict[str, int],
+        training: bool = True,
+    ) -> SimResult:
+        compute = 0.0
+        comm = 0.0
+        breakdown: Dict[str, float] = {}
+        for op in graph.topo_order():
+            if op.op_type == OperatorType.INPUT:
+                continue
+            if op.is_parallel_op():
+                c = self.xfer_cost(op, mesh_axes)
+                comm += c
+                breakdown[op.name] = c
+                continue
+            cm = self.cost_model.cost(op)
+            t = cm.forward_time + (cm.backward_time if training else 0.0)
+            compute += t
+            ps = self.partial_sum_cost(op, mesh_axes)
+            if training and ps:
+                ps *= 2.0  # fwd psum + bwd mirrored all-gather/psum
+            comm += ps
+            breakdown[op.name] = t + ps
+        sync = self.grad_sync_cost(graph, mesh_axes) if training else 0.0
+        # XLA overlaps collectives with independent compute
+        effective_comm = (comm + sync) * (1.0 - self.overlap_fraction)
+        total = compute + effective_comm
+        return SimResult(
+            total_time=total,
+            compute_time=compute,
+            comm_time=comm,
+            sync_time=sync,
+            per_device_memory=self.per_device_memory(graph, training),
+            breakdown=breakdown,
+        )

@@ -167,6 +167,9 @@ def assign_views(graph: Graph, mesh_axes: Dict[str, int]):
     the mesh axes (the view normalizer; SURVEY §7 hard part 4)."""
     for op in graph.topo_order():
         for pt in list(op.outputs) + list(op.weights):
-            view = assign_axes(pt.shape, mesh_axes)
-            validate_view(view, pt.shape, mesh_axes)
+            try:
+                view = assign_axes(pt.shape, mesh_axes)
+                validate_view(view, pt.shape, mesh_axes)
+            except ValueError as e:
+                raise ValueError(f"{pt.name} {pt.shape}: {e}") from e
             pt.machine_view = view
