@@ -5,8 +5,8 @@
 create_attention_encoder: multihead_attention + two dense layers, no
 norm/residual; default cfg at transformer.cc:79-85).
 
-`build_bert` is the BERT-base north-star config (BASELINE.md): proper
-pre-LN encoder blocks (attention + residual + layernorm + 4x GELU FFN),
+`build_bert` is the BERT-base north-star config (BASELINE.md): post-LN
+encoder blocks (attention + residual, then layernorm; 4x GELU FFN),
 which is both the real workload and the TP/SP search target.
 """
 from __future__ import annotations

@@ -179,8 +179,12 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
     cfg = model.config
     machine = make_machine_model(cfg, num_devices)
 
+    # one shared cost model: the (node_key)->cost cache must persist
+    # across candidate evaluations (reference simulator.cc:550-560)
+    cost_model = OpCostModel(machine)
+
     def sim_factory():
-        return Simulator(machine, OpCostModel(machine))
+        return Simulator(machine, cost_model)
 
     search = MCMCSearch(
         model.layers,

@@ -240,11 +240,14 @@ class TpuPodModel(MachineModel):
                            over_dcn: bool = False) -> float:
         if axis_len <= 1:
             return 0.0
-        # all-to-all moves (n-1)/n of the data; on a torus the bisection
-        # limits throughput to ~axis_len/4 concurrent links
         bw = self.dcn_bw if over_dcn else 2.0 * self.ici_bw
         lat = self.dcn_lat if over_dcn else self.ici_lat
-        return (axis_len - 1) / axis_len * size / bw + (axis_len - 1) * lat
+        t = (axis_len - 1) / axis_len * size / bw + (axis_len - 1) * lat
+        if not over_dcn:
+            # on a ring/torus axis the all-to-all is bisection-bound:
+            # ~axis_len/4 of the traffic crosses the cut links
+            t *= max(1.0, axis_len / 4.0)
+        return t
 
 
 def make_machine_model(config, num_devices: int) -> MachineModel:

@@ -58,8 +58,8 @@ def test_tensor_parallel_training_matches_single(devices8):
             m = ff.train_step({"x": xs}, ys)
         return float(m["loss"]), ff.get_parameter("fc1", "kernel")
 
-    loss_tp, k_tp = train(build_mlp(FFModel(FFConfig())), None and [], tp_strategy(4, 2))
-    loss_1, k_1 = train(build_mlp(FFModel(FFConfig())), None, None)
+    loss_tp, k_tp = train(build_mlp(FFModel(FFConfig())), devices8, tp_strategy(4, 2))
+    loss_1, k_1 = train(build_mlp(FFModel(FFConfig())), devices8[:1], None)
     assert abs(loss_tp - loss_1) < 1e-4
     np.testing.assert_allclose(k_tp, k_1, rtol=5e-5, atol=5e-5)
 

@@ -48,7 +48,9 @@ def test_simulator_dp_scales_compute():
     (large batch, modest weights)."""
     machine = TpuPodModel(topology=(8,))
     sim = Simulator(machine)
-    ff = build_mlp(hidden=512, batch=8192)
+    # hidden large enough that even the 1/8 shard stays flops-bound
+    # (at hidden=512 the shard goes HBM-bound and scaling tops out ~4x)
+    ff = build_mlp(hidden=2048, batch=8192)
     g1 = apply_strategy(ff.layers, data_parallel_strategy(1))
     assign_views(g1, {"data": 1})
     g8 = apply_strategy(ff.layers, data_parallel_strategy(8))
