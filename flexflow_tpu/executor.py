@@ -70,6 +70,8 @@ class GraphExecutor:
         self.order = graph.topo_order()
         self.sink = graph.sink_op()
         self._use_constraints = mesh.devices.size > 1
+        for op in self.order:
+            op._mesh = mesh  # ops with shard_map lowerings (ring attention)
         self._step_fn = None
         self._input_names = [op.name for op in graph.source_ops()]
 

@@ -86,3 +86,24 @@ def bert_tp_strategy(num_devices: int, tp: int = 2, num_layers: int = 12):
         s.shard_configs[f"attn_{i}"] = ShardConfig(channel=tp)
         s.shard_configs[f"ffn1_{i}"] = ShardConfig(channel=tp)
     return s
+
+
+def bert_sp_strategy(num_devices: int, sp: int = 4):
+    """Hybrid DP x SP (context-parallel) strategy: the sequence dim of
+    every activation is sharded over the "seq" axis and attention runs
+    as ring attention over ICI (parallel/ring_attention.py) — the
+    long-context capability slot the reference lacks (SURVEY §5)."""
+    from ..strategy import Strategy
+
+    if sp < 1 or num_devices % sp != 0:
+        raise ValueError(
+            f"num_devices {num_devices} not divisible by sp degree {sp}"
+        )
+    dp = num_devices // sp
+    s = Strategy(mesh_axes={"data": dp, "seq": sp})
+    chain = []
+    if dp > 1:
+        chain.append(("repartition", {"dim": 0, "degree": dp}))
+    chain.append(("repartition", {"dim": 1, "degree": sp}))
+    s.edge_ops["__inputs__"] = chain
+    return s

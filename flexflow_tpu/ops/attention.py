@@ -67,9 +67,23 @@ class MultiHeadAttention(Op):
             raise ShapeError(f"{self.name}: heads {p.num_heads} not divisible by "
                              f"degree {self.shard.channel}")
         if kd[1].degree != 1 or vd[1].degree != 1:
-            # K/V seq partitioning requires ring attention — a dedicated
-            # lowering path, not plain SPMD propagation.
-            raise ShapeError(f"{self.name}: use ring attention for k/v seq sharding")
+            # K/V seq partitioning lowers to ring attention — legal only
+            # when q/k/v share one seq sharding (self-attention SP).
+            if not (qd[1].degree == kd[1].degree == vd[1].degree):
+                raise ShapeError(
+                    f"{self.name}: ring attention needs equal q/k/v seq "
+                    f"degrees, got {qd[1].degree}/{kd[1].degree}/{vd[1].degree}"
+                )
+            if self.params.add_bias_kv or self.params.add_zero_attn:
+                raise ShapeError(
+                    f"{self.name}: kv-append options unsupported with "
+                    f"sequence sharding"
+                )
+            if self.params.dropout > 0.0:
+                raise ShapeError(
+                    f"{self.name}: attention dropout unsupported with "
+                    f"sequence sharding (ring attention)"
+                )
         ri = q.replica_degree
         c = self.shard.channel
         if c > 1 and ri % c == 0:
@@ -152,20 +166,109 @@ class MultiHeadAttention(Op):
             kh = jnp.concatenate([kh, jnp.zeros((bsz, 1, h, dk), kh.dtype)], axis=1)
             vh = jnp.concatenate([vh, jnp.zeros((bsz, 1, h, dv), vh.dtype)], axis=1)
         scale = 1.0 / np.sqrt(p.k_channels)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
-        if p.causal:
-            qlen, klen = scores.shape[-2], scores.shape[-1]
-            mask = jnp.tril(jnp.ones((qlen, klen), bool))
-            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores, axis=-1)
-        if training and p.dropout > 0.0 and rng is not None:
-            keep = 1.0 - p.dropout
-            probs = probs * jax.random.bernoulli(rng, keep, probs.shape) / keep
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+        ctx = self._attend(qh, kh, vh, scale, training=training, rng=rng)
         out = jnp.einsum("bqhd,hde->bqe", ctx, wo)
         if bo is not None:
             out = out + bo[None, None]
         return [out.astype(q.dtype)]
+
+    # -- attention core dispatch ----------------------------------------
+    def _seq_degree(self) -> int:
+        qdims = [d for d in self.inputs[0].shape.dims if not d.is_replica_dim]
+        return qdims[1].degree
+
+    def _attend(self, qh, kh, vh, scale, *, training, rng):
+        p: MultiHeadAttentionParams = self.params
+        sp = self._seq_degree()
+        if sp > 1:
+            # sequence parallelism: ring attention over the seq mesh axis
+            from ..parallel.ring_attention import ring_attention
+
+            mesh = getattr(self, "_mesh", None)
+            view = self.inputs[0].machine_view
+            assert mesh is not None and view is not None, (
+                f"{self.name}: ring attention needs a compiled mesh/view"
+            )
+            qdims_axes = [
+                a for d, a in zip(self.inputs[0].shape.dims, view.axes)
+                if not d.is_replica_dim
+            ]
+            batch_axes, seq_axes = qdims_axes[0], qdims_axes[1]
+            assert len(seq_axes) == 1, f"{self.name}: seq dim needs one mesh axis"
+            head_view = self.weights[0].machine_view
+            head_axes = head_view.axes[1] if head_view is not None else ()
+
+            def spec_of(axes):
+                if not axes:
+                    return None
+                return axes[0] if len(axes) == 1 else tuple(axes)
+
+            return ring_attention(
+                qh, kh, vh, mesh, seq_axes[0],
+                batch_spec=spec_of(batch_axes),
+                head_spec=spec_of(head_axes),
+                scale=scale, causal=p.causal,
+            )
+        kv_appended = kh.shape[1] - self.inputs[1].shape.logical_shape[1]
+        use_dropout = training and p.dropout > 0.0 and rng is not None
+        if not use_dropout and not (p.causal and kv_appended):
+            # hot path: flash attention (Pallas on TPU, fused jnp off-TPU)
+            from .pallas.flash_attention import mha_flash
+
+            mesh = getattr(self, "_mesh", None)
+            if (
+                mesh is not None
+                and mesh.devices.size > 1
+                and jax.default_backend() == "tpu"
+            ):
+                # GSPMD cannot partition a pallas_call: shard over the
+                # batch/head mesh axes explicitly (both embarrassingly
+                # parallel for attention)
+                return self._flash_sharded(qh, kh, vh, scale, mesh)
+            return mha_flash(qh, kh, vh, scale, p.causal)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+        if p.causal:
+            qlen, klen = scores.shape[-2], scores.shape[-1]
+            # appended bias_kv/zero_attn keys are always attendable;
+            # real keys follow absolute-position causality
+            mask = jnp.tril(jnp.ones((qlen, klen), bool))
+            if kv_appended:
+                mask = mask.at[:, klen - kv_appended:].set(True)
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if use_dropout:
+            keep = 1.0 - p.dropout
+            probs = probs * jax.random.bernoulli(rng, keep, probs.shape) / keep
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+
+    def _flash_sharded(self, qh, kh, vh, scale, mesh):
+        """shard_map-wrapped flash attention over batch/head axes."""
+        import functools
+
+        from jax.sharding import PartitionSpec
+
+        from .pallas.flash_attention import mha_flash
+
+        p: MultiHeadAttentionParams = self.params
+        view = self.inputs[0].machine_view
+        qdims_axes = [
+            a for d, a in zip(self.inputs[0].shape.dims, view.axes)
+            if not d.is_replica_dim
+        ] if view is not None else [(), (), ()]
+        head_view = self.weights[0].machine_view
+        head_axes = head_view.axes[1] if head_view is not None else ()
+
+        def spec_of(axes):
+            if not axes:
+                return None
+            return axes[0] if len(axes) == 1 else tuple(axes)
+
+        spec = PartitionSpec(spec_of(qdims_axes[0]), None, spec_of(head_axes), None)
+        fn = functools.partial(mha_flash, scale=scale, causal=p.causal)
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(qh, kh, vh)
 
     def flops(self):
         p: MultiHeadAttentionParams = self.params

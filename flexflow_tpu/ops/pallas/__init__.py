@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot ops (SURVEY §7: flash/ring attention,
+MoE dispatch).  Each kernel has a pure-jnp reference fallback used on
+CPU meshes and as the autodiff backward where a hand-written backward
+kernel is not warranted."""

@@ -239,15 +239,21 @@ class Reduce(Op):
         rank = len(ddims)
         axes = {a % rank for a in self.params.axes}
         dims = []
+        reduced_degree = 1
         for i, d in enumerate(ddims):
             if i in axes:
-                if d.degree != 1:
-                    raise ShapeError(f"{self.name}: reduced axis {i} partitioned")
+                # Reducing a partitioned axis is legal under SPMD: XLA
+                # emits the cross-shard psum; the result is replicated
+                # over that axis (replica degree absorbs the degree).
+                reduced_degree *= d.degree
                 if self.params.keepdims:
                     dims.append(ParallelDim(1))
             else:
                 dims.append(ParallelDim(d.size, d.degree))
-        dims.append(ParallelDim(1, ishape.replica_degree, is_replica_dim=True))
+        dims.append(
+            ParallelDim(1, ishape.replica_degree * reduced_degree,
+                        is_replica_dim=True)
+        )
         return [ParallelTensorShape(tuple(dims), ishape.dtype)]
 
     def forward(self, inputs, weights, *, training=False, rng=None):

@@ -1,0 +1,114 @@
+"""Ring attention: sequence-parallel attention over an ICI ring.
+
+The reference has NO context parallelism (SURVEY §5 — attention is one
+cudnnMultiHeadAttnForward call; the closest capability is "Repartition
+on the sequence dim + FFIterationConfig.seq_length").  This module is
+the TPU-native instantiation of that capability slot: q/k/v arrive
+sharded on the sequence dim over a mesh axis; K/V shards rotate around
+the ring via `ppermute` while each device accumulates its queries'
+online-softmax state — total memory O(s_local^2) and the transfers ride
+ICI neighbor links (bandwidth-optimal on a torus axis).
+
+Used by MultiHeadAttention when its inputs' seq dim is partitioned
+(strategy inserts Repartition(dim=1)); lowered via `shard_map`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, mask):
+    """One (q_block, kv_block) partial attention in f32.
+
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask: [sq, sk] bool or None.
+    Returns (scores_max, exp_scores_rowsum, weighted_v) for online merge.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [b, h, sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m, l, pv
+
+
+def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
+                            scale: float, causal: bool):
+    """Per-shard body (inside shard_map). qh/kh/vh: [b, s_local, h, d]."""
+    idx = jax.lax.axis_index(axis_name)
+    s_local = qh.shape[1]
+    b, _, h, d = qh.shape
+
+    m_acc = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    l_acc = jnp.zeros((b, h, s_local), jnp.float32)
+    o_acc = jnp.zeros((b, s_local, h, d), jnp.float32)
+
+    k_blk, v_blk = kh, vh
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    for step in range(sp):
+        # the block we currently hold started at device (idx - step) % sp
+        src = (idx - step) % sp
+        if causal:
+            q_pos = idx * s_local + jnp.arange(s_local)[:, None]
+            k_pos = src * s_local + jnp.arange(s_local)[None, :]
+            mask = q_pos >= k_pos  # [sq, sk]
+        else:
+            mask = None
+        m_b, l_b, pv_b = _block_attend(qh, k_blk, v_blk, scale, mask)
+        m_new = jnp.maximum(m_acc, m_b)
+        c_old = jnp.exp(m_acc - m_new)
+        c_new = jnp.exp(m_b - m_new)
+        l_acc = l_acc * c_old + l_b * c_new
+        o_acc = (
+            o_acc * c_old.transpose(0, 2, 1)[..., None]
+            + pv_b * c_new.transpose(0, 2, 1)[..., None]
+        )
+        m_acc = m_new
+        if step + 1 < sp:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    l_safe = jnp.where(l_acc > 0.0, l_acc, 1.0)
+    out = o_acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(qh.dtype)
+
+
+def ring_attention(
+    qh,
+    kh,
+    vh,
+    mesh: Mesh,
+    seq_axis: str,
+    *,
+    batch_spec=None,
+    head_spec=None,
+    scale: float = 1.0,
+    causal: bool = False,
+):
+    """Sequence-parallel attention on [b, s, h, d] arrays whose s dim is
+    sharded over `seq_axis`.  batch_spec/head_spec name the mesh axes (or
+    None) sharding the batch/head dims, so the shard_map specs match the
+    surrounding SPMD sharding."""
+    sp = mesh.shape[seq_axis]
+    spec = PartitionSpec(batch_spec, seq_axis, head_spec, None)
+    fn = functools.partial(
+        _ring_attention_sharded,
+        axis_name=seq_axis,
+        sp=sp,
+        scale=scale,
+        causal=causal,
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(qh, kh, vh)

@@ -242,12 +242,13 @@ class TpuPodModel(MachineModel):
             return 0.0
         bw = self.dcn_bw if over_dcn else 2.0 * self.ici_bw
         lat = self.dcn_lat if over_dcn else self.ici_lat
-        t = (axis_len - 1) / axis_len * size / bw + (axis_len - 1) * lat
+        t_bw = (axis_len - 1) / axis_len * size / bw
         if not over_dcn:
             # on a ring/torus axis the all-to-all is bisection-bound:
-            # ~axis_len/4 of the traffic crosses the cut links
-            t *= max(1.0, axis_len / 4.0)
-        return t
+            # ~axis_len/4 of the traffic crosses the cut links (scales
+            # the bandwidth term only, not per-hop latency)
+            t_bw *= max(1.0, axis_len / 4.0)
+        return t_bw + (axis_len - 1) * lat
 
 
 def make_machine_model(config, num_devices: int) -> MachineModel:
