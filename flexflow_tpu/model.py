@@ -546,9 +546,15 @@ class FFModel:
             strategy = Strategy.load(cfg.import_strategy_file)
         if strategy is None:
             if cfg.search_budget > 0 and not cfg.only_data_parallel:
-                from .pcg.search import mcmc_search
+                # reference: Unity graph_optimize is the default search
+                # path (GRAPH_OPTIMIZE_TASK_ID, graph.cc:2046); MCMC is
+                # the legacy SysML'19 path (model.cc:3285)
+                from .pcg.search import mcmc_search, unity_search
 
-                strategy = mcmc_search(self, num_devices)
+                if cfg.search_algo == "mcmc":
+                    strategy = mcmc_search(self, num_devices)
+                else:
+                    strategy = unity_search(self, num_devices)
             else:
                 strategy = data_parallel_strategy(num_devices)
         self.strategy = strategy

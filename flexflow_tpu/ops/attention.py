@@ -66,9 +66,11 @@ class MultiHeadAttention(Op):
         if p.num_heads % self.shard.channel != 0:
             raise ShapeError(f"{self.name}: heads {p.num_heads} not divisible by "
                              f"degree {self.shard.channel}")
-        if kd[1].degree != 1 or vd[1].degree != 1:
-            # K/V seq partitioning lowers to ring attention — legal only
+        if qd[1].degree != 1 or kd[1].degree != 1 or vd[1].degree != 1:
+            # Seq partitioning lowers to ring attention — legal only
             # when q/k/v share one seq sharding (self-attention SP).
+            # (Runtime dispatch keys on q's seq degree, so q-only
+            # sharding must be validated here too.)
             if not (qd[1].degree == kd[1].degree == vd[1].degree):
                 raise ShapeError(
                     f"{self.name}: ring attention needs equal q/k/v seq "
@@ -177,6 +179,24 @@ class MultiHeadAttention(Op):
         qdims = [d for d in self.inputs[0].shape.dims if not d.is_replica_dim]
         return qdims[1].degree
 
+    def _view_specs(self):
+        """(batch_spec, seq_axes, head_spec) from the compiled machine
+        views — shared by the ring and flash shard_map paths."""
+        view = self.inputs[0].machine_view
+        qdims_axes = [
+            a for d, a in zip(self.inputs[0].shape.dims, view.axes)
+            if not d.is_replica_dim
+        ] if view is not None else [(), (), ()]
+        head_view = self.weights[0].machine_view
+        head_axes = head_view.axes[1] if head_view is not None else ()
+
+        def spec_of(axes):
+            if not axes:
+                return None
+            return axes[0] if len(axes) == 1 else tuple(axes)
+
+        return spec_of(qdims_axes[0]), qdims_axes[1], spec_of(head_axes)
+
     def _attend(self, qh, kh, vh, scale, *, training, rng):
         p: MultiHeadAttentionParams = self.params
         sp = self._seq_degree()
@@ -185,28 +205,15 @@ class MultiHeadAttention(Op):
             from ..parallel.ring_attention import ring_attention
 
             mesh = getattr(self, "_mesh", None)
-            view = self.inputs[0].machine_view
-            assert mesh is not None and view is not None, (
+            assert mesh is not None and self.inputs[0].machine_view is not None, (
                 f"{self.name}: ring attention needs a compiled mesh/view"
             )
-            qdims_axes = [
-                a for d, a in zip(self.inputs[0].shape.dims, view.axes)
-                if not d.is_replica_dim
-            ]
-            batch_axes, seq_axes = qdims_axes[0], qdims_axes[1]
+            batch_spec, seq_axes, head_spec = self._view_specs()
             assert len(seq_axes) == 1, f"{self.name}: seq dim needs one mesh axis"
-            head_view = self.weights[0].machine_view
-            head_axes = head_view.axes[1] if head_view is not None else ()
-
-            def spec_of(axes):
-                if not axes:
-                    return None
-                return axes[0] if len(axes) == 1 else tuple(axes)
-
             return ring_attention(
                 qh, kh, vh, mesh, seq_axes[0],
-                batch_spec=spec_of(batch_axes),
-                head_spec=spec_of(head_axes),
+                batch_spec=batch_spec,
+                head_spec=head_spec,
                 scale=scale, causal=p.causal,
             )
         kv_appended = kh.shape[1] - self.inputs[1].shape.logical_shape[1]
@@ -250,20 +257,8 @@ class MultiHeadAttention(Op):
         from .pallas.flash_attention import mha_flash
 
         p: MultiHeadAttentionParams = self.params
-        view = self.inputs[0].machine_view
-        qdims_axes = [
-            a for d, a in zip(self.inputs[0].shape.dims, view.axes)
-            if not d.is_replica_dim
-        ] if view is not None else [(), (), ()]
-        head_view = self.weights[0].machine_view
-        head_axes = head_view.axes[1] if head_view is not None else ()
-
-        def spec_of(axes):
-            if not axes:
-                return None
-            return axes[0] if len(axes) == 1 else tuple(axes)
-
-        spec = PartitionSpec(spec_of(qdims_axes[0]), None, spec_of(head_axes), None)
+        batch_spec, _, head_spec = self._view_specs()
+        spec = PartitionSpec(batch_spec, None, head_spec, None)
         fn = functools.partial(mha_flash, scale=scale, causal=p.causal)
         return jax.shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -45,6 +45,7 @@ def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
     """Per-shard body (inside shard_map). qh/kh/vh: [b, s_local, h, d]."""
     idx = jax.lax.axis_index(axis_name)
     s_local = qh.shape[1]
+    k_local = kh.shape[1]  # may differ from s_local (cross-attention)
     b, _, h, d = qh.shape
 
     m_acc = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
@@ -57,8 +58,10 @@ def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
         # the block we currently hold started at device (idx - step) % sp
         src = (idx - step) % sp
         if causal:
+            # absolute-position causality (matches the dense path's
+            # tril over [qlen, klen] global positions)
             q_pos = idx * s_local + jnp.arange(s_local)[:, None]
-            k_pos = src * s_local + jnp.arange(s_local)[None, :]
+            k_pos = src * k_local + jnp.arange(k_local)[None, :]
             mask = q_pos >= k_pos  # [sq, sk]
         else:
             mask = None

@@ -18,6 +18,6 @@ def mcmc_search(model, num_devices: int) -> Strategy:
 
 
 def unity_search(model, num_devices: int) -> Strategy:
-    from .unity import graph_optimize
+    from .unity import unity_optimize
 
-    return graph_optimize(model, num_devices)
+    return unity_optimize(model, num_devices)

@@ -1,0 +1,508 @@
+"""Unity search: substitution-guided DP over graph splits.
+
+Reference: the Unity (OSDI'22) search stack —
+`GraphSearchHelper::graph_optimize` (substitution.cc:1898-1945),
+`generic_sequence_optimize` (DP over sequence splits at bottleneck
+nodes, cached by graph hash, substitution.cc:2430+), `base_optimize`
+(budget-bounded rewrite enumeration :2229-2320), `find_split_node`
+(:2094), the machine-view assignment DP (`SearchHelper`,
+graph.h:170-284 with cached_graph_costs graph.h:280), and the
+memory-aware lambda binary search (graph.cc:2056-2131).
+
+TPU-native redesign.  The reference enumerates PCG rewrites (inserting
+Repartition/Combine/... nodes) and assigns MachineViews by DP.  Here the
+mesh-realizable strategy space is (mesh factorization) x (per-op
+ShardConfig from the xfer catalog), and the DP decomposes the graph at
+single-tensor bottleneck cuts exactly like generic_sequence_optimize:
+
+  * a DP state at a cut is the crossing tensor's ParallelTensorShape
+    (which encodes partition degrees + replica degree — the analogue of
+    the reference's possible_split_output_tensor_shapes);
+  * each segment is evaluated for every (in-state, assignment of xfer
+    options to its ops) with a per-(segment-structure, in-state) cache —
+    so the 12 identical BERT layers are costed once, the analogue of
+    Unity's cached_graph_costs keyed by subgraph hash;
+  * segment cost = sharded compute (roofline/measured OpCostModel)
+    + partial-sum collectives + weight-gradient sync, i.e. the same
+    terms the SPMD simulator charges;
+  * the memory objective enters as `time + lambda * bytes` with the
+    reference's 10-iteration binary search on lambda when the best
+    strategy exceeds the per-device HBM budget.
+
+The outer loop enumerates mesh factorizations (data x model x expert),
+runs the DP for each, and ranks the resulting Strategies with the full
+simulator.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..fftype import OperatorType
+from ..ops.op import Op, ShapeError, ShardConfig
+from ..parallel.machine import assign_axes
+from ..strategy import _PARAM_CLASSES, Strategy, apply_strategy, assign_views
+from ..tensor import ParallelTensor, ParallelTensorShape
+from .graph import Graph
+from .mcmc import _factorizations
+from .substitution import (
+    GraphXfer,
+    XferChoice,
+    generate_all_pcg_xfers,
+    load_substitution_rules,
+    op_options,
+)
+
+_MAX_SEGMENT_ASSIGNMENTS = 4096
+
+
+@dataclasses.dataclass
+class _SegResult:
+    assignment: Tuple[Tuple[int, XferChoice], ...]  # (local op idx, choice)
+    time: float
+    memory: int
+    out_shapes: Tuple[ParallelTensorShape, ...]
+
+
+class UnitySearch:
+    def __init__(
+        self,
+        graph: Graph,
+        num_devices: int,
+        machine,
+        cost_model,
+        xfers: Optional[Sequence[GraphXfer]] = None,
+        enable_parameter_parallel: bool = False,
+        enable_attribute_parallel: bool = False,
+        budget: int = 0,
+        memory_budget: Optional[int] = None,
+        optimizer_slots: int = 2,
+        overlap_fraction: float = 0.3,
+    ):
+        self.graph = graph
+        self.n = num_devices
+        self.machine = machine
+        self.cost_model = cost_model
+        self.xfers = list(xfers) if xfers is not None else generate_all_pcg_xfers()
+        self.enable_parameter_parallel = enable_parameter_parallel
+        self.enable_attribute_parallel = enable_attribute_parallel
+        self.budget = budget  # 0 = unbounded; else cap on segment evaluations
+        self.memory_budget = memory_budget
+        self.optimizer_slots = optimizer_slots
+        self.overlap = overlap_fraction
+        self.evals = 0  # segment-assignment evaluations (budget counter)
+        self.cache_hits = 0
+        # (segment structural sig, in-shapes sig) -> List[_SegResult]
+        self._seg_cache: Dict[Tuple, List[_SegResult]] = {}
+        self._segments_memo = None
+        self._options_memo: Dict[Tuple, Dict[int, List[XferChoice]]] = {}
+        from ..sim.simulator import Simulator
+
+        self._sim = Simulator(machine, cost_model,
+                              overlap_fraction=overlap_fraction,
+                              optimizer_slots=optimizer_slots)
+
+    # ------------------------------------------------------------------
+    # graph splitting (reference find_split_node substitution.cc:2094)
+    # ------------------------------------------------------------------
+    def _segments(self) -> Tuple[List[List[Op]], List[Optional[int]]]:
+        """Split topo order at single-tensor cuts (cached — the graph is
+        immutable for the lifetime of a search).
+
+        Returns (segments, crossing_guid_per_boundary): segment k feeds
+        segment k+1 through exactly one tensor (the bottleneck)."""
+        if self._segments_memo is not None:
+            return self._segments_memo
+        topo = self.graph.topo_order()
+        pos = {op.guid: i for i, op in enumerate(topo)}
+        # last consumer position of each tensor
+        last_use: Dict[int, int] = {}
+        for op in topo:
+            for t in op.inputs:
+                last_use[t.guid] = max(last_use.get(t.guid, -1), pos[op.guid])
+        cuts: List[Tuple[int, int]] = []  # (topo position, crossing tensor guid)
+        for i in range(len(topo) - 1):
+            crossing = [
+                t.guid
+                for j in range(i + 1)
+                for t in topo[j].outputs
+                if last_use.get(t.guid, -1) > i
+            ]
+            if len(crossing) == 1:
+                cuts.append((i, crossing[0]))
+        segments: List[List[Op]] = []
+        boundaries: List[Optional[int]] = []
+        start = 0
+        for i, guid in cuts:
+            segments.append(topo[start : i + 1])
+            boundaries.append(guid)
+            start = i + 1
+        segments.append(topo[start:])
+        boundaries.append(None)
+        self._segments_memo = (segments, boundaries)
+        return self._segments_memo
+
+    # ------------------------------------------------------------------
+    # segment evaluation (reference SearchHelper::graph_cost + simulator)
+    # ------------------------------------------------------------------
+    def _seg_sig(self, seg: List[Op], boundary_in: List[int]) -> Tuple:
+        """Structural signature: identical stacked layers share it."""
+        local = {guid: ("b", k) for k, guid in enumerate(boundary_in)}
+        parts = []
+        for j, op in enumerate(seg):
+            srcs = tuple(local[t.guid] for t in op.inputs)
+            parts.append((op.op_type, op.params, srcs))
+            for oi, t in enumerate(op.outputs):
+                local[t.guid] = ("i", j, oi)
+        return tuple(parts)
+
+    def _comm_time(self, kind: str, size: int, group: int) -> float:
+        from ..sim.machine_model import TpuPodModel
+
+        m = self.machine
+        if isinstance(m, TpuPodModel):
+            if kind == "allreduce":
+                return m.axis_allreduce_time(size, group)
+            return m.axis_allgather_time(size, group)
+        g = list(range(group))
+        if kind == "allreduce":
+            return m.allreduce_time(size, g)
+        return m.allgather_time(size, g)
+
+    def _op_cost(self, op: Op, training: bool = True) -> Tuple[float, int]:
+        """(time, per-device bytes) for one instantiated op — the same
+        terms Simulator.simulate charges per op."""
+        cm = self.cost_model.cost(op)
+        t = cm.forward_time + (cm.backward_time if training else 0.0)
+        comm = 0.0
+        if op.outputs:
+            out_rep = op.outputs[0].shape.replica_degree
+            in_rep = max((x.shape.replica_degree for x in op.inputs), default=1)
+            if out_rep > in_rep:  # contraction-dim partials -> psum
+                k = out_rep // max(1, in_rep)
+                c = self._comm_time("allreduce", op.outputs[0].shape.shard_bytes(), k)
+                comm += 2.0 * c if training else c
+        mem = 0
+        for w in op.weights:
+            rep = w.shape.replica_degree
+            if training and rep > 1 and w.create_gradients:
+                comm += self._comm_time("allreduce", w.shape.shard_bytes(), rep)
+            mem += w.shape.shard_bytes() * ((2 + self.optimizer_slots) if training else 1)
+        for o in op.outputs:
+            mem += o.shape.shard_bytes()
+        return t + comm * (1.0 - self.overlap), mem
+
+    def _realizable(self, shapes, mesh_axes: Dict[str, int]) -> bool:
+        """Every shape's degrees must factor onto the mesh axes — the
+        reference's get_valid_machine_views filter (graph.h:205-210)."""
+        try:
+            for s in shapes:
+                assign_axes(s, mesh_axes)
+            return True
+        except ValueError:
+            return False
+
+    def _chain_apply(
+        self, shape: ParallelTensorShape, chain, mesh_axes: Dict[str, int],
+        training: bool,
+    ) -> Tuple[ParallelTensorShape, float]:
+        """Propagate + cost a parallel-op chain on an output tensor."""
+        from ..parallel.parallel_op import PARALLEL_OP_KINDS
+
+        time = 0.0
+        for kind, items in chain:
+            params = _PARAM_CLASSES[kind](**dict(items))
+            pop = PARALLEL_OP_KINDS[kind](params, [ParallelTensor(shape)])
+            c = self._sim.xfer_cost(pop, mesh_axes)
+            time += (2.0 * c if training else c) * (1.0 - self.overlap)
+            shape = pop.outputs[0].shape
+        return shape, time
+
+    def _options_by_op(self, mesh_axes: Dict[str, int]) -> Dict[int, List[XferChoice]]:
+        key = tuple(sorted(mesh_axes.items()))
+        memo = self._options_memo.get(key)
+        if memo is not None:
+            return memo
+        out = {}
+        for op in self.graph.ops:
+            opts = op_options(
+                op, mesh_axes, self.xfers,
+                self.enable_parameter_parallel, self.enable_attribute_parallel,
+            )
+            if len(opts) > 1:
+                out[op.guid] = opts
+        self._options_memo[key] = out
+        return out
+
+    def _enumerate_assignments(
+        self, seg: List[Op], options: Dict[int, List[XferChoice]]
+    ) -> List[Tuple[Tuple[int, XferChoice], ...]]:
+        cand = [(j, options[op.guid]) for j, op in enumerate(seg) if op.guid in options]
+        if not cand:
+            return [()]
+        total = 1
+        for _, opts in cand:
+            total *= len(opts)
+        if total > _MAX_SEGMENT_ASSIGNMENTS:
+            # group identical (type, params) ops: uniform choice per group
+            groups: Dict[Tuple, List[int]] = {}
+            for j, _ in cand:
+                key = (seg[j].op_type, seg[j].params)
+                groups.setdefault(key, []).append(j)
+            gkeys = list(groups)
+            gopts = [options[seg[groups[k][0]].guid] for k in gkeys]
+            out = []
+            for combo in itertools.product(*gopts):
+                a = []
+                for k, cfg in zip(gkeys, combo):
+                    a.extend((j, cfg) for j in groups[k])
+                out.append(tuple(a))
+            return out
+        return [
+            tuple(zip((j for j, _ in cand), combo))
+            for combo in itertools.product(*(opts for _, opts in cand))
+        ]
+
+    def _eval_segment(
+        self,
+        seg: List[Op],
+        boundary_in: List[int],  # guids of tensors entering the segment
+        in_shapes: Tuple[ParallelTensorShape, ...],
+        out_guids: List[int],  # guids of tensors leaving the segment
+        options: Dict[int, List[ShardConfig]],
+        input_dp: int,
+        axes_sig: Tuple,
+    ) -> List[_SegResult]:
+        sig = (self._seg_sig(seg, boundary_in), in_shapes, input_dp, axes_sig)
+        cached = self._seg_cache.get(sig)
+        if cached is not None:
+            self.cache_hits += 1
+            return cached
+        mesh_axes = dict(axes_sig)
+        results: List[_SegResult] = []
+        shape_in = dict(zip(boundary_in, in_shapes))
+        for assignment in self._enumerate_assignments(seg, options):
+            if self.budget and self.evals >= self.budget:
+                if results:
+                    break
+            self.evals += 1
+            choice_of = dict(assignment)
+            shapes: Dict[int, ParallelTensorShape] = dict(shape_in)
+            time = 0.0
+            mem = 0
+            ok = True
+            for j, op in enumerate(seg):
+                if op.op_type == OperatorType.INPUT:
+                    s = op.outputs[0].shape
+                    if input_dp > 1:
+                        if s.logical_shape and s.logical_shape[0] % input_dp == 0:
+                            s = s.data_parallel(input_dp)
+                        else:
+                            ok = False
+                            break
+                    shapes[op.outputs[0].guid] = s
+                    continue
+                choice = choice_of.get(j, XferChoice())
+                try:
+                    new_inputs = [ParallelTensor(shapes[t.guid]) for t in op.inputs]
+                    new_op = type(op)(
+                        op.params, new_inputs, name=op.name, shard=choice.shard,
+                    )
+                except (ShapeError, ValueError):
+                    ok = False
+                    break
+                out_shapes = [pt.shape for pt in new_op.outputs]
+                chain_time = 0.0
+                if choice.out_chain:
+                    try:
+                        out_shapes[0], chain_time = self._chain_apply(
+                            out_shapes[0], choice.out_chain, mesh_axes, True
+                        )
+                    except (ShapeError, ValueError):
+                        ok = False
+                        break
+                if not self._realizable(
+                    out_shapes + [w.shape for w in new_op.weights], mesh_axes
+                ):
+                    ok = False
+                    break
+                t, m = self._op_cost(new_op)
+                time += t + chain_time
+                mem += m
+                for pt, s in zip(op.outputs, out_shapes):
+                    shapes[pt.guid] = s
+            if not ok:
+                continue
+            results.append(
+                _SegResult(
+                    assignment=assignment,
+                    time=time,
+                    memory=mem,
+                    out_shapes=tuple(shapes[g] for g in out_guids),
+                )
+            )
+        self._seg_cache[sig] = results
+        return results
+
+    # ------------------------------------------------------------------
+    # sequence DP (reference generic_sequence_optimize substitution.cc:2430)
+    # ------------------------------------------------------------------
+    def _dp(self, mesh_axes: Dict[str, int], dp_degree: int,
+            lam: float) -> Optional[Tuple[Dict[str, ShardConfig], Dict, float, int]]:
+        options = self._options_by_op(mesh_axes)
+        axes_sig = tuple(sorted(mesh_axes.items()))
+        segments, boundaries = self._segments()
+        # states: in-shapes tuple -> (objective, time, mem,
+        #         {opname: ShardConfig}, {tensor name: edge chain})
+        states: Dict[Tuple, Tuple] = {(): (0.0, 0.0, 0, {}, {})}
+        incoming: List[int] = []  # guids crossing into current segment
+        for seg, out_guid in zip(segments, boundaries):
+            out_guids = [out_guid] if out_guid is not None else []
+            new_states: Dict[Tuple, Tuple] = {}
+            for in_shapes, (obj0, t0, m0, asg0, edges0) in states.items():
+                for res in self._eval_segment(
+                    seg, incoming, in_shapes, out_guids, options, dp_degree,
+                    axes_sig,
+                ):
+                    obj = obj0 + res.time + lam * res.memory
+                    key = res.out_shapes
+                    cur = new_states.get(key)
+                    if cur is None or obj < cur[0]:
+                        asg = dict(asg0)
+                        edges = dict(edges0)
+                        for j, choice in res.assignment:
+                            if not choice.shard.is_trivial():
+                                asg[seg[j].name] = choice.shard
+                            if choice.out_chain:
+                                edges[seg[j].outputs[0].name] = (
+                                    choice.chain_as_lists()
+                                )
+                        new_states[key] = (
+                            obj, t0 + res.time, m0 + res.memory, asg, edges
+                        )
+            if not new_states:
+                return None
+            states = new_states
+            incoming = out_guids
+        best = min(states.values(), key=lambda v: v[0])
+        return best[3], best[4], best[1], best[2]
+
+    # ------------------------------------------------------------------
+    # top level (reference graph_optimize_task graph.cc:2046-2160)
+    # ------------------------------------------------------------------
+    def _mesh_axes(self, dp: int, tp: int, ep: int) -> Dict[str, int]:
+        axes = {}
+        if dp > 1:
+            axes["data"] = dp
+        if tp > 1:
+            axes["model"] = tp
+        if ep > 1:
+            axes["expert"] = ep
+        if not axes:
+            axes["data"] = 1
+        return axes
+
+    def _build_strategy(self, mesh_axes: Dict[str, int], dp: int,
+                        shard_configs: Dict[str, ShardConfig],
+                        edges: Optional[Dict] = None) -> Strategy:
+        s = Strategy(mesh_axes=mesh_axes, shard_configs=dict(shard_configs))
+        if dp > 1:
+            s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
+        for tname, chain in (edges or {}).items():
+            s.edge_ops[tname] = chain
+        return s
+
+    def optimize(self, lam: float = 0.0) -> Optional[Strategy]:
+        has_moe = any(op.op_type == OperatorType.GROUP_BY for op in self.graph.ops)
+        best: Optional[Strategy] = None
+        best_obj = math.inf
+        for dp, tp, ep in _factorizations(self.n):
+            if ep > 1 and not has_moe:
+                continue
+            mesh_axes = self._mesh_axes(dp, tp, ep)
+            if tp > 1 and not self._options_by_op(mesh_axes):
+                continue  # no op can use the model axis
+            r = self._dp(mesh_axes, dp, lam)
+            if r is None:
+                continue
+            shard_configs, edges, time, mem = r
+            strategy = self._build_strategy(mesh_axes, dp, shard_configs, edges)
+            # validate + final rank with the strategy actually applied
+            try:
+                g = apply_strategy(self.graph, strategy)
+                assign_views(g, strategy.mesh_axes)
+            except (ShapeError, ValueError):
+                continue
+            obj = time + lam * mem
+            if self.memory_budget is not None and lam == 0.0 and mem > self.memory_budget:
+                obj *= 1.0 + (mem / self.memory_budget - 1.0)
+            if obj < best_obj:
+                best, best_obj = strategy, obj
+        return best
+
+    def optimize_with_memory(self) -> Optional[Strategy]:
+        """Lambda binary search (reference try_one_lambda + binary search,
+        graph.cc:2056-2131): smallest lambda whose best strategy fits the
+        per-device memory budget, 10 iterations."""
+        best = self.optimize(0.0)
+        if best is None or self.memory_budget is None:
+            return best
+        if self._strategy_memory(best) <= self.memory_budget:
+            return best
+        lo, hi = 0.0, self._lambda_hi()
+        chosen = best
+        for _ in range(10):
+            mid = (lo + hi) / 2.0
+            cand = self.optimize(mid)
+            if cand is not None and self._strategy_memory(cand) <= self.memory_budget:
+                chosen, hi = cand, mid
+            else:
+                lo = mid
+        return chosen
+
+    def _lambda_hi(self) -> float:
+        # scale so the memory term can dominate: time-per-byte at HBM speed
+        dev = self.machine.device()
+        return 100.0 / dev.hbm_bandwidth
+
+    def _strategy_memory(self, strategy: Strategy) -> int:
+        from ..sim.simulator import Simulator
+
+        g = apply_strategy(self.graph, strategy)
+        assign_views(g, strategy.mesh_axes)
+        sim = Simulator(self.machine, self.cost_model,
+                        optimizer_slots=self.optimizer_slots)
+        return sim.per_device_memory(g, training=True)
+
+
+def unity_optimize(model, num_devices: int) -> Strategy:
+    """Entry used by FFModel.compile (reference GRAPH_OPTIMIZE_TASK_ID ->
+    Graph::graph_optimize_task graph.cc:2046)."""
+    from ..sim.machine_model import make_machine_model
+    from ..sim.simulator import OpCostModel, Simulator
+
+    cfg = model.config
+    machine = make_machine_model(cfg, num_devices)
+    cost_model = OpCostModel(machine)
+    xfers = generate_all_pcg_xfers()
+    if cfg.substitution_json:
+        xfers = xfers + load_substitution_rules(cfg.substitution_json)
+    search = UnitySearch(
+        model.layers,
+        num_devices,
+        machine,
+        cost_model,
+        xfers=xfers,
+        enable_parameter_parallel=cfg.enable_parameter_parallel,
+        enable_attribute_parallel=cfg.enable_attribute_parallel,
+        budget=max(0, cfg.search_budget),
+        memory_budget=cfg.memory_per_device if cfg.memory_search else None,
+    )
+    best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
+    if best is None:
+        from ..strategy import data_parallel_strategy
+
+        return data_parallel_strategy(num_devices)
+    return best

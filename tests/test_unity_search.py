@@ -1,0 +1,222 @@
+"""Unity substitution-DP search tests.
+
+SURVEY §4 notes the reference never tests its search in isolation
+(exercised only via osdi22ae scripts); we test it hermetically —
+including a brute-force property check on a tiny graph (SURVEY §7 hard
+part 1 calls for exactly this).
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.fftype import ActiMode, LossType, OperatorType
+from flexflow_tpu.ops.op import ShardConfig
+from flexflow_tpu.pcg.substitution import (
+    generate_all_pcg_xfers,
+    load_substitution_rules,
+    op_options,
+)
+from flexflow_tpu.pcg.unity import UnitySearch
+from flexflow_tpu.sim.machine_model import TpuPodModel
+from flexflow_tpu.sim.simulator import OpCostModel, Simulator
+from flexflow_tpu.strategy import Strategy, apply_strategy, assign_views
+
+
+def build_mlp(hidden=2048, batch=64, layers=2):
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor([batch, hidden], name="x")
+    t = x
+    for i in range(layers):
+        t = ff.dense(t, hidden, activation=ActiMode.RELU, name=f"fc{i}")
+    return ff
+
+
+def build_transformer(batch=8, seq=32, hidden=64, layers=4, heads=4):
+    from flexflow_tpu.models.transformer import build_bert
+
+    ff = FFModel(FFConfig(batch_size=batch))
+    build_bert(ff, batch_size=batch, seq_length=seq, hidden_size=hidden,
+               num_layers=layers, num_heads=heads, intermediate_size=hidden * 4)
+    return ff
+
+
+def make_search(ff, n, **kw):
+    machine = TpuPodModel(topology=(n,))
+    cm = OpCostModel(machine)
+    return UnitySearch(ff.layers, n, machine, cm, **kw), machine, cm
+
+
+# ---------------------------------------------------------------------------
+# xfer catalog
+# ---------------------------------------------------------------------------
+
+def test_xfer_catalog_options():
+    ff = build_mlp(hidden=64, batch=8)
+    xfers = generate_all_pcg_xfers()
+    fc0 = next(op for op in ff.layers.ops if op.name == "fc0")
+    opts = op_options(fc0, {"data": 2, "model": 2}, xfers)
+    shards = [c.shard for c in opts]
+    assert ShardConfig() in shards
+    assert ShardConfig(channel=2) in shards
+    # channel comes in both keep-sharded and +combine variants
+    # (create_partition_linear_combine's trailing Combine)
+    chained = [c for c in opts if c.shard == ShardConfig(channel=2) and c.out_chain]
+    assert chained and chained[0].out_chain[0][0] == "combine"
+    # reduction gated behind enable_parameter_parallel
+    assert ShardConfig(reduction=2) not in shards
+    opts_pp = op_options(fc0, {"model": 2}, xfers, enable_parameter_parallel=True)
+    assert ShardConfig(reduction=2) in [c.shard for c in opts_pp]
+
+
+def test_substitution_json_loader(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(
+        '{"rules": [{"name": "my_rule", "op_type": "linear", "kind": "channel"}]}'
+    )
+    rules = load_substitution_rules(str(p))
+    assert len(rules) == 1
+    assert rules[0].op_type == OperatorType.LINEAR
+    with pytest.raises(ValueError):
+        p2 = tmp_path / "bad.json"
+        p2.write_text('{"rules": [{"op_type": "nope", "kind": "channel"}]}')
+        load_substitution_rules(str(p2))
+
+
+# ---------------------------------------------------------------------------
+# graph splitting
+# ---------------------------------------------------------------------------
+
+def test_segments_split_at_bottlenecks():
+    ff = build_mlp(layers=4)
+    search, _, _ = make_search(ff, 4)
+    segments, boundaries = search._segments()
+    # chain graph: every op boundary is a single-tensor cut
+    assert len(segments) >= 4
+    assert boundaries[-1] is None
+    for b in boundaries[:-1]:
+        assert b is not None
+
+
+def test_transformer_layer_segments_share_cache():
+    ff = build_transformer(layers=4)
+    search, _, _ = make_search(ff, 4)
+    s = search.optimize()
+    assert s is not None
+    # identical stacked layers must hit the segment cache (Unity's
+    # cached_graph_costs trick) — strictly fewer evals than a no-cache run
+    assert search.cache_hits > 0
+
+
+# ---------------------------------------------------------------------------
+# search quality
+# ---------------------------------------------------------------------------
+
+def simulate_strategy(ff, strategy, machine, cost_model):
+    g = apply_strategy(ff.layers, strategy)
+    assign_views(g, strategy.mesh_axes)
+    sim = Simulator(machine, cost_model)
+    return sim.simulate(g, strategy.mesh_axes, training=True)
+
+
+def test_unity_beats_or_matches_data_parallel():
+    # small batch + big weights: pure DP pays a huge grad allreduce, so
+    # the search should find a better hybrid (or at worst match DP)
+    ff = build_mlp(hidden=4096, batch=8)
+    search, machine, cm = make_search(ff, 8)
+    best = search.optimize()
+    assert best is not None
+    from flexflow_tpu.strategy import data_parallel_strategy
+
+    t_best = simulate_strategy(ff, best, machine, cm).total_time
+    t_dp = simulate_strategy(ff, data_parallel_strategy(8), machine, cm).total_time
+    assert t_best <= t_dp * 1.001
+
+
+def test_unity_brute_force_property():
+    """DP result must match exhaustive enumeration over the same space
+    on a tiny chain graph (fixed mesh factorization)."""
+    ff = build_mlp(hidden=256, batch=16, layers=3)
+    n = 4
+    search, machine, cm = make_search(ff, n)
+    best = search.optimize()
+    assert best is not None
+    t_best = simulate_strategy(ff, best, machine, cm).total_time
+
+    # brute force: same factorizations x per-op channel options
+    xfers = generate_all_pcg_xfers()
+    from flexflow_tpu.pcg.mcmc import _factorizations
+
+    t_min, s_min = np.inf, None
+    for dp, tp, ep in _factorizations(n):
+        if ep > 1:
+            continue
+        mesh_axes = {}
+        if dp > 1:
+            mesh_axes["data"] = dp
+        if tp > 1:
+            mesh_axes["model"] = tp
+        if not mesh_axes:
+            mesh_axes["data"] = 1
+        cand_ops = [op for op in ff.layers.ops
+                    if len(op_options(op, mesh_axes, xfers)) > 1]
+        opt_lists = [op_options(op, mesh_axes, xfers) for op in cand_ops]
+        for combo in itertools.product(*opt_lists) if opt_lists else [()]:
+            s = Strategy(mesh_axes=dict(mesh_axes))
+            if dp > 1:
+                s.edge_ops["__inputs__"] = [
+                    ("repartition", {"dim": 0, "degree": dp})
+                ]
+            for op, choice in zip(cand_ops, combo):
+                if not choice.shard.is_trivial():
+                    s.shard_configs[op.name] = choice.shard
+                if choice.out_chain:
+                    s.edge_ops[op.outputs[0].name] = choice.chain_as_lists()
+            try:
+                t = simulate_strategy(ff, s, machine, cm).total_time
+            except (ValueError,):
+                continue
+            if t < t_min:
+                t_min, s_min = t, s
+    # the DP space and cost decomposition differ slightly from the full
+    # simulator (overlap credit applied per-op vs globally), so allow 5%
+    assert t_best <= t_min * 1.05
+
+
+def test_unity_memory_lambda_search():
+    ff = build_mlp(hidden=2048, batch=64)
+    search, machine, cm = make_search(ff, 8)
+    free = search.optimize()
+    assert free is not None
+    mem_free = search._strategy_memory(free)
+    # force a budget below the unconstrained strategy's footprint
+    search2, _, _ = make_search(ff, 8)
+    search2.memory_budget = max(1, mem_free // 2)
+    constrained = search2.optimize_with_memory()
+    assert constrained is not None
+    # binary search should find a strategy within budget when one exists,
+    # or at least not a worse-memory one than unconstrained
+    assert search2._strategy_memory(constrained) <= mem_free
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: compile with unity search and train a step
+# ---------------------------------------------------------------------------
+
+def test_compile_with_unity_search_runs(devices8):
+    import jax
+
+    batch = 16
+    ff = build_mlp(hidden=64, batch=batch, layers=2)
+    # classifier head so sparse CE works
+    head = ff.dense(ff.layers.sink_op().outputs[0], 4, name="head")
+    ff.config.search_budget = 50
+    ff.config.num_devices = 8
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devices8)
+    assert ff.strategy is not None
+    x = np.random.RandomState(0).randn(batch, 64).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, batch).astype(np.int32)
+    m = ff.train_step({"x": x}, y)
+    assert np.isfinite(float(m["loss"]))
