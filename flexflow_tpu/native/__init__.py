@@ -1,0 +1,65 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference implements its runtime core in C++ (simulator event loop
+src/runtime/simulator.cc, dataloader python/flexflow_dataloader.cc);
+this package holds the TPU-native equivalents.  The shared library is
+(re)built on demand with the in-tree Makefile — `g++` is assumed (no
+pip deps); when the toolchain or build is unavailable every consumer
+falls back to a pure-Python implementation with identical semantics.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libffnative.so")
+_lock = threading.Lock()
+_lib = None
+_load_attempted = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for f in os.listdir(_DIR):
+        if f.endswith((".cc", ".h")) and os.path.getmtime(
+            os.path.join(_DIR, f)
+        ) > lib_mtime:
+            return True
+    return False
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(
+            ["make", "-C", _DIR],
+            capture_output=True, text=True, timeout=120,
+        )
+        return r.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it first if stale; None if
+    unavailable (consumers must fall back to Python)."""
+    global _lib, _load_attempted
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if _needs_build() and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            if lib.ffsim_abi_version() != 1:
+                return None
+            _lib = lib
+        except OSError:
+            return None
+        return _lib
