@@ -1,0 +1,8 @@
+"""ONNX frontend (gated on the `onnx` package).
+
+Reference: python/flexflow/onnx/model.py (375 LoC) — a protobuf walk
+lowering ONNX nodes to FFModel layer calls.
+"""
+from .model import ONNXModel, onnx_to_flexflow
+
+__all__ = ["ONNXModel", "onnx_to_flexflow"]

@@ -1,0 +1,211 @@
+"""ONNX graph -> FFModel importer.
+
+Reference: python/flexflow/onnx/model.py — `ONNXModel.apply` walks the
+onnx protobuf graph and dispatches per node.op_type (handle_conv,
+handle_gemm/handle_matmul, handle_relu, handle_maxpool, handle_concat,
+handle_flatten, handle_add, ...).  Same design here: one handler per
+op_type string; initializer tensors become weights copied in after
+compile.  Gated: raises ImportError at construction when the `onnx`
+package is absent (it is not baked into this image — export models via
+the torch frontend instead).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..fftype import ActiMode
+from ..model import FFModel
+from ..tensor import ParallelTensor
+
+
+def _attrs(node) -> Dict[str, object]:
+    import onnx
+
+    out = {}
+    for a in node.attribute:
+        out[a.name] = onnx.helper.get_attribute_value(a)
+    return out
+
+
+class ONNXModel:
+    def __init__(self, path_or_model):
+        try:
+            import onnx
+        except ImportError as e:  # pragma: no cover - onnx not in image
+            raise ImportError(
+                "the onnx package is required for the ONNX frontend; "
+                "this image does not bake it in — use the torch.fx "
+                "frontend (flexflow_tpu.torch_frontend) instead"
+            ) from e
+        if isinstance(path_or_model, (str, bytes)):
+            self.model = onnx.load(path_or_model)
+        else:
+            self.model = path_or_model
+        self.graph = self.model.graph
+        self.initializers: Dict[str, np.ndarray] = {}
+        import onnx.numpy_helper
+
+        for init in self.graph.initializer:
+            self.initializers[init.name] = onnx.numpy_helper.to_array(init)
+        self._weight_of_op: Dict[str, Dict[str, np.ndarray]] = {}
+
+    # ------------------------------------------------------------------
+    def apply(self, ff: FFModel,
+              inputs: Sequence[ParallelTensor]) -> List[ParallelTensor]:
+        env: Dict[str, object] = {}
+        graph_inputs = [
+            i for i in self.graph.input if i.name not in self.initializers
+        ]
+        for gi, t in zip(graph_inputs, inputs):
+            env[gi.name] = t
+        for name, arr in self.initializers.items():
+            env[name] = arr
+
+        for node in self.graph.node:
+            handler = getattr(self, f"_handle_{node.op_type.lower()}", None)
+            if handler is None:
+                raise ValueError(f"unsupported ONNX op: {node.op_type}")
+            outs = handler(ff, node, env)
+            if not isinstance(outs, (tuple, list)):
+                outs = [outs]
+            for oname, val in zip(node.output, outs):
+                env[oname] = val
+        return [env[o.name] for o in self.graph.output]
+
+    def copy_weights(self, ff: FFModel):
+        weights = ff.get_weights()
+        for op_name, entry in self._weight_of_op.items():
+            if op_name in weights:
+                for k, v in entry.items():
+                    weights[op_name][k] = v
+        ff.set_weights(weights)
+
+    # -- handlers (reference handle_* methods) ---------------------------
+    def _handle_gemm(self, ff, node, env):
+        x = env[node.input[0]]
+        w = env[node.input[1]]  # [out, in] (transB=1 convention)
+        at = _attrs(node)
+        if not at.get("transB", 0):
+            w = w.T
+        out_dim = w.shape[0]
+        use_bias = len(node.input) > 2
+        name = node.name or f"gemm_{node.output[0]}"
+        out = ff.dense(x, out_dim, use_bias=use_bias, name=name)
+        entry = {"kernel": np.ascontiguousarray(w.T)}
+        if use_bias:
+            entry["bias"] = np.asarray(env[node.input[2]])
+        self._weight_of_op[name] = entry
+        return out
+
+    def _handle_matmul(self, ff, node, env):
+        x = env[node.input[0]]
+        w = env[node.input[1]]
+        if isinstance(w, np.ndarray):  # weight matmul == dense, [in, out]
+            name = node.name or f"matmul_{node.output[0]}"
+            out = ff.dense(x, w.shape[1], use_bias=False, name=name)
+            self._weight_of_op[name] = {"kernel": np.ascontiguousarray(w)}
+            return out
+        return ff.batch_matmul(x, w, name=node.name or None)
+
+    def _handle_conv(self, ff, node, env):
+        x = env[node.input[0]]
+        w = env[node.input[1]]  # OIHW
+        at = _attrs(node)
+        kh, kw = at.get("kernel_shape", w.shape[2:4])
+        sh, sw = at.get("strides", [1, 1])
+        pads = at.get("pads", [0, 0, 0, 0])
+        groups = at.get("group", 1)
+        use_bias = len(node.input) > 2
+        name = node.name or f"conv_{node.output[0]}"
+        out = ff.conv2d(x, w.shape[0], kh, kw, sh, sw, pads[0], pads[1],
+                        groups=groups, use_bias=use_bias, name=name)
+        entry = {"kernel": np.asarray(w)}
+        if use_bias:
+            entry["bias"] = np.asarray(env[node.input[2]])
+        self._weight_of_op[name] = entry
+        return out
+
+    def _handle_maxpool(self, ff, node, env):
+        at = _attrs(node)
+        kh, kw = at["kernel_shape"]
+        sh, sw = at.get("strides", [1, 1])
+        pads = at.get("pads", [0, 0, 0, 0])
+        return ff.pool2d(env[node.input[0]], kh, kw, sh, sw, pads[0], pads[1],
+                         pool_type="max", name=node.name or None)
+
+    def _handle_averagepool(self, ff, node, env):
+        at = _attrs(node)
+        kh, kw = at["kernel_shape"]
+        sh, sw = at.get("strides", [1, 1])
+        pads = at.get("pads", [0, 0, 0, 0])
+        return ff.pool2d(env[node.input[0]], kh, kw, sh, sw, pads[0], pads[1],
+                         pool_type="avg", name=node.name or None)
+
+    def _handle_relu(self, ff, node, env):
+        return ff.relu(env[node.input[0]], name=node.name or None)
+
+    def _handle_sigmoid(self, ff, node, env):
+        return ff.sigmoid(env[node.input[0]], name=node.name or None)
+
+    def _handle_tanh(self, ff, node, env):
+        return ff.tanh(env[node.input[0]], name=node.name or None)
+
+    def _handle_softmax(self, ff, node, env):
+        at = _attrs(node)
+        return ff.softmax(env[node.input[0]], axis=at.get("axis", -1),
+                          name=node.name or None)
+
+    def _handle_add(self, ff, node, env):
+        return ff.add(env[node.input[0]], env[node.input[1]],
+                      name=node.name or None)
+
+    def _handle_sub(self, ff, node, env):
+        return ff.subtract(env[node.input[0]], env[node.input[1]],
+                           name=node.name or None)
+
+    def _handle_mul(self, ff, node, env):
+        return ff.multiply(env[node.input[0]], env[node.input[1]],
+                           name=node.name or None)
+
+    def _handle_concat(self, ff, node, env):
+        at = _attrs(node)
+        return ff.concat([env[i] for i in node.input], at.get("axis", 0),
+                         name=node.name or None)
+
+    def _handle_split(self, ff, node, env):
+        at = _attrs(node)
+        sizes = at.get("split")
+        if sizes is None:
+            sizes = len(node.output)
+        return ff.split(env[node.input[0]], list(sizes)
+                        if not isinstance(sizes, int) else sizes,
+                        at.get("axis", 0), name=node.name or None)
+
+    def _handle_flatten(self, ff, node, env):
+        return ff.flat(env[node.input[0]], name=node.name or None)
+
+    def _handle_reshape(self, ff, node, env):
+        shape = env[node.input[1]]
+        return ff.reshape(env[node.input[0]], [int(s) for s in shape],
+                          name=node.name or None)
+
+    def _handle_transpose(self, ff, node, env):
+        at = _attrs(node)
+        return ff.transpose(env[node.input[0]], list(at["perm"]),
+                            name=node.name or None)
+
+    def _handle_dropout(self, ff, node, env):
+        at = _attrs(node)
+        return ff.dropout(env[node.input[0]], at.get("ratio", 0.5),
+                          name=node.name or None)
+
+    def _handle_identity(self, ff, node, env):
+        return env[node.input[0]]
+
+
+def onnx_to_flexflow(path_or_model, ff: FFModel,
+                     inputs: Sequence[ParallelTensor]):
+    m = ONNXModel(path_or_model)
+    return m, m.apply(ff, inputs)

@@ -1,0 +1,11 @@
+"""PyTorch frontend: torch.fx symbolic trace -> FFModel lowering.
+
+Reference: python/flexflow/torch/model.py (2656 LoC) — ~60 fx Node
+classes each with a `to_ff` lowering (:248-2441) plus a string-IR file
+format (:2442+).  Here the trace lowers directly to FFModel layer calls
+(no intermediate file), and module weights can be copied into the
+compiled model for exact numerical parity with the torch original.
+"""
+from .model import PyTorchModel, torch_to_flexflow
+
+__all__ = ["PyTorchModel", "torch_to_flexflow"]

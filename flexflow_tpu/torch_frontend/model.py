@@ -1,0 +1,349 @@
+"""torch.fx -> FFModel importer.
+
+Reference: python/flexflow/torch/model.py — `PyTorchModel` traces an
+nn.Module with a customed fx tracer and lowers every fx node through a
+per-op Node subclass's `to_ff` (LinearNode.to_ff at model.py:285, ~60
+node kinds).  TPU-native redesign: one dispatch table lowering fx nodes
+straight to FFModel layer-API calls; weights transfer via
+`copy_weights` after compile (torch Linear stores [out, in] — ours is
+[in, out], transposed on the way in).
+"""
+from __future__ import annotations
+
+import operator
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..fftype import ActiMode, DataType
+from ..model import FFModel
+from ..tensor import ParallelTensor
+
+try:
+    import torch
+    import torch.fx
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    HAS_TORCH = True
+except ImportError:  # pragma: no cover - torch is baked into this image
+    HAS_TORCH = False
+
+
+def _act_of(module) -> ActiMode:
+    import torch.nn as nn
+
+    if isinstance(module, nn.ReLU):
+        return ActiMode.RELU
+    if isinstance(module, nn.GELU):
+        return ActiMode.GELU
+    if isinstance(module, nn.Sigmoid):
+        return ActiMode.SIGMOID
+    if isinstance(module, nn.Tanh):
+        return ActiMode.TANH
+    raise ValueError(f"unsupported activation module {module}")
+
+
+class PyTorchModel:
+    """Wraps an nn.Module for lowering into an FFModel.
+
+    Usage (mirrors the reference README.md:17-22 flow):
+        pt = PyTorchModel(torch_module)
+        out = pt.torch_to_ff(ffmodel, [input_tensor, ...])
+        ffmodel.compile(...)
+        pt.copy_weights(ffmodel)   # optional: exact torch parity
+    """
+
+    def __init__(self, module, seq_length: Optional[int] = None):
+        assert HAS_TORCH, "torch is required for the PyTorch frontend"
+        self.module = module
+        self.seq_length = seq_length
+        self.traced = torch.fx.symbolic_trace(module)
+        # fx node name -> ff op name (for weight copy)
+        self._op_of_node: Dict[str, str] = {}
+
+    # ------------------------------------------------------------------
+    def torch_to_ff(
+        self, ff: FFModel, inputs: Sequence[ParallelTensor]
+    ) -> List[ParallelTensor]:
+        env: Dict[str, object] = {}
+        input_iter = iter(inputs)
+        outputs: List[ParallelTensor] = []
+        modules = dict(self.traced.named_modules())
+
+        for node in self.traced.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = next(input_iter)
+            elif node.op == "get_attr":
+                env[node.name] = _fetch_attr(self.module, node.target)
+            elif node.op == "call_module":
+                env[node.name] = self._lower_module(
+                    ff, node, modules[node.target], env
+                )
+            elif node.op == "call_function":
+                env[node.name] = self._lower_function(ff, node, env)
+            elif node.op == "call_method":
+                env[node.name] = self._lower_method(ff, node, env)
+            elif node.op == "output":
+                args = node.args[0]
+                if isinstance(args, (tuple, list)):
+                    outputs.extend(env[a.name] for a in args)
+                else:
+                    outputs.append(env[args.name])
+        return outputs
+
+    # ------------------------------------------------------------------
+    # call_module lowerings (reference model.py:248-1200 module nodes)
+    # ------------------------------------------------------------------
+    def _lower_module(self, ff: FFModel, node, m, env):
+        a = [env[x.name] if isinstance(x, torch.fx.Node) else x
+             for x in node.args]
+        name = node.name
+        if isinstance(m, nn.Linear):
+            out = ff.dense(a[0], m.out_features, use_bias=m.bias is not None,
+                           name=name)
+            self._op_of_node[node.name] = name
+            return out
+        if isinstance(m, nn.Conv2d):
+            assert m.padding_mode == "zeros"
+            pad = m.padding if isinstance(m.padding, tuple) else (m.padding, m.padding)
+            out = ff.conv2d(
+                a[0], m.out_channels, m.kernel_size[0], m.kernel_size[1],
+                m.stride[0], m.stride[1], pad[0], pad[1],
+                groups=m.groups, use_bias=m.bias is not None, name=name,
+            )
+            self._op_of_node[node.name] = name
+            return out
+        if isinstance(m, nn.MaxPool2d):
+            k = m.kernel_size if isinstance(m.kernel_size, tuple) else (m.kernel_size,) * 2
+            s = m.stride if isinstance(m.stride, tuple) else (m.stride or m.kernel_size,) * 2
+            p = m.padding if isinstance(m.padding, tuple) else (m.padding,) * 2
+            return ff.pool2d(a[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                             pool_type="max", name=name)
+        if isinstance(m, nn.AvgPool2d):
+            k = m.kernel_size if isinstance(m.kernel_size, tuple) else (m.kernel_size,) * 2
+            s = m.stride if isinstance(m.stride, tuple) else (m.stride or m.kernel_size,) * 2
+            p = m.padding if isinstance(m.padding, tuple) else (m.padding,) * 2
+            return ff.pool2d(a[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                             pool_type="avg", name=name)
+        if isinstance(m, nn.AdaptiveAvgPool2d):
+            osize = m.output_size if isinstance(m.output_size, tuple) else (
+                m.output_size, m.output_size)
+            h, w = a[0].shape.logical_shape[2:4]
+            kh, kw = h // osize[0], w // osize[1]
+            return ff.pool2d(a[0], kh, kw, kh, kw, 0, 0, pool_type="avg",
+                             name=name)
+        if isinstance(m, nn.BatchNorm2d):
+            out = ff.batch_norm(a[0], relu=False, name=name)
+            self._op_of_node[node.name] = name
+            return out
+        if isinstance(m, nn.LayerNorm):
+            rank = a[0].shape.logical_rank
+            ndims = len(m.normalized_shape)
+            axes = tuple(range(rank - ndims, rank))
+            out = ff.layer_norm(a[0], axes, m.elementwise_affine, m.eps,
+                                name=name)
+            self._op_of_node[node.name] = name
+            return out
+        if isinstance(m, nn.Embedding):
+            out = ff.embedding(a[0], m.num_embeddings, m.embedding_dim,
+                               name=name)
+            self._op_of_node[node.name] = name
+            return out
+        if isinstance(m, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh)):
+            act = _act_of(m)
+            fn = {ActiMode.RELU: ff.relu, ActiMode.GELU: ff.gelu,
+                  ActiMode.SIGMOID: ff.sigmoid, ActiMode.TANH: ff.tanh}[act]
+            return fn(a[0], name=name)
+        if isinstance(m, nn.Softmax):
+            return ff.softmax(a[0], axis=m.dim if m.dim is not None else -1,
+                              name=name)
+        if isinstance(m, nn.Dropout):
+            return ff.dropout(a[0], m.p, name=name)
+        if isinstance(m, nn.Flatten):
+            assert m.start_dim == 1 and m.end_dim == -1, (
+                "only full flatten supported"
+            )
+            return ff.flat(a[0], name=name)
+        if isinstance(m, nn.Identity):
+            return a[0]
+        if isinstance(m, nn.MultiheadAttention):
+            assert m.batch_first, "set batch_first=True for MHA import"
+            out = ff.multihead_attention(
+                a[0], a[1], a[2], m.embed_dim, m.num_heads,
+                dropout=m.dropout, bias=m.in_proj_bias is not None,
+                add_bias_kv=m.bias_k is not None, name=name,
+            )
+            self._op_of_node[node.name] = name
+            return out
+        raise ValueError(f"unsupported torch module in trace: {m}")
+
+    # ------------------------------------------------------------------
+    # call_function lowerings (reference model.py FunctionNode kinds)
+    # ------------------------------------------------------------------
+    def _lower_function(self, ff: FFModel, node, env):
+        # map_arg resolves Nodes nested inside lists/tuples (torch.cat)
+        a = torch.fx.node.map_arg(list(node.args), lambda n: env[n.name])
+        kw = torch.fx.node.map_arg(dict(node.kwargs), lambda n: env[n.name])
+        t = node.target
+        name = node.name
+
+        def is_tensor(x):
+            return isinstance(x, ParallelTensor)
+
+        if t in (operator.add, torch.add):
+            if is_tensor(a[0]) and is_tensor(a[1]):
+                return ff.add(a[0], a[1], name=name)
+            tensor, scalar = (a[0], a[1]) if is_tensor(a[0]) else (a[1], a[0])
+            return ff.scalar_add(tensor, float(scalar), name=name)
+        if t in (operator.sub, torch.sub):
+            if is_tensor(a[0]) and is_tensor(a[1]):
+                return ff.subtract(a[0], a[1], name=name)
+            return ff.scalar_sub(a[0], float(a[1]), name=name)
+        if t in (operator.mul, torch.mul):
+            if is_tensor(a[0]) and is_tensor(a[1]):
+                return ff.multiply(a[0], a[1], name=name)
+            tensor, scalar = (a[0], a[1]) if is_tensor(a[0]) else (a[1], a[0])
+            return ff.scalar_multiply(tensor, float(scalar), name=name)
+        if t in (operator.truediv, torch.div):
+            if is_tensor(a[0]) and is_tensor(a[1]):
+                return ff.divide(a[0], a[1], name=name)
+            return ff.scalar_true_divide(a[0], float(a[1]), name=name)
+        if t in (torch.relu, F.relu):
+            return ff.relu(a[0], name=name)
+        if t is F.gelu:
+            return ff.gelu(a[0], name=name)
+        if t in (torch.sigmoid, F.sigmoid):
+            return ff.sigmoid(a[0], name=name)
+        if t in (torch.tanh, F.tanh):
+            return ff.tanh(a[0], name=name)
+        if t is F.softmax:
+            return ff.softmax(a[0], axis=kw.get("dim", a[1] if len(a) > 1 else -1),
+                              name=name)
+        if t is torch.flatten:
+            return ff.flat(a[0], name=name)
+        if t is torch.cat:
+            tensors = a[0]
+            axis = kw.get("dim", a[1] if len(a) > 1 else 0)
+            return ff.concat(list(tensors), axis, name=name)
+        if t is torch.split:
+            axis = kw.get("dim", a[2] if len(a) > 2 else 0)
+            return ff.split(a[0], a[1], axis, name=name)
+        if t in (torch.matmul, torch.bmm):
+            return ff.batch_matmul(a[0], a[1], name=name)
+        if t is torch.reshape:
+            return ff.reshape(a[0], a[1], name=name)
+        if t is torch.transpose:
+            return self._transpose(ff, a[0], a[1], a[2], name)
+        if t is torch.permute:
+            return ff.transpose(a[0], a[1], name=name)
+        if t is torch.mean:
+            axes = kw.get("dim", a[1] if len(a) > 1 else None)
+            if axes is None:
+                axes = list(range(a[0].shape.logical_rank))
+            if isinstance(axes, int):
+                axes = [axes]
+            return ff.mean(a[0], axes, keepdims=kw.get("keepdim", False),
+                           name=name)
+        if t is F.dropout:
+            return ff.dropout(a[0], kw.get("p", a[1] if len(a) > 1 else 0.5),
+                              name=name)
+        if t is getattr(operator, "getitem"):
+            return a[0][a[1]]
+        raise ValueError(f"unsupported torch function in trace: {t}")
+
+    def _transpose(self, ff, x, d0, d1, name):
+        perm = list(range(x.shape.logical_rank))
+        perm[d0], perm[d1] = perm[d1], perm[d0]
+        return ff.transpose(x, perm, name=name)
+
+    # ------------------------------------------------------------------
+    # call_method lowerings
+    # ------------------------------------------------------------------
+    def _lower_method(self, ff: FFModel, node, env):
+        a = [env[x.name] if isinstance(x, torch.fx.Node) else x
+             for x in node.args]
+        m = node.target
+        name = node.name
+        self_t = a[0]
+        if m in ("view", "reshape"):
+            shape = a[1] if isinstance(a[1], (tuple, list)) else a[1:]
+            shape = [int(s) for s in shape]
+            if any(s == -1 for s in shape):
+                total = self_t.shape.num_elements() if hasattr(
+                    self_t.shape, "num_elements") else int(
+                        np.prod(self_t.shape.logical_shape))
+                known = -int(np.prod([s for s in shape if s != -1]))
+                shape = [total // known if s == -1 else s for s in shape]
+            return ff.reshape(self_t, shape, name=name)
+        if m == "permute":
+            perm = a[1] if isinstance(a[1], (tuple, list)) else a[1:]
+            return ff.transpose(self_t, list(perm), name=name)
+        if m == "transpose":
+            return self._transpose(ff, self_t, a[1], a[2], name)
+        if m == "flatten":
+            start = a[1] if len(a) > 1 else 0  # Tensor.flatten defaults to 0
+            if start == 1:
+                return ff.flat(self_t, name=name)
+            shape = self_t.shape.logical_shape
+            total = int(np.prod(shape[start:]))
+            return ff.reshape(self_t, list(shape[:start]) + [total], name=name)
+        if m == "contiguous":
+            return self_t
+        if m == "mean":
+            axes = a[1] if len(a) > 1 else list(range(self_t.shape.logical_rank))
+            if isinstance(axes, int):
+                axes = [axes]
+            return ff.mean(self_t, axes, name=name)
+        if m == "size":
+            return self_t.shape.logical_shape[a[1]] if len(a) > 1 else (
+                self_t.shape.logical_shape)
+        raise ValueError(f"unsupported tensor method in trace: {m}")
+
+    # ------------------------------------------------------------------
+    # weight transfer (reference: file-format apply; here direct)
+    # ------------------------------------------------------------------
+    def copy_weights(self, ff: FFModel):
+        """Copy the torch module's parameters into the compiled FFModel
+        (torch Linear weight [out, in] -> ff kernel [in, out])."""
+        weights = ff.get_weights()
+        modules = dict(self.traced.named_modules())
+        for fx_name, op_name in self._op_of_node.items():
+            node = next(n for n in self.traced.graph.nodes if n.name == fx_name)
+            m = modules[node.target]
+            if op_name not in weights:
+                continue
+            entry = weights[op_name]
+            if isinstance(m, nn.Linear):
+                entry["kernel"] = m.weight.detach().numpy().T.copy()
+                if m.bias is not None:
+                    entry["bias"] = m.bias.detach().numpy().copy()
+            elif isinstance(m, nn.Conv2d):
+                # torch [out, in/g, kh, kw] -> ours matches lax HWIO? our
+                # Conv2D stores torch-layout kernel (see ops/dense.py)
+                entry["kernel"] = m.weight.detach().numpy().copy()
+                if m.bias is not None:
+                    entry["bias"] = m.bias.detach().numpy().copy()
+            elif isinstance(m, nn.Embedding):
+                entry["weight"] = m.weight.detach().numpy().copy()
+            elif isinstance(m, nn.LayerNorm) and m.elementwise_affine:
+                entry["gamma"] = m.weight.detach().numpy().copy()
+                entry["beta"] = m.bias.detach().numpy().copy()
+            elif isinstance(m, nn.BatchNorm2d):
+                entry["gamma"] = m.weight.detach().numpy().copy()
+                entry["beta"] = m.bias.detach().numpy().copy()
+        ff.set_weights(weights)
+
+
+def _fetch_attr(module, target: str):
+    obj = module
+    for part in target.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def torch_to_flexflow(module, ff: FFModel,
+                      inputs: Sequence[ParallelTensor]):
+    """One-call convenience (reference fx.torch_to_flexflow)."""
+    pt = PyTorchModel(module)
+    return pt, pt.torch_to_ff(ff, inputs)

@@ -1,0 +1,58 @@
+"""ONNX frontend tests — gated on the onnx package (not baked into this
+image; the frontend raises a clear ImportError then)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+
+try:
+    import onnx
+
+    HAS_ONNX = True
+except ImportError:
+    HAS_ONNX = False
+
+
+def test_onnx_missing_gives_clear_error():
+    if HAS_ONNX:
+        pytest.skip("onnx present")
+    from flexflow_tpu.onnx_frontend import ONNXModel
+
+    with pytest.raises(ImportError, match="torch.fx frontend"):
+        ONNXModel("/nonexistent.onnx")
+
+
+@pytest.mark.skipif(not HAS_ONNX, reason="onnx not installed")
+def test_onnx_mlp_roundtrip():
+    import onnx.helper as oh
+
+    # tiny Gemm+Relu+Gemm graph built by hand
+    w1 = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    w2 = np.random.RandomState(1).randn(4, 16).astype(np.float32)
+    nodes = [
+        oh.make_node("Gemm", ["x", "w1"], ["h"], transB=1, name="fc1"),
+        oh.make_node("Relu", ["h"], ["hr"], name="relu1"),
+        oh.make_node("Gemm", ["hr", "w2"], ["y"], transB=1, name="fc2"),
+    ]
+    graph = oh.make_graph(
+        nodes, "mlp",
+        [oh.make_tensor_value_info("x", onnx.TensorProto.FLOAT, [8, 8])],
+        [oh.make_tensor_value_info("y", onnx.TensorProto.FLOAT, [8, 4])],
+        initializer=[
+            onnx.numpy_helper.from_array(w1, "w1"),
+            onnx.numpy_helper.from_array(w2, "w2"),
+        ],
+    )
+    model = oh.make_model(graph)
+    from flexflow_tpu.onnx_frontend import ONNXModel
+
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor([8, 8], name="x")
+    om = ONNXModel(model)
+    om.apply(ff, [x])
+    ff.compile(loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    om.copy_weights(ff)
+    xs = np.random.RandomState(2).randn(8, 8).astype(np.float32)
+    got = np.asarray(ff.forward({"x": xs}))
+    want = np.maximum(xs @ w1.T, 0) @ w2.T
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
