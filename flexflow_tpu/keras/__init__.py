@@ -1,0 +1,41 @@
+"""Keras-style frontend.
+
+Reference: python/flexflow/keras/** (~3.5k LoC) — Sequential +
+functional `Model` over FlexFlow (models/base_model.py:31-541 with
+compile/fit), layer classes, callbacks.  Same surface here, built as a
+thin adapter that lowers the layer graph onto an FFModel at compile
+time, so every keras-frontend model gets the full strategy search +
+SPMD execution path.
+
+Layout convention follows the reference's keras port: image tensors are
+channels_first (NCHW), matching FFModel.conv2d.
+"""
+from .callbacks import Callback, EarlyStopping, LearningRateScheduler
+from .layers import (
+    Activation,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    LayerNormalization,
+    MaxPooling2D,
+    Multiply,
+    Permute,
+    Reshape,
+    Subtract,
+)
+from .models import Model, Sequential
+
+__all__ = [
+    "Activation", "Add", "AveragePooling2D", "BatchNormalization",
+    "Callback", "Concatenate", "Conv2D", "Dense", "Dropout",
+    "EarlyStopping", "Embedding", "Flatten", "Input",
+    "LayerNormalization", "LearningRateScheduler", "MaxPooling2D",
+    "Model", "Multiply", "Permute", "Reshape", "Sequential", "Subtract",
+]

@@ -1,0 +1,59 @@
+"""Training callbacks (reference python/flexflow/keras/callbacks.py:
+Callback base, LearningRateScheduler, VerifyMetrics; plus EarlyStopping
+as a quality-of-life addition)."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class Callback:
+    model = None  # set by Model.fit
+
+    def on_train_begin(self, ffmodel):
+        pass
+
+    def on_epoch_end(self, ffmodel, epoch: int, metrics):
+        pass
+
+    def on_train_end(self, ffmodel):
+        pass
+
+
+class LearningRateScheduler(Callback):
+    """schedule(epoch, current_lr) -> new_lr (reference
+    callbacks.py LearningRateScheduler)."""
+
+    def __init__(self, schedule: Callable[[int, float], float]):
+        self.schedule = schedule
+
+    def on_epoch_end(self, ffmodel, epoch: int, metrics):
+        cur = ffmodel.optimizer.get_lr()
+        new_lr = self.schedule(epoch + 1, cur)
+        if new_lr != cur:
+            ffmodel.set_learning_rate(new_lr)
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor: str = "accuracy", patience: int = 2,
+                 mode: str = "max"):
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.best: Optional[float] = None
+        self.wait = 0
+        self.stopped_epoch: Optional[int] = None
+
+    def on_epoch_end(self, ffmodel, epoch: int, metrics):
+        val = getattr(metrics, self.monitor)
+        better = (
+            self.best is None
+            or (val > self.best if self.mode == "max" else val < self.best)
+        )
+        if better:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = epoch
+                ffmodel._stop_training = True

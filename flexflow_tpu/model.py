@@ -137,6 +137,7 @@ class FFModel:
         self._name_counts: Dict[str, int] = {}
         self._used_names: set = set()
         self._fwd_fn = None
+        self._stop_training = False  # set by EarlyStopping-style callbacks
 
     # ------------------------------------------------------------------
     # tensor / naming helpers
@@ -659,6 +660,9 @@ class FFModel:
             history.append(pm)
             for cb in callbacks:
                 cb.on_epoch_end(self, epoch, pm)
+            if self._stop_training:
+                self._stop_training = False
+                break
         for cb in callbacks:
             cb.on_train_end(self)
         return history
@@ -687,6 +691,14 @@ class FFModel:
 
     def update(self):
         return None
+
+    def set_learning_rate(self, lr: float):
+        """Change the optimizer lr; rebuilds the jitted step (lr is a
+        trace-time constant — the rebuild hits XLA's compile cache for
+        previously-seen values)."""
+        self.optimizer.set_lr(lr)
+        if self.executor is not None:
+            self._step_fn = self.executor.build_step()
 
     # -- weight access (reference get_tensor/set_tensor,
     #    parallel_tensor.cc:650-750) -------------------------------------

@@ -33,6 +33,17 @@ class Optimizer:
     def update(self, weights, grads, state):
         raise NotImplementedError
 
+    # uniform lr access (SGD stores `lr`, Adam stores `alpha` after the
+    # reference's naming, optimizer.h:36-110)
+    def get_lr(self) -> float:
+        return getattr(self, "lr", None) or getattr(self, "alpha")
+
+    def set_lr(self, lr: float):
+        if hasattr(self, "alpha"):
+            self.alpha = lr
+        else:
+            self.lr = lr
+
 
 @dataclasses.dataclass
 class SGDOptimizer(Optimizer):

@@ -1,0 +1,89 @@
+"""Keras frontend tests (reference python/flexflow/keras surface:
+Sequential, functional Model, callbacks)."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig
+from flexflow_tpu.keras import (
+    Add,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    EarlyStopping,
+    Flatten,
+    Input,
+    LearningRateScheduler,
+    MaxPooling2D,
+    Model,
+    Sequential,
+)
+
+
+def test_sequential_mlp_trains(devices8):
+    m = Sequential([
+        Dense(32, activation="relu"),
+        Dense(4),
+    ], input_shape=(16,), config=FFConfig(batch_size=16))
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"], devices=devices8)
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 16).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    hist = m.fit(x, y, epochs=5, verbose=False)
+    assert hist[-1].accuracy > hist[0].accuracy
+    preds = m.predict(x[:16])
+    assert preds.shape == (16, 4)
+
+
+def test_sequential_cnn_compiles():
+    m = Sequential([
+        Conv2D(8, 3, activation="relu"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(10),
+    ], input_shape=(3, 16, 16), config=FFConfig(batch_size=8))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    x = np.random.RandomState(0).randn(8, 3, 16, 16).astype(np.float32)
+    assert m.predict(x).shape == (8, 10)
+
+
+def test_functional_multi_branch(devices8):
+    a = Input((8,), name="a")
+    b = Input((8,), name="b")
+    ha = Dense(16, activation="relu")(a)
+    hb = Dense(16, activation="relu")(b)
+    merged = Concatenate()( [ha, hb] )
+    res = Add()([ha, hb])
+    out = Dense(4)(Concatenate()([merged, res]))
+    m = Model(inputs=[a, b], outputs=out, config=FFConfig(batch_size=16))
+    m.compile(devices=devices8)
+    rng = np.random.RandomState(1)
+    xa = rng.randn(64, 8).astype(np.float32)
+    xb = rng.randn(64, 8).astype(np.float32)
+    y = ((xa.sum(1) + xb.sum(1)) > 0).astype(np.int32)
+    hist = m.fit({"a": xa, "b": xb}, y, epochs=3, verbose=False)
+    assert len(hist) == 3
+    assert "Dense" in m.summary()
+
+
+def test_lr_scheduler_and_early_stopping(devices8):
+    m = Sequential([Dense(8, activation="relu"), Dense(2)],
+                   input_shape=(4,), config=FFConfig(batch_size=8))
+    m.compile(devices=devices8)
+    lrs = []
+
+    def sched(epoch, lr):
+        lrs.append(lr)
+        return lr * 0.5
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    m.fit(x, y, epochs=3, verbose=False,
+          callbacks=[LearningRateScheduler(sched)])
+    assert lrs == [0.01, 0.005, 0.0025]
+
+    es = EarlyStopping(monitor="accuracy", patience=1)
+    hist = m.fit(x, y, epochs=50, verbose=False, callbacks=[es])
+    assert len(hist) < 50  # stopped early
