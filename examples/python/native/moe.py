@@ -1,0 +1,30 @@
+"""Mixture-of-Experts demo (reference examples/cpp/mixture_of_experts/moe.cc).
+
+MNIST-shaped synthetic data through the MoE classifier; expert
+parallelism shards the stacked expert FFN over the mesh 'ep' axis.
+"""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_moe_mlp
+
+
+def main():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    build_moe_mlp(ff, batch_size=cfg.batch_size, input_dim=784,
+                  num_classes=10, num_exp=5, num_select=2, hidden_size=64)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 8
+    xs = rng.randn(n, 784).astype(np.float32)
+    ys = rng.randint(0, 10, size=n).astype(np.int32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

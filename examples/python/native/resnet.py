@@ -1,0 +1,29 @@
+"""ResNet-50 training demo (reference examples/cpp/ResNet/resnet.cc).
+
+Synthetic CIFAR-style data; pass --search-budget to let the Unity
+search pick a hybrid strategy instead of pure DP.
+"""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_resnet50
+
+
+def main():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    build_resnet50(ff, batch_size=cfg.batch_size, num_classes=10, image_size=64)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 8
+    xs = rng.randn(n, 3, 64, 64).astype(np.float32)
+    ys = rng.randint(0, 10, size=n).astype(np.int32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

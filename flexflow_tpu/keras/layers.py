@@ -310,12 +310,15 @@ class Concatenate(Layer):
         self.axis = axis
 
     def compute_output_shape(self, input_shapes):
-        axis = self.axis if self.axis >= 0 else len(input_shapes[0]) + self.axis
+        # Keras axes are batch-INCLUSIVE; KTensor shapes exclude batch,
+        # so positive axis k maps to shape index k-1.
+        if self.axis == 0:
+            raise ValueError("Concatenate along the batch axis is not supported")
+        axis = self.axis - 1 if self.axis > 0 else len(input_shapes[0]) + self.axis
         out = list(input_shapes[0])
         out[axis] = sum(s[axis] for s in input_shapes)
         return [tuple(out)]
 
     def lower(self, ff, inputs):
-        # +1: KTensor shapes exclude batch, FFModel axes include it
-        axis = self.axis if self.axis < 0 else self.axis + 1
-        return ff.concat(inputs, axis, name=self.name)
+        # FFModel axes include batch, matching Keras's convention directly
+        return ff.concat(inputs, self.axis, name=self.name)

@@ -28,8 +28,14 @@ def compute_loss(
             logp = jax.nn.log_softmax(logits, axis=-1)
         else:
             logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))
-        labels = labels.reshape(labels.shape[0], -1)[:, 0] if labels.ndim > 1 else labels
-        nll = -jnp.take_along_axis(logp, labels.astype(jnp.int32)[:, None], axis=-1)
+        # labels: class ids with either the same rank as logits (trailing
+        # dim 1, reference label-tensor layout model.cc:3086-3124) or one
+        # rank less (per-sample or per-token ids)
+        if labels.ndim == logits.ndim:
+            labels = labels[..., 0]
+        nll = -jnp.take_along_axis(
+            logp, labels.astype(jnp.int32)[..., None], axis=-1
+        )
         return jnp.mean(nll)
     if loss_type == LossType.CATEGORICAL_CROSSENTROPY:
         if from_logits:

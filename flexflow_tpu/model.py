@@ -498,7 +498,16 @@ class FFModel:
         """MoE composite (reference src/ops/moe.cc:20-44): gate -> topk ->
         group_by -> per-expert FFN -> aggregate.  The expert FFN here is a
         batched dense over the stacked expert dim, so expert parallelism
-        is sharding that dim (ShardConfig.expert)."""
+        is sharding that dim (ShardConfig.expert).
+
+        Rank-3 inputs [b, s, h] are flattened to [b*s, h] tokens around
+        the dispatch and restored afterwards (the reference's group_by
+        is 2-D only; its encoder path moe.cc:100-130 is dead code in its
+        own example main)."""
+        orig_shape = input.shape.logical_shape
+        if len(orig_shape) == 3:
+            b, s, h = orig_shape
+            input = self.reshape(input, [b * s, h])
         gate = self.dense(input, num_exp, ActiMode.NONE)
         gate_sm = self.softmax(gate)
         topk_out = self.top_k(gate_sm, num_select)
@@ -506,8 +515,12 @@ class FFModel:
         grouped = self.group_by(input, assign, num_exp, alpha)
         # per-expert FFN: [n, cap, d] -> [n, cap, hidden]
         hidden = self.experts_dense(grouped, expert_hidden_size, activation=ActiMode.RELU)
-        return self.aggregate(values, assign, gate_sm, hidden, num_exp, lambda_bal,
-                              name=name)
+        out = self.aggregate(values, assign, gate_sm, hidden, num_exp, lambda_bal,
+                             name=name)
+        if len(orig_shape) == 3:
+            out = self.reshape(out, [orig_shape[0], orig_shape[1],
+                                     expert_hidden_size])
+        return out
 
     def experts_dense(self, grouped, out_dim: int, activation=ActiMode.NONE,
                       use_bias: bool = True, name=None):

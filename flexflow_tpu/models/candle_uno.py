@@ -1,0 +1,47 @@
+"""CANDLE-Uno builder (cancer drug response MLP ensemble).
+
+Parity with /root/reference/examples/cpp/candle_uno/candle_uno.cc:27-129:
+multiple input feature towers through shared-shape dense stacks, concat,
+deep joint MLP, scalar regression head (MSE loss).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..fftype import ActiMode
+from ..model import FFModel
+
+
+def _feature_tower(ff: FFModel, t, dims: Sequence[int], prefix: str):
+    for i, d in enumerate(dims):
+        t = ff.dense(t, d, activation=ActiMode.RELU, use_bias=False,
+                     name=f"{prefix}_{i}")
+    return t
+
+
+def build_candle_uno(
+    ff: FFModel,
+    batch_size: int = 64,
+    input_dims: Optional[Sequence[int]] = None,
+    dense_layers: Optional[Sequence[int]] = None,
+    dense_feature_layers: Optional[Sequence[int]] = None,
+):
+    """Defaults mirror candle_uno.cc:27-36 (4192-wide stacks; shrunk via
+    arguments for tests).  input_dims: one entry per feature tower —
+    reference uses gene/drug feature sets (candle_uno.cc:105-121)."""
+    input_dims = list(input_dims or [942, 5270, 2048])
+    dense_layers = list(dense_layers or [4192] * 4)
+    dense_feature_layers = list(dense_feature_layers or [4192] * 4)
+
+    encoded = []
+    for i, in_dim in enumerate(input_dims):
+        inp = ff.create_tensor([batch_size, in_dim], name=f"input_{i}")
+        encoded.append(
+            _feature_tower(ff, inp, dense_feature_layers, prefix=f"tower_{i}")
+        )
+    out = ff.concat(encoded, axis=-1, name="concat")
+    for i, d in enumerate(dense_layers):
+        out = ff.dense(out, d, activation=ActiMode.RELU, use_bias=False,
+                       name=f"joint_{i}")
+    out = ff.dense(out, 1, use_bias=False, name="head")
+    return out

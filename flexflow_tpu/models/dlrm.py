@@ -1,0 +1,97 @@
+"""DLRM and XDL recommender builders.
+
+Parity with /root/reference/examples/cpp/DLRM/dlrm.cc:44-170 and
+/root/reference/examples/cpp/XDL/xdl.cc:40-145.  The reference shards
+the big embedding tables over devices via attribute parallelism
+(embedding.cc:132-141); in the TPU build that is ShardConfig's
+attribute degree on the vocab dim, lowering to an all-to-all over ICI.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+from ..fftype import ActiMode, AggrMode
+from ..initializer import UniformInitializer
+from ..model import FFModel
+
+
+def _mlp(ff: FFModel, t, dims: Sequence[int], sigmoid_layer: int,
+         prefix: str):
+    """create_mlp (dlrm.cc:44-70, xdl.cc:38-59): ReLU stack with one
+    sigmoid layer.  `dims` lists output widths only (the reference's `ln`
+    includes the input dim, so its layer i == our i)."""
+    for i, d in enumerate(dims):
+        act = ActiMode.SIGMOID if i == sigmoid_layer else ActiMode.RELU
+        t = ff.dense(t, d, activation=act, use_bias=False, name=f"{prefix}_{i}")
+    return t
+
+
+def _embedding(ff: FFModel, input, vocab: int, dim: int, name: str):
+    # create_emb (dlrm.cc:72-82): uniform +/- sqrt(1/vocab)
+    rng = math.sqrt(1.0 / vocab)
+    init = UniformInitializer(minv=-rng, maxv=rng)
+    return ff.embedding(input, vocab, dim, aggr=AggrMode.SUM,
+                        kernel_initializer=init, name=name)
+
+
+def build_dlrm(
+    ff: FFModel,
+    batch_size: int = 64,
+    embedding_size: Sequence[int] = (1000000, 1000000, 1000000, 1000000),
+    embedding_bag_size: int = 1,
+    sparse_feature_size: int = 64,
+    dense_feature_dim: int = 64,
+    mlp_bot: Optional[Sequence[int]] = None,
+    mlp_top: Optional[Sequence[int]] = None,
+):
+    """dense MLP-bot + per-table embeddings -> concat interaction -> MLP-top
+    with sigmoid on the final layer (dlrm.cc:84-170, interaction 'cat',
+    LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)."""
+    mlp_bot = list(mlp_bot or [sparse_feature_size, sparse_feature_size])
+    mlp_top = list(mlp_top or [64, 64, 2])
+
+    sparse_inputs = [
+        ff.create_tensor([batch_size, embedding_bag_size], dtype="int32",
+                         name=f"sparse_input_{i}")
+        for i in range(len(embedding_size))
+    ]
+    dense_input = ff.create_tensor([batch_size, dense_feature_dim],
+                                   name="dense_input")
+
+    x = _mlp(ff, dense_input, mlp_bot, sigmoid_layer=-1, prefix="bot")
+    ly: List = [
+        _embedding(ff, si, embedding_size[i], sparse_feature_size,
+                   name=f"embedding_{i}")
+        for i, si in enumerate(sparse_inputs)
+    ]
+    z = ff.concat([x] + ly, axis=-1, name="interact_cat")
+    # reference passes mlp_top.size()-2, the last index of its ln-based
+    # loop — i.e. the final layer is the sigmoid one
+    p = _mlp(ff, z, mlp_top, sigmoid_layer=len(mlp_top) - 1, prefix="top")
+    return p
+
+
+def build_xdl(
+    ff: FFModel,
+    batch_size: int = 64,
+    embedding_size: Sequence[int] = (1000000, 1000000, 1000000, 1000000),
+    embedding_bag_size: int = 1,
+    sparse_feature_size: int = 64,
+    mlp_dims: Optional[Sequence[int]] = None,
+):
+    """XDL: concat(embeddings) -> MLP with sigmoid final layer
+    (xdl.cc:120-145, LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)."""
+    mlp_dims = list(mlp_dims or [512, 512, 512, 2])
+    sparse_inputs = [
+        ff.create_tensor([batch_size, embedding_bag_size], dtype="int32",
+                         name=f"sparse_input_{i}")
+        for i in range(len(embedding_size))
+    ]
+    ly = [
+        _embedding(ff, si, embedding_size[i], sparse_feature_size,
+                   name=f"embedding_{i}")
+        for i, si in enumerate(sparse_inputs)
+    ]
+    t = ff.concat(ly, axis=-1, name="concat")
+    return _mlp(ff, t, mlp_dims, sigmoid_layer=len(mlp_dims) - 1, prefix="mlp")

@@ -1,0 +1,31 @@
+"""MLP_Unify builder — the Unity two-tower MLP benchmark.
+
+Parity with /root/reference/examples/cpp/MLP_Unify/mlp.cc:36-57: two
+inputs through parallel 8x8192 dense towers, summed, softmaxed.  The
+Unity search discovers the alternating data/model-parallel strategy for
+the wide denses; on TPU those are 'channel' ShardConfig degrees that
+keep each 8192-wide GEMM MXU-resident per shard.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..fftype import ActiMode
+from ..model import FFModel
+
+
+def build_mlp_unify(
+    ff: FFModel,
+    batch_size: int = 64,
+    input_dim: int = 1024,
+    hidden_dims: Optional[Sequence[int]] = None,
+):
+    hidden_dims = list(hidden_dims or [8192] * 8)
+    t1 = ff.create_tensor([batch_size, input_dim], name="input1")
+    t2 = ff.create_tensor([batch_size, input_dim], name="input2")
+    for i, d in enumerate(hidden_dims):
+        act = ActiMode.NONE if i + 1 == len(hidden_dims) else ActiMode.RELU
+        t1 = ff.dense(t1, d, activation=act, use_bias=False, name=f"t1_dense_{i}")
+        t2 = ff.dense(t2, d, activation=act, use_bias=False, name=f"t2_dense_{i}")
+    t = ff.add(t1, t2, name="add")
+    return ff.softmax(t, name="softmax")

@@ -1,0 +1,101 @@
+"""ResNet-50 and ResNeXt-50 builders.
+
+Parity with the reference C++ examples
+(/root/reference/examples/cpp/ResNet/resnet.cc:38-113,
+/root/reference/examples/cpp/resnext50/resnext.cc:13-88) expressed
+through the FFModel layer API; convs lower to
+`lax.conv_general_dilated` so XLA tiles them onto the MXU.
+
+Builders are size-parameterized: default configs match the reference
+(input 3x229x229 / 3x224x224, [3,4,6,3] stages); tests pass tiny
+image sizes and stage depths.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..fftype import ActiMode
+from ..model import FFModel
+
+
+def _channels(t) -> int:
+    return t.shape.logical_shape[1]  # NCHW
+
+
+def bottleneck_block(ff: FFModel, input, out_channels: int, stride: int):
+    """1x1 -> 3x3(stride) -> 1x1(4x) with projection shortcut
+    (resnet.cc:38-55)."""
+    t = ff.conv2d(input, out_channels, 1, 1, 1, 1, 0, 0)
+    t = ff.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1)
+    t = ff.conv2d(t, 4 * out_channels, 1, 1, 1, 1, 0, 0)
+    if stride > 1 or _channels(input) != 4 * out_channels:
+        input = ff.conv2d(input, 4 * out_channels, 1, 1, stride, stride, 0, 0)
+    t = ff.add(input, t)
+    return ff.relu(t, inplace=False)
+
+
+def build_resnet50(
+    ff: FFModel,
+    batch_size: int = 64,
+    num_classes: int = 10,
+    image_size: int = 229,
+    stage_blocks: Sequence[int] = (3, 4, 6, 3),
+    base_channels: int = 64,
+):
+    t = ff.create_tensor([batch_size, 3, image_size, image_size], name="input")
+    t = ff.conv2d(t, base_channels, 7, 7, 2, 2, 3, 3, name="stem_conv")
+    t = ff.pool2d(t, 3, 3, 2, 2, 1, 1, name="stem_pool")
+    ch = base_channels
+    for stage, blocks in enumerate(stage_blocks):
+        for i in range(blocks):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            t = bottleneck_block(ff, t, ch, stride)
+        ch *= 2
+    h = t.shape.logical_shape[2]
+    w = t.shape.logical_shape[3]
+    t = ff.pool2d(t, h, w, 1, 1, 0, 0, pool_type="avg", name="head_pool")
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes, name="fc")
+    return ff.softmax(t, name="softmax")
+
+
+def resnext_block(ff: FFModel, input, stride: int, out_channels: int,
+                  groups: int, has_residual: bool = False):
+    """Grouped 3x3 bottleneck (resnext.cc:13-32)."""
+    t = ff.conv2d(input, out_channels, 1, 1, 1, 1, 0, 0, activation=ActiMode.RELU)
+    t = ff.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1,
+                  activation=ActiMode.RELU, groups=groups)
+    t = ff.conv2d(t, 2 * out_channels, 1, 1, 1, 1, 0, 0)
+    if (stride > 1 or _channels(input) != 2 * out_channels) and has_residual:
+        input = ff.conv2d(input, 2 * out_channels, 1, 1, stride, stride, 0, 0,
+                          activation=ActiMode.RELU)
+        t = ff.relu(ff.add(input, t), inplace=False)
+    return t
+
+
+def build_resnext50(
+    ff: FFModel,
+    batch_size: int = 16,
+    num_classes: int = 1000,
+    image_size: int = 224,
+    stage_blocks: Sequence[int] = (3, 4, 6, 3),
+    groups: int = 32,
+    base_channels: int = 128,
+):
+    """ResNeXt-50 (32x4d) per resnext.cc:55-88."""
+    t = ff.create_tensor([batch_size, 3, image_size, image_size], name="input")
+    t = ff.conv2d(t, 64, 7, 7, 2, 2, 3, 3, activation=ActiMode.RELU, name="stem_conv")
+    t = ff.pool2d(t, 3, 3, 2, 2, 1, 1, name="stem_pool")
+    ch = base_channels
+    for stage, blocks in enumerate(stage_blocks):
+        for i in range(blocks):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            t = resnext_block(ff, t, stride, ch, groups)
+        ch *= 2
+    t = ff.relu(t, inplace=False)
+    h = t.shape.logical_shape[2]
+    w = t.shape.logical_shape[3]
+    t = ff.pool2d(t, h, w, 1, 1, 0, 0, pool_type="avg", name="head_pool")
+    t = ff.flat(t)
+    t = ff.dense(t, num_classes, name="fc")
+    return ff.softmax(t, name="softmax")

@@ -19,6 +19,30 @@ from ..tensor import ParallelDim, ParallelTensorShape
 from .op import Op, ShapeError
 
 
+def _is_prefix_merge(ddims, target0: int) -> bool:
+    """True if target0 is the product of a leading run of input dims."""
+    prod = 1
+    for d in ddims:
+        prod *= d.size
+        if prod == target0:
+            return True
+        if prod > target0:
+            return False
+    return False
+
+
+def _is_prefix_split(lead_size: int, target) -> bool:
+    """True if a leading run of target dims multiplies to lead_size."""
+    prod = 1
+    for s in target:
+        prod *= s
+        if prod == lead_size:
+            return True
+        if prod > lead_size:
+            return False
+    return False
+
+
 def _data_dims(shape: ParallelTensorShape):
     return [d for d in shape.dims if not d.is_replica_dim]
 
@@ -49,8 +73,30 @@ class Reshape(Op):
             raise ShapeError(f"{self.name}: cannot reshape {ishape} to {target}")
         ddims = _data_dims(ishape)
         degrees = [1] * len(target)
-        # carry the leading (sample) dim's degree when its size is preserved
+        # The leading (sample) dim's degree survives three SPMD-safe cases:
+        #   * size preserved;
+        #   * merge: leading partitioned dim folded with following
+        #     UNpartitioned dims ([b(deg),s,h] -> [b*s,h] — each shard
+        #     stays contiguous, no data movement);
+        #   * split: leading partitioned dim split into a prefix of the
+        #     target ([b*s(deg),h] -> [b,s,h] with deg | b).
         if ddims and target and ddims[0].size == target[0]:
+            degrees[0] = ddims[0].degree
+        elif (
+            ddims
+            and target
+            and all(d.degree == 1 for d in ddims[1:])
+            and _is_prefix_merge(ddims, target[0])
+            and target[0] % max(ddims[0].degree, 1) == 0
+        ):
+            degrees[0] = ddims[0].degree
+        elif (
+            ddims
+            and target
+            and all(d.degree == 1 for d in ddims[1:])
+            and _is_prefix_split(ddims[0].size, target)
+            and target[0] % max(ddims[0].degree, 1) == 0
+        ):
             degrees[0] = ddims[0].degree
         elif any(d.degree > 1 for d in ddims):
             raise ShapeError(f"{self.name}: reshape of partitioned dims unsupported")

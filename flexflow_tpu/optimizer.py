@@ -36,7 +36,8 @@ class Optimizer:
     # uniform lr access (SGD stores `lr`, Adam stores `alpha` after the
     # reference's naming, optimizer.h:36-110)
     def get_lr(self) -> float:
-        return getattr(self, "lr", None) or getattr(self, "alpha")
+        lr = getattr(self, "lr", None)
+        return self.alpha if lr is None else lr
 
     def set_lr(self, lr: float):
         if hasattr(self, "alpha"):

@@ -1,0 +1,164 @@
+"""Model-zoo parity tests: every reference example family
+(/root/reference/examples/cpp/*) builds, compiles data-parallel on the
+8-device CPU mesh, and runs a train step with finite loss.
+
+Tiny configs keep CPU compile time bounded; architecture shape logic is
+identical to the full-size builders.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import (
+    build_candle_uno,
+    build_dlrm,
+    build_inception_v3,
+    build_mlp_unify,
+    build_moe_mlp,
+    build_resnet50,
+    build_resnext50,
+    build_xdl,
+)
+
+BATCH = 8
+
+
+def _compile(ff, devices, loss=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+             metrics=(MetricsType.ACCURACY,)):
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=loss,
+        metrics=list(metrics),
+        devices=devices,
+    )
+
+
+def _step_classification(ff, inputs, num_classes=4):
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, num_classes, size=BATCH).astype(np.int32)
+    m = ff.train_step(inputs, y)
+    key = "sparse_cce_loss" if "sparse_cce_loss" in m else "loss"
+    loss = float(m[key])
+    assert np.isfinite(loss)
+    return loss
+
+
+def test_resnet50_tiny(devices8):
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_resnet50(ff, batch_size=BATCH, num_classes=4, image_size=32,
+                   stage_blocks=(1, 1), base_channels=8)
+    _compile(ff, devices8)
+    x = np.random.RandomState(1).randn(BATCH, 3, 32, 32).astype(np.float32)
+    _step_classification(ff, {"input": x})
+
+
+def test_resnext50_tiny(devices8):
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_resnext50(ff, batch_size=BATCH, num_classes=4, image_size=32,
+                    stage_blocks=(1, 1), groups=4, base_channels=8)
+    _compile(ff, devices8)
+    x = np.random.RandomState(1).randn(BATCH, 3, 32, 32).astype(np.float32)
+    _step_classification(ff, {"input": x})
+
+
+def test_inception_v3_tiny(devices8):
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_inception_v3(ff, batch_size=BATCH, num_classes=4, image_size=75,
+                       channel_scale=1 / 16)
+    _compile(ff, devices8)
+    x = np.random.RandomState(1).randn(BATCH, 3, 75, 75).astype(np.float32)
+    _step_classification(ff, {"input": x})
+
+
+def test_dlrm_tiny(devices8):
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_dlrm(ff, batch_size=BATCH, embedding_size=(50, 60, 70),
+               sparse_feature_size=8, dense_feature_dim=8,
+               mlp_bot=[8, 8], mlp_top=[16, 2])
+    _compile(ff, devices8, loss=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+             metrics=(MetricsType.MEAN_SQUARED_ERROR,))
+    rng = np.random.RandomState(1)
+    inputs = {
+        f"sparse_input_{i}": rng.randint(0, v, size=(BATCH, 1)).astype(np.int32)
+        for i, v in enumerate((50, 60, 70))
+    }
+    inputs["dense_input"] = rng.randn(BATCH, 8).astype(np.float32)
+    y = rng.rand(BATCH, 2).astype(np.float32)
+    m = ff.train_step(inputs, y)
+    assert np.isfinite(float(m["mse_loss"]))
+
+
+def test_xdl_tiny(devices8):
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_xdl(ff, batch_size=BATCH, embedding_size=(40, 40),
+              sparse_feature_size=8, mlp_dims=[16, 2])
+    _compile(ff, devices8, loss=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+             metrics=(MetricsType.MEAN_SQUARED_ERROR,))
+    rng = np.random.RandomState(1)
+    inputs = {
+        f"sparse_input_{i}": rng.randint(0, 40, size=(BATCH, 1)).astype(np.int32)
+        for i in range(2)
+    }
+    y = rng.rand(BATCH, 2).astype(np.float32)
+    m = ff.train_step(inputs, y)
+    assert np.isfinite(float(m["mse_loss"]))
+
+
+def test_candle_uno_tiny(devices8):
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_candle_uno(ff, batch_size=BATCH, input_dims=[12, 20, 8],
+                     dense_layers=[16, 16], dense_feature_layers=[16, 16])
+    _compile(ff, devices8, loss=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+             metrics=(MetricsType.MEAN_SQUARED_ERROR,))
+    rng = np.random.RandomState(1)
+    inputs = {f"input_{i}": rng.randn(BATCH, d).astype(np.float32)
+              for i, d in enumerate((12, 20, 8))}
+    y = rng.randn(BATCH, 1).astype(np.float32)
+    m = ff.train_step(inputs, y)
+    assert np.isfinite(float(m["mse_loss"]))
+
+
+def test_mlp_unify_tiny(devices8):
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_mlp_unify(ff, batch_size=BATCH, input_dim=16, hidden_dims=[32, 32, 4])
+    _compile(ff, devices8)
+    rng = np.random.RandomState(1)
+    inputs = {
+        "input1": rng.randn(BATCH, 16).astype(np.float32),
+        "input2": rng.randn(BATCH, 16).astype(np.float32),
+    }
+    _step_classification(ff, inputs)
+
+
+def test_moe_mlp_tiny(devices8):
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_moe_mlp(ff, batch_size=BATCH, input_dim=16, num_classes=4,
+                  num_exp=4, num_select=2, hidden_size=16)
+    _compile(ff, devices8)
+    x = np.random.RandomState(1).randn(BATCH, 16).astype(np.float32)
+    _step_classification(ff, {"input": x})
+
+
+def test_moe_encoder_tiny(devices8):
+    from flexflow_tpu.models import build_moe_encoder
+
+    cfg = FFConfig(batch_size=BATCH, num_devices=8)
+    ff = FFModel(cfg)
+    build_moe_encoder(ff, batch_size=BATCH, seq_length=8, hidden_size=16,
+                      num_layers=1, num_heads=4, num_exp=4, num_select=2,
+                      num_classes=4)
+    _compile(ff, devices8)
+    x = np.random.RandomState(1).randn(BATCH, 8, 16).astype(np.float32)
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 4, size=(BATCH, 8)).astype(np.int32)
+    m = ff.train_step({"input": x}, y)
+    key = "sparse_cce_loss" if "sparse_cce_loss" in m else "loss"
+    assert np.isfinite(float(m[key]))
