@@ -7,7 +7,14 @@ runtime + mapper; Pallas kernels replace custom CUDA; ICI/DCN
 collectives replace NCCL; and the Unity/MCMC strategy search drives a
 TPU-pod machine model.  See SURVEY.md at the repo root.
 """
+from .checkpoint import (
+    CheckpointManager,
+    ModelCheckpoint,
+    load_weights_npz,
+    save_weights_npz,
+)
 from .config import FFConfig, FFIterationConfig
+from .dataloader import SingleDataLoader
 from .fftype import (
     ActiMode,
     AggrMode,

@@ -1,0 +1,197 @@
+"""Data loading: full-dataset host ingest + prefetched sharded batches.
+
+Counterpart of the reference's SingleDataLoader
+(python/flexflow_dataloader.h:34-116, .cc/.cu): the reference stages the
+whole numpy dataset into zero-copy memory once, then per batch launches
+index tasks that copy sample slices to each GPU.  TPU-native: batches
+are assembled host-side (native C++ gather when available — see
+native/dataloader.cc — else numpy) and `jax.device_put` with the
+executor's input NamedShardings; a background thread keeps a bounded
+queue of device-resident batches so host assembly and the H2D transfer
+overlap the jitted step.
+"""
+from __future__ import annotations
+
+import ctypes
+import queue
+import threading
+from typing import Dict, Iterator, Optional, Tuple, Union
+
+import numpy as np
+
+from .native import get_lib
+
+
+def _native_shuffle(n: int, seed: int) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "ffdl_shuffle_indices"):
+        return None
+    idx = np.empty(n, dtype=np.int64)
+    lib.ffdl_shuffle_indices(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(n),
+        ctypes.c_uint64(seed),
+    )
+    return idx
+
+
+def _py_shuffle(n: int, seed: int) -> np.ndarray:
+    """Python mirror of ffdl_shuffle_indices (same xorshift64 PRNG)."""
+    idx = np.arange(n, dtype=np.int64)
+    s = np.uint64(seed if seed else 0x9E3779B97F4A7C15)
+    mask = np.uint64(0xFFFFFFFFFFFFFFFF)
+    for i in range(n - 1, 0, -1):
+        s = (s ^ (s << np.uint64(13))) & mask
+        s = s ^ (s >> np.uint64(7))
+        s = (s ^ (s << np.uint64(17))) & mask
+        j = int(s % np.uint64(i + 1))
+        idx[i], idx[j] = idx[j], idx[i]
+    return idx
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    out = _native_shuffle(n, seed)
+    return out if out is not None else _py_shuffle(n, seed)
+
+
+def _gather(src: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """Batch-assembly gather; native path releases the GIL."""
+    src = np.ascontiguousarray(src)
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "ffdl_gather_rows") and src.ndim >= 1:
+        row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+        dst = np.empty((len(indices),) + src.shape[1:], dtype=src.dtype)
+        rc = lib.ffdl_gather_rows(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(src.shape[0]),
+            ctypes.c_int64(row_bytes),
+            np.ascontiguousarray(indices, dtype=np.int64).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)
+            ),
+            ctypes.c_int64(len(indices)),
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if rc == 0:
+            return dst
+    return np.take(src, indices, axis=0)
+
+
+class SingleDataLoader:
+    """Batched, optionally shuffled, prefetching loader bound to a
+    compiled FFModel's input shardings.
+
+    API parity: num_samples/next_batch/reset
+    (flexflow_dataloader.h:34-116); adds `__iter__` epochs and
+    background device prefetch (capability the reference gets from
+    Legion's async index tasks).
+    """
+
+    def __init__(
+        self,
+        ff,
+        x: Union[np.ndarray, Dict[str, np.ndarray]],
+        y: np.ndarray,
+        batch_size: Optional[int] = None,
+        shuffle: bool = False,
+        seed: int = 0,
+        prefetch: int = 2,
+    ):
+        self.ff = ff
+        input_ops = ff.layers.source_ops()
+        if isinstance(x, dict):
+            self.x_map = {k: np.ascontiguousarray(v) for k, v in x.items()}
+        else:
+            self.x_map = {input_ops[0].name: np.ascontiguousarray(x)}
+        self.y = np.ascontiguousarray(y)
+        self.num_samples = len(self.y)
+        for k, v in self.x_map.items():
+            if len(v) != self.num_samples:
+                raise ValueError(
+                    f"input {k} has {len(v)} samples, labels have {self.num_samples}"
+                )
+        self.batch_size = batch_size or ff.config.batch_size
+        self.num_batches = self.num_samples // self.batch_size
+        if self.num_batches == 0:
+            raise ValueError(
+                f"dataset of {self.num_samples} samples smaller than batch "
+                f"size {self.batch_size}"
+            )
+        self.shuffle = shuffle
+        self.seed = seed
+        self.prefetch = max(1, prefetch)
+        self._epoch = -1  # first reset() brings it to 0
+        self._order = np.arange(self.num_samples, dtype=np.int64)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        self._thread: Optional[threading.Thread] = None
+        self._next_index = 0
+        self.reset()
+
+    # -- reference API --------------------------------------------------
+    def reset(self):
+        """Start the next epoch (flexflow_dataloader.h:50) — each call
+        advances the shuffle order."""
+        self._stop_worker()
+        self._epoch += 1
+        if self.shuffle:
+            self._order = shuffle_indices(
+                self.num_samples, self.seed + self._epoch + 1
+            )
+        self._next_index = 0
+        self._queue = queue.Queue(maxsize=self.prefetch)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def next_batch(self) -> Tuple[Dict[str, object], object]:
+        """Device-resident (inputs, labels) for the next batch."""
+        if self._next_index >= self.num_batches:
+            raise StopIteration
+        self._next_index += 1
+        item = self._queue.get()
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self) -> Iterator[Tuple[Dict[str, object], object]]:
+        if self._next_index > 0 or self._thread is None:
+            self.reset()
+        for _ in range(self.num_batches):
+            yield self.next_batch()
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    # -- internals ------------------------------------------------------
+    def _worker(self):
+        import jax
+
+        try:
+            in_sh = self.ff.executor.input_shardings()
+            lab_sh = self.ff.executor.label_sharding()
+            for b in range(self.num_batches):
+                idx = self._order[b * self.batch_size:(b + 1) * self.batch_size]
+                inputs = {
+                    k: jax.device_put(_gather(v, idx), in_sh[k])
+                    for k, v in self.x_map.items()
+                }
+                labels = jax.device_put(_gather(self.y, idx), lab_sh)
+                self._queue.put((inputs, labels))
+        except Exception as e:  # surfaced on next_batch
+            self._queue.put(e)
+
+    def _stop_worker(self):
+        t = self._thread
+        if t is not None and t.is_alive():
+            # drain so the worker unblocks and finishes its epoch
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            while t.is_alive():
+                try:
+                    self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    if not t.is_alive():
+                        break
+        self._thread = None

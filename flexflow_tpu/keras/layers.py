@@ -311,12 +311,13 @@ class Concatenate(Layer):
 
     def compute_output_shape(self, input_shapes):
         # Keras axes are batch-INCLUSIVE; KTensor shapes exclude batch,
-        # so positive axis k maps to shape index k-1.
-        if self.axis == 0:
+        # so batch-inclusive axis k maps to shape index k-1.
+        full_rank = len(input_shapes[0]) + 1
+        axis = self.axis if self.axis >= 0 else full_rank + self.axis
+        if axis == 0:
             raise ValueError("Concatenate along the batch axis is not supported")
-        axis = self.axis - 1 if self.axis > 0 else len(input_shapes[0]) + self.axis
         out = list(input_shapes[0])
-        out[axis] = sum(s[axis] for s in input_shapes)
+        out[axis - 1] = sum(s[axis - 1] for s in input_shapes)
         return [tuple(out)]
 
     def lower(self, ff, inputs):

@@ -637,8 +637,12 @@ class FFModel:
         epochs: Optional[int] = None,
         callbacks: Sequence = (),
         verbose: bool = True,
+        shuffle: bool = False,
     ) -> List[PerfMetrics]:
-        """Train over numpy data (reference fit loop flexflow_cffi.py:2044-2087)."""
+        """Train over numpy data (reference fit loop flexflow_cffi.py:2044-2087),
+        batched through SingleDataLoader (prefetched, sharded placement)."""
+        from .dataloader import SingleDataLoader
+
         assert self._step_fn is not None, "call compile() first"
         batch_size = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs
@@ -649,18 +653,17 @@ class FFModel:
             x_map = {op.name: arr for op, arr in zip(input_ops, x)}
         else:
             x_map = {input_ops[0].name: x}
-        n = len(y)
-        num_batches = n // batch_size
+        loader = SingleDataLoader(self, x_map, y, batch_size=batch_size,
+                                  shuffle=shuffle, seed=self.config.seed)
+        num_batches = loader.num_batches
         history: List[PerfMetrics] = []
         for cb in callbacks:
             cb.on_train_begin(self)
         for epoch in range(epochs):
             pm = PerfMetrics()
             t0 = time.perf_counter()
-            for b in range(num_batches):
-                sl = slice(b * batch_size, (b + 1) * batch_size)
-                batch = {k: v[sl] for k, v in x_map.items()}
-                m = self.train_step(batch, y[sl])
+            for batch, labels in loader:
+                m = self.train_step(batch, labels)
                 pm.update({k: float(v) for k, v in m.items() if k != "loss"})
             jax.block_until_ready(jax.tree.leaves(self._weights)[0])
             dt = time.perf_counter() - t0

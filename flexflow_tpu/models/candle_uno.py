@@ -31,7 +31,7 @@ def build_candle_uno(
     reference uses gene/drug feature sets (candle_uno.cc:105-121)."""
     input_dims = list(input_dims or [942, 5270, 2048])
     dense_layers = list(dense_layers or [4192] * 4)
-    dense_feature_layers = list(dense_feature_layers or [4192] * 4)
+    dense_feature_layers = list(dense_feature_layers or [4192] * 8)
 
     encoded = []
     for i, in_dim in enumerate(input_dims):

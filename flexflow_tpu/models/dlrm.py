@@ -48,8 +48,10 @@ def build_dlrm(
     """dense MLP-bot + per-table embeddings -> concat interaction -> MLP-top
     with sigmoid on the final layer (dlrm.cc:84-170, interaction 'cat',
     LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)."""
+    # reference defaults dlrm.cc:26-29: ln vectors include the input dim,
+    # so mlp_top {64,64,2} is the 2-layer width list [64, 2]
     mlp_bot = list(mlp_bot or [sparse_feature_size, sparse_feature_size])
-    mlp_top = list(mlp_top or [64, 64, 2])
+    mlp_top = list(mlp_top or [64, 2])
 
     sparse_inputs = [
         ff.create_tensor([batch_size, embedding_bag_size], dtype="int32",
@@ -82,7 +84,8 @@ def build_xdl(
 ):
     """XDL: concat(embeddings) -> MLP with sigmoid final layer
     (xdl.cc:120-145, LOSS_MEAN_SQUARED_ERROR_AVG_REDUCE)."""
-    mlp_dims = list(mlp_dims or [512, 512, 512, 2])
+    # xdl.cc mlp {256,256,256,2} includes the input dim -> widths [256,256,2]
+    mlp_dims = list(mlp_dims or [256, 256, 2])
     sparse_inputs = [
         ff.create_tensor([batch_size, embedding_bag_size], dtype="int32",
                          name=f"sparse_input_{i}")

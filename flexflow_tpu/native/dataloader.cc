@@ -1,0 +1,72 @@
+// Native batch-assembly core for the dataloader.
+//
+// Counterpart of the reference's C++/CUDA dataloader
+// (python/flexflow_dataloader.cc: full-dataset-in-ZC-mem ingest +
+// per-batch index-task loads).  On TPU the device transfer is
+// jax.device_put; the host-side hot path — gathering shuffled sample
+// rows into a contiguous batch buffer — is this file.  ctypes releases
+// the GIL for the call, so assembly overlaps with the jitted step.
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows: dst[i] = src[indices[i]] for i in [0, n).
+// row_bytes is the size of one sample row; src has num_rows rows.
+// Multithreaded for large batches; returns 0 on success.
+int ffdl_gather_rows(const uint8_t *src, int64_t num_rows, int64_t row_bytes,
+                     const int64_t *indices, int64_t n, uint8_t *dst) {
+  for (int64_t i = 0; i < n; i++) {
+    if (indices[i] < 0 || indices[i] >= num_rows) return -1;
+  }
+  const int64_t total = n * row_bytes;
+  int nthreads = 1;
+  if (total > (4 << 20)) {
+    unsigned hw = std::thread::hardware_concurrency();
+    nthreads = hw > 8 ? 8 : (hw ? (int)hw : 1);
+  }
+  if (nthreads <= 1) {
+    for (int64_t i = 0; i < n; i++) {
+      std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                  (size_t)row_bytes);
+    }
+    return 0;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads);
+  const int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; t++) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    workers.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; i++) {
+        std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                    (size_t)row_bytes);
+      }
+    });
+  }
+  for (auto &w : workers) w.join();
+  return 0;
+}
+
+// Fisher-Yates shuffle of [0..n) with an xorshift64 PRNG — matches the
+// Python fallback in dataloader.py exactly (same algorithm, same seed
+// evolution) so shuffled epochs are reproducible across backends.
+void ffdl_shuffle_indices(int64_t *indices, int64_t n, uint64_t seed) {
+  for (int64_t i = 0; i < n; i++) indices[i] = i;
+  uint64_t s = seed ? seed : 0x9E3779B97F4A7C15ull;
+  for (int64_t i = n - 1; i > 0; i--) {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    int64_t j = (int64_t)(s % (uint64_t)(i + 1));
+    int64_t tmp = indices[i];
+    indices[i] = indices[j];
+    indices[j] = tmp;
+  }
+}
+
+}  // extern "C"

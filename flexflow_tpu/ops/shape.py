@@ -80,7 +80,12 @@ class Reshape(Op):
         #     stays contiguous, no data movement);
         #   * split: leading partitioned dim split into a prefix of the
         #     target ([b*s(deg),h] -> [b,s,h] with deg | b).
-        if ddims and target and ddims[0].size == target[0]:
+        if (
+            ddims
+            and target
+            and ddims[0].size == target[0]
+            and all(d.degree == 1 for d in ddims[1:])
+        ):
             degrees[0] = ddims[0].degree
         elif (
             ddims
