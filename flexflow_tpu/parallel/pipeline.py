@@ -1,0 +1,225 @@
+"""Pipeline parallelism (first-class, TPU-native).
+
+The reference has only vestigial pipeline hooks — PIPELINE_INIT/FWD/BWD
+task IDs exist (include/flexflow/model.h:190-192) but no pipeline op is
+implemented; SURVEY §2.3 directs this build to treat PP as a
+build-fresh strategy.  TPU-native design (the scaling-book recipe):
+
+* mesh axis ``pp`` holds the stages; each device owns a contiguous
+  chunk of identical blocks, stacked on a leading dim and sharded over
+  ``pp`` (homogeneous-stage pipelining — the transformer case);
+* the GPipe schedule is a ``lax.scan`` over ``M + S - 1`` ticks inside
+  ``shard_map``: every tick each stage runs its block chunk, then
+  ``lax.ppermute`` shifts activations one stage forward over ICI;
+* the *backward* pipeline is not hand-written: ``jax.grad`` through the
+  scan + ppermute emits the reverse schedule (ppermute transposes to
+  the opposite shift) automatically — the functional-autodiff win over
+  the reference's task-based design.
+
+All-stages-equal SPMD means invalid ticks (pipeline fill/drain) compute
+garbage that is masked, costing the standard bubble fraction
+(S-1)/(M+S-1).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def gpipe(
+    stage_fn: Callable,
+    stage_params,
+    x_mb: jax.Array,
+    *,
+    axis_name: str = "pp",
+    num_stages: int,
+    num_microbatches: int,
+):
+    """GPipe forward over one pipeline group.  Call INSIDE shard_map.
+
+    stage_fn(stage_params, act) -> act: this device's stage (shape
+    preserved — homogeneous stages).
+    stage_params: the local stage's parameters (already pp-sharded).
+    x_mb: [M, mb, ...] microbatched input (read on stage 0; other
+    stages may hold anything of the same shape).
+    Returns [M, mb, ...] outputs, broadcast to every stage of the group.
+    """
+    S, M = num_stages, num_microbatches
+    stage = lax.axis_index(axis_name)
+    zero = jnp.zeros_like(x_mb[0])
+
+    def tick(buf, t):
+        # stage 0 consumes microbatch t (clipped; masked when t >= M)
+        x_t = jnp.take(x_mb, jnp.minimum(t, M - 1), axis=0)
+        x_t = jnp.where(t < M, x_t, zero)
+        inp = jnp.where(stage == 0, x_t, buf)
+        y = stage_fn(stage_params, inp)
+        nxt = lax.ppermute(y, axis_name, [(i, (i + 1) % S) for i in range(S)])
+        return nxt, y
+
+    _, ys = lax.scan(tick, zero, jnp.arange(M + S - 1))
+    outs = ys[S - 1:]  # [M, mb, ...]; real values live on the last stage
+    # where-mask (not multiply) so NaN/inf from fill/drain garbage ticks
+    # on earlier stages cannot leak through the psum broadcast
+    outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+    return lax.psum(outs, axis_name)  # broadcast to the group
+
+
+def _split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches {num_microbatches}"
+        )
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+
+def pipelined_apply(
+    block_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    pp_axis: str = "pp",
+    dp_axis: str = "data",
+):
+    """Apply a stack of identical blocks as a dp x pp pipelined SPMD
+    computation.
+
+    block_fn(params_i, act) -> act: ONE block (e.g. a transformer
+    layer).  stacked_params: pytree with leading dim L = num blocks,
+    sharded over ``pp`` (L % pp == 0).  x: [batch, ...] sharded over
+    ``data``.  Differentiable end to end.
+    """
+    pp = mesh.shape[pp_axis]
+    layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if layers % pp:
+        raise ValueError(f"{layers} blocks not divisible by pp={pp}")
+
+    def stage_fn(local_params, act):
+        # run this stage's L/pp blocks in order
+        def body(a, p):
+            return block_fn(p, a), None
+
+        out, _ = lax.scan(body, act, local_params)
+        return out
+
+    def spmd(params, xb):
+        x_mb = _split_microbatches(xb, num_microbatches)
+        y_mb = gpipe(stage_fn, params, x_mb, axis_name=pp_axis,
+                     num_stages=pp, num_microbatches=num_microbatches)
+        return y_mb.reshape((-1,) + y_mb.shape[2:])
+
+    param_specs = jax.tree.map(
+        lambda a: P(pp_axis, *([None] * (a.ndim - 1))), stacked_params
+    )
+    in_x = P(dp_axis, *([None] * (x.ndim - 1)))
+    return jax.shard_map(
+        spmd,
+        mesh=mesh,
+        in_specs=(param_specs, in_x),
+        out_specs=in_x,
+        check_vma=False,
+    )(stacked_params, x)
+
+
+def stacked_param_sharding(mesh: Mesh, a, pp_axis: str = "pp"):
+    """NamedSharding for a [L, ...] stacked block-parameter array."""
+    return NamedSharding(mesh, P(pp_axis, *([None] * (a.ndim - 1))))
+
+
+# ----------------------------------------------------------------------
+# Reference-parity demo model: a pipelined transformer-encoder train
+# step used by tests and the driver's multichip dryrun.
+# ----------------------------------------------------------------------
+
+def _init_block_params(key, layers, hidden, ffn, dtype=jnp.float32):
+    ks = jax.random.split(key, 4)
+    scale = 1.0 / jnp.sqrt(hidden)
+    return {
+        "w_qkv": jax.random.normal(ks[0], (layers, hidden, 3 * hidden), dtype) * scale,
+        "w_o": jax.random.normal(ks[1], (layers, hidden, hidden), dtype) * scale,
+        "w_in": jax.random.normal(ks[2], (layers, hidden, ffn), dtype) * scale,
+        "w_out": jax.random.normal(ks[3], (layers, ffn, hidden), dtype) * scale,
+    }
+
+
+def _encoder_block(p, x, *, num_heads: int):
+    b, s, h = x.shape
+    hd = h // num_heads
+    qkv = x @ p["w_qkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, num_heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    a = jax.nn.softmax(q @ k.transpose(0, 1, 3, 2) / jnp.sqrt(hd), axis=-1)
+    o = (a @ v).transpose(0, 2, 1, 3).reshape(b, s, h)
+    x = _ln(x + o @ p["w_o"])
+    y = jax.nn.relu(x @ p["w_in"]) @ p["w_out"]
+    return _ln(x + y)
+
+
+def _ln(x, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps)
+
+
+def make_pipelined_transformer_step(
+    mesh: Mesh,
+    *,
+    layers: int,
+    hidden: int,
+    ffn: int,
+    num_heads: int,
+    num_classes: int,
+    num_microbatches: int,
+    lr: float = 0.01,
+    pp_axis: str = "pp",
+    dp_axis: str = "data",
+):
+    """(init_fn, step_fn): a full SGD train step (fwd+loss+bwd+update)
+    for a block-stacked encoder pipelined over `pp` and batch-sharded
+    over `data`."""
+
+    def init_fn(seed: int):
+        key = jax.random.key(seed)
+        kb, kh = jax.random.split(key)
+        params = {
+            "blocks": _init_block_params(kb, layers, hidden, ffn),
+            "head": jax.random.normal(kh, (hidden, num_classes)) / jnp.sqrt(hidden),
+        }
+        shardings = {
+            "blocks": jax.tree.map(
+                lambda a: stacked_param_sharding(mesh, a, pp_axis),
+                params["blocks"],
+            ),
+            "head": NamedSharding(mesh, P(None, None)),
+        }
+        return jax.tree.map(jax.device_put, params, shardings)
+
+    block = functools.partial(_encoder_block, num_heads=num_heads)
+
+    def loss_fn(params, x, y):
+        h = pipelined_apply(block, params["blocks"], x, mesh=mesh,
+                            num_microbatches=num_microbatches,
+                            pp_axis=pp_axis, dp_axis=dp_axis)
+        logits = h.mean(axis=1) @ params["head"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+    @jax.jit
+    def step_fn(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    return init_fn, step_fn
