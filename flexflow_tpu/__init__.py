@@ -38,6 +38,7 @@ from .loss import Loss
 from .metrics import Metrics, PerfMetrics
 from .model import FFModel
 from .optimizer import AdamOptimizer, SGDOptimizer
+from .recompile import RecompileState
 from .strategy import Strategy, data_parallel_strategy
 from .tensor import ParallelDim, ParallelTensor, ParallelTensorShape, Tensor
 

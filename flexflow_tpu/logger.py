@@ -1,0 +1,46 @@
+"""RecursiveLogger: indentation-scoped debug tracing (reference
+src/runtime/recursive_logger.cc, used throughout the substitution
+search to print nested DP/rewrite decisions, substitution.cc:1713)."""
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Iterator
+
+# library convention: never touch the root logger; the application
+# configures handlers, we just avoid "no handler" warnings
+logging.getLogger("flexflow_tpu").addHandler(logging.NullHandler())
+
+
+class RecursiveLogger:
+    def __init__(self, name: str = "flexflow_tpu"):
+        self._log = logging.getLogger(name)
+        self._depth = 0
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def debug(self, msg: str, *args):
+        self._log.debug("%s" + msg, "  " * self._depth, *args)
+
+    def info(self, msg: str, *args):
+        self._log.info("%s" + msg, "  " * self._depth, *args)
+
+    @contextlib.contextmanager
+    def enter(self, label: str = "") -> Iterator[None]:
+        if label:
+            self.debug("%s {", label)
+        self._depth += 1
+        try:
+            yield
+        finally:
+            self._depth -= 1
+            if label:
+                self.debug("}")
+
+    def set_level(self, level):
+        self._log.setLevel(level)
+
+
+search_logger = RecursiveLogger("flexflow_tpu.search")

@@ -546,6 +546,12 @@ class FFModel:
         seed: Optional[int] = None,
     ):
         cfg = self.config
+        self._compile_args = {
+            "loss_type": loss_type,
+            "metrics": tuple(metrics),
+            "comp_mode": comp_mode,
+            "devices": list(devices) if devices is not None else None,
+        }
         self.optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
         # Reference convention (loss_functions.cu): a model ending in
         # Softmax feeds probabilities to the loss, not logits.
@@ -657,6 +663,10 @@ class FFModel:
                                   shuffle=shuffle, seed=self.config.seed)
         num_batches = loader.num_batches
         history: List[PerfMetrics] = []
+        if self.config.profiling:
+            from .profiler import print_profile, profile_operators
+
+            print_profile(profile_operators(self))
         for cb in callbacks:
             cb.on_train_begin(self)
         for epoch in range(epochs):
@@ -707,6 +717,44 @@ class FFModel:
 
     def update(self):
         return None
+
+    def recompile(self, strategy=None, devices=None):
+        """Re-run compile under a new Strategy/device set, carrying the
+        trained weights and optimizer state across (RecompileState's
+        alter-hook workhorse; reference model.cc:2422-2427).  Weights
+        transfer by op/weight name; shapes must be unchanged."""
+        saved_w = self.get_weights()
+        saved_opt = jax.tree.map(np.asarray, self._opt_state)
+        saved_state = jax.tree.map(np.asarray, self._state)
+        saved_rng = self._rng  # mid-training stream must not restart
+        args = self._compile_args
+        self.compile(
+            optimizer=self.optimizer,
+            loss_type=args["loss_type"],
+            metrics=args["metrics"],
+            comp_mode=args["comp_mode"],
+            strategy=strategy,
+            devices=devices if devices is not None else args["devices"],
+        )
+        self.set_weights(saved_w)
+
+        def reput(saved, current):
+            return jax.tree.map(
+                lambda v, cur: jax.device_put(
+                    v, getattr(cur, "sharding", None)
+                ) if getattr(cur, "sharding", None) is not None else v,
+                saved, current,
+            )
+
+        self._opt_state = reput(saved_opt, self._opt_state)
+        self._state = reput(saved_state, self._state)
+        self._rng = saved_rng
+
+    def recompile_on_condition(self, r) -> bool:
+        """Fire r.alter() when r.trigger() holds (model.cc:2422)."""
+        from .recompile import recompile_on_condition
+
+        return recompile_on_condition(self, r)
 
     def set_learning_rate(self, lr: float):
         """Change the optimizer lr; rebuilds the jitted step (lr is a

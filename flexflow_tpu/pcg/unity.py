@@ -415,31 +415,39 @@ class UnitySearch:
         return s
 
     def optimize(self, lam: float = 0.0) -> Optional[Strategy]:
+        from ..logger import search_logger as slog
+
         has_moe = any(op.op_type == OperatorType.GROUP_BY for op in self.graph.ops)
         best: Optional[Strategy] = None
         best_obj = math.inf
-        for dp, tp, ep in _factorizations(self.n):
-            if ep > 1 and not has_moe:
-                continue
-            mesh_axes = self._mesh_axes(dp, tp, ep)
-            if tp > 1 and not self._options_by_op(mesh_axes):
-                continue  # no op can use the model axis
-            r = self._dp(mesh_axes, dp, lam)
-            if r is None:
-                continue
-            shard_configs, edges, time, mem = r
-            strategy = self._build_strategy(mesh_axes, dp, shard_configs, edges)
-            # validate + final rank with the strategy actually applied
-            try:
-                g = apply_strategy(self.graph, strategy)
-                assign_views(g, strategy.mesh_axes)
-            except (ShapeError, ValueError):
-                continue
-            obj = time + lam * mem
-            if self.memory_budget is not None and lam == 0.0 and mem > self.memory_budget:
-                obj *= 1.0 + (mem / self.memory_budget - 1.0)
-            if obj < best_obj:
-                best, best_obj = strategy, obj
+        with slog.enter(f"unity optimize n={self.n} lambda={lam:g}"):
+            for dp, tp, ep in _factorizations(self.n):
+                if ep > 1 and not has_moe:
+                    continue
+                mesh_axes = self._mesh_axes(dp, tp, ep)
+                if tp > 1 and not self._options_by_op(mesh_axes):
+                    continue  # no op can use the model axis
+                r = self._dp(mesh_axes, dp, lam)
+                if r is None:
+                    continue
+                shard_configs, edges, time, mem = r
+                strategy = self._build_strategy(mesh_axes, dp, shard_configs, edges)
+                # validate + final rank with the strategy actually applied
+                try:
+                    g = apply_strategy(self.graph, strategy)
+                    assign_views(g, strategy.mesh_axes)
+                except (ShapeError, ValueError):
+                    continue
+                obj = time + lam * mem
+                if self.memory_budget is not None and lam == 0.0 and mem > self.memory_budget:
+                    obj *= 1.0 + (mem / self.memory_budget - 1.0)
+                slog.debug(
+                    "candidate dp=%d tp=%d ep=%d: time=%.3gms mem=%.1fMB obj=%.3g%s",
+                    dp, tp, ep, time * 1e3, mem / 2**20, obj,
+                    " *best*" if obj < best_obj else "",
+                )
+                if obj < best_obj:
+                    best, best_obj = strategy, obj
         return best
 
     def optimize_with_memory(self) -> Optional[Strategy]:

@@ -1,0 +1,116 @@
+"""Per-operator profiling (reference --profiling + per-kernel cudaEvent
+timing, kernels/linear_kernels.cu:95-118, and the search's
+inner_measure_operator_cost harness, model.cu:38-75).
+
+TPU-native: each op's forward is jitted standalone on shard-shaped
+random inputs and timed with block_until_ready — warmup runs absorb
+compile, repeat runs are averaged.  `make_measure_fn` adapts this into
+the simulator's OpCostModel measured-override hook so the strategy
+search can calibrate against real chip timings.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .fftype import OperatorType
+from .ops.op import Op
+
+
+def _rand_array(shape, dtype, key):
+    jd = jnp.dtype(dtype.np_dtype)
+    if jnp.issubdtype(jd, jnp.floating):
+        return jax.random.normal(key, shape, jd)
+    return jnp.zeros(shape, jd)  # int inputs (indices): zeros are in-range
+
+
+def measure_op_forward(
+    op: Op,
+    device=None,
+    warmup: int = 2,
+    repeats: int = 5,
+    shard_shapes: bool = True,
+) -> Optional[float]:
+    """Mean forward wall time in seconds of the op's jitted kernel on
+    shard-local shapes (one device's share of the work); None when the
+    op cannot be profiled standalone (e.g. needs graph context)."""
+    try:
+        key = jax.random.key(0)
+        ins = []
+        for i, t in enumerate(op.inputs):
+            shp = t.shape.shard_shape if shard_shapes else t.shape.logical_shape
+            ins.append(_rand_array(shp, t.shape.dtype, jax.random.fold_in(key, i)))
+        ws = []
+        for i, spec in enumerate(op.weight_specs):
+            shp = (spec.shape.shard_shape if shard_shapes
+                   else spec.shape.logical_shape)
+            ws.append(_rand_array(shp, spec.shape.dtype,
+                                  jax.random.fold_in(key, 100 + i)))
+
+        def fn(ins, ws, rng):
+            return op.forward(ins, ws, training=False, rng=rng)
+
+        jfn = jax.jit(fn)
+        if device is not None:
+            ins = jax.device_put(ins, device)
+            ws = jax.device_put(ws, device)
+        rng = jax.random.key(1)
+        for _ in range(max(1, warmup)):
+            jax.block_until_ready(jfn(ins, ws, rng))
+        t0 = time.perf_counter()
+        for _ in range(max(1, repeats)):
+            out = jfn(ins, ws, rng)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / max(1, repeats)
+    except Exception:
+        return None
+
+
+def make_measure_fn(device=None, warmup: int = 2, repeats: int = 5):
+    """OpCostModel measure_fn: op -> forward seconds (or None)."""
+
+    def fn(op: Op) -> Optional[float]:
+        return measure_op_forward(op, device=device, warmup=warmup,
+                                  repeats=repeats)
+
+    return fn
+
+
+_SKIP = {OperatorType.INPUT, OperatorType.WEIGHT, OperatorType.NOOP}
+
+
+def profile_operators(
+    ff, device=None, warmup: int = 2, repeats: int = 5,
+) -> List[Dict[str, object]]:
+    """Per-op timing table for a compiled FFModel (reference --profiling
+    printout).  Rows: name, type, fwd_ms, flops, shard shapes."""
+    graph = ff.operators if ff.operators is not None else ff.layers
+    rows: List[Dict[str, object]] = []
+    for op in graph.topo_order():
+        if op.op_type in _SKIP or op.is_parallel_op():
+            continue
+        t = measure_op_forward(op, device=device, warmup=warmup,
+                               repeats=repeats)
+        rows.append({
+            "name": op.name,
+            "type": op.op_type.name,
+            "fwd_ms": None if t is None else t * 1e3,
+            "flops": op.flops(),
+            "out_shape": [tuple(o.shape.shard_shape) for o in op.outputs],
+        })
+    return rows
+
+
+def print_profile(rows: List[Dict[str, object]]):
+    name_w = max((len(str(r["name"])) for r in rows), default=4) + 2
+    print(f"{'op':<{name_w}}{'type':<20}{'fwd ms':>10}{'GFLOP':>12}")
+    for r in rows:
+        ms = "n/a" if r["fwd_ms"] is None else f"{r['fwd_ms']:.3f}"
+        gf = r["flops"] / 1e9
+        print(f"{r['name']:<{name_w}}{r['type']:<20}{ms:>10}{gf:>12.3f}")
+    total = sum(r["fwd_ms"] or 0.0 for r in rows)
+    print(f"{'TOTAL':<{name_w}}{'':<20}{total:>10.3f}")

@@ -1,0 +1,46 @@
+"""Dynamic recompilation (reference RecompileState, recompile.h:26-42,
+recompile_state.cc, FFModel::recompile_on_condition model.cc:2422-2427).
+
+The reference's only dynamic-adaptation mechanism: a user trigger
+function inspects runtime signals (the MoE Cache op's staleness score,
+examples/cpp/mixture_of_experts/moe.cc:65-98) and an alter function
+mutates the model, after which training continues.  TPU-native: "alter"
+usually swaps the parallelization Strategy or model hyperparams and
+calls `FFModel.recompile()`, which re-runs compile while carrying the
+trained weights and optimizer state over (matched by op/weight name and
+shape) — XLA's compilation cache makes repeat strategies cheap.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class RecompileState:
+    """Holds trigger/alter hooks and a recompilation counter."""
+
+    def __init__(
+        self,
+        trigger_func: Callable[["object"], bool],
+        alter_func: Callable[["object"], None],
+        ff,
+    ):
+        self.trigger_func = trigger_func
+        self.alter_func = alter_func
+        self.ff = ff
+        self.recompilations = 0
+
+    def trigger(self) -> bool:
+        return bool(self.trigger_func(self.ff))
+
+    def alter(self) -> None:
+        self.alter_func(self.ff)
+        self.recompilations += 1
+
+
+def recompile_on_condition(ff, r: RecompileState) -> bool:
+    """Fire alter() when trigger() holds (model.cc:2422-2427).
+    Returns True when a recompilation happened."""
+    if r.trigger():
+        r.alter()
+        return True
+    return False

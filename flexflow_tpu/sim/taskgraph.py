@@ -57,15 +57,27 @@ class TaskGraphBuilder:
         self._device: List[int] = []
         self._deps: List[List[int]] = []
         self._edges: List[Tuple[int, int, float, List[int]]] = []
-        # bidirectional ring links: 2*d = d -> (d+1)%D, 2*d+1 = d -> (d-1)%D
-        if isinstance(machine, TpuPodModel):
-            bw, lat = machine.ici_bw, machine.ici_lat
+        from .network import NetworkedMachineModel
+
+        self._net: "NetworkedMachineModel | None" = None
+        if isinstance(machine, NetworkedMachineModel):
+            # arbitrary topology: one contention link per directed edge
+            self._net = machine
+            links, self._link_index = machine.link_table()
+            self._link_bw = [
+                machine.link_bw * machine.conn[u, v] for u, v in links
+            ]
+            self._link_lat = [machine.link_lat] * len(links)
         else:
-            bw, lat = getattr(machine, "intra_bw", 100e9), getattr(
-                machine, "intra_lat", 1e-6
-            )
-        self._link_bw = [bw] * (2 * num_devices)
-        self._link_lat = [lat] * (2 * num_devices)
+            # bidirectional ring: 2*d = d -> (d+1)%D, 2*d+1 = d -> (d-1)%D
+            if isinstance(machine, TpuPodModel):
+                bw, lat = machine.ici_bw, machine.ici_lat
+            else:
+                bw, lat = getattr(machine, "intra_bw", 100e9), getattr(
+                    machine, "intra_lat", 1e-6
+                )
+            self._link_bw = [bw] * (2 * num_devices)
+            self._link_lat = [lat] * (2 * num_devices)
 
     def add_task(self, compute: float, device: int,
                  deps: Sequence[int] = ()) -> int:
@@ -77,6 +89,16 @@ class TaskGraphBuilder:
 
     def add_dep(self, task: int, dep: int):
         self._deps[task].append(dep)
+
+    def route(self, src: int, dst: int) -> List[int]:
+        """Link ids along src->dst: routed over the topology's shortest
+        path when a NetworkedMachineModel is attached (reference
+        route_transfer, simulator.cc:1488-1689), else the ring."""
+        if src == dst:
+            return []
+        if self._net is not None:
+            return self._net.route_links(src, dst, self._link_index)
+        return self.ring_route(src, dst)
 
     def ring_route(self, src: int, dst: int) -> List[int]:
         """Store-and-forward over consecutive ring links, shorter way."""
@@ -101,7 +123,7 @@ class TaskGraphBuilder:
                  src_dev: int, dst_dev: int):
         self._edges.append(
             (src_task, dst_task, float(nbytes),
-             self.ring_route(src_dev, dst_dev))
+             self.route(src_dev, dst_dev))
         )
 
     def expand_allreduce(

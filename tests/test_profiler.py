@@ -1,0 +1,98 @@
+"""Profiler + observability tests: per-op timing harness, OpCostModel
+measured-override wiring, dot exports, recursive logger."""
+import logging
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.logger import RecursiveLogger
+from flexflow_tpu.profiler import (
+    make_measure_fn,
+    measure_op_forward,
+    profile_operators,
+)
+
+
+def _model(devices):
+    cfg = FFConfig(batch_size=8, num_devices=len(devices))
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 16], name="x")
+    t = ff.dense(x, 32, activation=ActiMode.RELU, name="fc1")
+    t = ff.dense(t, 4, name="fc2")
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices)
+    return ff
+
+
+def test_measure_op_forward(devices8):
+    ff = _model(devices8[:1])
+    ops = [op for op in ff.operators.topo_order() if op.name.startswith("fc")]
+    t = measure_op_forward(ops[0], warmup=1, repeats=2)
+    assert t is not None and 0 < t < 1.0
+
+
+def test_profile_operators_table(devices8, capsys):
+    from flexflow_tpu.profiler import print_profile
+
+    ff = _model(devices8)
+    rows = profile_operators(ff, warmup=1, repeats=1)
+    names = [r["name"] for r in rows]
+    assert "fc1" in names and "fc2" in names
+    assert all(r["fwd_ms"] is None or r["fwd_ms"] > 0 for r in rows)
+    print_profile(rows)
+    out = capsys.readouterr().out
+    assert "fc1" in out and "TOTAL" in out
+
+
+def test_measure_fn_feeds_cost_model(devices8):
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+    from flexflow_tpu.sim.simulator import OpCostModel
+
+    ff = _model(devices8[:1])
+    cm = OpCostModel(TpuPodModel(), measure_fn=make_measure_fn(warmup=1, repeats=1))
+    op = next(op for op in ff.operators.topo_order() if op.name == "fc1")
+    c = cm.cost(op)
+    assert c.forward_time > 0
+    assert cm.cost(op) is c  # cached
+
+
+def test_profiling_flag_prints_table(devices8, capsys):
+    cfg = FFConfig(batch_size=8, num_devices=8, profiling=True)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 8], name="x")
+    ff.softmax(ff.dense(x, 4, name="fc"))
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices8)
+    xs = np.zeros((16, 8), np.float32)
+    ys = np.zeros(16, np.int32)
+    ff.fit(xs, ys, epochs=1, verbose=False)
+    assert "fc" in capsys.readouterr().out
+
+
+def test_dot_exports(devices8, tmp_path):
+    cfg = FFConfig(batch_size=8, num_devices=8,
+                   export_compgraph_file=str(tmp_path / "comp.dot"),
+                   export_taskgraph_file=str(tmp_path / "task.dot"))
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 8], name="x")
+    ff.softmax(ff.dense(x, 4, name="fc"))
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices8)
+    comp = (tmp_path / "comp.dot").read_text()
+    task = (tmp_path / "task.dot").read_text()
+    assert "digraph" in comp and "fc" in comp
+    assert "digraph" in task
+
+
+def test_recursive_logger_indents(caplog):
+    log = RecursiveLogger("test.recursive")
+    log.set_level(logging.DEBUG)
+    with caplog.at_level(logging.DEBUG, logger="test.recursive"):
+        log.debug("outer")
+        with log.enter("scope"):
+            log.debug("inner")
+            assert log.depth == 1
+    msgs = [r.getMessage() for r in caplog.records]
+    assert "outer" in msgs[0]
+    assert msgs[1] == "scope {"
+    assert msgs[2] == "  inner"
+    assert msgs[3] == "  }" or msgs[3] == "}"

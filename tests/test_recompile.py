@@ -1,0 +1,123 @@
+"""RecompileState / FFModel.recompile tests (reference recompile.h +
+moe.cc trigger/alter usage): strategy swap mid-training preserves
+weights and training continues."""
+import numpy as np
+
+from flexflow_tpu import (
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    RecompileState,
+    SGDOptimizer,
+)
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.strategy import data_parallel_strategy
+
+
+def _model(devices):
+    cfg = FFConfig(batch_size=16, num_devices=len(devices))
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 8], name="x")
+    t = ff.dense(x, 32, activation=ActiMode.RELU)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+               devices=devices)
+    return ff
+
+
+def test_trigger_alter_counter(devices8):
+    ff = _model(devices8)
+    fired = []
+    r = RecompileState(
+        trigger_func=lambda m: len(fired) < 2,
+        alter_func=lambda m: fired.append(1),
+        ff=ff,
+    )
+    assert ff.recompile_on_condition(r) is True
+    assert ff.recompile_on_condition(r) is True
+    assert ff.recompile_on_condition(r) is False
+    assert r.recompilations == 2 and len(fired) == 2
+
+
+def test_recompile_preserves_weights_and_outputs(devices8):
+    ff = _model(devices8)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 8).astype(np.float32)
+    ys = rng.randint(0, 4, 64).astype(np.int32)
+    ff.fit(xs, ys, epochs=1, verbose=False)
+    before = np.asarray(ff.forward({"x": xs[:16]}))
+
+    # alter: shrink to fewer devices (new mesh + shardings)
+    ff.recompile(strategy=data_parallel_strategy(4),
+                 devices=list(ff.mesh.devices.flat)[:4])
+    after = np.asarray(ff.forward({"x": xs[:16]}))
+    np.testing.assert_allclose(before, after, rtol=2e-5, atol=2e-5)
+
+    # training continues after the swap
+    hist = ff.fit(xs, ys, epochs=2, verbose=False)
+    assert np.isfinite(hist[-1].sparse_cce_loss)
+
+
+def test_recompile_preserves_bn_state_and_rng(devices8):
+    """Non-trainable state (BatchNorm running stats) and the training
+    RNG stream must survive a recompile."""
+    cfg = FFConfig(batch_size=16, num_devices=8)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 4, 4, 4], name="x")
+    t = ff.batch_norm(x, relu=True)
+    t = ff.flat(t)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devices8)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 4, 4, 4).astype(np.float32) * 3 + 1
+    ys = rng.randint(0, 4, 32).astype(np.int32)
+    ff.fit(xs, ys, epochs=2, verbose=False)
+
+    import jax
+
+    state_before = jax.tree.map(np.asarray, ff._state)
+    rng_before = np.asarray(jax.random.key_data(ff._rng))
+    ff.recompile(strategy=data_parallel_strategy(4),
+                 devices=list(ff.mesh.devices.flat)[:4])
+    state_after = jax.tree.map(np.asarray, ff._state)
+    for a, b in zip(jax.tree.leaves(state_before), jax.tree.leaves(state_after)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        rng_before, np.asarray(jax.random.key_data(ff._rng))
+    )
+    # running stats actually moved away from init during training
+    leaves = jax.tree.leaves(state_before)
+    assert any(not np.allclose(l, 0.0) and not np.allclose(l, 1.0)
+               for l in leaves)
+
+
+def test_recompile_in_training_loop_via_cache_score(devices8):
+    """moe.cc-style usage: a trigger watching a score, alter swapping
+    strategy once the score crosses a threshold."""
+    ff = _model(devices8)
+    score = {"v": 0.0}
+
+    def trigger(m):
+        return score["v"] > 0.5 and r.recompilations == 0
+
+    def alter(m):
+        m.recompile(strategy=data_parallel_strategy(2),
+                    devices=list(m.mesh.devices.flat)[:2])
+
+    r = RecompileState(trigger, alter, ff)
+    rng = np.random.RandomState(1)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = rng.randint(0, 4, 32).astype(np.int32)
+    for it in range(4):
+        ff.train_step({"x": xs[:16]}, ys[:16])
+        score["v"] = it * 0.3
+        ff.recompile_on_condition(r)
+    assert r.recompilations == 1
+    assert ff.mesh.devices.size == 2
