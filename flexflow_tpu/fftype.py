@@ -135,6 +135,8 @@ class OperatorType(enum.Enum):
     AGGREGATE = "aggregate"
     AGGREGATE_SPEC = "aggregate_spec"
     CACHE = "cache"
+    # Recurrent (reference legacy nmt/ LSTM)
+    LSTM = "lstm"
     # Fusion
     FUSED = "fused"
     # Parallel ops (the parallelism IR, reference src/parallel_ops/)

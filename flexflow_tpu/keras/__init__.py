@@ -31,6 +31,7 @@ from .layers import (
     Subtract,
 )
 from .models import Model, Sequential
+from . import datasets
 
 __all__ = [
     "Activation", "Add", "AveragePooling2D", "BatchNormalization",

@@ -1,0 +1,123 @@
+"""Keras-style datasets (reference python/flexflow/keras/datasets/:
+cifar10, mnist, reuters wrappers).
+
+This build runs with zero network egress, so loaders first look for a
+cached copy under ``~/.keras/datasets`` (the standard Keras cache
+layout) and otherwise return *deterministic synthetic data* with the
+exact real shapes/dtypes/label ranges — clearly flagged via the
+``synthetic`` attribute so tests and demos can rely on shape parity
+without network access.
+"""
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+_CACHE = os.path.expanduser("~/.keras/datasets")
+
+
+def _synthetic_images(n, shape, classes, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, size=(n, 1)).astype(np.int64)
+    x = np.zeros((n,) + shape, np.uint8)
+    # class-dependent blobs so models can actually fit the data
+    for c in range(classes):
+        idx = np.nonzero(y[:, 0] == c)[0]
+        base = rng.randint(0, 200, size=shape)
+        x[idx] = np.clip(
+            base[None] + rng.randint(-40, 40, size=(len(idx),) + shape), 0, 255
+        ).astype(np.uint8)
+    return x, y
+
+
+class _Loader:
+    synthetic = True
+
+
+def _npz(path):
+    try:
+        return np.load(path, allow_pickle=True)
+    except (OSError, ValueError):
+        return None
+
+
+class cifar10:
+    """(50000, 3, 32, 32) uint8 train / (10000, ...) test, labels [0,10)."""
+
+    synthetic = False
+
+    @staticmethod
+    def load_data(num_samples: int = 50000
+                  ) -> Tuple[Tuple[np.ndarray, np.ndarray],
+                             Tuple[np.ndarray, np.ndarray]]:
+        cached = _npz(os.path.join(_CACHE, "cifar10.npz"))
+        if cached is not None:
+            cifar10.synthetic = False
+            return ((cached["x_train"][:num_samples],
+                     cached["y_train"][:num_samples]),
+                    (cached["x_test"], cached["y_test"]))
+        cifar10.synthetic = True
+        n_test = max(1, num_samples // 5)
+        xtr, ytr = _synthetic_images(num_samples, (3, 32, 32), 10, seed=0)
+        xte, yte = _synthetic_images(n_test, (3, 32, 32), 10, seed=1)
+        return (xtr, ytr), (xte, yte)
+
+
+class mnist:
+    """(60000, 28, 28) uint8 train / (10000, 28, 28) test, labels [0,10)."""
+
+    synthetic = False
+
+    @staticmethod
+    def load_data(num_samples: int = 60000):
+        cached = _npz(os.path.join(_CACHE, "mnist.npz"))
+        if cached is not None:
+            mnist.synthetic = False
+            return ((cached["x_train"][:num_samples],
+                     cached["y_train"][:num_samples]),
+                    (cached["x_test"], cached["y_test"]))
+        mnist.synthetic = True
+        n_test = max(1, num_samples // 6)
+        xtr, ytr = _synthetic_images(num_samples, (28, 28), 10, seed=2)
+        xte, yte = _synthetic_images(n_test, (28, 28), 10, seed=3)
+        return (xtr, ytr[:, 0]), (xte, yte[:, 0])
+
+
+class reuters:
+    """Newswire topic classification: variable-length int sequences,
+    46 classes (returned pre-padded to maxlen for the synthetic path)."""
+
+    synthetic = False
+    num_classes = 46
+
+    @staticmethod
+    def load_data(num_words: int = 10000, maxlen: int = 200,
+                  num_samples: int = 8982):
+        cached = _npz(os.path.join(_CACHE, "reuters.npz"))
+        if cached is not None:
+            reuters.synthetic = False
+            return ((cached["x_train"][:num_samples],
+                     cached["y_train"][:num_samples]),
+                    (cached["x_test"], cached["y_test"]))
+        reuters.synthetic = True
+        rng = np.random.RandomState(4)
+        n_test = max(1, num_samples // 4)
+
+        def make(n, seed):
+            r = np.random.RandomState(seed)
+            y = r.randint(0, reuters.num_classes, size=n).astype(np.int64)
+            # topic-dependent word distributions
+            x = np.zeros((n, maxlen), np.int64)
+            for i in range(n):
+                center = (y[i] + 1) * (num_words // (reuters.num_classes + 1))
+                length = r.randint(maxlen // 4, maxlen)
+                words = np.clip(
+                    r.normal(center, num_words / 20, size=length).astype(np.int64),
+                    1, num_words - 1,
+                )
+                x[i, :length] = words
+            return x, y
+
+        return make(num_samples, 5), make(n_test, 6)

@@ -14,6 +14,7 @@ from typing import Dict, List, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .fftype import MetricsType
 
@@ -54,11 +55,18 @@ class Metrics:
 
     def compute(self, logits: jax.Array, labels: jax.Array) -> Dict[str, jax.Array]:
         """Jit-side metric computation; returns scalar sums per metric."""
-        out: Dict[str, jax.Array] = {"train_all": jnp.array(logits.shape[0], jnp.int32)}
         sparse = labels.ndim < logits.ndim or labels.shape[-1] == 1
         if sparse:
-            lab = labels.reshape(labels.shape[0], -1)[:, 0] if labels.ndim > 1 else labels
+            # class-id labels: same rank as logits with trailing dim 1
+            # (reference label-tensor layout) or one rank less (per-sample
+            # or per-token ids)
+            lab = labels[..., 0] if labels.ndim == logits.ndim else labels
             lab = lab.astype(jnp.int32)
+            n_scored = int(np.prod(lab.shape))
+        else:
+            # one-hot labels: one scored position per class-dim slice
+            n_scored = int(np.prod(labels.shape[:-1]))
+        out: Dict[str, jax.Array] = {"train_all": jnp.array(n_scored, jnp.int32)}
         for m in self.metrics:
             if m == MetricsType.ACCURACY:
                 pred = jnp.argmax(logits, axis=-1)
@@ -67,7 +75,7 @@ class Metrics:
             elif m == MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 out["sparse_cce_loss"] = -jnp.sum(
-                    jnp.take_along_axis(logp, lab[:, None], axis=-1)
+                    jnp.take_along_axis(logp, lab[..., None], axis=-1)
                 )
             elif m == MetricsType.CATEGORICAL_CROSSENTROPY:
                 logp = jnp.log(jnp.clip(logits, 1e-12, 1.0))

@@ -522,6 +522,15 @@ class FFModel:
                                      expert_hidden_size])
         return out
 
+    def lstm(self, input, hidden_size: int, return_sequences: bool = True,
+             name=None):
+        """Fused lax.scan LSTM (reference legacy nmt/ LSTM rebuilt as a
+        first-class op, ops/recurrent.py)."""
+        from .ops.recurrent import LSTM, LSTMParams
+
+        p = LSTMParams(hidden_size, return_sequences)
+        return self._add(LSTM(p, [input], name=self._name("lstm", name)))
+
     def experts_dense(self, grouped, out_dim: int, activation=ActiMode.NONE,
                       use_bias: bool = True, name=None):
         """Batched per-expert dense over stacked [n, cap, d] expert inputs."""

@@ -4,5 +4,6 @@ from .dlrm import build_dlrm, build_xdl
 from .inception import build_inception_v3
 from .mlp import build_mlp_unify
 from .moe import build_moe_encoder, build_moe_mlp
+from .nmt import build_nmt
 from .resnet import build_resnet50, build_resnext50
 from .transformer import build_bert, build_transformer

@@ -1,0 +1,46 @@
+"""Seq2seq NMT builder (reference legacy nmt/ subtree: standalone LSTM
+encoder-decoder machine translation with hand-written parallel ops,
+nmt/rnn.h, nmt/nmt.cc — pre-FFModel code rebuilt here on the layer
+API).
+
+Teacher-forced training: source tokens -> embed -> encoder LSTM stack;
+target tokens -> embed -> decoder LSTM stack (conditioned on the
+encoder's final context by feature concat) -> vocab projection.
+"""
+from __future__ import annotations
+
+from ..fftype import AggrMode
+from ..model import FFModel
+
+
+def build_nmt(
+    ff: FFModel,
+    batch_size: int = 64,
+    src_len: int = 16,
+    tgt_len: int = 16,
+    src_vocab: int = 8000,
+    tgt_vocab: int = 8000,
+    embed_dim: int = 64,
+    hidden_size: int = 128,
+    num_layers: int = 2,
+):
+    src = ff.create_tensor([batch_size, src_len], dtype="int32", name="src")
+    tgt = ff.create_tensor([batch_size, tgt_len], dtype="int32", name="tgt")
+
+    enc = ff.embedding(src, src_vocab, embed_dim, aggr=AggrMode.NONE,
+                       name="src_embed")
+    for i in range(num_layers):
+        enc = ff.lstm(enc, hidden_size, return_sequences=True,
+                      name=f"enc_lstm_{i}")
+    # context: mean over source positions -> broadcast to target length
+    ctx = ff.mean(enc, axes=[1], keepdims=True, name="enc_context")
+
+    dec = ff.embedding(tgt, tgt_vocab, embed_dim, aggr=AggrMode.NONE,
+                       name="tgt_embed")
+    for i in range(num_layers):
+        dec = ff.lstm(dec, hidden_size, return_sequences=True,
+                      name=f"dec_lstm_{i}")
+    # condition decoder states on encoder context (broadcast add)
+    dec = ff.add(dec, ctx, name="condition")
+    logits = ff.dense(dec, tgt_vocab, name="vocab_proj")
+    return ff.softmax(logits, name="softmax")

@@ -1,0 +1,117 @@
+"""Recurrent ops: LSTM.
+
+Parity slot for the reference's legacy NMT subtree (nmt/rnn.h,
+nmt/lstm.cu — a hand-rolled cuDNN LSTM with its own parallel ops and
+mapper).  TPU-native: one fused LSTM op whose time loop is a
+``lax.scan`` (XLA unrolls nothing; weights stay MXU-resident across
+steps) and whose gate matmul is a single [in+hidden, 4*hidden] GEMM.
+Data-parallel over batch like any other op; autodiff gives BPTT.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import DataType, OperatorType
+from ..initializer import GlorotUniform, Initializer
+from ..tensor import ParallelDim, ParallelTensorShape
+from .op import Op, ShapeError, WeightSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class LSTMBiasInitializer(Initializer):
+    """Zeros with the forget-gate block set to 1 — the offset lives in
+    the stored weight itself so get_weights/set_weights round-trip with
+    external LSTM implementations (Keras/ONNX bias convention)."""
+
+    hidden: int
+
+    def __call__(self, key, shape, dtype):
+        b = jnp.zeros(shape, dtype)
+        return b.at[self.hidden:2 * self.hidden].set(1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class LSTMParams:
+    hidden_size: int
+    return_sequences: bool = True
+    dtype: DataType = DataType.FLOAT
+
+
+class LSTM(Op):
+    """Single-layer LSTM over [batch, seq, in_dim].
+
+    Output: [batch, seq, hidden] (return_sequences) or [batch, hidden].
+    Weights: kernel [in+hidden, 4*hidden] (i, f, g, o gate order),
+    bias [4*hidden] with forget-gate bias init 1.
+    """
+
+    op_type = OperatorType.LSTM
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        if ishape.logical_rank != 3:
+            raise ShapeError(f"{self.name}: LSTM expects [batch, seq, d]")
+        b, s, d = ishape.logical_shape
+        h = self.params.hidden_size
+        bdim = ishape.dims[0]
+        if self.params.return_sequences:
+            dims = (
+                ParallelDim(b, bdim.degree),
+                ParallelDim(s),
+                ParallelDim(h),
+                ParallelDim(1, ishape.replica_degree, is_replica_dim=True),
+            )
+        else:
+            dims = (
+                ParallelDim(b, bdim.degree),
+                ParallelDim(h),
+                ParallelDim(1, ishape.replica_degree, is_replica_dim=True),
+            )
+        return [ParallelTensorShape(dims, ishape.dtype)]
+
+    def make_weight_specs(self, input_shapes):
+        (ishape,) = input_shapes
+        d = ishape.logical_shape[2]
+        h = self.params.hidden_size
+        rep = ParallelDim(1, 1, is_replica_dim=True)
+        kshape = ParallelTensorShape(
+            (ParallelDim(d + h), ParallelDim(4 * h), rep), self.params.dtype
+        )
+        bshape = ParallelTensorShape(
+            (ParallelDim(4 * h), rep), self.params.dtype
+        )
+        return [
+            WeightSpec("kernel", kshape, GlorotUniform()),
+            WeightSpec("bias", bshape, LSTMBiasInitializer(h)),
+        ]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        (x,) = inputs
+        kernel, bias = weights
+        b, s, d = x.shape
+        h = self.params.hidden_size
+
+        def step(carry, xt):
+            hprev, cprev = carry
+            z = jnp.concatenate([xt, hprev], axis=-1) @ kernel + bias
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * cprev + jax.nn.sigmoid(i) * jnp.tanh(g)
+            hnew = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (hnew, c), hnew
+
+        h0 = jnp.zeros((b, h), x.dtype)
+        (_, _), hs = jax.lax.scan(step, (h0, h0), x.transpose(1, 0, 2))
+        hs = hs.transpose(1, 0, 2)  # [b, s, h]
+        if self.params.return_sequences:
+            return [hs]
+        return [hs[:, -1, :]]
+
+    def flops(self) -> float:
+        (ishape,) = [t.shape for t in self.inputs]
+        b, s, d = ishape.logical_shape
+        h = self.params.hidden_size
+        return 2.0 * b * s * (d + h) * 4 * h

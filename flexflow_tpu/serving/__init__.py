@@ -1,0 +1,15 @@
+"""Inference serving (reference triton/ subtree: a Triton backend
+running ONNX models on Legion with a static LayerStrategy,
+triton/src/strategy.h:29-224, onnx_parser.cc).
+
+TPU-native: `InferenceEngine` compiles a model's forward under a fixed
+Strategy into bucketed jitted callables (static shapes per batch
+bucket, so XLA compiles once per bucket); `DynamicBatcher` coalesces
+concurrent requests up to max_batch/timeout — the Triton scheduler's
+role; `serve_http` exposes a stdlib JSON endpoint.
+"""
+from .engine import InferenceEngine
+from .batcher import DynamicBatcher
+from .server import serve_http
+
+__all__ = ["InferenceEngine", "DynamicBatcher", "serve_http"]

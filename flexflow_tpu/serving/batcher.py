@@ -1,0 +1,104 @@
+"""Dynamic request batching (the Triton scheduler role: coalesce
+concurrent single requests into one device batch, bounded by
+max_batch_size and a flush timeout)."""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class _Pending:
+    __slots__ = ("inputs", "event", "result", "error")
+
+    def __init__(self, inputs):
+        self.inputs = inputs
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[Exception] = None
+
+
+class DynamicBatcher:
+    """Background thread that drains the request queue, concatenates up
+    to max_batch samples, runs the engine once, and scatters results."""
+
+    def __init__(self, engine, max_batch: int = 32,
+                 flush_timeout_s: float = 0.005):
+        self.engine = engine
+        self.max_batch = max_batch
+        self.flush_timeout_s = flush_timeout_s
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self.batches_run = 0
+
+    # -- client API -----------------------------------------------------
+    def infer(self, inputs: Dict[str, np.ndarray],
+              timeout: Optional[float] = 30.0) -> np.ndarray:
+        """Blocking single/partial-batch request; thread-safe."""
+        p = _Pending({k: np.asarray(v) for k, v in inputs.items()})
+        self._queue.put(p)
+        if not p.event.wait(timeout):
+            raise TimeoutError("inference request timed out")
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        # fail anything still queued so callers don't sit out their timeout
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            p.error = RuntimeError("DynamicBatcher closed")
+            p.event.set()
+
+    # -- worker ---------------------------------------------------------
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch: List[_Pending] = [first]
+            total = len(next(iter(first.inputs.values())))
+            # absolute deadline from the FIRST request, so a steady
+            # trickle can't defer the flush past the configured bound
+            deadline = time.monotonic() + self.flush_timeout_s
+            while total < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(nxt)
+                total += len(next(iter(nxt.inputs.values())))
+            self._run(batch)
+
+    def _run(self, batch: List[_Pending]):
+        try:
+            keys = list(batch[0].inputs.keys())
+            joined = {
+                k: np.concatenate([p.inputs[k] for p in batch]) for k in keys
+            }
+            out = self.engine.infer(joined)
+            self.batches_run += 1
+            start = 0
+            for p in batch:
+                n = len(next(iter(p.inputs.values())))
+                p.result = out[start:start + n]
+                start += n
+                p.event.set()
+        except Exception as e:
+            for p in batch:
+                p.error = e
+                p.event.set()

@@ -1,0 +1,95 @@
+"""Inference engine: static-strategy compiled forward with batch
+buckets (reference triton/src: ONNX parse -> static LayerStrategy ->
+Legion inference; here ONNX/torch/Keras all funnel through FFModel and
+the engine jits its forward per power-of-two batch bucket)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..fftype import CompMode
+from ..model import FFModel
+
+
+def _bucket(n: int, max_batch: int, multiple: int = 1) -> int:
+    """Next power of two >= n, rounded up to `multiple` (the mesh's
+    data-axis size — every bucket must shard evenly).  The cap is the
+    largest multiple of `multiple` <= max_batch (at least `multiple`),
+    so the invariant holds even when max_batch itself doesn't divide."""
+    cap = max((max_batch // multiple) * multiple, multiple)
+    b = 1
+    while b < n:
+        b <<= 1
+    if b % multiple:
+        b = ((b + multiple - 1) // multiple) * multiple
+    return min(max(b, multiple), cap)
+
+
+class InferenceEngine:
+    """Wraps a compiled FFModel for inference: pads requests to the
+    next power-of-two bucket, runs the jitted forward, strips padding.
+
+    `from_onnx` mirrors the Triton backend's model source; any FFModel
+    (hand-built, torch.fx- or Keras-imported) works via `__init__`.
+    """
+
+    def __init__(self, ff: FFModel, max_batch: int = 64):
+        if ff.executor is None:
+            raise ValueError("compile() the model before serving it")
+        self.ff = ff
+        self.max_batch = max_batch
+        self._fwd = ff.executor.build_forward()
+        self._input_names = [op.name for op in ff.layers.source_ops()]
+        self.requests_served = 0
+
+    @classmethod
+    def from_onnx(cls, path: str, batch_size: int = 64, devices=None,
+                  strategy=None, **kwargs) -> "InferenceEngine":
+        from ..config import FFConfig
+        from ..onnx_frontend.model import ONNXModel
+
+        cfg = FFConfig(batch_size=batch_size)
+        ff = FFModel(cfg)
+        om = ONNXModel(path)
+        om.apply(ff, batch_size=batch_size)
+        ff.compile(comp_mode=CompMode.INFERENCE, strategy=strategy,
+                   devices=devices)
+        om.copy_weights(ff)
+        return cls(ff, max_batch=batch_size, **kwargs)
+
+    # ------------------------------------------------------------------
+    def infer(self, inputs: Dict[str, np.ndarray]) -> np.ndarray:
+        """One batch (any size <= max_batch * k — larger requests are
+        chunked); returns the sink output as numpy."""
+        n = len(next(iter(inputs.values())))
+        dp = self.ff.mesh.shape.get("data", 1) if self.ff.mesh else 1
+        chunk_cap = max((self.max_batch // dp) * dp, dp)
+        outs: List[np.ndarray] = []
+        start = 0
+        while start < n:
+            take = min(chunk_cap, n - start)
+            chunk = {k: v[start:start + take] for k, v in inputs.items()}
+            outs.append(self._infer_bucketed(chunk, take))
+            start += take
+        self.requests_served += 1
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
+
+    def _infer_bucketed(self, chunk: Dict[str, np.ndarray], n: int) -> np.ndarray:
+        import jax
+
+        dp = self.ff.mesh.shape.get("data", 1) if self.ff.mesh else 1
+        b = _bucket(n, self.max_batch, multiple=dp)
+        padded = {}
+        for k, v in chunk.items():
+            if len(v) < b:
+                pad = np.zeros((b - len(v),) + v.shape[1:], v.dtype)
+                v = np.concatenate([v, pad])
+            padded[k] = v
+        sh = self.ff.executor.input_shardings()
+        put = {k: jax.device_put(v, sh[k]) for k, v in padded.items()}
+        out = self._fwd(self.ff._weights, self.ff._state, put)
+        return np.asarray(out)[:n]
+
+    def input_names(self) -> Sequence[str]:
+        return list(self._input_names)

@@ -1,0 +1,73 @@
+"""Minimal HTTP JSON inference endpoint (stdlib-only; the Triton
+backend's HTTP surface analogue).
+
+POST /v2/infer     {"inputs": {name: nested-list, ...}} -> {"outputs": [...]}
+GET  /v2/health    -> {"status": "ok", "requests": N}
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+def serve_http(batcher, host: str = "127.0.0.1", port: int = 8000,
+               block: bool = True):
+    """Serve a DynamicBatcher (or bare InferenceEngine) over HTTP.
+    Returns the server object; when block=False it runs on a daemon
+    thread (server.shutdown() stops it)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v2/health":
+                served = getattr(batcher, "batches_run",
+                                 getattr(batcher, "requests_served", 0))
+                self._send(200, {"status": "ok", "requests": served})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v2/infer":
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                inputs = {
+                    k: np.asarray(v, dtype=np.float32)
+                    if not _is_int(v) else np.asarray(v, dtype=np.int32)
+                    for k, v in req["inputs"].items()
+                }
+                out = batcher.infer(inputs)
+                self._send(200, {"outputs": np.asarray(out).tolist()})
+            except Exception as e:  # surface as a JSON error
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    if block:
+        server.serve_forever()
+    else:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+    return server
+
+
+def _is_int(v) -> bool:
+    x = v
+    while isinstance(x, (list, tuple)) and x:
+        x = x[0]
+    return isinstance(x, int)
