@@ -29,7 +29,7 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     if on_tpu:
-        batch, seq, hidden, layers, heads, inter = 32, 128, 768, 12, 12, 3072
+        batch, seq, hidden, layers, heads, inter = 64, 128, 768, 12, 12, 3072
     else:  # CPU smoke config so the bench always produces a line
         batch, seq, hidden, layers, heads, inter = 8, 32, 64, 2, 4, 128
 
@@ -47,6 +47,12 @@ def main():
     rng = np.random.RandomState(0)
     x = rng.randn(batch, seq, hidden).astype(np.float32)
     y = rng.randint(0, 2, batch).astype(np.int32)
+    # stage the batch on-device once: the bench measures steady-state
+    # step time (train data is device-resident via the dataloader's
+    # prefetch in real runs; under axon the tunnel would otherwise add
+    # a noisy ~25MB host->device copy per step)
+    x = jax.device_put(x, ff.executor.input_shardings()["input"])
+    y = jax.device_put(y, ff.executor.label_sharding())
 
     import sys
 
@@ -55,14 +61,21 @@ def main():
     # warmup (compile + cache)
     for _ in range(3):
         m = ff.train_step({"input": x}, y)
-    jax.block_until_ready(m["loss"])
+    _ = float(m["loss"])  # hard fetch: tunnel block_until_ready is unreliable
     print(f"bench: warmup done in {time.perf_counter()-t_c:.1f}s", file=sys.stderr)
 
-    iters = 20 if on_tpu else 5
+    # Steady-state step time: device-resident batch, long serial chain
+    # (each step consumes the previous step's donated weights), one hard
+    # value fetch of the final loss AND a weight leaf at the end — under
+    # the axon tunnel, block_until_ready alone returns early, and any
+    # per-step host round-trip adds ~80ms of tunnel latency that real
+    # training (prefetched dataloader) never pays.
+    iters = 50 if on_tpu else 5
     t0 = time.perf_counter()
     for _ in range(iters):
         m = ff.train_step({"input": x}, y)
-    jax.block_until_ready(m["loss"])
+    _ = float(m["loss"])
+    _ = np.asarray(jax.tree.leaves(ff._weights)[0]).ravel()[0]
     dt = time.perf_counter() - t0
 
     samples_per_sec = iters * batch / dt

@@ -61,6 +61,9 @@ class FFConfig:
     profiling: bool = False
     parameter_sync: ParameterSyncType = ParameterSyncType.ALL_REDUCE
     compute_dtype: str = "float32"  # bf16 on TPU for perf runs
+    # use the Pallas flash-attention kernel only at KV length >= this
+    # (0 = always; plain XLA attention wins at short sequence)
+    flash_min_seq: int = 0
 
     # -- exports (reference: --taskgraph/--compgraph/--include-costs-dot-graph)
     export_taskgraph_file: Optional[str] = None
@@ -106,6 +109,8 @@ class FFConfig:
         p.add_argument("--simulator-segment-size", type=int, default=16777216)
         p.add_argument("--fusion", action="store_true")
         p.add_argument("--profiling", action="store_true")
+        p.add_argument("--flash-min-seq", dest="flash_min_seq", type=int,
+                       default=0)
         p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
         p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
         p.add_argument("--taskgraph", type=str, default=None)
@@ -135,6 +140,7 @@ class FFConfig:
             simulator_segment_size=args.simulator_segment_size,
             perform_fusion=args.fusion,
             profiling=args.profiling,
+            flash_min_seq=args.flash_min_seq,
             export_strategy_file=args.export_strategy,
             import_strategy_file=args.import_strategy,
             export_taskgraph_file=args.taskgraph,

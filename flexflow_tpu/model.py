@@ -606,6 +606,8 @@ class FFModel:
                 cfg.compute_dtype if cfg.compute_dtype != "float32" else None
             ),
         )
+        for op in self.operators.topo_order():
+            op._flash_min_seq = cfg.flash_min_seq
         self._weights, self._state = self.executor.init_weights(
             seed if seed is not None else cfg.seed
         )

@@ -218,7 +218,13 @@ class MultiHeadAttention(Op):
             )
         kv_appended = kh.shape[1] - self.inputs[1].shape.logical_shape[1]
         use_dropout = training and p.dropout > 0.0 and rng is not None
-        if not use_dropout and not (p.causal and kv_appended):
+        # FFConfig.flash_min_seq (--flash-min-seq), set on ops at compile
+        flash_min = getattr(self, "_flash_min_seq", 0)
+        if (
+            not use_dropout
+            and not (p.causal and kv_appended)
+            and kh.shape[1] >= flash_min
+        ):
             # hot path: flash attention (Pallas on TPU, fused jnp off-TPU)
             from .pallas.flash_attention import mha_flash
 
