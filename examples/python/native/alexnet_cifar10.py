@@ -1,0 +1,30 @@
+"""BASELINE minimum slice: AlexNet on CIFAR-10, pure data parallel
+(reference bootcamp_demo/ff_alexnet_cifar10.py; BASELINE.md row 3).
+Uses the keras cifar10 loader (cached real data or synthetic blobs)."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.keras import datasets
+from flexflow_tpu.models import build_alexnet
+
+
+def main():
+    cfg = FFConfig.from_args()
+    cfg.only_data_parallel = True
+    ff = FFModel(cfg)
+    # AlexNet's stride-4 stem needs >=64px inputs; CIFAR is upsampled 2x
+    build_alexnet(ff, batch_size=cfg.batch_size, num_classes=10, image_size=64)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    (x_train, y_train), _ = datasets.cifar10.load_data(num_samples=1024)
+    xs = x_train.astype(np.float32) / 255.0
+    xs = xs.repeat(2, axis=2).repeat(2, axis=3)  # 32 -> 64 px
+    ys = y_train.reshape(-1).astype(np.int32)
+    ff.fit(xs, ys, epochs=cfg.epochs, shuffle=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""BASELINE north-star config 1: ResNet-50 imported via torch.fx,
+strategy discovered by search (reference: fx.torch_to_flexflow +
+--budget; BASELINE.md row 1).
+
+torchvision isn't in this image, so the standard bottleneck ResNet-50
+is defined inline in plain torch and symbolically traced; run with
+`--budget 1000 --search-algo mcmc` to reproduce the north-star setup.
+"""
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.torch_frontend.model import PyTorchModel
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.conv3 = nn.Conv2d(width, cout, 1, bias=False)
+        self.relu = nn.ReLU()
+        self.down = (
+            nn.Conv2d(cin, cout, 1, stride, bias=False)
+            if stride != 1 or cin != cout else None
+        )
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        y = self.relu(self.conv1(x))
+        y = self.relu(self.conv2(y))
+        y = self.conv3(y)
+        return self.relu(y + idt)
+
+
+class ResNet50(nn.Module):
+    def __init__(self, classes=1000):
+        super().__init__()
+        self.stem = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.pool = nn.MaxPool2d(3, 2, 1)
+        self.relu = nn.ReLU()
+        layers = []
+        cin = 64
+        for width, blocks, stride in [(64, 3, 1), (128, 4, 2),
+                                      (256, 6, 2), (512, 3, 2)]:
+            for i in range(blocks):
+                layers.append(Bottleneck(cin, width, stride if i == 0 else 1))
+                cin = width * Bottleneck.expansion
+        self.layers = nn.Sequential(*layers)
+        self.avg = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(cin, classes)
+
+    def forward(self, x):
+        x = self.pool(self.relu(self.stem(x)))
+        x = self.layers(x)
+        x = self.avg(x)
+        x = torch.flatten(x, 1)
+        return self.fc(x)
+
+
+def main():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 3, 224, 224], name="input")
+    pt = PyTorchModel(ResNet50(classes=1000))
+    (out,) = pt.torch_to_ff(ff, [x])
+    out = ff.softmax(out)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.1),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    print(f"strategy: mesh={ff.strategy.mesh_axes}")
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    xs = rng.randn(n, 3, 224, 224).astype(np.float32)
+    ys = rng.randint(0, 1000, n).astype(np.int32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,7 @@ class FFModel:
         self._opt_state = None
         self._state = None
         self._step_fn = None
+        self._step_cache: Dict[int, tuple] = {}
         self._eval_fn = None
         self._rng = None
         self._label_replication = 1
@@ -608,12 +609,19 @@ class FFModel:
         )
         for op in self.operators.topo_order():
             op._flash_min_seq = cfg.flash_min_seq
+            # keep the live graph in sync with iter_config across
+            # compile/recompile (ops are rebuilt, the config persists)
+            op._iter_seq_length = self.iter_config.seq_length
+        self._step_cache = {}
         self._weights, self._state = self.executor.init_weights(
             seed if seed is not None else cfg.seed
         )
         self._opt_state = self.optimizer.init_state(self._weights)
         self._step_fn = self.executor.build_step()
         self._eval_fn = self.executor.build_eval_step()
+        self._step_cache[self.iter_config.seq_length] = (
+            self._step_fn, self._eval_fn,
+        )
         self._rng = jax.random.key(cfg.seed)
         if cfg.export_compgraph_file:
             self.layers.export_dot(cfg.export_compgraph_file)
@@ -632,8 +640,30 @@ class FFModel:
         put_labels = jax.device_put(labels, self.executor.label_sharding())
         return put_inputs, put_labels
 
-    def train_step(self, inputs: Dict[str, np.ndarray], labels: np.ndarray):
+    def set_iteration_config(self, seq_length: Optional[int]):
+        """FFIterationConfig.seq_length threading (reference
+        model.cc:2415-2419): BatchMatmul ops mask positions past
+        seq_length on their declared seq dims.  Step functions are
+        memoized per seq_length, so alternating bucketed lengths pays
+        one trace each, then dict lookups."""
+        if seq_length is None or seq_length == self.iter_config.seq_length:
+            return
+        self.iter_config.seq_length = seq_length
+        for op in self.operators.topo_order():
+            op._iter_seq_length = seq_length
+        cached = self._step_cache.get(seq_length)
+        if cached is None:
+            self._step_fn = self.executor.build_step()
+            self._eval_fn = self.executor.build_eval_step()
+            self._step_cache[seq_length] = (self._step_fn, self._eval_fn)
+        else:
+            self._step_fn, self._eval_fn = cached
+        self._fwd_fn = None
+
+    def train_step(self, inputs: Dict[str, np.ndarray], labels: np.ndarray,
+                   seq_length: Optional[int] = None):
         """One jitted iteration: forward + loss + backward + metrics + update."""
+        self.set_iteration_config(seq_length)
         put_inputs, put_labels = self._device_put_batch(inputs, labels)
         self._rng, step_rng = jax.random.split(self._rng)
         self._weights, self._opt_state, self._state, m = self._step_fn(
@@ -709,7 +739,9 @@ class FFModel:
     def init_operators(self):
         return None
 
-    def forward(self, inputs: Dict[str, np.ndarray]):
+    def forward(self, inputs: Dict[str, np.ndarray],
+                seq_length: Optional[int] = None):
+        self.set_iteration_config(seq_length)
         if self._fwd_fn is None:
             self._fwd_fn = self.executor.build_forward()
         put = {
@@ -774,6 +806,11 @@ class FFModel:
         self.optimizer.set_lr(lr)
         if self.executor is not None:
             self._step_fn = self.executor.build_step()
+            self._eval_fn = self.executor.build_eval_step()
+            # step fns traced under the old lr are stale
+            self._step_cache = {
+                self.iter_config.seq_length: (self._step_fn, self._eval_fn)
+            }
 
     # -- weight access (reference get_tensor/set_tensor,
     #    parallel_tensor.cc:650-750) -------------------------------------

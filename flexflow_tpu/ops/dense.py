@@ -363,7 +363,25 @@ class BatchMatmul(Op):
 
     def forward(self, inputs, weights, *, training=False, rng=None):
         a, b = inputs
+        # FFIterationConfig.seq_length early truncation
+        # (batch_matmul.cc:70-77): positions past seq_length on the
+        # declared seq dims are masked out — static shapes for XLA, the
+        # masked work is dead and fuses away.
+        seq_len = getattr(self, "_iter_seq_length", -1)
+        p: BatchMatmulParams = self.params
+        if seq_len > 0:
+            a = self._mask_seq(a, p.a_seq_length_dim, seq_len)
+            b = self._mask_seq(b, p.b_seq_length_dim, seq_len)
         return [jnp.matmul(a, b)]
+
+    @staticmethod
+    def _mask_seq(x, dim: int, seq_len: int):
+        if dim < 0 or dim >= x.ndim:
+            return x
+        idx = jnp.arange(x.shape[dim])
+        shape = [1] * x.ndim
+        shape[dim] = x.shape[dim]
+        return x * (idx < seq_len).reshape(shape).astype(x.dtype)
 
     def flops(self):
         a = self.inputs[0].shape.logical_shape

@@ -438,9 +438,7 @@ class UnitySearch:
                     assign_views(g, strategy.mesh_axes)
                 except (ShapeError, ValueError):
                     continue
-                obj = time + lam * mem
-                if self.memory_budget is not None and lam == 0.0 and mem > self.memory_budget:
-                    obj *= 1.0 + (mem / self.memory_budget - 1.0)
+                obj = self._objective(time, mem, lam)
                 slog.debug(
                     "candidate dp=%d tp=%d ep=%d: time=%.3gms mem=%.1fMB obj=%.3g%s",
                     dp, tp, ep, time * 1e3, mem / 2**20, obj,
@@ -448,7 +446,88 @@ class UnitySearch:
                 )
                 if obj < best_obj:
                     best, best_obj = strategy, obj
+            for strategy, obj, label in self._sp_candidates(lam):
+                slog.debug(
+                    "candidate %s: obj=%.3g%s", label, obj,
+                    " *best*" if obj < best_obj else "",
+                )
+                if obj < best_obj:
+                    best, best_obj = strategy, obj
         return best
+
+    def _objective(self, time: float, mem: int, lam: float) -> float:
+        """Single ranking formula for ALL candidate families (dp/tp/ep
+        and sp): time + lambda*mem, with an over-budget penalty in the
+        lam=0 pass."""
+        obj = time + lam * mem
+        if (
+            self.memory_budget is not None
+            and lam == 0.0
+            and mem > self.memory_budget
+        ):
+            obj *= 1.0 + (mem / self.memory_budget - 1.0)
+        return obj
+
+    def _sp_candidates(self, lam: float):
+        """Sequence-parallel (context-parallel) candidates: dp x sp
+        meshes where activations are seq-sharded and attention lowers to
+        ring attention over ICI (parallel/ring_attention.py) — the
+        long-context strategy slot the reference leaves empty (SURVEY
+        §5).  Costed with the same Simulator terms as the DP search plus
+        the ring's KV-rotation traffic."""
+        has_attn = any(
+            op.op_type == OperatorType.MULTIHEAD_ATTENTION for op in self.graph.ops
+        )
+        if not has_attn:
+            return
+        sources = [op for op in self.graph.ops
+                   if op.op_type == OperatorType.INPUT]
+        seq_ok = all(
+            op.outputs[0].shape.logical_rank >= 3 for op in sources
+        )
+        if not seq_ok:
+            return
+        training = True
+        for sp in range(2, self.n + 1):
+            if self.n % sp:
+                continue
+            dp = self.n // sp
+            if any(
+                op.outputs[0].shape.logical_shape[1] % sp
+                for op in sources
+            ):
+                continue
+            mesh_axes = {"seq": sp}
+            if dp > 1:
+                mesh_axes["data"] = dp
+            s = Strategy(mesh_axes=dict(mesh_axes))
+            chain = []
+            if dp > 1:
+                chain.append(("repartition", {"dim": 0, "degree": dp}))
+            chain.append(("repartition", {"dim": 1, "degree": sp}))
+            s.edge_ops["__inputs__"] = chain
+            try:
+                g = apply_strategy(self.graph, s)
+                assign_views(g, s.mesh_axes)
+            except (ShapeError, ValueError):
+                continue
+            res = self._sim.simulate(g, mesh_axes, training=training)
+            # ring attention KV rotation: ~an allgather of the group's
+            # K+V per attention forward; backward re-rotates KV and
+            # rotates dK/dV (~2x more); comm overlaps blockwise compute
+            ring = 0.0
+            for op in g.topo_order():
+                if op.op_type != OperatorType.MULTIHEAD_ATTENTION:
+                    continue
+                kv_bytes = (
+                    op.inputs[1].shape.shard_bytes()
+                    + op.inputs[2].shape.shard_bytes()
+                ) * sp
+                ring += 3.0 * self._comm_time("allgather", kv_bytes, sp)
+            time = res.total_time + ring * (1.0 - self.overlap)
+            mem = res.per_device_memory
+            obj = self._objective(time, mem, lam)
+            yield s, obj, f"dp={dp} sp={sp} (ring attention)"
 
     def optimize_with_memory(self) -> Optional[Strategy]:
         """Lambda binary search (reference try_one_lambda + binary search,

@@ -68,3 +68,39 @@ def test_assign_axes_replica():
     s = ParallelTensorShape.make([32], replica_degree=8)
     view = assign_axes(s, {"data": 8})
     assert view.axes == ((), ("data",))
+
+
+def test_batch_matmul_seq_length_truncation(devices8):
+    """FFIterationConfig.seq_length parity (batch_matmul.cc:70-77):
+    positions past seq_length on the declared seq dim are masked."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+    def build():
+        ff = FFModel(FFConfig(batch_size=2))
+        a = ff.create_tensor([2, 8, 4], name="a")
+        b = ff.create_tensor([2, 4, 8], name="b")
+        ff.batch_matmul(a, b, a_seq_length_dim=1, b_seq_length_dim=2)
+        ff.compile(optimizer=SGDOptimizer(lr=0.1), devices=devices8[:1])
+        return ff
+
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 8, 4).astype(np.float32)
+    b = rng.randn(2, 4, 8).astype(np.float32)
+
+    ff = build()
+    full = np.asarray(ff.forward({"a": a, "b": b}))
+    np.testing.assert_allclose(full, a @ b, rtol=1e-5, atol=1e-5)
+
+    trunc = np.asarray(ff.forward({"a": a, "b": b}, seq_length=3))
+    a3 = a.copy()
+    a3[:, 3:, :] = 0.0
+    b3 = b.copy()
+    b3[:, :, 3:] = 0.0
+    np.testing.assert_allclose(trunc, a3 @ b3, rtol=1e-5, atol=1e-5)
+    assert ff.iter_config.seq_length == 3
+
+    # resetting to full length restores the untruncated program
+    again = np.asarray(ff.forward({"a": a, "b": b}, seq_length=8))
+    np.testing.assert_allclose(again, a @ b, rtol=1e-5, atol=1e-5)

@@ -124,3 +124,35 @@ def test_imported_model_trains(devices8):
     hist = ff.fit(x, y, batch_size=16, epochs=5, verbose=False)
     # accuracy improves across epochs (default metrics = accuracy only)
     assert hist[-1].accuracy > hist[0].accuracy
+
+
+def test_resnet50_example_imports_and_trains(devices8):
+    """BASELINE north-star config 1's model: the examples/ ResNet-50
+    (inline torchvision-equivalent) fx-imports and runs a train step
+    with numerical-parity weights."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "examples", "python", "pytorch"))
+    from resnet50_search import ResNet50
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.torch_frontend.model import PyTorchModel
+
+    cfg = FFConfig(batch_size=8, num_devices=8)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 3, 64, 64], name="input")
+    pt = PyTorchModel(ResNet50(classes=10))
+    (out,) = pt.torch_to_ff(ff, [x])
+    ff.softmax(out)
+    assert len(ff.layers.topo_order()) > 100  # full 16-block tower
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devices8)
+    rng = np.random.RandomState(0)
+    m = ff.train_step(
+        {"input": rng.randn(8, 3, 64, 64).astype(np.float32)},
+        rng.randint(0, 10, 8).astype(np.int32),
+    )
+    assert np.isfinite(float(m["loss"]))

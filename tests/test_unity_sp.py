@@ -1,0 +1,88 @@
+"""Unity search with sequence-parallel candidates: the search must
+consider dp x sp (ring attention) meshes, pick SP when attention
+dominates at long sequence, and its chosen strategy must execute
+correctly end-to-end."""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models.transformer import build_bert
+from flexflow_tpu.pcg.unity import UnitySearch
+from flexflow_tpu.sim.machine_model import TpuPodModel
+from flexflow_tpu.sim.simulator import OpCostModel
+
+
+def _bert(seq, hidden=32, heads=4, layers=1, batch=8):
+    ff = FFModel(FFConfig(batch_size=batch))
+    build_bert(ff, batch_size=batch, seq_length=seq, hidden_size=hidden,
+               num_layers=layers, num_heads=heads,
+               intermediate_size=hidden * 2)
+    return ff
+
+
+def _search(ff, n=8):
+    m = TpuPodModel()
+    return UnitySearch(ff.layers, n, m, OpCostModel(m))
+
+
+def test_sp_candidates_enumerated():
+    ff = _bert(seq=512)
+    s = _search(ff)
+    cands = list(s._sp_candidates(0.0))
+    degrees = sorted(int(lbl.split("sp=")[1].split(" ")[0])
+                     for _, _, lbl in cands)
+    assert degrees == [2, 4, 8]
+    for strat, obj, _ in cands:
+        assert "seq" in strat.mesh_axes
+        assert np.isfinite(obj) and obj > 0
+
+
+def test_sp_not_offered_without_attention():
+    ff = FFModel(FFConfig(batch_size=8))
+    from flexflow_tpu.fftype import ActiMode
+
+    x = ff.create_tensor([8, 16, 8], name="x")
+    t = ff.dense(x, 8, activation=ActiMode.RELU)
+    ff.softmax(t)
+    s = _search(ff)
+    assert list(s._sp_candidates(0.0)) == []
+
+
+def test_search_returns_valid_strategy_with_sp_in_space():
+    ff = _bert(seq=256)
+    s = _search(ff)
+    best = s.optimize()
+    assert best is not None
+    # whatever won, it must apply + execute (validated inside optimize,
+    # re-checked here through compile)
+    import jax
+
+    devs = jax.devices("cpu")[:8]
+    ff2 = _bert(seq=256)
+    ff2.compile(optimizer=SGDOptimizer(lr=0.01), strategy=best, devices=devs)
+    xs = np.random.RandomState(0).randn(8, 256, 32).astype(np.float32)
+    out = np.asarray(ff2.forward({"input": xs}))
+    assert np.isfinite(out).all()
+
+
+def test_sp_strategy_from_search_matches_single_device(devices8):
+    """Force the SP winner by costing: long seq, tiny hidden makes
+    attention (O(s^2)) dominate, and verify numerics of the searched
+    strategy against 1 device."""
+    ff = _bert(seq=512, hidden=16, heads=2)
+    s = _search(ff)
+    cands = list(s._sp_candidates(0.0))
+    strat = min(cands, key=lambda c: c[1])[0]
+
+    ff_sp = _bert(seq=512, hidden=16, heads=2)
+    ff_sp.compile(optimizer=SGDOptimizer(lr=0.01), strategy=strat,
+                  devices=devices8, seed=3)
+    ff_1 = _bert(seq=512, hidden=16, heads=2)
+    ff_1.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices8[:1], seed=3)
+
+    xs = np.random.RandomState(1).randn(8, 512, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ff_sp.forward({"input": xs})),
+        np.asarray(ff_1.forward({"input": xs})),
+        rtol=2e-4, atol=2e-4,
+    )
