@@ -1,0 +1,30 @@
+"""CANDLE-Uno demo (reference examples/cpp/candle_uno,
+osdi22ae/candle_uno.sh): multi-tower drug-response regression."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_candle_uno
+
+INPUT_DIMS = (942, 5270, 2048)
+
+
+def main():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    build_candle_uno(ff, batch_size=cfg.batch_size,
+                     input_dims=list(INPUT_DIMS))
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    xs = {f"input_{i}": rng.randn(n, d).astype(np.float32)
+          for i, d in enumerate(INPUT_DIMS)}
+    ys = rng.rand(n, 1).astype(np.float32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

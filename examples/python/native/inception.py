@@ -1,0 +1,27 @@
+"""InceptionV3 training demo (reference examples/cpp/InceptionV3,
+Unity AE scripts/osdi22ae/inception.sh: b=64 budget=10)."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_inception_v3
+
+
+def main():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    build_inception_v3(ff, batch_size=cfg.batch_size, num_classes=10,
+                       image_size=299)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    xs = rng.randn(n, 3, 299, 299).astype(np.float32)
+    ys = rng.randint(0, 10, n).astype(np.int32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""ResNeXt-50 (32x4d) training demo (reference examples/cpp/resnext50,
+Unity AE scripts/osdi22ae/resnext-50.sh: b=16 budget=20)."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_resnext50
+
+
+def main():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    build_resnext50(ff, batch_size=cfg.batch_size, num_classes=1000,
+                    image_size=224)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 4
+    xs = rng.randn(n, 3, 224, 224).astype(np.float32)
+    ys = rng.randint(0, 1000, n).astype(np.int32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

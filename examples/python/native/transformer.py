@@ -1,0 +1,34 @@
+"""BERT/Transformer training demo (reference
+examples/cpp/Transformer/transformer.cc: 12L/1024h/16heads/seq512 at
+b=8 in the Unity AE, scripts/osdi22ae/bert.sh).
+
+`--budget N` lets the search pick a hybrid dp x tp / dp x sp strategy.
+"""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_transformer
+
+
+def main():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    build_transformer(ff, batch_size=cfg.batch_size, seq_length=512,
+                      hidden_size=1024, num_layers=12, num_heads=16)
+    # per-token scalar head (dense -> 1), MSE — the reference example's
+    # synthetic objective shape
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.001),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    print(f"strategy: {ff.strategy.mesh_axes}")
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 8
+    xs = rng.randn(n, 512, 1024).astype(np.float32)
+    ys = rng.rand(n, 512, 1).astype(np.float32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""XDL recommender demo (reference examples/cpp/XDL, osdi22ae/xdl.sh)."""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_xdl
+
+EMB = (100000, 100000, 100000, 100000)
+
+
+def main():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    build_xdl(ff, batch_size=cfg.batch_size, embedding_size=EMB,
+              sparse_feature_size=64)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[MetricsType.MEAN_SQUARED_ERROR],
+    )
+    rng = np.random.RandomState(0)
+    n = cfg.batch_size * 8
+    xs = {f"sparse_input_{i}": rng.randint(0, v, size=(n, 1)).astype(np.int32)
+          for i, v in enumerate(EMB)}
+    ys = rng.rand(n, 2).astype(np.float32)
+    ff.fit(xs, ys, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    main()

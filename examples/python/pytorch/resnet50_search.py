@@ -21,19 +21,23 @@ class Bottleneck(nn.Module):
         super().__init__()
         cout = width * self.expansion
         self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
         self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
         self.conv3 = nn.Conv2d(width, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
         self.relu = nn.ReLU()
         self.down = (
-            nn.Conv2d(cin, cout, 1, stride, bias=False)
+            nn.Sequential(nn.Conv2d(cin, cout, 1, stride, bias=False),
+                          nn.BatchNorm2d(cout))
             if stride != 1 or cin != cout else None
         )
 
     def forward(self, x):
         idt = x if self.down is None else self.down(x)
-        y = self.relu(self.conv1(x))
-        y = self.relu(self.conv2(y))
-        y = self.conv3(y)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
         return self.relu(y + idt)
 
 
@@ -41,6 +45,7 @@ class ResNet50(nn.Module):
     def __init__(self, classes=1000):
         super().__init__()
         self.stem = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn = nn.BatchNorm2d(64)
         self.pool = nn.MaxPool2d(3, 2, 1)
         self.relu = nn.ReLU()
         layers = []
@@ -55,7 +60,7 @@ class ResNet50(nn.Module):
         self.fc = nn.Linear(cin, classes)
 
     def forward(self, x):
-        x = self.pool(self.relu(self.stem(x)))
+        x = self.pool(self.relu(self.bn(self.stem(x))))
         x = self.layers(x)
         x = self.avg(x)
         x = torch.flatten(x, 1)

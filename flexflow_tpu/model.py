@@ -619,8 +619,9 @@ class FFModel:
         self._opt_state = self.optimizer.init_state(self._weights)
         self._step_fn = self.executor.build_step()
         self._eval_fn = self.executor.build_eval_step()
+        self._fwd_fn = self.executor.build_forward()
         self._step_cache[self.iter_config.seq_length] = (
-            self._step_fn, self._eval_fn,
+            self._step_fn, self._eval_fn, self._fwd_fn,
         )
         self._rng = jax.random.key(cfg.seed)
         if cfg.export_compgraph_file:
@@ -655,10 +656,12 @@ class FFModel:
         if cached is None:
             self._step_fn = self.executor.build_step()
             self._eval_fn = self.executor.build_eval_step()
-            self._step_cache[seq_length] = (self._step_fn, self._eval_fn)
+            self._fwd_fn = self.executor.build_forward()
+            self._step_cache[seq_length] = (
+                self._step_fn, self._eval_fn, self._fwd_fn,
+            )
         else:
-            self._step_fn, self._eval_fn = cached
-        self._fwd_fn = None
+            self._step_fn, self._eval_fn, self._fwd_fn = cached
 
     def train_step(self, inputs: Dict[str, np.ndarray], labels: np.ndarray,
                    seq_length: Optional[int] = None):
@@ -807,9 +810,12 @@ class FFModel:
         if self.executor is not None:
             self._step_fn = self.executor.build_step()
             self._eval_fn = self.executor.build_eval_step()
+            self._fwd_fn = self.executor.build_forward()
             # step fns traced under the old lr are stale
             self._step_cache = {
-                self.iter_config.seq_length: (self._step_fn, self._eval_fn)
+                self.iter_config.seq_length: (
+                    self._step_fn, self._eval_fn, self._fwd_fn,
+                )
             }
 
     # -- weight access (reference get_tensor/set_tensor,

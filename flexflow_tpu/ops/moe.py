@@ -121,13 +121,13 @@ class GroupBy(Op):
         return [ParallelTensorShape(dims, data.dtype)]
 
     def forward(self, inputs, weights, *, training=False, rng=None):
+        from .moe_dispatch import sort_group_by
+
         data, assign = inputs
         p: GroupByParams = self.params
         b, k = assign.shape
         cap = _capacity(b, k, p.n, p.alpha)
-        disp = _dispatch_mask(assign, p.n, cap)  # [b, k, n, cap]
-        expert_in = jnp.einsum("bknc,bd->ncd", disp, data)
-        return [expert_in.astype(data.dtype)]
+        return [sort_group_by(data, assign, p.n, cap)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,15 +161,16 @@ class Aggregate(Op):
         return [ParallelTensorShape(dims, expert_out.dtype)]
 
     def forward(self, inputs, weights, *, training=False, rng=None):
+        from .moe_dispatch import sort_combine
+
         gate_scores, assign, gate_full, expert_out = inputs
         p: AggregateParams = self.params
         n, cap, e = expert_out.shape
         b, k = assign.shape
-        disp = _dispatch_mask(assign, n, cap)  # [b, k, n, cap]
         denom = jnp.sum(gate_scores, axis=-1, keepdims=True) + 1e-9
         norm_scores = gate_scores / denom
-        combine = jnp.einsum("bknc,bk->bnc", disp, norm_scores)
-        y = jnp.einsum("bnc,nce->be", combine, expert_out)
+        rows, _ = sort_combine(expert_out, assign, cap)  # [bk, e]
+        y = jnp.sum(rows.reshape(b, k, e) * norm_scores[:, :, None], axis=1)
         self._last_aux = self._balance_loss(assign, gate_full, n, p.lambda_bal)
         return [y.astype(expert_out.dtype)]
 
@@ -202,15 +203,16 @@ class AggregateSpec(Aggregate):
         return [ParallelTensorShape(dims, expert_out.dtype)]
 
     def forward(self, inputs, weights, *, training=False, rng=None):
+        from .moe_dispatch import sort_combine
+
         gate_scores, assign, gate_full, expert_out = inputs
         p: AggregateParams = self.params
         n, cap, e = expert_out.shape
         b, k = assign.shape
-        disp = _dispatch_mask(assign, n, cap)  # [b, k, n, cap]
-        # per-(sample, slot) prediction: [b, k, e]
-        preds = jnp.einsum("bknc,nce->bke", disp, expert_out)
+        # per-(sample, slot) prediction rows [bk, e]
+        preds, _ = sort_combine(expert_out, assign, cap)
         self._last_aux = self._balance_loss(assign, gate_full, n, p.lambda_bal)
-        return [preds.reshape(b * k, e).astype(expert_out.dtype)]
+        return [preds.astype(expert_out.dtype)]
 
 
 @dataclasses.dataclass(frozen=True)

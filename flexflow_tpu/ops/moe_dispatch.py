@@ -1,0 +1,72 @@
+"""Sort-based MoE dispatch (the standard TPU trick; SURVEY hard-part 3).
+
+The reference's group_by/aggregate are data-dependent CUDA
+scatter/gather kernels (group_by.cu:1-206, aggregate.cu).  The dense
+one-hot formulation (`_dispatch_mask` in moe.py) is numerically
+identical but costs O(b·k·n·cap·d) MXU work.  This module computes the
+same capacity-bounded assignment with a stable sort + rank-in-group
+scan — O(bk·log bk) on XLA:TPU's bitonic sort — and moves rows with
+one scatter-add (dispatch) / gather (combine), each O(bk·d).
+
+Priority semantics match `_dispatch_mask` exactly: tokens are served in
+flattened (sample-major, slot-minor) order; ranks past `capacity` are
+dropped.  Integer sort indices carry no gradient, matching the one-hot
+path (gradients flow through the moved rows only).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def dispatch_indices(
+    assign: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """[b, k] int expert ids -> (slot [bk], keep [bk]).
+
+    slot[i] = expert_id[i] * capacity + rank-of-i-within-its-expert
+    (clamped); keep[i] = rank < capacity.  Flat index i = b*k + slot,
+    i.e. the same priority order as the reference's cumsum scatter.
+    """
+    flat = assign.reshape(-1).astype(jnp.int32)
+    bk = flat.shape[0]
+    idx = jnp.arange(bk, dtype=jnp.int32)
+    order = jnp.argsort(flat, stable=True)  # groups tokens by expert
+    sorted_e = flat[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_e[1:] != sorted_e[:-1]]
+    )
+    group_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, idx, 0)
+    )
+    rank_sorted = idx - group_start
+    rank = jnp.zeros(bk, jnp.int32).at[order].set(rank_sorted)
+    keep = rank < capacity
+    slot = flat * capacity + jnp.minimum(rank, capacity - 1)
+    return slot, keep
+
+
+def sort_group_by(
+    data: jax.Array, assign: jax.Array, n: int, capacity: int
+) -> jax.Array:
+    """[b, d] tokens + [b, k] assignments -> [n, capacity, d] expert
+    inputs (dropped tokens contribute zero rows)."""
+    b, k = assign.shape
+    d = data.shape[1]
+    slot, keep = dispatch_indices(assign, capacity)
+    rows = jnp.repeat(data, k, axis=0)  # row i serves flat token i
+    contrib = rows * keep[:, None].astype(data.dtype)
+    out = jnp.zeros((n * capacity, d), data.dtype).at[slot].add(contrib)
+    return out.reshape(n, capacity, d)
+
+
+def sort_combine(
+    expert_out: jax.Array, assign: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """[n, cap, e] expert outputs -> per-(token, slot) rows [bk, e]
+    (zero for dropped tokens), plus keep [bk]."""
+    slot, keep = dispatch_indices(assign, capacity)
+    flat_out = expert_out.reshape(-1, expert_out.shape[-1])
+    return flat_out[slot] * keep[:, None].astype(expert_out.dtype), keep

@@ -1,0 +1,90 @@
+"""Search optimality property tests (SURVEY hard-part 1: 'budget for
+property tests against brute force on tiny graphs').
+
+On tiny graphs, enumerate EVERY strategy in the search space (mesh
+factorization x per-op option assignment), rank each with the full
+Simulator — the search's own final judge — and assert the Unity DP's
+winner is within tolerance of the brute-force optimum under that
+metric."""
+import itertools
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.pcg.mcmc import _factorizations
+from flexflow_tpu.pcg.unity import UnitySearch
+from flexflow_tpu.sim.machine_model import TpuPodModel
+from flexflow_tpu.sim.simulator import OpCostModel, Simulator
+from flexflow_tpu.strategy import apply_strategy, assign_views
+
+
+def _mlp(widths, batch=32, in_dim=32):
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor([batch, in_dim], name="x")
+    t = x
+    for i, w in enumerate(widths):
+        t = ff.dense(t, w, activation=ActiMode.RELU, name=f"fc{i}")
+    ff.softmax(t)
+    return ff
+
+
+def _brute_force_best(search: UnitySearch, sim: Simulator):
+    """Global enumeration over the identical candidate space."""
+    best_obj, best = np.inf, None
+    ops = search.graph.topo_order()
+    for dp, tp, ep in _factorizations(search.n):
+        if ep > 1:
+            continue  # no MoE in these graphs
+        mesh_axes = search._mesh_axes(dp, tp, ep)
+        options = search._options_by_op(mesh_axes)
+        opt_lists = [
+            [(op.guid, c) for c in options[op.guid]]
+            for op in ops if op.guid in options
+        ]
+        for combo in itertools.product(*opt_lists) if opt_lists else [()]:
+            shard_configs = {}
+            edges = {}
+            for guid, choice in combo:
+                op = next(o for o in ops if o.guid == guid)
+                shard_configs[op.name] = choice.shard
+                if choice.out_chain:
+                    edges[op.outputs[0].name] = list(choice.out_chain)
+            strategy = search._build_strategy(mesh_axes, dp, shard_configs,
+                                              edges)
+            try:
+                g = apply_strategy(search.graph, strategy)
+                assign_views(g, strategy.mesh_axes)
+            except Exception:
+                continue
+            res = sim.simulate(g, mesh_axes)
+            if res.total_time < best_obj:
+                best_obj, best = res.total_time, strategy
+    return best_obj, best
+
+
+@pytest.mark.parametrize("widths,n", [
+    ([64], 4), ([64, 128], 4), ([256, 64], 8),
+])
+def test_unity_within_tolerance_of_brute_force(widths, n):
+    ff = _mlp(widths)
+    machine = TpuPodModel()
+    cm = OpCostModel(machine)
+    search = UnitySearch(ff.layers, n, machine, cm)
+    sim = Simulator(machine, cm)
+
+    chosen = search.optimize()
+    assert chosen is not None
+    g = apply_strategy(ff.layers, chosen)
+    assign_views(g, chosen.mesh_axes)
+    chosen_time = sim.simulate(g, chosen.mesh_axes).total_time
+
+    bf_time, bf = _brute_force_best(search, sim)
+    assert bf is not None
+    # the DP evaluates segments with the same cost terms; allow a small
+    # slack for the chain-cost approximation at segment boundaries
+    assert chosen_time <= bf_time * 1.25 + 1e-9, (
+        f"search picked {chosen_time:.3e}s vs brute-force {bf_time:.3e}s "
+        f"(mesh {chosen.mesh_axes} vs {bf.mesh_axes})"
+    )
