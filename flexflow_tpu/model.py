@@ -481,10 +481,18 @@ class FFModel:
         self._label_replication = op.inputs[1].shape.logical_shape[-1]
         return out
 
-    def cache(self, input, num_batches: int, name=None):
-        return self._add(
-            Cache(CacheParams(num_batches), [input], name=self._name("cache", name))
-        )
+    def cache(self, input, num_batches: int, *, score_fn=None, name=None):
+        """Identity passthrough accumulating a host-side staleness score
+        (reference src/ops/cache.cc, score_f moe.cc:40-63).  score_fn, if
+        given, is called with this FFModel after every fit batch; its
+        float feeds op.trigger for recompile_on_condition."""
+        if score_fn is not None and not callable(score_fn):
+            raise TypeError(f"score_fn must be callable, got {type(score_fn)}")
+        op = Cache(CacheParams(num_batches), [input],
+                   name=self._name("cache", name))
+        op.score_fn = score_fn
+        self._add(op)
+        return op.outputs[0]
 
     def moe(
         self,
@@ -607,6 +615,12 @@ class FFModel:
                 cfg.compute_dtype if cfg.compute_dtype != "float32" else None
             ),
         )
+        # score hooks live on the FRONTEND ops (the user's handles);
+        # strategy application clones the compiled PCG's op objects
+        self._cache_ops = [
+            op for op in self.layers.topo_order()
+            if op.op_type == OperatorType.CACHE
+        ]
         for op in self.operators.topo_order():
             op._flash_min_seq = cfg.flash_min_seq
             # keep the live graph in sync with iter_config across
@@ -719,6 +733,10 @@ class FFModel:
             for batch, labels in loader:
                 m = self.train_step(batch, labels)
                 pm.update({k: float(v) for k, v in m.items() if k != "loss"})
+                for op in self._cache_ops:
+                    fn = getattr(op, "score_fn", None)
+                    if fn is not None:
+                        op.update_score(float(fn(self)))
             jax.block_until_ready(jax.tree.leaves(self._weights)[0])
             dt = time.perf_counter() - t0
             throughput = num_batches * batch_size / dt

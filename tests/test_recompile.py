@@ -2,6 +2,7 @@
 moe.cc trigger/alter usage): strategy swap mid-training preserves
 weights and training continues."""
 import numpy as np
+import pytest
 
 from flexflow_tpu import (
     FFConfig,
@@ -121,3 +122,24 @@ def test_recompile_in_training_loop_via_cache_score(devices8):
         ff.recompile_on_condition(r)
     assert r.recompilations == 1
     assert ff.mesh.devices.size == 2
+
+
+def test_cache_score_drives_recompile_trigger(devices8):
+    """moe.cc:39-98 parity: a Cache op's score_fn is polled each fit
+    batch; its running average feeds a RecompileState trigger."""
+    cfg = FFConfig(batch_size=8, num_devices=8)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([8, 8], name="x")
+    t = ff.cache(x, num_batches=4, score_fn=lambda m: 0.9)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices8)
+    rng = np.random.RandomState(0)
+    ff.fit(rng.randn(32, 8).astype(np.float32),
+           rng.randint(0, 4, 32).astype(np.int32), epochs=2, verbose=False)
+    op = ff._cache_ops[0]
+    assert op.trigger == pytest.approx(0.9)
+    assert len(op.score_history) == 4  # bounded by num_batches
+    r = RecompileState(lambda m: m._cache_ops[0].trigger > 0.5,
+                       lambda m: None, ff)
+    assert ff.recompile_on_condition(r)
