@@ -219,7 +219,9 @@ class MultiHeadAttention(Op):
         kv_appended = kh.shape[1] - self.inputs[1].shape.logical_shape[1]
         use_dropout = training and p.dropout > 0.0 and rng is not None
         # FFConfig.flash_min_seq (--flash-min-seq), set on ops at compile
-        flash_min = getattr(self, "_flash_min_seq", 0)
+        from ..config import DEFAULT_FLASH_MIN_SEQ
+
+        flash_min = getattr(self, "_flash_min_seq", DEFAULT_FLASH_MIN_SEQ)
         if (
             not use_dropout
             and not (p.causal and kv_appended)

@@ -109,3 +109,23 @@ def test_ring_attention_training_step(devices8):
     losses = [float(ff.train_step({"input": xs}, ys)["loss"]) for _ in range(8)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_flash_path_through_model_layer(devices8):
+    """flash_min_seq=0 forces the Pallas/flash branch in
+    MultiHeadAttention._attend through the full model path (coverage
+    guard: the default threshold routes short seqs to plain XLA)."""
+    import numpy as np
+
+    def build(flash_min):
+        ff = FFModel(FFConfig(batch_size=4, num_devices=1,
+                              flash_min_seq=flash_min))
+        build_bert(ff, batch_size=4, seq_length=32, hidden_size=32,
+                   num_layers=1, num_heads=4, intermediate_size=64)
+        ff.compile(devices=devices8[:1], seed=11)
+        return ff
+
+    xs = np.random.RandomState(0).randn(4, 32, 32).astype(np.float32)
+    out_flash = np.asarray(build(0).forward({"input": xs}))
+    out_plain = np.asarray(build(10_000).forward({"input": xs}))
+    np.testing.assert_allclose(out_flash, out_plain, rtol=2e-4, atol=2e-4)
