@@ -20,8 +20,12 @@ from .fftype import ParameterSyncType
 
 # single source of truth for the flash-attention crossover (see the
 # flash_min_seq field comment); attention ops fall back to this when
-# used outside FFModel.compile
-DEFAULT_FLASH_MIN_SEQ = 4096
+# used outside FFModel.compile.  Effectively "XLA by default": measured
+# on-chip, XLA's fused attention beat the Pallas kernel at every length
+# tried (seq 128: 36.9 vs 47.9 ms/step; seq 8192: 163 vs 209 ms/step,
+# BERT-base-width, honest steady-state timing) — XLA applies its own
+# flash-style rewrite without materializing the score matrix.
+DEFAULT_FLASH_MIN_SEQ = 1 << 30
 
 
 @dataclasses.dataclass
@@ -66,11 +70,8 @@ class FFConfig:
     profiling: bool = False
     parameter_sync: ParameterSyncType = ParameterSyncType.ALL_REDUCE
     compute_dtype: str = "float32"  # bf16 on TPU for perf runs
-    # use the Pallas flash-attention kernel only at KV length >= this.
-    # Measured on-chip (BERT-base, honest steady-state): XLA's fused
-    # attention beats the Pallas kernel through seq 2048 (1736 vs 1337
-    # samples/s at seq 128); flash earns its keep where the [s, s]
-    # score materialization threatens HBM.  0 forces flash everywhere.
+    # use the Pallas flash-attention kernel only at KV length >= this;
+    # 0 forces flash everywhere (see DEFAULT_FLASH_MIN_SEQ above)
     flash_min_seq: int = DEFAULT_FLASH_MIN_SEQ
 
     # -- exports (reference: --taskgraph/--compgraph/--include-costs-dot-graph)

@@ -24,6 +24,7 @@ from .layers import (
     Flatten,
     Input,
     LayerNormalization,
+    LSTM,
     MaxPooling2D,
     Multiply,
     Permute,
@@ -37,6 +38,7 @@ __all__ = [
     "Activation", "Add", "AveragePooling2D", "BatchNormalization",
     "Callback", "Concatenate", "Conv2D", "Dense", "Dropout",
     "EarlyStopping", "Embedding", "Flatten", "Input",
-    "LayerNormalization", "LearningRateScheduler", "MaxPooling2D",
+    "LayerNormalization", "LearningRateScheduler", "LSTM", "MaxPooling2D",
     "Model", "Multiply", "Permute", "Reshape", "Sequential", "Subtract",
+    "datasets",
 ]

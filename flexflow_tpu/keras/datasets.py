@@ -98,9 +98,19 @@ class reuters:
         cached = _npz(os.path.join(_CACHE, "reuters.npz"))
         if cached is not None:
             reuters.synthetic = False
-            return ((cached["x_train"][:num_samples],
-                     cached["y_train"][:num_samples]),
-                    (cached["x_test"], cached["y_test"]))
+
+            def norm(x, y, n):
+                # the cache stores ragged object arrays of full-vocab
+                # ids; honor num_words/maxlen like the keras loader
+                x, y = x[:n], np.asarray(y[:n])
+                out = np.zeros((len(x), maxlen), np.int64)
+                for i, seq in enumerate(x):
+                    seq = np.asarray(seq, np.int64)[:maxlen]
+                    out[i, : len(seq)] = np.clip(seq, 0, num_words - 1)
+                return out, y
+
+            return (norm(cached["x_train"], cached["y_train"], num_samples),
+                    norm(cached["x_test"], cached["y_test"], len(cached["x_test"])))
         reuters.synthetic = True
         rng = np.random.RandomState(4)
         n_test = max(1, num_samples // 4)

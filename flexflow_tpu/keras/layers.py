@@ -91,15 +91,21 @@ class Dense(Layer):
                  name: Optional[str] = None):
         super().__init__(name)
         self.units = units
-        self.activation = _act(activation)
+        # Keras allows activation="softmax" on Dense; it is a separate
+        # op here (reference convention: model ends in a Softmax op)
+        self.softmax = activation == "softmax"
+        self.activation = _act(None if self.softmax else activation)
         self.use_bias = use_bias
 
     def compute_output_shape(self, input_shapes):
         return [tuple(input_shapes[0][:-1]) + (self.units,)]
 
     def lower(self, ff, inputs):
-        return ff.dense(inputs[0], self.units, activation=self.activation,
-                        use_bias=self.use_bias, name=self.name)
+        out = ff.dense(inputs[0], self.units, activation=self.activation,
+                       use_bias=self.use_bias, name=self.name)
+        if self.softmax:
+            out = ff.softmax(out)
+        return out
 
 
 def _pair(v) -> Tuple[int, int]:
@@ -201,10 +207,12 @@ class Dropout(Layer):
 
 class Embedding(Layer):
     def __init__(self, input_dim: int, output_dim: int,
+                 input_length: Optional[int] = None,
                  name: Optional[str] = None):
         super().__init__(name)
         self.input_dim = input_dim
         self.output_dim = output_dim
+        self.input_length = input_length
 
     def compute_output_shape(self, input_shapes):
         return [tuple(input_shapes[0]) + (self.output_dim,)]
@@ -212,6 +220,31 @@ class Embedding(Layer):
     def lower(self, ff, inputs):
         return ff.embedding(inputs[0], self.input_dim, self.output_dim,
                             name=self.name)
+
+
+class LSTM(Layer):
+    """Keras-style LSTM over (seq, features) inputs (batch excluded from
+    shapes per KTensor convention); wraps the fused lax.scan LSTM op
+    (ops/recurrent.py) — goes beyond the reference's Keras frontend,
+    which never exposed its legacy nmt/ LSTM."""
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.units = units
+        self.return_sequences = return_sequences
+
+    def compute_output_shape(self, input_shapes):
+        (shape,) = input_shapes
+        if len(shape) != 2:
+            raise ValueError(f"LSTM expects (seq, features), got {shape}")
+        if self.return_sequences:
+            return [(shape[0], self.units)]
+        return [(self.units,)]
+
+    def lower(self, ff, inputs):
+        return ff.lstm(inputs[0], self.units,
+                       return_sequences=self.return_sequences, name=self.name)
 
 
 class Activation(Layer):

@@ -180,10 +180,21 @@ class Sequential(_BaseModel):
     def compile(self, *args, input_shape: Optional[Sequence[int]] = None,
                 **kwargs):
         shape = tuple(input_shape) if input_shape else self._input_shape
+        from .layers import Embedding
+
+        dtype = "float32"
+        first = self._layers[0] if self._layers else None
+        if isinstance(first, Embedding):
+            # Keras convention: Embedding-first models take int token ids;
+            # input_length supplies the shape when none was given
+            dtype = "int32"
+            if shape is None and first.input_length is not None:
+                shape = (first.input_length,)
         assert shape is not None, (
-            "Sequential needs input_shape (constructor or compile kwarg)"
+            "Sequential needs input_shape (constructor or compile kwarg, "
+            "or Embedding(input_length=...))"
         )
-        x = Input(shape)
+        x = Input(shape, dtype=dtype)
         self._inputs = [x]
         t = x
         for l in self._layers:

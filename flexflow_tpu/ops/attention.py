@@ -31,6 +31,12 @@ from ..tensor import ParallelDim, ParallelTensorShape
 from .op import Op, ShapeError, WeightSpec
 
 
+# force the flash kernel when the per-device [b, h, q, k] score tensor
+# would exceed this, regardless of flash_min_seq — OOM insurance for the
+# non-flash branch, which counts on XLA fusing the scores away
+_FLASH_FORCE_SCORE_BYTES = 2 << 30
+
+
 @dataclasses.dataclass(frozen=True)
 class MultiHeadAttentionParams:
     embed_dim: int
@@ -222,10 +228,17 @@ class MultiHeadAttention(Op):
         from ..config import DEFAULT_FLASH_MIN_SEQ
 
         flash_min = getattr(self, "_flash_min_seq", DEFAULT_FLASH_MIN_SEQ)
+        # HBM guard: when the [b, h, q, k] score matrix would be enormous,
+        # never trust the non-flash branch's reliance on XLA fusing it away
+        scores_bytes = (
+            qh.shape[0] * qh.shape[2] * qh.shape[1] * kh.shape[1]
+            * jnp.dtype(qh.dtype).itemsize
+        )
+        force_flash = scores_bytes > _FLASH_FORCE_SCORE_BYTES
         if (
             not use_dropout
             and not (p.causal and kv_appended)
-            and kh.shape[1] >= flash_min
+            and (kh.shape[1] >= flash_min or force_flash)
         ):
             # hot path: flash attention (Pallas on TPU, fused jnp off-TPU)
             from .pallas.flash_attention import mha_flash

@@ -87,3 +87,23 @@ def test_lr_scheduler_and_early_stopping(devices8):
     es = EarlyStopping(monitor="accuracy", patience=1)
     hist = m.fit(x, y, epochs=50, verbose=False, callbacks=[es])
     assert len(hist) < 50  # stopped early
+
+
+def test_keras_lstm_reuters_style(devices8):
+    """Embedding -> LSTM -> Dense classifier over the reuters loader —
+    the reference's keras dataset workload shape."""
+    from flexflow_tpu.keras import LSTM, Dense, Embedding, Sequential
+    from flexflow_tpu.keras.datasets import reuters
+
+    (x_train, y_train), _ = reuters.load_data(num_words=200, maxlen=16,
+                                              num_samples=64)
+    model = Sequential([
+        Embedding(200, 16, input_length=16),
+        LSTM(16, return_sequences=False),
+        Dense(46, activation="softmax"),
+    ])
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=16, devices=devices8)
+    hist = model.fit(x_train.astype("int32"), y_train.astype("int32"),
+                     batch_size=16, epochs=2, verbose=False)
+    assert len(hist) == 2
