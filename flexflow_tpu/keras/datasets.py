@@ -101,12 +101,15 @@ class reuters:
 
             def norm(x, y, n):
                 # the cache stores ragged object arrays of full-vocab
-                # ids; honor num_words/maxlen like the keras loader
+                # ids; out-of-vocab ids map to Keras's oov_char (2).
+                # Deviation from the real loader: over-length sequences
+                # are truncated to maxlen rather than dropped.
                 x, y = x[:n], np.asarray(y[:n])
                 out = np.zeros((len(x), maxlen), np.int64)
                 for i, seq in enumerate(x):
                     seq = np.asarray(seq, np.int64)[:maxlen]
-                    out[i, : len(seq)] = np.clip(seq, 0, num_words - 1)
+                    seq = np.where(seq < num_words, seq, 2)
+                    out[i, : len(seq)] = seq
                 return out, y
 
             return (norm(cached["x_train"], cached["y_train"], num_samples),

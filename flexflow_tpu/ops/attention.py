@@ -228,13 +228,28 @@ class MultiHeadAttention(Op):
         from ..config import DEFAULT_FLASH_MIN_SEQ
 
         flash_min = getattr(self, "_flash_min_seq", DEFAULT_FLASH_MIN_SEQ)
-        # HBM guard: when the [b, h, q, k] score matrix would be enormous,
-        # never trust the non-flash branch's reliance on XLA fusing it away
+        # HBM guard: when the PER-DEVICE [b, h, q, k] score matrix would
+        # be enormous, never trust the non-flash branch's reliance on XLA
+        # fusing it away.  Shapes here are global (GSPMD traces the full
+        # array), so divide by the partition degrees (batch/seq from the
+        # input view, heads from the channel shard).
+        part = max(1, self.inputs[0].shape.total_degree) * max(
+            1, self.shard.channel
+        )
         scores_bytes = (
             qh.shape[0] * qh.shape[2] * qh.shape[1] * kh.shape[1]
             * jnp.dtype(qh.dtype).itemsize
-        )
+        ) // part
         force_flash = scores_bytes > _FLASH_FORCE_SCORE_BYTES
+        if force_flash and (use_dropout or (p.causal and kv_appended)):
+            import warnings
+
+            warnings.warn(
+                f"{self.name}: ~{scores_bytes >> 30} GiB of attention "
+                "scores will materialize per device — the flash path "
+                "cannot take over because of "
+                + ("attention dropout" if use_dropout else "causal+bias_kv")
+            )
         if (
             not use_dropout
             and not (p.causal and kv_appended)

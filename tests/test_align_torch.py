@@ -1,0 +1,144 @@
+"""Op-level numerical alignment vs CPU PyTorch (reference tests/align:
+run the op in FF and in torch, compare tensors).  Each case builds a
+single-op FFModel, copies torch's weights in, and compares forward
+outputs on the same inputs."""
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.fftype import ActiMode
+
+RTOL, ATOL = 2e-5, 2e-5
+
+
+def _compile(ff, devices):
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices[:1])
+    return ff
+
+
+def test_align_dense(devices8):
+    torch.manual_seed(0)
+    tm = nn.Linear(16, 32)
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor([8, 16], name="x")
+    ff.dense(x, 32, name="fc")
+    _compile(ff, devices8)
+    ff.set_weights({"fc": {
+        "kernel": tm.weight.detach().numpy().T,
+        "bias": tm.bias.detach().numpy(),
+    }})
+    xs = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": xs})),
+        tm(torch.from_numpy(xs)).detach().numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_align_conv2d(devices8):
+    torch.manual_seed(1)
+    tm = nn.Conv2d(3, 8, 3, stride=2, padding=1)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor([4, 3, 16, 16], name="x")
+    ff.conv2d(x, 8, 3, 3, 2, 2, 1, 1, name="conv")
+    _compile(ff, devices8)
+    ff.set_weights({"conv": {
+        "kernel": tm.weight.detach().numpy(),
+        "bias": tm.bias.detach().numpy(),
+    }})
+    xs = np.random.RandomState(1).randn(4, 3, 16, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": xs})),
+        tm(torch.from_numpy(xs)).detach().numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_align_layernorm(devices8):
+    torch.manual_seed(2)
+    tm = nn.LayerNorm(12)
+    with torch.no_grad():
+        tm.weight.mul_(1.5).add_(0.1)
+        tm.bias.add_(0.2)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor([4, 6, 12], name="x")
+    ff.layer_norm(x, axes=[-1], name="ln")
+    _compile(ff, devices8)
+    ff.set_weights({"ln": {
+        "gamma": tm.weight.detach().numpy(),
+        "beta": tm.bias.detach().numpy(),
+    }})
+    xs = np.random.RandomState(2).randn(4, 6, 12).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": xs})),
+        tm(torch.from_numpy(xs)).detach().numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_align_pool_softmax_activations(devices8):
+    xs = np.random.RandomState(3).randn(4, 3, 8, 8).astype(np.float32)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor([4, 3, 8, 8], name="x")
+    t = ff.pool2d(x, 2, 2, 2, 2, name="pool")
+    t = ff.relu(t, inplace=False)
+    t = ff.flat(t)
+    ff.softmax(t)
+    _compile(ff, devices8)
+    want = torch.nn.functional.max_pool2d(torch.from_numpy(xs), 2, 2)
+    want = torch.relu(want).flatten(1)
+    want = torch.softmax(want, dim=-1).numpy()
+    np.testing.assert_allclose(np.asarray(ff.forward({"x": xs})), want,
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_align_embedding(devices8):
+    torch.manual_seed(4)
+    tm = nn.Embedding(50, 12)
+    ff = FFModel(FFConfig(batch_size=4))
+    from flexflow_tpu.fftype import AggrMode
+
+    x = ff.create_tensor([4, 6], dtype="int32", name="x")
+    ff.embedding(x, 50, 12, aggr=AggrMode.NONE, name="emb")
+    _compile(ff, devices8)
+    ff.set_weights({"emb": {"weight": tm.weight.detach().numpy()}})
+    xs = np.random.RandomState(4).randint(0, 50, (4, 6)).astype(np.int32)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": xs})),
+        tm(torch.from_numpy(xs.astype(np.int64))).detach().numpy(),
+        rtol=RTOL, atol=ATOL)
+
+
+def test_align_lstm(devices8):
+    """LSTM vs torch.nn.LSTM (single layer, batch_first)."""
+    torch.manual_seed(5)
+    hidden, din = 8, 6
+    tm = nn.LSTM(din, hidden, batch_first=True)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor([4, 5, din], name="x")
+    ff.lstm(x, hidden, name="lstm")
+    _compile(ff, devices8)
+
+    # torch packs gates as [i, f, g, o] over 4H rows; our kernel is
+    # [din+hidden, 4H] with the same gate order
+    w_ih = tm.weight_ih_l0.detach().numpy()  # [4H, din]
+    w_hh = tm.weight_hh_l0.detach().numpy()  # [4H, H]
+    kernel = np.concatenate([w_ih.T, w_hh.T], axis=0)  # [din+H, 4H]
+    bias = (tm.bias_ih_l0 + tm.bias_hh_l0).detach().numpy()
+    ff.set_weights({"lstm": {"kernel": kernel, "bias": bias}})
+
+    xs = np.random.RandomState(5).randn(4, 5, din).astype(np.float32)
+    want, _ = tm(torch.from_numpy(xs))
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": xs})), want.detach().numpy(),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_align_batch_matmul(devices8):
+    a = np.random.RandomState(6).randn(3, 4, 5).astype(np.float32)
+    b = np.random.RandomState(7).randn(3, 5, 6).astype(np.float32)
+    ff = FFModel(FFConfig(batch_size=3))
+    ta = ff.create_tensor([3, 4, 5], name="a")
+    tb = ff.create_tensor([3, 5, 6], name="b")
+    ff.batch_matmul(ta, tb)
+    _compile(ff, devices8)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"a": a, "b": b})),
+        torch.bmm(torch.from_numpy(a), torch.from_numpy(b)).numpy(),
+        rtol=RTOL, atol=ATOL)
