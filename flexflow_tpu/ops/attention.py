@@ -233,9 +233,14 @@ class MultiHeadAttention(Op):
         # fusing it away.  Shapes here are global (GSPMD traces the full
         # array), so divide by the partition degrees (batch/seq from the
         # input view, heads from the channel shard).
-        part = max(1, self.inputs[0].shape.total_degree) * max(
-            1, self.shard.channel
-        )
+        # non-replica dim degrees only (replication does not shrink
+        # per-device data; TP head sharding appears as q's replica dim,
+        # counted once via shard.channel)
+        data_deg = 1
+        for d in self.inputs[0].shape.dims:
+            if not d.is_replica_dim:
+                data_deg *= max(1, d.degree)
+        part = data_deg * max(1, self.shard.channel)
         scores_bytes = (
             qh.shape[0] * qh.shape[2] * qh.shape[1] * kh.shape[1]
             * jnp.dtype(qh.dtype).itemsize
@@ -248,7 +253,9 @@ class MultiHeadAttention(Op):
                 f"{self.name}: ~{scores_bytes >> 30} GiB of attention "
                 "scores will materialize per device — the flash path "
                 "cannot take over because of "
-                + ("attention dropout" if use_dropout else "causal+bias_kv")
+                + ("attention dropout" if use_dropout
+                   else "causal attention with appended kv "
+                        "(add_bias_kv/add_zero_attn)")
             )
         if (
             not use_dropout

@@ -93,7 +93,13 @@ class MCMCSearch:
         self.memory_lambda = memory_lambda
         self.rng = random.Random(seed)
         self.candidates = find_candidates(graph)
-        self.factorizations = _factorizations(num_devices)
+        # an expert axis only makes sense when expert-shardable ops
+        # exist — otherwise it just replicates work over idle devices
+        has_experts = any(c.kind == "expert" for c in self.candidates)
+        self.factorizations = [
+            (dp, tp, ep) for dp, tp, ep in _factorizations(num_devices)
+            if ep == 1 or has_experts
+        ]
         self.history: List[Tuple[int, float]] = []
 
     # -- strategy construction ------------------------------------------

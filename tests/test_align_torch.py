@@ -8,7 +8,6 @@ import torch
 import torch.nn as nn
 
 from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
-from flexflow_tpu.fftype import ActiMode
 
 RTOL, ATOL = 2e-5, 2e-5
 
