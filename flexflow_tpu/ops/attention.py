@@ -236,11 +236,8 @@ class MultiHeadAttention(Op):
         # non-replica dim degrees only (replication does not shrink
         # per-device data; TP head sharding appears as q's replica dim,
         # counted once via shard.channel)
-        data_deg = 1
-        for d in self.inputs[0].shape.dims:
-            if not d.is_replica_dim:
-                data_deg *= max(1, d.degree)
-        part = data_deg * max(1, self.shard.channel)
+        data_deg = int(np.prod(self.inputs[0].shape.degrees))
+        part = max(1, data_deg) * max(1, self.shard.channel)
         scores_bytes = (
             qh.shape[0] * qh.shape[2] * qh.shape[1] * kh.shape[1]
             * jnp.dtype(qh.dtype).itemsize

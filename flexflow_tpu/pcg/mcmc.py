@@ -27,8 +27,11 @@ from ..strategy import Strategy, apply_strategy, assign_views, data_parallel_str
 from .graph import Graph
 
 
-def _factorizations(n: int) -> List[Tuple[int, int, int]]:
-    """(data, model, expert) triples with product n."""
+def _factorizations(n: int, allow_expert: bool = True) -> List[Tuple[int, int, int]]:
+    """(data, model, expert) triples with product n.  allow_expert=False
+    drops ep>1 triples — the single source of the 'expert axis only with
+    expert-shardable ops' invariant shared by the MCMC and Unity
+    searches."""
     out = []
     for d in range(1, n + 1):
         if n % d:
@@ -37,7 +40,10 @@ def _factorizations(n: int) -> List[Tuple[int, int, int]]:
         for m in range(1, rest + 1):
             if rest % m:
                 continue
-            out.append((d, m, rest // m))
+            e = rest // m
+            if e > 1 and not allow_expert:
+                continue
+            out.append((d, m, e))
     return out
 
 
@@ -93,13 +99,10 @@ class MCMCSearch:
         self.memory_lambda = memory_lambda
         self.rng = random.Random(seed)
         self.candidates = find_candidates(graph)
-        # an expert axis only makes sense when expert-shardable ops
-        # exist — otherwise it just replicates work over idle devices
         has_experts = any(c.kind == "expert" for c in self.candidates)
-        self.factorizations = [
-            (dp, tp, ep) for dp, tp, ep in _factorizations(num_devices)
-            if ep == 1 or has_experts
-        ]
+        self.factorizations = _factorizations(
+            num_devices, allow_expert=has_experts
+        )
         self.history: List[Tuple[int, float]] = []
 
     # -- strategy construction ------------------------------------------

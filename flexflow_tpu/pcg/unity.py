@@ -421,9 +421,7 @@ class UnitySearch:
         best: Optional[Strategy] = None
         best_obj = math.inf
         with slog.enter(f"unity optimize n={self.n} lambda={lam:g}"):
-            for dp, tp, ep in _factorizations(self.n):
-                if ep > 1 and not has_moe:
-                    continue
+            for dp, tp, ep in _factorizations(self.n, allow_expert=has_moe):
                 mesh_axes = self._mesh_axes(dp, tp, ep)
                 if tp > 1 and not self._options_by_op(mesh_axes):
                     continue  # no op can use the model axis

@@ -1,0 +1,114 @@
+"""Unity-vs-data-parallel comparison (reference scripts/osdi22ae/*.sh:
+each AE workload runs the Unity search and reports its strategy's
+speedup over the pure data-parallel baseline).
+
+Per workload: run the Unity search, rank BOTH strategies with the
+simulator (the search's own judge), and — with --run — execute both on
+the available devices and print measured throughputs.
+
+  PYTHONPATH=. python scripts/unity_vs_dp.py --workload mlp -n 8
+  PYTHONPATH=. python scripts/unity_vs_dp.py --workload bert -n 8 --run
+"""
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def build(workload: str, batch: int):
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models import build_mlp_unify
+    from flexflow_tpu.models.transformer import build_transformer
+
+    ff = FFModel(FFConfig(batch_size=batch))
+    if workload == "mlp":
+        build_mlp_unify(ff, batch_size=batch, input_dim=256,
+                        hidden_dims=[2048] * 4 + [16])
+        data = {
+            "input1": np.random.randn(batch, 256).astype(np.float32),
+            "input2": np.random.randn(batch, 256).astype(np.float32),
+        }
+        labels = np.random.randint(0, 16, batch).astype(np.int32)
+    elif workload == "bert":
+        build_transformer(ff, batch_size=batch, seq_length=128,
+                          hidden_size=256, num_layers=4, num_heads=8)
+        data = {"input": np.random.randn(batch, 128, 256).astype(np.float32)}
+        labels = np.random.rand(batch, 128, 1).astype(np.float32)
+    else:
+        raise SystemExit(f"unknown workload {workload}")
+    return ff, data, labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workload", default="mlp", choices=["mlp", "bert"])
+    p.add_argument("-n", "--num-devices", type=int, default=8)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--run", action="store_true",
+                   help="also execute both strategies and time them")
+    args = p.parse_args()
+
+    from flexflow_tpu.pcg.unity import UnitySearch
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+    from flexflow_tpu.sim.simulator import OpCostModel, Simulator
+    from flexflow_tpu.strategy import (
+        apply_strategy,
+        assign_views,
+        data_parallel_strategy,
+    )
+
+    ff, data, labels = build(args.workload, args.batch_size)
+    machine = TpuPodModel()
+    cm = OpCostModel(machine)
+    sim = Simulator(machine, cm)
+
+    def ranked(strategy):
+        g = apply_strategy(ff.layers, strategy)
+        assign_views(g, strategy.mesh_axes)
+        return sim.simulate(g, strategy.mesh_axes).total_time
+
+    dp = data_parallel_strategy(args.num_devices)
+    t0 = time.perf_counter()
+    unity = UnitySearch(ff.layers, args.num_devices, machine, cm).optimize()
+    search_s = time.perf_counter() - t0
+    if unity is None:
+        print(f"workload={args.workload} n={args.num_devices}: no valid "
+              f"Unity strategy found; data-parallel simulated "
+              f"{ranked(dp) * 1e3:.3f} ms/iter")
+        sys.exit(0)
+    t_dp, t_unity = ranked(dp), ranked(unity)
+    print(f"workload={args.workload} n={args.num_devices} "
+          f"(search took {search_s:.1f}s)")
+    print(f"  data-parallel   : mesh={dp.mesh_axes}  simulated "
+          f"{t_dp * 1e3:.3f} ms/iter")
+    print(f"  unity strategy  : mesh={unity.mesh_axes}  simulated "
+          f"{t_unity * 1e3:.3f} ms/iter  "
+          f"({t_dp / t_unity:.2f}x vs DP)")
+
+    if not args.run:
+        return
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+
+    for name, strategy in [("data-parallel", dp), ("unity", unity)]:
+        m, d, l = build(args.workload, args.batch_size)
+        loss = (LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+                if args.workload == "mlp"
+                else LossType.MEAN_SQUARED_ERROR_AVG_REDUCE)
+        m.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=loss,
+                  strategy=strategy)
+        for _ in range(3):
+            res = m.train_step(d, l)
+        _ = float(res["loss"])
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = m.train_step(d, l)
+        _ = float(res["loss"])
+        dt = (time.perf_counter() - t0) / iters
+        print(f"  measured {name:<14}: {dt * 1e3:.1f} ms/iter "
+              f"({args.batch_size / dt:.0f} samples/s)")
+
+
+if __name__ == "__main__":
+    main()
