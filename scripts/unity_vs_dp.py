@@ -58,7 +58,7 @@ def main():
         data_parallel_strategy,
     )
 
-    ff, data, labels = build(args.workload, args.batch_size)
+    ff, _, _ = build(args.workload, args.batch_size)
     machine = TpuPodModel()
     cm = OpCostModel(machine)
     sim = Simulator(machine, cm)

@@ -220,3 +220,21 @@ def test_compile_with_unity_search_runs(devices8):
     y = np.random.RandomState(1).randint(0, 4, batch).astype(np.int32)
     m = ff.train_step({"x": x}, y)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_substitutions_to_dot_tool():
+    """tools/substitutions_to_dot renders every catalog rule."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from substitutions_to_dot import to_dot
+
+    from flexflow_tpu.pcg.substitution import generate_all_pcg_xfers
+
+    xfers = generate_all_pcg_xfers()
+    dot = to_dot(xfers)
+    assert dot.startswith("digraph")
+    assert dot.count("subgraph cluster_") == len(xfers)
+    for x in xfers:
+        assert x.name in dot

@@ -1,0 +1,58 @@
+"""Render the substitution-rule catalog to Graphviz dot (reference
+tools/substitutions_to_dot: rule-file visualization).
+
+Each rule renders as source-pattern -> target-pattern: an op of its
+type rewritten into the sharded form with the parallel ops the kind
+implies (channel -> Repartition/Combine on the channel dim,
+reduction -> Replicate/Reduce, attribute/expert -> attribute-dim
+Repartition + AllToAll boundaries).
+
+  PYTHONPATH=. python tools/substitutions_to_dot.py [rules.json] > subs.dot
+"""
+import sys
+
+KIND_DECOR = {
+    "channel": ("Repartition[out-ch]", "Combine[out-ch]"),
+    "reduction": ("Replicate", "Reduce"),
+    "attribute": ("Repartition[attr]", "AllToAll"),
+    "expert": ("Repartition[expert]", "AllToAll"),
+}
+
+
+def to_dot(xfers) -> str:
+    lines = [
+        "digraph substitutions {",
+        "  rankdir=LR;",
+        '  node [shape=box, fontname="monospace"];',
+    ]
+    for i, x in enumerate(xfers):
+        pre, post = KIND_DECOR[x.kind]
+        src = f"s{i}"
+        lines += [
+            f'  subgraph cluster_{i} {{ label="{x.name}";',
+            f'    {src}_in  [label="{x.op_type.value}"];',
+            f'    {src}_pre  [label="{pre}", style=dashed];',
+            f'    {src}_op   [label="{x.op_type.value} (sharded: {x.kind})"];',
+            f'    {src}_post [label="{post}", style=dashed];',
+            f"    {src}_in -> {src}_pre -> {src}_op -> {src}_post;",
+            "  }",
+        ]
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main():
+    from flexflow_tpu.pcg.substitution import (
+        generate_all_pcg_xfers,
+        load_substitution_rules,
+    )
+
+    if len(sys.argv) > 1:
+        xfers = load_substitution_rules(sys.argv[1])
+    else:
+        xfers = generate_all_pcg_xfers()
+    print(to_dot(xfers))
+
+
+if __name__ == "__main__":
+    main()
