@@ -118,6 +118,8 @@ class CheckpointManager:
         ocp = self._ocp
         if step is None:
             step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
         restored = self._mgr.restore(
             step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
         )

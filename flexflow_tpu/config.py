@@ -54,6 +54,13 @@ class FFConfig:
     enable_inplace_optimizations: bool = True
     search_overlap_backward_update: bool = False
     substitution_json: Optional[str] = None
+    # calibrate search costs by timing real jitted kernels on the chip
+    # (reference inner_measure_operator_cost, model.cu:38-75).
+    # None = auto: on when a real TPU backend is present, off on CPU
+    # meshes (where the analytic roofline is the right proxy).
+    search_calibrate: Optional[bool] = None
+    # measured (node_key -> seconds) cache persisted across runs
+    op_cost_cache_file: Optional[str] = None
     memory_search: bool = False
     memory_lambda: float = 1.0
     export_strategy_file: Optional[str] = None
@@ -78,6 +85,18 @@ class FFConfig:
     export_taskgraph_file: Optional[str] = None
     export_compgraph_file: Optional[str] = None
     include_costs_dot_graph: bool = False
+
+    def should_calibrate(self) -> bool:
+        """Resolve search_calibrate's auto mode: measured costs when a
+        real accelerator backend is live, analytic roofline otherwise."""
+        if self.search_calibrate is not None:
+            return self.search_calibrate
+        try:
+            import jax
+
+            return jax.default_backend() not in ("cpu",)
+        except Exception:
+            return False
 
     def resolve_num_devices(self) -> int:
         if self.num_devices > 0:
@@ -112,6 +131,12 @@ class FFConfig:
         p.add_argument("--enable-attribute-parallel", action="store_true")
         p.add_argument("--enable-sample-parallel", action="store_true")
         p.add_argument("--substitution-json", type=str, default=None)
+        p.add_argument("--search-calibrate", dest="search_calibrate",
+                       action="store_true", default=None)
+        p.add_argument("--no-search-calibrate", dest="search_calibrate",
+                       action="store_false")
+        p.add_argument("--op-cost-cache", dest="op_cost_cache", type=str,
+                       default=None)
         p.add_argument("--memory-search", action="store_true")
         p.add_argument("--machine-model-version", type=int, default=0)
         p.add_argument("--machine-model-file", type=str, default=None)
@@ -143,6 +168,8 @@ class FFConfig:
             enable_attribute_parallel=args.enable_attribute_parallel,
             enable_sample_parallel=args.enable_sample_parallel,
             substitution_json=args.substitution_json,
+            search_calibrate=args.search_calibrate,
+            op_cost_cache_file=args.op_cost_cache,
             memory_search=args.memory_search,
             machine_model_version=args.machine_model_version,
             machine_model_file=args.machine_model_file,

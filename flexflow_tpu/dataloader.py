@@ -123,6 +123,7 @@ class SingleDataLoader:
         self._order = np.arange(self.num_samples, dtype=np.int64)
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
         self._next_index = 0
         self.reset()
 
@@ -138,7 +139,10 @@ class SingleDataLoader:
             )
         self._next_index = 0
         self._queue = queue.Queue(maxsize=self.prefetch)
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._stop), daemon=True
+        )
         self._thread.start()
 
     def next_batch(self) -> Tuple[Dict[str, object], object]:
@@ -162,36 +166,40 @@ class SingleDataLoader:
         return self.num_batches
 
     # -- internals ------------------------------------------------------
-    def _worker(self):
+    def _worker(self, out_queue: "queue.Queue", stop: threading.Event):
         import jax
 
         try:
             in_sh = self.ff.executor.input_shardings()
             lab_sh = self.ff.executor.label_sharding()
             for b in range(self.num_batches):
+                if stop.is_set():
+                    return
                 idx = self._order[b * self.batch_size:(b + 1) * self.batch_size]
                 inputs = {
                     k: jax.device_put(_gather(v, idx), in_sh[k])
                     for k, v in self.x_map.items()
                 }
                 labels = jax.device_put(_gather(self.y, idx), lab_sh)
-                self._queue.put((inputs, labels))
+                while not stop.is_set():
+                    try:
+                        out_queue.put((inputs, labels), timeout=0.1)
+                        break
+                    except queue.Full:
+                        pass
         except Exception as e:  # surfaced on next_batch
-            self._queue.put(e)
+            out_queue.put(e)
 
     def _stop_worker(self):
         t = self._thread
         if t is not None and t.is_alive():
-            # drain so the worker unblocks and finishes its epoch
+            # signal cancellation — the worker exits after at most the
+            # one batch it is currently assembling
+            self._stop.set()
             try:
                 while True:
                     self._queue.get_nowait()
             except queue.Empty:
                 pass
-            while t.is_alive():
-                try:
-                    self._queue.get(timeout=0.1)
-                except queue.Empty:
-                    if not t.is_alive():
-                        break
+            t.join(timeout=30.0)
         self._thread = None

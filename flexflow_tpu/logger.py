@@ -21,11 +21,17 @@ class RecursiveLogger:
     def depth(self) -> int:
         return self._depth
 
+    def _indent(self, msg: str, args) -> str:
+        # pre-format so a literal '%' in msg can't break logging
+        return "  " * self._depth + (msg % args if args else msg)
+
     def debug(self, msg: str, *args):
-        self._log.debug("%s" + msg, "  " * self._depth, *args)
+        if self._log.isEnabledFor(logging.DEBUG):
+            self._log.debug("%s", self._indent(msg, args))
 
     def info(self, msg: str, *args):
-        self._log.info("%s" + msg, "  " * self._depth, *args)
+        if self._log.isEnabledFor(logging.INFO):
+            self._log.info("%s", self._indent(msg, args))
 
     @contextlib.contextmanager
     def enter(self, label: str = "") -> Iterator[None]:

@@ -233,10 +233,12 @@ class MultiHeadAttention(Op):
         # fusing it away.  Shapes here are global (GSPMD traces the full
         # array), so divide by the partition degrees (batch/seq from the
         # input view, heads from the channel shard).
-        # non-replica dim degrees only (replication does not shrink
-        # per-device data; TP head sharding appears as q's replica dim,
-        # counted once via shard.channel)
-        data_deg = int(np.prod(self.inputs[0].shape.degrees))
+        # Only the batch and seq partition degrees shrink the [b,h,q,k]
+        # score tensor — a hidden-dim partition does not (heads are
+        # counted once via shard.channel, replication never shrinks
+        # per-device data).
+        deg = self.inputs[0].shape.degrees
+        data_deg = int(np.prod(deg[:2])) if len(deg) >= 2 else int(deg[0])
         part = max(1, data_deg) * max(1, self.shard.channel)
         scores_bytes = (
             qh.shape[0] * qh.shape[2] * qh.shape[1] * kh.shape[1]

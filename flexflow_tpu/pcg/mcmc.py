@@ -183,14 +183,14 @@ class MCMCSearch:
 def mcmc_optimize(model, num_devices: int) -> Strategy:
     """Entry used by FFModel.compile (config-driven)."""
     from ..sim.machine_model import make_machine_model
-    from ..sim.simulator import OpCostModel, Simulator
+    from ..sim.simulator import Simulator, make_cost_model
 
     cfg = model.config
     machine = make_machine_model(cfg, num_devices)
 
     # one shared cost model: the (node_key)->cost cache must persist
     # across candidate evaluations (reference simulator.cc:550-560)
-    cost_model = OpCostModel(machine)
+    cost_model = make_cost_model(cfg, machine)
 
     def sim_factory():
         return Simulator(machine, cost_model)
@@ -206,4 +206,5 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
         seed=cfg.seed,
     )
     best = search.optimize()
+    cost_model.save_persistent()
     return best

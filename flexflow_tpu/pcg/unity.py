@@ -566,11 +566,11 @@ def unity_optimize(model, num_devices: int) -> Strategy:
     """Entry used by FFModel.compile (reference GRAPH_OPTIMIZE_TASK_ID ->
     Graph::graph_optimize_task graph.cc:2046)."""
     from ..sim.machine_model import make_machine_model
-    from ..sim.simulator import OpCostModel, Simulator
+    from ..sim.simulator import make_cost_model
 
     cfg = model.config
     machine = make_machine_model(cfg, num_devices)
-    cost_model = OpCostModel(machine)
+    cost_model = make_cost_model(cfg, machine)
     xfers = generate_all_pcg_xfers()
     if cfg.substitution_json:
         xfers = xfers + load_substitution_rules(cfg.substitution_json)
@@ -586,6 +586,7 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         memory_budget=cfg.memory_per_device if cfg.memory_search else None,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
+    cost_model.save_persistent()
     if best is None:
         from ..strategy import data_parallel_strategy
 

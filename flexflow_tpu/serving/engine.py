@@ -93,3 +93,11 @@ class InferenceEngine:
 
     def input_names(self) -> Sequence[str]:
         return list(self._input_names)
+
+    def input_specs(self) -> Dict[str, np.dtype]:
+        """name -> numpy dtype of each model input (from the compiled
+        tensor specs, so HTTP payloads need not guess)."""
+        return {
+            op.name: op.outputs[0].shape.dtype.np_dtype  # jnp: knows bf16
+            for op in self.ff.layers.source_ops()
+        }

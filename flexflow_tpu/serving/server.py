@@ -47,11 +47,14 @@ def serve_http(batcher, host: str = "127.0.0.1", port: int = 8000,
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(length) or b"{}")
-                inputs = {
-                    k: np.asarray(v, dtype=np.float32)
-                    if not _is_int(v) else np.asarray(v, dtype=np.int32)
-                    for k, v in req["inputs"].items()
-                }
+                specs = _input_specs(batcher)
+                inputs = {}
+                for k, v in req["inputs"].items():
+                    if k in specs:
+                        dt = specs[k]  # model-declared dtype wins
+                    else:
+                        dt = np.int32 if _is_int(v) else np.float32
+                    inputs[k] = np.asarray(v, dtype=dt)
                 out = batcher.infer(inputs)
                 self._send(200, {"outputs": np.asarray(out).tolist()})
             except Exception as e:  # surface as a JSON error
@@ -71,3 +74,14 @@ def _is_int(v) -> bool:
     while isinstance(x, (list, tuple)) and x:
         x = x[0]
     return isinstance(x, int)
+
+
+def _input_specs(batcher) -> dict:
+    """Engine-declared input dtypes; a DynamicBatcher wraps the engine."""
+    for obj in (batcher, getattr(batcher, "engine", None)):
+        if obj is not None and hasattr(obj, "input_specs"):
+            try:
+                return obj.input_specs()
+            except Exception:
+                return {}
+    return {}

@@ -67,20 +67,68 @@ class OpCostModel:
 
     measure_fn, when provided, times the real jitted op on hardware and
     its result replaces the analytic estimate (reference
-    inner_measure_operator_cost); results persist in the cache dict which
-    can be JSON-dumped between runs.
+    inner_measure_operator_cost, model.cu:38-75, with the same
+    (params, view)->cost cache, simulator.cc:550-560).  Measured results
+    additionally persist to `cache_path` as JSON so later searches —
+    even in fresh processes — reuse chip timings without re-profiling.
     """
+
+    #: ops cheaper than this many FLOPs keep the analytic estimate —
+    #: their cost is dispatch-dominated and profiling each candidate
+    #: view would cost far more than the information is worth
+    MEASURE_MIN_FLOPS = 5e6
 
     def __init__(
         self,
         machine: MachineModel,
         measure_fn: Optional[Callable[[Op], Optional[float]]] = None,
         compute_dtype_bytes: int = 2,  # bf16
+        cache_path: Optional[str] = None,
+        device_key: str = "",
     ):
         self.machine = machine
         self.measure_fn = measure_fn
         self.cache: Dict[Tuple, CostMetrics] = {}
         self.dtype_bytes = compute_dtype_bytes
+        self.cache_path = cache_path
+        # measured times are chip-specific: namespace persisted keys by
+        # the device kind so a cache calibrated on one backend is never
+        # replayed on another
+        self.device_key = device_key
+        self.measured_hits = 0  # cost() calls answered by a measurement
+        self._persistent: Dict[str, float] = {}
+        self._dirty = False
+        if cache_path:
+            self.load_persistent(cache_path)
+
+    # -- measured-cost persistence --------------------------------------
+    def load_persistent(self, path: str):
+        import json
+        import os
+
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                self._persistent.update(
+                    {k: float(v) for k, v in data.items()}
+                )
+            except (OSError, ValueError):
+                pass
+
+    def save_persistent(self, path: Optional[str] = None):
+        import json
+        import os
+
+        path = path or self.cache_path
+        if not path or not self._dirty:
+            return
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._persistent, f)
+        os.replace(tmp, path)
+        self._dirty = False
 
     def cost(self, op: Op) -> CostMetrics:
         key = op.node_key()
@@ -88,13 +136,27 @@ class OpCostModel:
         if hit is not None:
             return hit
         cm = self._analytic(op)
-        if self.measure_fn is not None and not op.is_parallel_op():
-            measured = self.measure_fn(op)
-            if measured is not None:
-                cm.forward_time = measured
-                cm.backward_time = 2.0 * measured
+        measured = self._measured(op, key)
+        if measured is not None:
+            self.measured_hits += 1
+            cm.forward_time = measured
+            cm.backward_time = 2.0 * measured
         self.cache[key] = cm
         return cm
+
+    def _measured(self, op: Op, key: Tuple) -> Optional[float]:
+        if self.measure_fn is None or op.is_parallel_op():
+            return None
+        if op.flops() < self.MEASURE_MIN_FLOPS:
+            return None
+        skey = f"{self.device_key}|{key!r}"
+        if skey in self._persistent:
+            return self._persistent[skey]
+        measured = self.measure_fn(op)
+        if measured is not None:
+            self._persistent[skey] = measured
+            self._dirty = True
+        return measured
 
     def _shard_fraction(self, op: Op) -> float:
         """Fraction of the op's total FLOPs done by one device."""
@@ -125,6 +187,36 @@ class OpCostModel:
             outputs_memory=out_bytes,
             weights_memory=w_bytes,
         )
+
+
+def make_cost_model(cfg, machine: MachineModel) -> OpCostModel:
+    """Build the search's cost model from FFConfig: measured calibration
+    (profiler.make_measure_fn) when cfg.should_calibrate(), with the
+    measured cache persisted across runs (reference keeps the same
+    (params, view)->cost cache for the whole search,
+    simulator.cc:550-560)."""
+    measure_fn = None
+    cache_path = cfg.op_cost_cache_file
+    device_key = ""
+    if cfg.should_calibrate():
+        from ..profiler import make_measure_fn
+
+        measure_fn = make_measure_fn()
+        try:
+            import jax
+
+            device_key = jax.devices()[0].device_kind
+        except Exception:
+            device_key = "unknown"
+        if cache_path is None:
+            import os
+
+            cache_path = os.path.join(
+                os.path.expanduser("~"), ".cache", "flexflow_tpu",
+                "op_costs.json",
+            )
+    return OpCostModel(machine, measure_fn=measure_fn, cache_path=cache_path,
+                       device_key=device_key)
 
 
 def _axis_sizes_of_view(pt, mesh_axes: Dict[str, int]) -> Dict[str, int]:

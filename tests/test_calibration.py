@@ -1,0 +1,122 @@
+"""Measured-cost calibration of the strategy search.
+
+Reference: the search times real kernels per candidate and caches by
+(params, view) — inner_measure_operator_cost model.cu:38-75, cost cache
+simulator.cc:550-560.  Here: profiler.make_measure_fn -> OpCostModel
+measured override, persisted to disk across searches.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.sim.machine_model import TpuPodModel
+from flexflow_tpu.sim.simulator import OpCostModel, make_cost_model
+
+
+def build_mlp(hidden=1024, batch=64, layers=2):
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor([batch, hidden], name="x")
+    t = x
+    for i in range(layers):
+        t = ff.dense(t, hidden, activation=ActiMode.RELU, name=f"fc{i}")
+    return ff
+
+
+def big_op(ff):
+    """An op above OpCostModel.MEASURE_MIN_FLOPS."""
+    op = next(o for o in ff.layers.ops if o.name == "fc0")
+    assert op.flops() >= OpCostModel.MEASURE_MIN_FLOPS
+    return op
+
+
+def test_measured_override_and_persistence(tmp_path):
+    ff = build_mlp()
+    op = big_op(ff)
+    machine = TpuPodModel(topology=(4,))
+    calls = []
+
+    def fake_measure(o):
+        calls.append(o.name)
+        return 123e-6
+
+    path = str(tmp_path / "costs.json")
+    cm = OpCostModel(machine, measure_fn=fake_measure, cache_path=path)
+    c = cm.cost(op)
+    assert c.forward_time == pytest.approx(123e-6)
+    assert c.backward_time == pytest.approx(246e-6)
+    assert cm.measured_hits == 1 and calls == ["fc0"]
+    # cached in-memory: no re-measure
+    cm.cost(op)
+    assert calls == ["fc0"]
+    cm.save_persistent()
+    data = json.loads(open(path).read())
+    assert list(data.values()) == [pytest.approx(123e-6)]
+
+    # a fresh model consults the DISK cache, never the measure_fn
+    calls2 = []
+    cm2 = OpCostModel(
+        machine, measure_fn=lambda o: calls2.append(o.name) or 1.0,
+        cache_path=path,
+    )
+    c2 = cm2.cost(build_mlp().layers.ops[1])  # equal node_key, new objects
+    assert c2.forward_time == pytest.approx(123e-6)
+    assert calls2 == [] and cm2.measured_hits == 1
+
+
+def test_small_ops_stay_analytic():
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor([4, 8], name="x")
+    ff.dense(x, 8, name="tiny")
+    machine = TpuPodModel(topology=(4,))
+    cm = OpCostModel(machine, measure_fn=lambda o: 1.0)
+    op = next(o for o in ff.layers.ops if o.name == "tiny")
+    c = cm.cost(op)
+    assert c.forward_time < 1.0 and cm.measured_hits == 0
+
+
+def test_unity_search_consults_measured_costs(tmp_path, monkeypatch):
+    """End-to-end: unity_optimize with calibration on must route costs
+    through the measured path and persist them."""
+    import flexflow_tpu.profiler as profiler
+
+    measured = []
+
+    def fake_make_measure_fn(*a, **kw):
+        def fn(op):
+            measured.append(op.name)
+            return 50e-6
+
+        return fn
+
+    monkeypatch.setattr(profiler, "make_measure_fn", fake_make_measure_fn)
+    path = str(tmp_path / "search_costs.json")
+    ff = build_mlp(hidden=1024, batch=32, layers=2)
+    ff.config.search_calibrate = True
+    ff.config.op_cost_cache_file = path
+
+    from flexflow_tpu.pcg.unity import unity_optimize
+
+    s = unity_optimize(ff, 4)
+    assert s is not None
+    assert measured, "search never consulted the measured cost path"
+    data = json.loads(open(path).read())
+    assert data and all(v == pytest.approx(50e-6) for v in data.values())
+
+
+def test_make_cost_model_off_on_cpu_auto():
+    cfg = FFConfig()  # search_calibrate=None -> auto; tests force CPU
+    cm = make_cost_model(cfg, TpuPodModel(topology=(4,)))
+    assert cm.measure_fn is None
+
+
+def test_measure_op_forward_real_kernel():
+    """The chain-timed profiler returns a sane positive time on CPU."""
+    from flexflow_tpu.profiler import measure_op_forward
+
+    ff = build_mlp(hidden=256, batch=32, layers=1)
+    op = next(o for o in ff.layers.ops if o.name == "fc0")
+    t = measure_op_forward(op, chain=4, warmup=1, repeats=2)
+    assert t is not None and 0.0 <= t < 1.0
