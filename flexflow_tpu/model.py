@@ -599,7 +599,23 @@ class FFModel:
         if cfg.export_strategy_file:
             strategy.save(cfg.export_strategy_file)
 
-        self.operators = apply_strategy(self.layers, strategy)
+        # replay the strategy's graph-rewrite trace (reference: the
+        # winning GraphXfer rewrites applied by graph_optimize,
+        # substitution.cc:1898-1945), then apply + cancel redundant
+        # parallel-op boundaries
+        compiled_frontend = self.layers
+        if strategy.rewrites:
+            from .pcg.rewrite import apply_rewrites, rules_for_config
+
+            compiled_frontend = apply_rewrites(
+                compiled_frontend, strategy.rewrites, rules_for_config(cfg)
+            )
+        self._compiled_frontend = compiled_frontend
+        from .pcg.rewrite import cancel_all_inverse_parallel_ops
+
+        self.operators = cancel_all_inverse_parallel_ops(
+            apply_strategy(compiled_frontend, strategy)
+        )
         assign_views(self.operators, strategy.mesh_axes)
         self.mesh = make_mesh(strategy.mesh_axes, devices)
 

@@ -80,8 +80,16 @@ class UnitySearch:
         memory_budget: Optional[int] = None,
         optimizer_slots: int = 2,
         overlap_fraction: float = 0.3,
+        rewrite_rules: Optional[Sequence] = None,
+        rewrite_depth: int = 2,
+        rewrite_max_variants: int = 8,
     ):
         self.graph = graph
+        self._base_graph = graph
+        self.rewrite_rules = rewrite_rules  # None -> built-in catalog
+        self.rewrite_depth = rewrite_depth
+        self.rewrite_max_variants = rewrite_max_variants
+        self._variants_memo = None
         self.n = num_devices
         self.machine = machine
         self.cost_model = cost_model
@@ -109,39 +117,12 @@ class UnitySearch:
     # ------------------------------------------------------------------
     def _segments(self) -> Tuple[List[List[Op]], List[Optional[int]]]:
         """Split topo order at single-tensor cuts (cached — the graph is
-        immutable for the lifetime of a search).
+        immutable for the lifetime of a search); pcg/segments.py holds
+        the shared implementation."""
+        if self._segments_memo is None:
+            from .segments import split_segments
 
-        Returns (segments, crossing_guid_per_boundary): segment k feeds
-        segment k+1 through exactly one tensor (the bottleneck)."""
-        if self._segments_memo is not None:
-            return self._segments_memo
-        topo = self.graph.topo_order()
-        pos = {op.guid: i for i, op in enumerate(topo)}
-        # last consumer position of each tensor
-        last_use: Dict[int, int] = {}
-        for op in topo:
-            for t in op.inputs:
-                last_use[t.guid] = max(last_use.get(t.guid, -1), pos[op.guid])
-        cuts: List[Tuple[int, int]] = []  # (topo position, crossing tensor guid)
-        for i in range(len(topo) - 1):
-            crossing = [
-                t.guid
-                for j in range(i + 1)
-                for t in topo[j].outputs
-                if last_use.get(t.guid, -1) > i
-            ]
-            if len(crossing) == 1:
-                cuts.append((i, crossing[0]))
-        segments: List[List[Op]] = []
-        boundaries: List[Optional[int]] = []
-        start = 0
-        for i, guid in cuts:
-            segments.append(topo[start : i + 1])
-            boundaries.append(guid)
-            start = i + 1
-        segments.append(topo[start:])
-        boundaries.append(None)
-        self._segments_memo = (segments, boundaries)
+            self._segments_memo = split_segments(self.graph)
         return self._segments_memo
 
     # ------------------------------------------------------------------
@@ -149,14 +130,9 @@ class UnitySearch:
     # ------------------------------------------------------------------
     def _seg_sig(self, seg: List[Op], boundary_in: List[int]) -> Tuple:
         """Structural signature: identical stacked layers share it."""
-        local = {guid: ("b", k) for k, guid in enumerate(boundary_in)}
-        parts = []
-        for j, op in enumerate(seg):
-            srcs = tuple(local[t.guid] for t in op.inputs)
-            parts.append((op.op_type, op.params, srcs))
-            for oi, t in enumerate(op.outputs):
-                local[t.guid] = ("i", j, oi)
-        return tuple(parts)
+        from .segments import segment_signature
+
+        return segment_signature(seg, boundary_in)
 
     def _comm_time(self, kind: str, size: int, group: int) -> float:
         from ..sim.machine_model import TpuPodModel
@@ -221,7 +197,7 @@ class UnitySearch:
         return shape, time
 
     def _options_by_op(self, mesh_axes: Dict[str, int]) -> Dict[int, List[XferChoice]]:
-        key = tuple(sorted(mesh_axes.items()))
+        key = (id(self.graph), tuple(sorted(mesh_axes.items())))
         memo = self._options_memo.get(key)
         if memo is not None:
             return memo
@@ -414,43 +390,92 @@ class UnitySearch:
             s.edge_ops[tname] = chain
         return s
 
-    def optimize(self, lam: float = 0.0) -> Optional[Strategy]:
+    def _variants(self):
+        """Rewritten-graph candidates (reference base_optimize's bounded
+        rewrite enumeration, substitution.cc:2229-2320); [(graph, trace)]
+        with the original graph first."""
+        if self._variants_memo is None:
+            from .rewrite import enumerate_variants, generate_rewrite_rules
+
+            rules = (list(self.rewrite_rules) if self.rewrite_rules is not None
+                     else generate_rewrite_rules())
+            if self.rewrite_max_variants <= 1 or not rules:
+                self._variants_memo = [(self._base_graph, [])]
+            else:
+                self._variants_memo = enumerate_variants(
+                    self._base_graph, rules,
+                    max_depth=self.rewrite_depth,
+                    max_variants=self.rewrite_max_variants,
+                )
+        return self._variants_memo
+
+    def _set_graph(self, graph: Graph):
+        if graph is self.graph:
+            return
+        self.graph = graph
+        self._segments_memo = None
+
+    def _optimize_graph(self, lam: float):
+        """Best (strategy, obj) for the CURRENT self.graph across mesh
+        factorizations and sp candidates."""
         from ..logger import search_logger as slog
 
         has_moe = any(op.op_type == OperatorType.GROUP_BY for op in self.graph.ops)
         best: Optional[Strategy] = None
         best_obj = math.inf
+        for dp, tp, ep in _factorizations(self.n, allow_expert=has_moe):
+            mesh_axes = self._mesh_axes(dp, tp, ep)
+            if tp > 1 and not self._options_by_op(mesh_axes):
+                continue  # no op can use the model axis
+            r = self._dp(mesh_axes, dp, lam)
+            if r is None:
+                continue
+            shard_configs, edges, time, mem = r
+            strategy = self._build_strategy(mesh_axes, dp, shard_configs, edges)
+            # validate + final rank with the strategy actually applied
+            try:
+                g = apply_strategy(self.graph, strategy)
+                assign_views(g, strategy.mesh_axes)
+            except (ShapeError, ValueError):
+                continue
+            obj = self._objective(time, mem, lam)
+            slog.debug(
+                "candidate dp=%d tp=%d ep=%d: time=%.3gms mem=%.1fMB obj=%.3g%s",
+                dp, tp, ep, time * 1e3, mem / 2**20, obj,
+                " *best*" if obj < best_obj else "",
+            )
+            if obj < best_obj:
+                best, best_obj = strategy, obj
+        for strategy, obj, label in self._sp_candidates(lam):
+            slog.debug(
+                "candidate %s: obj=%.3g%s", label, obj,
+                " *best*" if obj < best_obj else "",
+            )
+            if obj < best_obj:
+                best, best_obj = strategy, obj
+        return best, best_obj
+
+    def optimize(self, lam: float = 0.0) -> Optional[Strategy]:
+        from ..logger import search_logger as slog
+
+        best: Optional[Strategy] = None
+        best_obj = math.inf
         with slog.enter(f"unity optimize n={self.n} lambda={lam:g}"):
-            for dp, tp, ep in _factorizations(self.n, allow_expert=has_moe):
-                mesh_axes = self._mesh_axes(dp, tp, ep)
-                if tp > 1 and not self._options_by_op(mesh_axes):
-                    continue  # no op can use the model axis
-                r = self._dp(mesh_axes, dp, lam)
-                if r is None:
-                    continue
-                shard_configs, edges, time, mem = r
-                strategy = self._build_strategy(mesh_axes, dp, shard_configs, edges)
-                # validate + final rank with the strategy actually applied
-                try:
-                    g = apply_strategy(self.graph, strategy)
-                    assign_views(g, strategy.mesh_axes)
-                except (ShapeError, ValueError):
-                    continue
-                obj = self._objective(time, mem, lam)
-                slog.debug(
-                    "candidate dp=%d tp=%d ep=%d: time=%.3gms mem=%.1fMB obj=%.3g%s",
-                    dp, tp, ep, time * 1e3, mem / 2**20, obj,
-                    " *best*" if obj < best_obj else "",
-                )
-                if obj < best_obj:
+            for graph, trace in self._variants():
+                self._set_graph(graph)
+                if trace:
+                    slog.debug("rewritten variant: %s",
+                               "+".join(f"{n}[{i}]" for n, i in trace))
+                strategy, obj = self._optimize_graph(lam)
+                if strategy is not None and obj < best_obj:
+                    strategy.rewrites = [list(r) for r in trace]
+                    if trace:
+                        slog.debug(
+                            "rewrite %s improves obj to %.3g",
+                            "+".join(n for n, _ in trace), obj,
+                        )
                     best, best_obj = strategy, obj
-            for strategy, obj, label in self._sp_candidates(lam):
-                slog.debug(
-                    "candidate %s: obj=%.3g%s", label, obj,
-                    " *best*" if obj < best_obj else "",
-                )
-                if obj < best_obj:
-                    best, best_obj = strategy, obj
+        self._set_graph(self._base_graph)
         return best
 
     def _objective(self, time: float, mem: int, lam: float) -> float:
@@ -555,7 +580,14 @@ class UnitySearch:
     def _strategy_memory(self, strategy: Strategy) -> int:
         from ..sim.simulator import Simulator
 
-        g = apply_strategy(self.graph, strategy)
+        base = self._base_graph
+        if strategy.rewrites:
+            from .rewrite import apply_rewrites, generate_rewrite_rules
+
+            rules = (list(self.rewrite_rules) if self.rewrite_rules is not None
+                     else generate_rewrite_rules())
+            base = apply_rewrites(base, strategy.rewrites, rules)
+        g = apply_strategy(base, strategy)
         assign_views(g, strategy.mesh_axes)
         sim = Simulator(self.machine, self.cost_model,
                         optimizer_slots=self.optimizer_slots)
@@ -571,9 +603,12 @@ def unity_optimize(model, num_devices: int) -> Strategy:
     cfg = model.config
     machine = make_machine_model(cfg, num_devices)
     cost_model = make_cost_model(cfg, machine)
+    from .rewrite import rules_for_config
+
     xfers = generate_all_pcg_xfers()
     if cfg.substitution_json:
         xfers = xfers + load_substitution_rules(cfg.substitution_json)
+    rewrite_rules = rules_for_config(cfg)
     search = UnitySearch(
         model.layers,
         num_devices,
@@ -584,6 +619,7 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         enable_attribute_parallel=cfg.enable_attribute_parallel,
         budget=max(0, cfg.search_budget),
         memory_budget=cfg.memory_per_device if cfg.memory_search else None,
+        rewrite_rules=rewrite_rules,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()

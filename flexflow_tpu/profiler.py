@@ -31,13 +31,13 @@ def _rand_array(shape, dtype, key):
 _base_fetch_time_cache: Dict[str, float] = {}
 
 
-def _base_fetch_time(device=None) -> float:
+def _base_fetch_time(device=None, refresh: bool = False) -> float:
     """Fixed cost of one jitted-dispatch + hard value fetch — on a
     tunneled TPU this is the ~80 ms round trip that would otherwise be
     charged to every op; subtracted from chain timings."""
     key = str(device)
     hit = _base_fetch_time_cache.get(key)
-    if hit is not None:
+    if hit is not None and not refresh:
         return hit
     x = jnp.zeros((8,), jnp.float32)
     if device is not None:
@@ -117,6 +117,10 @@ def measure_op_forward(
             best = min(best, time.perf_counter() - t0)
         # chain+1 op executions per call (scan body + final fetch op)
         if best <= base:
+            # a stale (load-inflated) cached base can swallow the kernel
+            # time; re-measure it once under current conditions
+            base = _base_fetch_time(device, refresh=True)
+        if best <= base:
             # fetch-latency jitter swallowed the kernel time — a 0 here
             # would be cached as "free" forever; report unmeasurable and
             # let the analytic estimate stand
@@ -126,12 +130,15 @@ def measure_op_forward(
         return None
 
 
-def make_measure_fn(device=None, warmup: int = 2, repeats: int = 5):
-    """OpCostModel measure_fn: op -> forward seconds (or None)."""
+def make_measure_fn(device=None, warmup: int = 1, repeats: int = 3,
+                    chain: int = 16):
+    """OpCostModel measure_fn: op -> forward seconds (or None).
+    Defaults mirror measure_op_forward's — the chained-scan timing makes
+    extra repeats redundant."""
 
     def fn(op: Op) -> Optional[float]:
         return measure_op_forward(op, device=device, warmup=warmup,
-                                  repeats=repeats)
+                                  repeats=repeats, chain=chain)
 
     return fn
 

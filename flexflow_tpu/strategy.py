@@ -52,6 +52,11 @@ class Strategy:
     mesh_axes: Dict[str, int]
     shard_configs: Dict[str, ShardConfig] = dataclasses.field(default_factory=dict)
     edge_ops: Dict[str, List[Tuple[str, dict]]] = dataclasses.field(default_factory=dict)
+    # graph-rewrite trace: [(rule name, match index), ...] replayed on
+    # the frontend graph by pcg/rewrite.py before the strategy applies
+    # (reference: the rewrites GraphXfer::run applied to the winning
+    # graph, substitution.cc:1898-1945)
+    rewrites: List[List] = dataclasses.field(default_factory=list)
 
     # -- serialization ---------------------------------------------------
     def to_json(self) -> str:
@@ -62,6 +67,7 @@ class Strategy:
                     k: dataclasses.asdict(v) for k, v in self.shard_configs.items()
                 },
                 "edge_ops": self.edge_ops,
+                "rewrites": [list(r) for r in self.rewrites],
             },
             indent=2,
         )
@@ -78,6 +84,7 @@ class Strategy:
                 k: [(kind, dict(p)) for kind, p in v]
                 for k, v in d.get("edge_ops", {}).items()
             },
+            rewrites=[list(r) for r in d.get("rewrites", [])],
         )
 
     def save(self, path: str):
