@@ -28,15 +28,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .fftype import CompMode, OperatorType
 from .loss import Loss
 from .metrics import Metrics
-from .ops.op import Op
+from .ops.op import Op, trainable_weight_count as _num_trainable
 from .optimizer import Optimizer
 from .parallel.machine import view_to_spec
 from .pcg.graph import Graph
-
-
-def _num_trainable(op: Op) -> int:
-    fn = getattr(op, "num_trainable_weights", None)
-    return fn() if fn is not None else len(op.weight_specs)
 
 
 class GraphExecutor:
@@ -53,6 +48,7 @@ class GraphExecutor:
         label_replication: int = 1,
         remat: bool = False,
         compute_dtype=None,
+        pipeline_plan=None,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -74,6 +70,15 @@ class GraphExecutor:
             op._mesh = mesh  # ops with shard_map lowerings (ring attention)
         self._step_fn = None
         self._input_names = [op.name for op in graph.source_ops()]
+        # pipeline-parallel region (parallel/pipeline_plan.py): block ops
+        # execute via the GPipe schedule with pp-stacked weights under
+        # the "__pipeline__" pytree key instead of per-op entries
+        self.pipeline_plan = pipeline_plan
+        self._block_guids = (
+            {op.guid for blk in pipeline_plan.blocks for op in blk}
+            if pipeline_plan is not None
+            else set()
+        )
 
     # -- shardings -------------------------------------------------------
     def tensor_sharding(self, pt) -> NamedSharding:
@@ -82,12 +87,26 @@ class GraphExecutor:
     def weight_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
         out: Dict[str, Dict[str, NamedSharding]] = {}
         for op in self.order:
+            if op.guid in self._block_guids:
+                continue
             nt = _num_trainable(op)
             entry = {}
             for w in op.weights[:nt]:
                 entry[w.name.split(".")[-1]] = self.tensor_sharding(w)
             if entry:
                 out[op.name] = entry
+        if self.pipeline_plan is not None:
+            entry = {}
+            plan = self.pipeline_plan
+            for j, op in enumerate(plan.blocks[0]):
+                for spec, pt in zip(op.weight_specs, op.weights):
+                    ndim = len(pt.shape.logical_shape) + 1  # stacked dim
+                    entry[f"{j}.{spec.name}"] = NamedSharding(
+                        self.mesh,
+                        PartitionSpec(plan.pp_axis, *([None] * (ndim - 1))),
+                    )
+            if entry:
+                out["__pipeline__"] = entry
         return out
 
     def state_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
@@ -126,6 +145,8 @@ class GraphExecutor:
             state: Dict[str, Dict[str, jax.Array]] = {}
             key = jax.random.key(seed)
             for op in self.order:
+                if op.guid in self._block_guids:
+                    continue
                 nt = _num_trainable(op)
                 for i, (spec, pt) in enumerate(zip(op.weight_specs, op.weights)):
                     key, sub = jax.random.split(key)
@@ -137,6 +158,26 @@ class GraphExecutor:
                         weights.setdefault(op.name, {})[short] = arr
                     else:
                         state.setdefault(op.name, {})[short] = arr
+            if self.pipeline_plan is not None:
+                # per-block independent inits stacked on a leading dim
+                # sharded over the pp axis
+                for j, t_op in enumerate(self.pipeline_plan.blocks[0]):
+                    for wi, spec in enumerate(t_op.weight_specs):
+                        layers = []
+                        for blk in self.pipeline_plan.blocks:
+                            w_spec = blk[j].weight_specs[wi]
+                            w_pt = blk[j].weights[wi]
+                            key, sub = jax.random.split(key)
+                            layers.append(
+                                w_spec.initializer(
+                                    sub,
+                                    w_pt.shape.logical_shape,
+                                    w_pt.dtype.np_dtype,
+                                )
+                            )
+                        weights.setdefault("__pipeline__", {})[
+                            f"{j}.{spec.name}"
+                        ] = jnp.stack(layers)
             return weights, state
 
         out_shardings = (w_shardings, s_shardings)
@@ -166,7 +207,16 @@ class GraphExecutor:
                 return x.astype(self.compute_dtype)
             return x
 
+        pipeline_done = False
         for op in self.order:
+            if op.guid in self._block_guids:
+                if not pipeline_done:
+                    out = self._run_pipeline_region(
+                        weights, env, to_compute, training, rng
+                    )
+                    env[self.pipeline_plan.region_out_guid] = out
+                    pipeline_done = True
+                continue
             if op.op_type == OperatorType.INPUT:
                 env[op.outputs[0].guid] = to_compute(inputs[op.name])
                 continue
@@ -201,6 +251,52 @@ class GraphExecutor:
         if self.compute_dtype is not None and jnp.issubdtype(out.dtype, jnp.floating):
             out = out.astype(jnp.float32)  # loss/metrics in full precision
         return out, new_state, aux_losses, env
+
+    # -- pipeline region -------------------------------------------------
+    def _run_pipeline_region(self, weights, env, to_compute, training, rng):
+        """Execute the homogeneous block stack via the GPipe schedule
+        (parallel/pipeline.py): blocks stacked over the pp axis, one
+        ppermute per tick over ICI, backward by autodiff through the
+        scan."""
+        from .parallel.pipeline import pipelined_apply
+
+        plan = self.pipeline_plan
+        template = plan.blocks[0]
+        act = env[plan.region_in_guid]
+        stacked = {
+            k: to_compute(v) for k, v in weights["__pipeline__"].items()
+        }
+        # per-layer index rides the stacked pytree so dropout rng can
+        # fold in the physical block id inside the scanned body
+        stacked["__layer__"] = jnp.arange(plan.num_blocks, dtype=jnp.int32)
+
+        def block_fn(params, a):
+            local = {plan.region_in_guid: a}
+            for j, t_op in enumerate(template):
+                ins = [local[t.guid] for t in t_op.inputs]
+                ws = [
+                    params[f"{j}.{s.name}"] for s in t_op.weight_specs
+                ]
+                op_rng = None
+                if rng is not None:
+                    op_rng = jax.random.fold_in(
+                        jax.random.fold_in(rng, t_op.guid),
+                        params["__layer__"],
+                    )
+                outs = t_op.forward(ins, ws, training=training, rng=op_rng)
+                for pt, val in zip(t_op.outputs, outs):
+                    local[pt.guid] = val
+            return local[plan.template_out_guid]
+
+        return pipelined_apply(
+            block_fn,
+            stacked,
+            act,
+            mesh=self.mesh,
+            num_microbatches=plan.num_microbatches,
+            pp_axis=plan.pp_axis,
+            dp_axis=plan.dp_axis,
+        )
 
     # -- train step ------------------------------------------------------
     def build_step(self):

@@ -619,6 +619,13 @@ class FFModel:
         assign_views(self.operators, strategy.mesh_axes)
         self.mesh = make_mesh(strategy.mesh_axes, devices)
 
+        pipeline_plan = None
+        if strategy.pipeline:
+            from .parallel.pipeline_plan import plan_pipeline
+
+            pipeline_plan = plan_pipeline(
+                self.operators, strategy.pipeline, strategy.mesh_axes
+            )
         self.executor = GraphExecutor(
             self.operators,
             self.mesh,
@@ -630,6 +637,7 @@ class FFModel:
             compute_dtype=(
                 cfg.compute_dtype if cfg.compute_dtype != "float32" else None
             ),
+            pipeline_plan=pipeline_plan,
         )
         # score hooks live on the FRONTEND ops (the user's handles);
         # strategy application clones the compiled PCG's op objects

@@ -175,3 +175,10 @@ def check_no_partition(shape: ParallelTensorShape, dim_idx: int, opname: str):
 class ShapeError(ValueError):
     """Raised when an op cannot accept the given input parallel shapes —
     the search treats this as an illegal strategy candidate."""
+
+
+def trainable_weight_count(op: Op) -> int:
+    """Weights [0:n] are trainable; the rest are op state (BatchNorm
+    running stats).  Ops opt in via a num_trainable_weights method."""
+    fn = getattr(op, "num_trainable_weights", None)
+    return fn() if fn is not None else len(op.weight_specs)

@@ -60,10 +60,18 @@ _MAX_SEGMENT_ASSIGNMENTS = 4096
 
 @dataclasses.dataclass
 class _SegResult:
-    assignment: Tuple[Tuple[int, XferChoice], ...]  # (local op idx, choice)
+    # (region-local topo index, choice): structural, NOT guid-keyed —
+    # cached results are reused across structurally-identical regions
+    # (stacked BERT layers, rewritten graph variants) whose ops differ
+    assignment: Tuple[Tuple[int, XferChoice], ...]
     time: float
     memory: int
     out_shapes: Tuple[ParallelTensorShape, ...]
+
+
+#: max states a region evaluation hands back to its parent (best per
+#: out-shape signature first, then scalarized-cost beam)
+_MAX_REGION_STATES = 64
 
 
 class UnitySearch:
@@ -212,6 +220,223 @@ class UnitySearch:
         self._options_memo[key] = out
         return out
 
+    # -- region evaluation: enumerate / horizontal / vertical ----------
+    #
+    # Reference: SearchHelper::graph_cost's DP over sequential, vertical
+    # and horizontal graph splits (graph.h:170-284, split_at_node /
+    # split_horizontal graph.h:346-349).  A region whose joint
+    # assignment space exceeds _MAX_SEGMENT_ASSIGNMENTS is decomposed:
+    # horizontally into independent branch components (Inception-style
+    # parallel branches get per-branch choices, combined only through
+    # their output shapes at the join), else vertically at a
+    # multi-tensor topo cut; only irreducible single-op regions fall
+    # back to exhaustive/grouped enumeration.
+
+    def _boundary_in(self, seg: List[Op]) -> List[int]:
+        """External input tensor guids, ordered by first consumption."""
+        produced = {t.guid for op in seg for t in op.outputs}
+        out: List[int] = []
+        seen = set()
+        for op in seg:
+            for t in op.inputs:
+                if t.guid not in produced and t.guid not in seen:
+                    seen.add(t.guid)
+                    out.append(t.guid)
+        return out
+
+    def _out_refs(self, seg: List[Op], out_guids: List[int]) -> Tuple:
+        """Structural refs of exported tensors (cache-key component)."""
+        ref = {}
+        for j, op in enumerate(seg):
+            for oi, t in enumerate(op.outputs):
+                ref[t.guid] = (j, oi)
+        return tuple(ref[g] for g in out_guids)
+
+    def _n_assignments(self, seg, options) -> int:
+        total = 1
+        for op in seg:
+            opts = options.get(op.guid)
+            if opts:
+                total *= len(opts)
+        return total
+
+    def _prune_states(self, results: List[_SegResult], lam: float) -> List[_SegResult]:
+        """Best result per out-shape signature, then a scalarized-cost
+        beam of _MAX_REGION_STATES (the analogue of the reference's
+        bounded per-subgraph state sets)."""
+        best: Dict[Tuple, _SegResult] = {}
+        for r in results:
+            cur = best.get(r.out_shapes)
+            if cur is None or (r.time + lam * r.memory) < (cur.time + lam * cur.memory):
+                best[r.out_shapes] = r
+        out = sorted(best.values(), key=lambda r: r.time + lam * r.memory)
+        return out[:_MAX_REGION_STATES]
+
+    def _eval_region(
+        self,
+        seg: List[Op],
+        shape_env: Dict[int, ParallelTensorShape],
+        out_guids: List[int],
+        options: Dict[int, List[XferChoice]],
+        input_dp: int,
+        axes_sig: Tuple,
+        lam: float,
+    ) -> List[_SegResult]:
+        boundary_in = self._boundary_in(seg)
+        in_shapes = tuple(shape_env[g] for g in boundary_in)
+        sig = (
+            self._seg_sig(seg, boundary_in),
+            self._out_refs(seg, out_guids),
+            in_shapes, input_dp, axes_sig, lam,
+        )
+        cached = self._seg_cache.get(sig)
+        if cached is not None:
+            self.cache_hits += 1
+            return cached
+        n = self._n_assignments(seg, options)
+        results: Optional[List[_SegResult]] = None
+        if n > _MAX_SEGMENT_ASSIGNMENTS and len(seg) >= 2:
+            results = self._eval_horizontal(
+                seg, shape_env, out_guids, options, input_dp, axes_sig, lam
+            )
+            if results is None:
+                results = self._eval_vertical(
+                    seg, shape_env, out_guids, options, input_dp, axes_sig, lam
+                )
+        if results is None:
+            results = self._eval_enumerate(
+                seg, shape_env, out_guids, options, input_dp, axes_sig
+            )
+        results = self._prune_states(results, lam)
+        self._seg_cache[sig] = results
+        return results
+
+    def _components(self, seg: List[Op]) -> List[List[Op]]:
+        """Weakly-connected components of the region's INTERNAL dataflow
+        (edges through externally-produced tensors don't connect)."""
+        parent = {op.guid: op.guid for op in seg}
+
+        def find(g):
+            while parent[g] != g:
+                parent[g] = parent[parent[g]]
+                g = parent[g]
+            return g
+
+        producer = {t.guid: op.guid for op in seg for t in op.outputs}
+        for op in seg:
+            for t in op.inputs:
+                p = producer.get(t.guid)
+                if p is not None:
+                    ra, rb = find(p), find(op.guid)
+                    if ra != rb:
+                        parent[ra] = rb
+        comps: Dict[int, List[Op]] = {}
+        for op in seg:
+            comps.setdefault(find(op.guid), []).append(op)
+        return list(comps.values())
+
+    def _eval_horizontal(
+        self, seg, shape_env, out_guids, options, input_dp, axes_sig, lam
+    ) -> Optional[List[_SegResult]]:
+        """Peel the join op and evaluate independent branch components
+        separately (reference split_horizontal, graph.h:346-349)."""
+        sink, rest = seg[-1], seg[:-1]
+        comps = self._components(rest)
+        if len(comps) <= 1:
+            return None
+        sink_in = {t.guid for t in sink.inputs}
+        out_set = set(out_guids)
+        parent_pos = {op.guid: j for j, op in enumerate(seg)}
+        combos: List[Tuple[Tuple, float, int, Dict[int, ParallelTensorShape]]] = [
+            ((), 0.0, 0, {})
+        ]
+        for comp in comps:
+            comp_outs = [
+                t.guid
+                for op in comp
+                for t in op.outputs
+                if t.guid in sink_in or t.guid in out_set
+            ]
+            rs = self._eval_region(
+                comp, shape_env, comp_outs, options, input_dp, axes_sig, lam
+            )
+            if not rs:
+                return []
+            # child indices are local to the component; lift to parent
+            lift = [parent_pos[op.guid] for op in comp]
+            new_combos = []
+            for asg0, t0, m0, env0 in combos:
+                for r in rs:
+                    env = dict(env0)
+                    env.update(zip(comp_outs, r.out_shapes))
+                    asg = tuple((lift[j], c) for j, c in r.assignment)
+                    new_combos.append(
+                        (asg0 + asg, t0 + r.time, m0 + r.memory, env)
+                    )
+            # keep the combination frontier bounded
+            new_combos.sort(key=lambda c: c[1] + lam * c[2])
+            combos = new_combos[:_MAX_REGION_STATES]
+        sink_idx = len(seg) - 1
+        results: List[_SegResult] = []
+        for asg0, t0, m0, env0 in combos:
+            env = dict(shape_env)
+            env.update(env0)
+            sink_outs = [g for g in out_guids if g not in env0]
+            for r in self._eval_region(
+                [sink], env, sink_outs, options, input_dp, axes_sig, lam
+            ):
+                env2 = dict(env)
+                env2.update(zip(sink_outs, r.out_shapes))
+                asg = tuple((sink_idx, c) for _, c in r.assignment)
+                results.append(
+                    _SegResult(
+                        assignment=asg0 + asg,
+                        time=t0 + r.time,
+                        memory=m0 + r.memory,
+                        out_shapes=tuple(env2[g] for g in out_guids),
+                    )
+                )
+        return results
+
+    def _eval_vertical(
+        self, seg, shape_env, out_guids, options, input_dp, axes_sig, lam
+    ) -> List[_SegResult]:
+        """Split at a mid topo position; the crossing state is the tuple
+        of ALL crossing tensor shapes (reference split_at_node's
+        non-dominator generalization)."""
+        k = len(seg) // 2
+        first, second = seg[:k], seg[k:]
+        consumed2 = {t.guid for op in second for t in op.inputs}
+        out_set = set(out_guids)
+        first_out = [
+            t.guid
+            for op in first
+            for t in op.outputs
+            if t.guid in consumed2 or t.guid in out_set
+        ]
+        results: List[_SegResult] = []
+        for r1 in self._eval_region(
+            first, shape_env, first_out, options, input_dp, axes_sig, lam
+        ):
+            env = dict(shape_env)
+            env.update(zip(first_out, r1.out_shapes))
+            second_out = [g for g in out_guids if g not in env]
+            for r2 in self._eval_region(
+                second, env, second_out, options, input_dp, axes_sig, lam
+            ):
+                env2 = dict(env)
+                env2.update(zip(second_out, r2.out_shapes))
+                asg2 = tuple((j + k, c) for j, c in r2.assignment)
+                results.append(
+                    _SegResult(
+                        assignment=r1.assignment + asg2,
+                        time=r1.time + r2.time,
+                        memory=r1.memory + r2.memory,
+                        out_shapes=tuple(env2[g] for g in out_guids),
+                    )
+                )
+        return results
+
     def _enumerate_assignments(
         self, seg: List[Op], options: Dict[int, List[XferChoice]]
     ) -> List[Tuple[Tuple[int, XferChoice], ...]]:
@@ -222,7 +447,15 @@ class UnitySearch:
         for _, opts in cand:
             total *= len(opts)
         if total > _MAX_SEGMENT_ASSIGNMENTS:
-            # group identical (type, params) ops: uniform choice per group
+            # irreducible over-cap region: group identical (type, params)
+            # ops and force a uniform choice per group
+            from ..logger import search_logger as slog
+
+            slog.debug(
+                "assignment cap hit on irreducible region (%d ops, %d "
+                "assignments > %d): grouping identical ops",
+                len(seg), total, _MAX_SEGMENT_ASSIGNMENTS,
+            )
             groups: Dict[Tuple, List[int]] = {}
             for j, _ in cand:
                 key = (seg[j].op_type, seg[j].params)
@@ -241,31 +474,24 @@ class UnitySearch:
             for combo in itertools.product(*(opts for _, opts in cand))
         ]
 
-    def _eval_segment(
+    def _eval_enumerate(
         self,
         seg: List[Op],
-        boundary_in: List[int],  # guids of tensors entering the segment
-        in_shapes: Tuple[ParallelTensorShape, ...],
-        out_guids: List[int],  # guids of tensors leaving the segment
-        options: Dict[int, List[ShardConfig]],
+        shape_env: Dict[int, ParallelTensorShape],
+        out_guids: List[int],
+        options: Dict[int, List[XferChoice]],
         input_dp: int,
         axes_sig: Tuple,
     ) -> List[_SegResult]:
-        sig = (self._seg_sig(seg, boundary_in), in_shapes, input_dp, axes_sig)
-        cached = self._seg_cache.get(sig)
-        if cached is not None:
-            self.cache_hits += 1
-            return cached
         mesh_axes = dict(axes_sig)
         results: List[_SegResult] = []
-        shape_in = dict(zip(boundary_in, in_shapes))
         for assignment in self._enumerate_assignments(seg, options):
             if self.budget and self.evals >= self.budget:
                 if results:
                     break
             self.evals += 1
             choice_of = dict(assignment)
-            shapes: Dict[int, ParallelTensorShape] = dict(shape_in)
+            shapes: Dict[int, ParallelTensorShape] = dict(shape_env)
             time = 0.0
             mem = 0
             ok = True
@@ -319,7 +545,6 @@ class UnitySearch:
                     out_shapes=tuple(shapes[g] for g in out_guids),
                 )
             )
-        self._seg_cache[sig] = results
         return results
 
     # ------------------------------------------------------------------
@@ -338,9 +563,10 @@ class UnitySearch:
             out_guids = [out_guid] if out_guid is not None else []
             new_states: Dict[Tuple, Tuple] = {}
             for in_shapes, (obj0, t0, m0, asg0, edges0) in states.items():
-                for res in self._eval_segment(
-                    seg, incoming, in_shapes, out_guids, options, dp_degree,
-                    axes_sig,
+                shape_env = dict(zip(incoming, in_shapes))
+                for res in self._eval_region(
+                    seg, shape_env, out_guids, options, dp_degree,
+                    axes_sig, lam,
                 ):
                     obj = obj0 + res.time + lam * res.memory
                     key = res.out_shapes
@@ -349,10 +575,11 @@ class UnitySearch:
                         asg = dict(asg0)
                         edges = dict(edges0)
                         for j, choice in res.assignment:
+                            op = seg[j]
                             if not choice.shard.is_trivial():
-                                asg[seg[j].name] = choice.shard
+                                asg[op.name] = choice.shard
                             if choice.out_chain:
-                                edges[seg[j].outputs[0].name] = (
+                                edges[op.outputs[0].name] = (
                                     choice.chain_as_lists()
                                 )
                         new_states[key] = (
@@ -447,6 +674,13 @@ class UnitySearch:
             if obj < best_obj:
                 best, best_obj = strategy, obj
         for strategy, obj, label in self._sp_candidates(lam):
+            slog.debug(
+                "candidate %s: obj=%.3g%s", label, obj,
+                " *best*" if obj < best_obj else "",
+            )
+            if obj < best_obj:
+                best, best_obj = strategy, obj
+        for strategy, obj, label in self._pp_candidates(lam):
             slog.debug(
                 "candidate %s: obj=%.3g%s", label, obj,
                 " *best*" if obj < best_obj else "",
@@ -552,6 +786,114 @@ class UnitySearch:
             obj = self._objective(time, mem, lam)
             yield s, obj, f"dp={dp} sp={sp} (ring attention)"
 
+    def _pp_candidates(self, lam: float):
+        """Pipeline-parallel candidates: dp x pp meshes over the graph's
+        homogeneous block stack (parallel/pipeline_plan.py), ranked with
+        the standard GPipe terms — bubble fraction (S-1)/(M+S-1) on the
+        block region plus per-tick ppermute traffic over ICI.  The
+        reference's vestigial PIPELINE_* hooks (model.h:190-192) made a
+        searchable strategy per SURVEY §2.3."""
+        from ..parallel.pipeline_plan import plan_pipeline
+        from .segments import find_repeated_blocks
+
+        blocks = find_repeated_blocks(self.graph)
+        L = len(blocks)
+        if L < 2:
+            return
+        block_names = {op.name for blk in blocks for op in blk}
+        sources = [op for op in self.graph.ops
+                   if op.op_type == OperatorType.INPUT]
+        if not sources:
+            return
+        b = sources[0].outputs[0].shape.logical_shape[0]
+        # boundary activation: block 1's external input tensor
+        produced1 = {t.guid for op in blocks[1] for t in op.outputs}
+        boundary_t = None
+        for op in blocks[1]:
+            for t in op.inputs:
+                if t.guid not in produced1:
+                    boundary_t = t
+        if boundary_t is None:
+            return
+        for pp in range(2, min(self.n, L) + 1):
+            if self.n % pp or L % pp:
+                continue
+            dp = self.n // pp
+            if b % dp:
+                continue
+            local_b = b // dp
+            mbs = sorted({m for m in (pp, 2 * pp, 4 * pp, local_b)
+                          if 1 < m <= local_b and local_b % m == 0})
+            if not mbs:
+                continue
+            s0 = Strategy(mesh_axes={"data": dp})
+            if dp > 1:
+                s0.edge_ops["__inputs__"] = [
+                    ("repartition", {"dim": 0, "degree": dp})
+                ]
+            try:
+                g = apply_strategy(self.graph, s0)
+            except (ShapeError, ValueError):
+                continue
+            t_block = t_rest = 0.0
+            mem_block = mem_rest = 0
+            dp_axes = {"data": dp}
+            for op in g.topo_order():
+                if op.op_type == OperatorType.INPUT:
+                    continue
+                if op.is_parallel_op():
+                    t = (2.0 * self._sim.xfer_cost(op, dp_axes)
+                         * (1.0 - self.overlap))
+                    m = 0
+                else:
+                    t, m = self._op_cost(op)
+                if op.name in block_names:
+                    t_block += t
+                    mem_block += m
+                else:
+                    t_rest += t
+                    mem_rest += m
+            act_bytes = max(1, boundary_t.shape.size_bytes() // dp)
+
+            def mk_strategy(M: int) -> Strategy:
+                mesh_axes = {"data": dp, "pipe": pp} if dp > 1 else {"pipe": pp}
+                s = Strategy(
+                    mesh_axes=mesh_axes,
+                    pipeline={
+                        "degree": pp,
+                        "num_microbatches": M,
+                        "axis": "pipe",
+                        "dp_axis": "data" if dp > 1 else None,
+                    },
+                )
+                if dp > 1:
+                    s.edge_ops["__inputs__"] = [
+                        ("repartition", {"dim": 0, "degree": dp})
+                    ]
+                return s
+
+            # validate once per pp degree — the applied graph and plan
+            # are independent of M (mbs already guarantees divisibility)
+            probe = mk_strategy(mbs[0])
+            try:
+                gg = apply_strategy(self.graph, probe)
+                assign_views(gg, probe.mesh_axes)
+                plan_pipeline(gg, probe.pipeline, probe.mesh_axes)
+            except (ShapeError, ValueError):
+                continue
+            for M in mbs:
+                # region wall time: (M+S-1)/(M*S) of the dp-sharded
+                # block total (compute+sync), i.e. /S with GPipe bubble
+                region = t_block * (M + pp - 1) / (M * pp)
+                # fwd activation shift + bwd grad shift per tick
+                ring = 2.0 * (M + pp - 2) * self._comm_time(
+                    "allgather", max(1, act_bytes // M), 2
+                )
+                time = t_rest + region + ring * (1.0 - self.overlap)
+                mem = mem_rest + mem_block // pp
+                obj = self._objective(time, mem, lam)
+                yield mk_strategy(M), obj, f"dp={dp} pp={pp} M={M} (gpipe)"
+
     def optimize_with_memory(self) -> Optional[Strategy]:
         """Lambda binary search (reference try_one_lambda + binary search,
         graph.cc:2056-2131): smallest lambda whose best strategy fits the
@@ -591,7 +933,19 @@ class UnitySearch:
         assign_views(g, strategy.mesh_axes)
         sim = Simulator(self.machine, self.cost_model,
                         optimizer_slots=self.optimizer_slots)
-        return sim.per_device_memory(g, training=True)
+        op_scale = None
+        if strategy.pipeline:
+            # each device holds only its stage's 1/S of the block stack
+            from ..parallel.pipeline_plan import plan_pipeline
+
+            plan = plan_pipeline(g, strategy.pipeline, strategy.mesh_axes)
+            block_guids = {op.guid for blk in plan.blocks for op in blk}
+            S = plan.num_stages
+
+            def op_scale(op, _g=block_guids, _s=S):  # noqa: E731
+                return 1.0 / _s if op.guid in _g else 1.0
+
+        return sim.per_device_memory(g, training=True, op_scale=op_scale)
 
 
 def unity_optimize(model, num_devices: int) -> Strategy:

@@ -322,18 +322,23 @@ class Simulator:
         return total
 
     # -- memory ----------------------------------------------------------
-    def per_device_memory(self, graph: Graph, training: bool = True) -> int:
-        weights = 0
-        acts = 0
+    def per_device_memory(self, graph: Graph, training: bool = True,
+                          op_scale=None) -> int:
+        """op_scale(op) -> float scales an op's contribution (pipeline
+        strategies pass 1/num_stages for block ops — each device holds
+        only its stage's weights/activations)."""
+        weights = 0.0
+        acts = 0.0
         for op in graph.ops:
+            s = op_scale(op) if op_scale is not None else 1.0
             for w in op.weights:
-                weights += w.shape.shard_bytes()
+                weights += w.shape.shard_bytes() * s
             for t in op.outputs:
-                acts += t.shape.shard_bytes()
+                acts += t.shape.shard_bytes() * s
         if training:
             # grads + optimizer slots for weights; activations live for bwd
             weights = weights * (2 + self.optimizer_slots)
-        return weights + acts
+        return int(weights + acts)
 
     # -- top level -------------------------------------------------------
     def simulate(

@@ -57,6 +57,11 @@ class Strategy:
     # (reference: the rewrites GraphXfer::run applied to the winning
     # graph, substitution.cc:1898-1945)
     rewrites: List[List] = dataclasses.field(default_factory=list)
+    # pipeline parallelism payload {"degree", "num_microbatches",
+    # "axis", "dp_axis"} lowered by parallel/pipeline_plan.py (the
+    # reference's vestigial PIPELINE_* hooks, model.h:190-192, made
+    # first-class)
+    pipeline: Optional[Dict] = None
 
     # -- serialization ---------------------------------------------------
     def to_json(self) -> str:
@@ -68,6 +73,7 @@ class Strategy:
                 },
                 "edge_ops": self.edge_ops,
                 "rewrites": [list(r) for r in self.rewrites],
+                "pipeline": self.pipeline,
             },
             indent=2,
         )
@@ -85,6 +91,7 @@ class Strategy:
                 for k, v in d.get("edge_ops", {}).items()
             },
             rewrites=[list(r) for r in d.get("rewrites", [])],
+            pipeline=d.get("pipeline"),
         )
 
     def save(self, path: str):

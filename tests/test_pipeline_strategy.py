@@ -1,0 +1,143 @@
+"""Pipeline parallelism as a first-class Strategy.
+
+Reference parity: the reference reserves PIPELINE_INIT/FWD/BWD task ids
+(include/flexflow/model.h:190-192) but implements no pipeline op; SURVEY
+§2.3 directs this build to make PP a build-fresh searchable strategy.
+Covers: plan validation, pp Strategy training matching single-device
+numerics (GPipe via shard_map+ppermute, parallel/pipeline.py), strategy
+JSON round-trip, and the Unity search emitting a pp strategy when
+neither dp nor tp can use the mesh.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.strategy import Strategy
+
+
+def _stacked(n, layers=4, batch=16, hidden=32, classes=4):
+    cfg = FFConfig(batch_size=batch, num_devices=n)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([batch, hidden], name="x")
+    t = x
+    for i in range(layers):
+        t = ff.dense(t, hidden, activation=ActiMode.RELU, name=f"blk{i}")
+    t = ff.dense(t, classes, name="head")
+    ff.softmax(t)
+    return ff
+
+
+def _pp_strategy(dp, pp, M):
+    axes = {"data": dp, "pipe": pp} if dp > 1 else {"pipe": pp}
+    s = Strategy(
+        mesh_axes=axes,
+        pipeline={"degree": pp, "num_microbatches": M, "axis": "pipe",
+                  "dp_axis": "data" if dp > 1 else None},
+    )
+    if dp > 1:
+        s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
+    return s
+
+
+def test_pp_strategy_matches_single_device(devices8):
+    """dp=2 x pp=2 GPipe training matches the 1-device model step for
+    step when weights are transferred from the stacked pp layout."""
+    ff = _stacked(4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               strategy=_pp_strategy(2, 2, 4), devices=devices8[:4])
+    w = ff.get_weights()
+    assert set(w) == {"__pipeline__", "head"}
+    assert w["__pipeline__"]["0.kernel"].shape == (4, 32, 32)
+
+    ff1 = _stacked(1)
+    ff1.compile(optimizer=SGDOptimizer(lr=0.05), devices=devices8[:1])
+    w1 = ff1.get_weights()
+    for k in range(4):
+        w1[f"blk{k}"]["kernel"] = w["__pipeline__"]["0.kernel"][k]
+        w1[f"blk{k}"]["bias"] = w["__pipeline__"]["0.bias"][k]
+    w1["head"] = w["head"]
+    ff1.set_weights(w1)
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 32).astype(np.float32)
+    y = rs.randint(0, 4, size=(16,))
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": x})), np.asarray(ff1.forward({"x": x})),
+        rtol=2e-5, atol=2e-5,
+    )
+    losses_pp = [float(ff.train_step({"x": x}, y)["loss"]) for _ in range(5)]
+    losses_1d = [float(ff1.train_step({"x": x}, y)["loss"]) for _ in range(5)]
+    np.testing.assert_allclose(losses_pp, losses_1d, rtol=1e-4, atol=1e-5)
+    assert losses_pp[-1] < losses_pp[0]
+
+
+def test_pp_strategy_pipe_only_mesh(devices8):
+    """pp without a data axis (mesh {'pipe': 4})."""
+    ff = _stacked(4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               strategy=_pp_strategy(1, 4, 4), devices=devices8[:4])
+    x = np.random.randn(16, 32).astype(np.float32)
+    y = np.random.randint(0, 4, size=(16,))
+    m = ff.train_step({"x": x}, y)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_pp_plan_validation_errors():
+    from flexflow_tpu.parallel.pipeline_plan import plan_pipeline
+
+    ff = _stacked(4, layers=3)  # 3 blocks, not divisible by pp=2
+    with pytest.raises(ValueError, match="not divisible"):
+        plan_pipeline(
+            ff.layers,
+            {"degree": 2, "num_microbatches": 4, "axis": "pipe",
+             "dp_axis": None},
+            {"pipe": 2},
+        )
+    # no repeated blocks at all
+    cfg = FFConfig(batch_size=8)
+    ff2 = FFModel(cfg)
+    x = ff2.create_tensor([8, 16], name="x")
+    t = ff2.dense(x, 32, name="a")
+    ff2.softmax(t)
+    with pytest.raises(ValueError, match="block"):
+        plan_pipeline(
+            ff2.layers,
+            {"degree": 2, "num_microbatches": 2, "axis": "pipe",
+             "dp_axis": None},
+            {"pipe": 2},
+        )
+
+
+def test_pp_strategy_json_roundtrip(tmp_path):
+    s = _pp_strategy(2, 2, 8)
+    p = tmp_path / "pp.json"
+    s.save(str(p))
+    s2 = Strategy.load(str(p))
+    assert s2.pipeline == s.pipeline
+    assert s2.mesh_axes == s.mesh_axes
+
+
+def test_unity_search_emits_pipeline(devices8):
+    """With a prime hidden width (no tp options) and a batch smaller
+    than the device count (no pure-dp factorization), the only viable
+    8-device strategy is GPipe — the search must find and emit it, and
+    the result must compile + train."""
+    from flexflow_tpu.pcg.unity import UnitySearch
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+    from flexflow_tpu.sim.simulator import OpCostModel
+
+    ff = _stacked(8, layers=8, batch=2, hidden=31, classes=5)
+    machine = TpuPodModel(topology=(2, 4))
+    search = UnitySearch(ff.layers, 8, machine, OpCostModel(machine))
+    best = search.optimize()
+    assert best is not None
+    assert best.pipeline is not None, f"expected pp strategy, got {best}"
+    assert best.mesh_axes.get("pipe") == best.pipeline["degree"]
+
+    ff.compile(optimizer=SGDOptimizer(lr=0.05), strategy=best,
+               devices=devices8[:8])
+    x = np.random.randn(2, 31).astype(np.float32)
+    y = np.random.randint(0, 5, size=(2,))
+    m = ff.train_step({"x": x}, y)
+    assert np.isfinite(float(m["loss"]))

@@ -88,3 +88,58 @@ def test_unity_within_tolerance_of_brute_force(widths, n):
         f"search picked {chosen_time:.3e}s vs brute-force {bf_time:.3e}s "
         f"(mesh {chosen.mesh_axes} vs {bf.mesh_axes})"
     )
+
+
+def _branchy_tower(n_branches, batch=32, in_dim=32):
+    """Inception-style parallel branches joined by a concat."""
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor([batch, in_dim], name="x")
+    outs = []
+    for i in range(n_branches):
+        t = ff.dense(x, 32 + 16 * i, activation=ActiMode.RELU, name=f"b{i}a")
+        t = ff.dense(t, 64, activation=ActiMode.RELU, name=f"b{i}b")
+        outs.append(t)
+    t = ff.concat(outs, axis=1)
+    t = ff.dense(t, 16, name="head")
+    ff.softmax(t)
+    return ff
+
+
+def test_branchy_graph_decomposition_matches_brute_force(monkeypatch):
+    """Reference split_horizontal/split_at_node (graph.h:346-349): with
+    the assignment cap forced tiny, the branch region must decompose
+    (per-branch choices, combined at the join) and still find the
+    brute-force optimum instead of collapsing to grouped-uniform."""
+    from flexflow_tpu.pcg import unity as unity_mod
+
+    ff = _branchy_tower(2)
+    machine = TpuPodModel()
+    cm = OpCostModel(machine)
+    search = UnitySearch(ff.layers, 4, machine, cm,
+                         rewrite_max_variants=1)  # isolate decomposition
+    sim = Simulator(machine, cm)
+
+    monkeypatch.setattr(unity_mod, "_MAX_SEGMENT_ASSIGNMENTS", 4)
+    horizontal_calls = []
+    orig_h = search._eval_horizontal
+
+    def spy(*a, **k):
+        horizontal_calls.append(1)
+        return orig_h(*a, **k)
+
+    search._eval_horizontal = spy
+    chosen = search.optimize()
+    assert chosen is not None
+    assert horizontal_calls, "branch region never split horizontally"
+
+    g = apply_strategy(ff.layers, chosen)
+    assign_views(g, chosen.mesh_axes)
+    chosen_time = sim.simulate(g, chosen.mesh_axes).total_time
+
+    monkeypatch.setattr(unity_mod, "_MAX_SEGMENT_ASSIGNMENTS", 10 ** 9)
+    bf_time, bf = _brute_force_best(search, sim)
+    assert bf is not None
+    assert chosen_time <= bf_time * 1.25 + 1e-9, (
+        f"decomposed search picked {chosen_time:.3e}s vs brute-force "
+        f"{bf_time:.3e}s (mesh {chosen.mesh_axes} vs {bf.mesh_axes})"
+    )
