@@ -121,12 +121,9 @@ def plan_pipeline(
         )
 
     def external_in(blk: List[Op]) -> int:
-        produced = {t.guid for op in blk for t in op.outputs}
-        ext = []
-        for op in blk:
-            for t in op.inputs:
-                if t.guid not in produced and t.guid not in ext:
-                    ext.append(t.guid)
+        from ..pcg.segments import external_inputs
+
+        ext = external_inputs(blk)
         if len(ext) != 1:
             raise ValueError(
                 f"pipelined block has {len(ext)} external inputs, need 1"

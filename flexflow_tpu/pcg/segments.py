@@ -14,6 +14,21 @@ from ..ops.op import Op
 from .graph import Graph
 
 
+def external_inputs(ops: List[Op]) -> List[int]:
+    """Guids of tensors consumed by `ops` but produced outside, ordered
+    by first consumption — THE boundary-detection helper shared by the
+    Unity region DP, the pipeline planner, and pp candidate costing."""
+    produced = {t.guid for op in ops for t in op.outputs}
+    out: List[int] = []
+    seen = set()
+    for op in ops:
+        for t in op.inputs:
+            if t.guid not in produced and t.guid not in seen:
+                seen.add(t.guid)
+                out.append(t.guid)
+    return out
+
+
 def split_segments(graph: Graph) -> Tuple[List[List[Op]], List[Optional[int]]]:
     """Split topo order at single-tensor cuts.
 

@@ -91,7 +91,11 @@ class UnitySearch:
         rewrite_rules: Optional[Sequence] = None,
         rewrite_depth: int = 2,
         rewrite_max_variants: int = 8,
+        event_rerank: bool = True,
+        event_topk: int = 4,
     ):
+        self.event_rerank = event_rerank
+        self.event_topk = event_topk
         self.graph = graph
         self._base_graph = graph
         self.rewrite_rules = rewrite_rules  # None -> built-in catalog
@@ -234,15 +238,9 @@ class UnitySearch:
 
     def _boundary_in(self, seg: List[Op]) -> List[int]:
         """External input tensor guids, ordered by first consumption."""
-        produced = {t.guid for op in seg for t in op.outputs}
-        out: List[int] = []
-        seen = set()
-        for op in seg:
-            for t in op.inputs:
-                if t.guid not in produced and t.guid not in seen:
-                    seen.add(t.guid)
-                    out.append(t.guid)
-        return out
+        from .segments import external_inputs
+
+        return external_inputs(seg)
 
     def _out_refs(self, seg: List[Op], out_guids: List[int]) -> Tuple:
         """Structural refs of exported tensors (cache-key component)."""
@@ -642,13 +640,12 @@ class UnitySearch:
         self.graph = graph
         self._segments_memo = None
 
-    def _optimize_graph(self, lam: float):
-        """Best (strategy, obj) for the CURRENT self.graph across mesh
-        factorizations and sp candidates."""
+    def _optimize_graph(self, lam: float, collector: List[Tuple]):
+        """Append every valid (obj, strategy, graph) for the CURRENT
+        self.graph to collector (mesh factorizations, sp, pp)."""
         from ..logger import search_logger as slog
 
         has_moe = any(op.op_type == OperatorType.GROUP_BY for op in self.graph.ops)
-        best: Optional[Strategy] = None
         best_obj = math.inf
         for dp, tp, ep in _factorizations(self.n, allow_expert=has_moe):
             mesh_axes = self._mesh_axes(dp, tp, ep)
@@ -671,46 +668,134 @@ class UnitySearch:
                 dp, tp, ep, time * 1e3, mem / 2**20, obj,
                 " *best*" if obj < best_obj else "",
             )
-            if obj < best_obj:
-                best, best_obj = strategy, obj
+            best_obj = min(best_obj, obj)
+            collector.append((obj, strategy, self.graph))
         for strategy, obj, label in self._sp_candidates(lam):
             slog.debug(
                 "candidate %s: obj=%.3g%s", label, obj,
                 " *best*" if obj < best_obj else "",
             )
-            if obj < best_obj:
-                best, best_obj = strategy, obj
+            best_obj = min(best_obj, obj)
+            collector.append((obj, strategy, self.graph))
         for strategy, obj, label in self._pp_candidates(lam):
             slog.debug(
                 "candidate %s: obj=%.3g%s", label, obj,
                 " *best*" if obj < best_obj else "",
             )
-            if obj < best_obj:
-                best, best_obj = strategy, obj
-        return best, best_obj
+            best_obj = min(best_obj, obj)
+            collector.append((obj, strategy, self.graph))
+
+    def _event_objective(
+        self, strategy: Strategy, graph: Graph, lam: float
+    ) -> Optional[float]:
+        """Contention-aware objective from the event-driven taskgraph
+        simulator (reference simulate_runtime, simulator.cc:822-1250;
+        ring expansion :1690-1800) — replaces the analytic model's flat
+        overlap credit for the final top-K ranking.
+
+        Pipeline candidates stay on the same scale: the event sim runs
+        the applied graph WITHOUT the GPipe schedule (it cannot express
+        it), then the block region's share of the makespan is scaled by
+        the bubble factor (M+S-1)/(M*S) — so pp is never compared via
+        its optimistic analytic number against others' event numbers."""
+        from ..logger import search_logger as slog
+
+        try:
+            from ..sim.taskgraph import TaskGraphSimulator
+
+            g = apply_strategy(graph, strategy)
+            assign_views(g, strategy.mesh_axes)
+            res = TaskGraphSimulator(self.machine, self.cost_model).simulate(
+                g, strategy.mesh_axes, training=True
+            )
+            time = res.total_time
+            op_scale = None
+            if strategy.pipeline:
+                from ..parallel.pipeline_plan import plan_pipeline
+
+                plan = plan_pipeline(g, strategy.pipeline, strategy.mesh_axes)
+                block_guids = {
+                    op.guid for blk in plan.blocks for op in blk
+                }
+                t_block = t_rest = 0.0
+                for op in g.topo_order():
+                    if op.op_type == OperatorType.INPUT or op.is_parallel_op():
+                        continue
+                    t, _ = self._op_cost(op)
+                    if op.guid in block_guids:
+                        t_block += t
+                    else:
+                        t_rest += t
+                total = t_block + t_rest
+                frac = t_block / total if total > 0 else 0.0
+                S = plan.num_stages
+                M = plan.num_microbatches
+                factor = (M + S - 1) / (M * S)
+                time = time * ((1.0 - frac) + frac * factor)
+
+                def op_scale(op, _g=block_guids, _s=S):  # noqa: E731
+                    return 1.0 / _s if op.guid in _g else 1.0
+
+            mem = self._sim.per_device_memory(g, training=True,
+                                              op_scale=op_scale)
+            return self._objective(time, mem, lam)
+        except Exception as e:  # noqa: BLE001
+            slog.debug(
+                "event rerank unavailable for %s: %s: %s",
+                strategy.mesh_axes, type(e).__name__, e,
+            )
+            return None
 
     def optimize(self, lam: float = 0.0) -> Optional[Strategy]:
         from ..logger import search_logger as slog
 
-        best: Optional[Strategy] = None
-        best_obj = math.inf
+        collector: List[Tuple] = []
         with slog.enter(f"unity optimize n={self.n} lambda={lam:g}"):
             for graph, trace in self._variants():
                 self._set_graph(graph)
                 if trace:
                     slog.debug("rewritten variant: %s",
                                "+".join(f"{n}[{i}]" for n, i in trace))
-                strategy, obj = self._optimize_graph(lam)
-                if strategy is not None and obj < best_obj:
-                    strategy.rewrites = [list(r) for r in trace]
-                    if trace:
-                        slog.debug(
-                            "rewrite %s improves obj to %.3g",
-                            "+".join(n for n, _ in trace), obj,
-                        )
-                    best, best_obj = strategy, obj
-        self._set_graph(self._base_graph)
-        return best
+                before = len(collector)
+                self._optimize_graph(lam, collector)
+                for i in range(before, len(collector)):
+                    collector[i][1].rewrites = [list(r) for r in trace]
+            self._set_graph(self._base_graph)
+            if not collector:
+                return None
+            collector.sort(key=lambda c: c[0])
+            if not self.event_rerank:
+                return collector[0][1]
+            # re-rank the analytic top-K with the event simulator's
+            # contention-aware makespan (reference: candidates are
+            # ultimately judged by simulate_runtime, not the analytic
+            # estimators)
+            # distinct meshes only — pp candidates differing solely in
+            # microbatch count would otherwise crowd the top-K
+            seen_keys = set()
+            top: List[Tuple] = []
+            for c in collector:
+                key = (tuple(sorted(c[1].mesh_axes.items())),
+                       c[1].pipeline is None)
+                if key in seen_keys:
+                    continue
+                seen_keys.add(key)
+                top.append(c)
+                if len(top) >= self.event_topk:
+                    break
+            best, best_obj = None, math.inf
+            for obj, strategy, graph in top:
+                e = self._event_objective(strategy, graph, lam)
+                final = e if e is not None else obj
+                slog.debug(
+                    "event rerank %s: analytic=%.3g event=%s%s",
+                    strategy.mesh_axes, obj,
+                    f"{e:.3g}" if e is not None else "n/a",
+                    " *best*" if final < best_obj else "",
+                )
+                if final < best_obj:
+                    best, best_obj = strategy, final
+            return best if best is not None else collector[0][1]
 
     def _objective(self, time: float, mem: int, lam: float) -> float:
         """Single ranking formula for ALL candidate families (dp/tp/ep
@@ -806,15 +891,14 @@ class UnitySearch:
         if not sources:
             return
         b = sources[0].outputs[0].shape.logical_shape[0]
-        # boundary activation: block 1's external input tensor
-        produced1 = {t.guid for op in blocks[1] for t in op.outputs}
-        boundary_t = None
-        for op in blocks[1]:
-            for t in op.inputs:
-                if t.guid not in produced1:
-                    boundary_t = t
-        if boundary_t is None:
-            return
+        # boundary activation: block 1's single external input tensor
+        from .segments import external_inputs
+
+        ext = external_inputs(blocks[1])
+        if len(ext) != 1:
+            return  # plan_pipeline would reject this region too
+        by_guid = {t.guid: t for op in self.graph.ops for t in op.outputs}
+        boundary_t = by_guid[ext[0]]
         for pp in range(2, min(self.n, L) + 1):
             if self.n % pp or L % pp:
                 continue

@@ -338,10 +338,14 @@ class TaskGraphSimulator:
 
     def __init__(self, machine: MachineModel,
                  cost_model: Optional[OpCostModel] = None,
-                 force_python: bool = False):
+                 force_python: bool = False,
+                 ring_attention: bool = True):
         self.machine = machine
         self.cost_model = cost_model or OpCostModel(machine)
         self.force_python = force_python
+        # model seq-sharded attention's KV rotation as ring phases
+        # (ablation toggle for tests/what-if costing)
+        self.ring_attention = ring_attention
 
     def build(self, graph: Graph, mesh_axes: Dict[str, int],
               training: bool = True) -> TaskGraphArrays:
@@ -385,6 +389,32 @@ class TaskGraphSimulator:
                     tasks = self._grouped_collective(
                         b, "allreduce", k, size, tasks, all_devices
                     )
+                if (
+                    self.ring_attention
+                    and op.op_type == OperatorType.MULTIHEAD_ATTENTION
+                    and len(op.inputs) >= 3
+                ):
+                    # ring attention: seq-sharded KV rotates once around
+                    # the sp group per forward (ppermute per block step),
+                    # ~2x more for backward re-rotation + dK/dV — the
+                    # bandwidth equivalent of 3 allgathers of the local
+                    # KV (replaces the analytic flat term, unity.py
+                    # _sp_candidates)
+                    dd = [
+                        d for d in op.inputs[0].shape.dims
+                        if not d.is_replica_dim
+                    ]
+                    if len(dd) >= 2 and dd[1].degree > 1:
+                        sp = dd[1].degree
+                        kv = (
+                            op.inputs[1].shape.shard_bytes()
+                            + op.inputs[2].shape.shard_bytes()
+                        )
+                        tasks = self._grouped_collective(
+                            b, "allgather", sp,
+                            3.0 * kv * sp if training else kv * sp,
+                            tasks, all_devices,
+                        )
             for t in op.outputs:
                 producer[t.guid] = tasks
         if training:
