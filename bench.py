@@ -1,95 +1,166 @@
-"""Benchmark: BERT-base training throughput, samples/sec/chip.
+"""Benchmark: the BASELINE north star's two headline workloads on one chip.
 
-Run on the real TPU chip by the driver.  Measures steady-state jitted
-train-step time (forward + backward + optimizer) in bf16 on BERT-base
-(12L, hidden 768, 12 heads, seq 128) and prints ONE JSON line.
+Leg 1 — BERT-base (12L, hidden 768, 12 heads, seq 128) trained from REAL
+token ids (embedding lookup -> encoder -> loss; `from_token_ids=True`),
+bf16, samples/sec/chip.
+Leg 2 — ResNet-50 (the torch.fx-imported bottleneck tower of
+examples/python/pytorch/resnet50_search.py, BASELINE.json configs[1])
+at 224px, bf16, compiled under the auto-searched strategy.
 
-vs_baseline anchors to BASELINE.md's north star — A100-NCCL per-GPU
-throughput for BERT-base at seq 128 in mixed precision, taken as
-~250 samples/s/GPU (A100 cards sustain roughly 230-280 samples/s on
-BERT-base seq-128 fine-tuning; the reference repo publishes no absolute
-number, BASELINE.md:3-5).
+Prints ONE JSON line; `legs` carries both workloads' numbers.
+vs_baseline anchors to A100-NCCL per-GPU throughput (the reference repo
+publishes no absolute numbers, BASELINE.md:3-5): ~250 samples/s for
+BERT-base seq-128 fine-tune, ~2500 img/s for ResNet-50 mixed-precision
+training (DGX-A100 per-GPU MLPerf-era envelope).
 """
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
 A100_BERT_BASE_SEQ128_SAMPLES_PER_SEC = 250.0
+A100_RESNET50_SAMPLES_PER_SEC = 2500.0
 
 
-def main():
+def _steady_state(ff, inputs, labels, iters):
+    """Steady-state samples/sec: device-resident batch, long serial
+    chain (each step consumes the previous step's donated weights), one
+    hard value fetch at the end — under the axon tunnel,
+    block_until_ready alone returns early and per-step host round trips
+    add ~80ms the real (prefetched-dataloader) training never pays."""
+    import jax
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = ff.train_step(inputs, labels)
+    _ = float(m["loss"])
+    _ = np.asarray(jax.tree.leaves(ff._weights)[0]).ravel()[0]
+    return time.perf_counter() - t0
+
+
+def bench_bert(dev, on_tpu):
     import jax
 
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
     from flexflow_tpu.models.transformer import build_bert
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
     if on_tpu:
         batch, seq, hidden, layers, heads, inter = 64, 128, 768, 12, 12, 3072
-    else:  # CPU smoke config so the bench always produces a line
+    else:
         batch, seq, hidden, layers, heads, inter = 8, 32, 64, 2, 4, 128
 
     cfg = FFConfig(batch_size=batch, num_devices=1,
                    compute_dtype="bfloat16" if on_tpu else "float32")
     ff = FFModel(cfg)
     build_bert(ff, batch_size=batch, seq_length=seq, hidden_size=hidden,
-               num_layers=layers, num_heads=heads, intermediate_size=inter)
+               num_layers=layers, num_heads=heads, intermediate_size=inter,
+               from_token_ids=True)
     ff.compile(
         optimizer=SGDOptimizer(lr=0.01),
         loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
         devices=[dev],
     )
-
     rng = np.random.RandomState(0)
-    x = rng.randn(batch, seq, hidden).astype(np.float32)
+    ids = rng.randint(0, 30522, size=(batch, seq)).astype(np.int32)
     y = rng.randint(0, 2, batch).astype(np.int32)
-    # stage the batch on-device once: the bench measures steady-state
-    # step time (train data is device-resident via the dataloader's
-    # prefetch in real runs; under axon the tunnel would otherwise add
-    # a noisy ~25MB host->device copy per step)
-    x = jax.device_put(x, ff.executor.input_shardings()["input"])
+    ids = jax.device_put(ids, ff.executor.input_shardings()["input"])
     y = jax.device_put(y, ff.executor.label_sharding())
 
-    import sys
-
-    print(f"bench: compiled model graph, starting warmup", file=sys.stderr)
+    print("bench[bert]: compiled, warming up", file=sys.stderr)
     t_c = time.perf_counter()
-    # warmup (compile + cache)
     for _ in range(3):
-        m = ff.train_step({"input": x}, y)
-    _ = float(m["loss"])  # hard fetch: tunnel block_until_ready is unreliable
-    print(f"bench: warmup done in {time.perf_counter()-t_c:.1f}s", file=sys.stderr)
-
-    # Steady-state step time: device-resident batch, long serial chain
-    # (each step consumes the previous step's donated weights), one hard
-    # value fetch of the final loss AND a weight leaf at the end — under
-    # the axon tunnel, block_until_ready alone returns early, and any
-    # per-step host round-trip adds ~80ms of tunnel latency that real
-    # training (prefetched dataloader) never pays.
-    iters = 50 if on_tpu else 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        m = ff.train_step({"input": x}, y)
+        m = ff.train_step({"input": ids}, y)
     _ = float(m["loss"])
-    _ = np.asarray(jax.tree.leaves(ff._weights)[0]).ravel()[0]
-    dt = time.perf_counter() - t0
+    print(f"bench[bert]: warmup {time.perf_counter()-t_c:.1f}s",
+          file=sys.stderr)
+    iters = 50 if on_tpu else 5
+    dt = _steady_state(ff, {"input": ids}, y, iters)
+    sps = iters * batch / dt
+    return {
+        "workload": f"BERT-base seq{seq} b{batch} token-ids train, bf16",
+        "samples_per_sec_per_chip": round(sps, 2),
+        "vs_a100": round(sps / A100_BERT_BASE_SEQ128_SAMPLES_PER_SEC, 4),
+    }
 
-    samples_per_sec = iters * batch / dt
+
+def bench_resnet50(dev, on_tpu):
+    import jax
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "examples", "python", "pytorch"))
+    from resnet50_search import ResNet50
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.torch_frontend.model import PyTorchModel
+
+    if on_tpu:
+        batch, px, classes = 64, 224, 1000
+    else:
+        batch, px, classes = 4, 32, 10
+
+    # auto-searched strategy per BASELINE.json configs[1] (single chip:
+    # the search degenerates to the trivial mesh but the path runs;
+    # calibration off keeps the bench inside its time box)
+    cfg = FFConfig(batch_size=batch, num_devices=1, search_budget=1000,
+                   search_algo="mcmc", search_calibrate=False,
+                   compute_dtype="bfloat16" if on_tpu else "float32")
+    ff = FFModel(cfg)
+    x = ff.create_tensor([batch, 3, px, px], name="input")
+    pt = PyTorchModel(ResNet50(classes=classes))
+    (out,) = pt.torch_to_ff(ff, [x])
+    ff.softmax(out)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.1),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        devices=[dev],
+    )
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch, 3, px, px).astype(np.float32)
+    ys = rng.randint(0, classes, batch).astype(np.int32)
+    xs = jax.device_put(xs, ff.executor.input_shardings()["input"])
+    ys = jax.device_put(ys, ff.executor.label_sharding())
+
+    print("bench[resnet50]: compiled, warming up", file=sys.stderr)
+    t_c = time.perf_counter()
+    for _ in range(3):
+        m = ff.train_step({"input": xs}, ys)
+    _ = float(m["loss"])
+    print(f"bench[resnet50]: warmup {time.perf_counter()-t_c:.1f}s",
+          file=sys.stderr)
+    iters = 20 if on_tpu else 3
+    dt = _steady_state(ff, {"input": xs}, ys, iters)
+    sps = iters * batch / dt
+    return {
+        "workload": f"ResNet-50 {px}px b{batch} fx-import train, bf16, "
+                    f"searched strategy",
+        "samples_per_sec_per_chip": round(sps, 2),
+        "vs_a100": round(sps / A100_RESNET50_SAMPLES_PER_SEC, 4),
+    }
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    bert = bench_bert(dev, on_tpu)
+    resnet = bench_resnet50(dev, on_tpu)
+    geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
+                            * max(resnet["vs_a100"], 1e-9)))
     result = {
-        "metric": f"samples/sec/chip (BERT-base seq{seq} b{batch} train, bf16)"
-        if on_tpu
-        else f"samples/sec/chip (tiny-BERT CPU smoke seq{seq} b{batch})",
-        "value": round(samples_per_sec, 2),
+        "metric": (
+            "samples/sec/chip: BERT-base seq128 b64 token-ids + "
+            "ResNet-50 224px b64 (bf16; vs_baseline = geomean vs A100)"
+            if on_tpu else "CPU smoke: BERT tiny + ResNet tiny"
+        ),
+        "value": bert["samples_per_sec_per_chip"],
         "unit": "samples/s",
-        "vs_baseline": round(
-            samples_per_sec / A100_BERT_BASE_SEQ128_SAMPLES_PER_SEC, 4
-        )
-        if on_tpu
-        else 0.0,
+        "vs_baseline": round(geomean, 4) if on_tpu else 0.0,
+        "legs": {"bert_base": bert, "resnet50": resnet},
     }
     print(json.dumps(result))
 

@@ -50,8 +50,15 @@ class FFConfig:
     only_data_parallel: bool = False
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
+    # partition a non-batch sample dim across a 'sample' mesh axis
+    # (reference config.h:134); consumed by UnitySearch._sample_candidates
     enable_sample_parallel: bool = False
-    enable_inplace_optimizations: bool = True
+    # NOTE: the reference's --enable-inplace-optimizations
+    # (model.cc:2884-2919, in-place relu buffers) has no analogue here:
+    # XLA buffer assignment + donated weight/opt-state buffers subsume it
+    # entirely, so the flag is intentionally NOT carried.
+    # credit gradient sync as mostly hidden behind remaining backward
+    # compute in search costing (reference config.h:130)
     search_overlap_backward_update: bool = False
     substitution_json: Optional[str] = None
     # calibrate search costs by timing real jitted kernels on the chip
@@ -70,11 +77,18 @@ class FFConfig:
     #    --simulator-segment-size)
     machine_model_version: int = 0
     machine_model_file: Optional[str] = None
+    # bounds per-region search enumeration (its reference role: cap
+    # per-segment simulation work); can only lower the built-in cap
     simulator_segment_size: int = 16777216
 
     # -- execution
-    perform_fusion: bool = False  # reference --fusion; XLA fuses anyway
+    # reference --fusion (apply_fusion model.cc:2495): fold trailing
+    # activations into producers at compile; XLA fuses kernels anyway,
+    # this shrinks the PCG/search space
+    perform_fusion: bool = False
     profiling: bool = False
+    # gradient-sync cost model: ALL_REDUCE rings vs PS flat 2*size/BW
+    # (reference ParameterSyncType config.h:55-59, simulator.cc:786-813)
     parameter_sync: ParameterSyncType = ParameterSyncType.ALL_REDUCE
     compute_dtype: str = "float32"  # bf16 on TPU for perf runs
     # use the Pallas flash-attention kernel only at KV length >= this;
@@ -130,6 +144,10 @@ class FFConfig:
         p.add_argument("--enable-parameter-parallel", action="store_true")
         p.add_argument("--enable-attribute-parallel", action="store_true")
         p.add_argument("--enable-sample-parallel", action="store_true")
+        p.add_argument("--search-overlap-backward-update", "--overlap",
+                       dest="overlap_backward_update", action="store_true")
+        p.add_argument("--parameter-sync", dest="parameter_sync", type=str,
+                       default="all_reduce", choices=("none", "ps", "all_reduce"))
         p.add_argument("--substitution-json", type=str, default=None)
         p.add_argument("--search-calibrate", dest="search_calibrate",
                        action="store_true", default=None)
@@ -167,6 +185,8 @@ class FFConfig:
             enable_parameter_parallel=args.enable_parameter_parallel,
             enable_attribute_parallel=args.enable_attribute_parallel,
             enable_sample_parallel=args.enable_sample_parallel,
+            search_overlap_backward_update=args.overlap_backward_update,
+            parameter_sync=ParameterSyncType(args.parameter_sync),
             substitution_json=args.substitution_json,
             search_calibrate=args.search_calibrate,
             op_cost_cache_file=args.op_cost_cache,

@@ -570,7 +570,9 @@ class FFModel:
             "comp_mode": comp_mode,
             "devices": list(devices) if devices is not None else None,
         }
-        self.optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
+        self.optimizer = optimizer or SGDOptimizer(
+            lr=cfg.learning_rate, weight_decay=cfg.weight_decay
+        )
         # Reference convention (loss_functions.cu): a model ending in
         # Softmax feeds probabilities to the loss, not logits.
         sink_is_softmax = self.layers.sink_op().op_type == OperatorType.SOFTMAX
@@ -610,6 +612,14 @@ class FFModel:
             compiled_frontend = apply_rewrites(
                 compiled_frontend, strategy.rewrites, rules_for_config(cfg)
             )
+        if cfg.perform_fusion:
+            # reference --fusion (apply_fusion model.cc:2495): fold
+            # trailing activations into their producers, skipping
+            # anything the strategy names
+            from .pcg.rewrite import fuse_activations
+
+            protected = set(strategy.edge_ops) | set(strategy.shard_configs)
+            compiled_frontend = fuse_activations(compiled_frontend, protected)
         self._compiled_frontend = compiled_frontend
         from .pcg.rewrite import cancel_all_inverse_parallel_ops
 
@@ -665,7 +675,20 @@ class FFModel:
         if cfg.export_compgraph_file:
             self.layers.export_dot(cfg.export_compgraph_file)
         if cfg.export_taskgraph_file:
-            self.operators.export_dot(cfg.export_taskgraph_file)
+            cost_fn = None
+            if cfg.include_costs_dot_graph:
+                # reference --include-costs-dot-graph (config.h:145):
+                # annotate each node with its simulated forward cost
+                from .sim.machine_model import make_machine_model
+                from .sim.simulator import OpCostModel
+
+                cm = OpCostModel(make_machine_model(cfg, num_devices))
+                cost_fn = lambda op: cm.cost(op).forward_time  # noqa: E731
+            self.operators.export_dot(
+                cfg.export_taskgraph_file,
+                include_costs=cfg.include_costs_dot_graph,
+                cost_fn=cost_fn,
+            )
         return self
 
     # ------------------------------------------------------------------

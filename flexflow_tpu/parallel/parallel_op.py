@@ -196,4 +196,5 @@ PARALLEL_OP_KINDS = {
     "replicate": Replicate,
     "reduction": Reduction,
     "all_to_all": AllToAll,
+    "fused": FusedParallelOp,
 }

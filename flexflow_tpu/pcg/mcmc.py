@@ -192,8 +192,17 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
     # across candidate evaluations (reference simulator.cc:550-560)
     cost_model = make_cost_model(cfg, machine)
 
+    from .unity import _sync_mode
+
     def sim_factory():
-        return Simulator(machine, cost_model)
+        return Simulator(
+            machine,
+            cost_model,
+            sync_overlap_fraction=(
+                0.7 if cfg.search_overlap_backward_update else None
+            ),
+            parameter_sync=_sync_mode(cfg.parameter_sync),
+        )
 
     search = MCMCSearch(
         model.layers,

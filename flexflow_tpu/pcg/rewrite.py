@@ -416,6 +416,49 @@ def enumerate_variants(
     return out
 
 
+def fuse_activations(graph: Graph, protected_names=()) -> Graph:
+    """--fusion compile pass (reference apply_fusion, model.cc:2495,
+    :2964-3061 — there it folds ops into FusedOp tasks; here the real
+    win is shrinking the PCG/search space since XLA fuses kernels
+    anyway): fold trailing activations into linear/conv2d until none
+    remain.  Matches touching tensors or ops named in `protected_names`
+    (strategy edge chains / shard configs) are left alone so the
+    strategy still resolves."""
+    rules = [
+        FuseActivation(OperatorType.LINEAR),
+        FuseActivation(OperatorType.CONV2D),
+    ]
+    protected = set(protected_names)
+
+    def eligible(rule):
+        for m in rule.find_matches(graph):
+            prod, act = m.ops
+            if (
+                prod.name in protected
+                or act.name in protected
+                or any(t.name in protected for t in prod.outputs)
+                or any(t.name in protected for t in act.outputs)
+            ):
+                continue
+            yield m
+
+    # each applied fuse removes one op, so #ops bounds the fixpoint
+    for _ in range(len(graph.ops)):
+        applied = False
+        for rule in rules:
+            for m in eligible(rule):
+                g2 = rule.apply(graph, m)
+                if g2 is not None:
+                    graph = g2
+                    applied = True
+                    break
+            if applied:
+                break
+        if not applied:
+            break
+    return graph
+
+
 def cancel_all_inverse_parallel_ops(graph: Graph, max_iters: int = 32) -> Graph:
     """Fixed-point cancellation pass run on the applied (post-strategy)
     PCG before lowering, so redundant gather+rescatter boundaries never

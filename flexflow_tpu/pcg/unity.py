@@ -93,9 +93,22 @@ class UnitySearch:
         rewrite_max_variants: int = 8,
         event_rerank: bool = True,
         event_topk: int = 4,
+        sync_overlap_fraction: Optional[float] = None,
+        parameter_sync: str = "allreduce",
+        max_assignments: Optional[int] = None,
+        enable_sample_parallel: bool = False,
     ):
         self.event_rerank = event_rerank
         self.event_topk = event_topk
+        self.sync_overlap = (
+            sync_overlap_fraction if sync_overlap_fraction is not None
+            else overlap_fraction
+        )
+        self.parameter_sync = parameter_sync
+        # reference --simulator-segment-size: bounds per-region search
+        # work; never raises the built-in cap
+        self.max_assignments = max_assignments
+        self.enable_sample_parallel = enable_sample_parallel
         self.graph = graph
         self._base_graph = graph
         self.rewrite_rules = rewrite_rules  # None -> built-in catalog
@@ -122,7 +135,9 @@ class UnitySearch:
 
         self._sim = Simulator(machine, cost_model,
                               overlap_fraction=overlap_fraction,
-                              optimizer_slots=optimizer_slots)
+                              optimizer_slots=optimizer_slots,
+                              sync_overlap_fraction=sync_overlap_fraction,
+                              parameter_sync=parameter_sync)
 
     # ------------------------------------------------------------------
     # graph splitting (reference find_split_node substitution.cc:2094)
@@ -159,12 +174,19 @@ class UnitySearch:
             return m.allreduce_time(size, g)
         return m.allgather_time(size, g)
 
+    def _sync_time(self, size: int, rep: int) -> float:
+        """Gradient sync under the configured ParameterSyncType —
+        delegated to the shared Simulator formula so the per-op costing
+        and whole-graph grad_sync_cost can never diverge."""
+        return self._sim.sync_time(size, rep)
+
     def _op_cost(self, op: Op, training: bool = True) -> Tuple[float, int]:
         """(time, per-device bytes) for one instantiated op — the same
         terms Simulator.simulate charges per op."""
         cm = self.cost_model.cost(op)
         t = cm.forward_time + (cm.backward_time if training else 0.0)
         comm = 0.0
+        sync = 0.0
         if op.outputs:
             out_rep = op.outputs[0].shape.replica_degree
             in_rep = max((x.shape.replica_degree for x in op.inputs), default=1)
@@ -176,11 +198,13 @@ class UnitySearch:
         for w in op.weights:
             rep = w.shape.replica_degree
             if training and rep > 1 and w.create_gradients:
-                comm += self._comm_time("allreduce", w.shape.shard_bytes(), rep)
+                sync += self._sync_time(w.shape.shard_bytes(), rep)
             mem += w.shape.shard_bytes() * ((2 + self.optimizer_slots) if training else 1)
         for o in op.outputs:
             mem += o.shape.shard_bytes()
-        return t + comm * (1.0 - self.overlap), mem
+        time = (t + comm * (1.0 - self.overlap)
+                + sync * (1.0 - self.sync_overlap))
+        return time, mem
 
     def _realizable(self, shapes, mesh_axes: Dict[str, int]) -> bool:
         """Every shape's degrees must factor onto the mesh axes — the
@@ -258,6 +282,15 @@ class UnitySearch:
                 total *= len(opts)
         return total
 
+    def _cap(self) -> int:
+        """Per-region assignment cap; --simulator-segment-size can only
+        lower the built-in bound (its reference role: limit per-segment
+        simulation work)."""
+        cap = _MAX_SEGMENT_ASSIGNMENTS
+        if self.max_assignments is not None:
+            cap = min(cap, max(1, self.max_assignments))
+        return cap
+
     def _prune_states(self, results: List[_SegResult], lam: float) -> List[_SegResult]:
         """Best result per out-shape signature, then a scalarized-cost
         beam of _MAX_REGION_STATES (the analogue of the reference's
@@ -293,7 +326,7 @@ class UnitySearch:
             return cached
         n = self._n_assignments(seg, options)
         results: Optional[List[_SegResult]] = None
-        if n > _MAX_SEGMENT_ASSIGNMENTS and len(seg) >= 2:
+        if n > self._cap() and len(seg) >= 2:
             results = self._eval_horizontal(
                 seg, shape_env, out_guids, options, input_dp, axes_sig, lam
             )
@@ -444,7 +477,7 @@ class UnitySearch:
         total = 1
         for _, opts in cand:
             total *= len(opts)
-        if total > _MAX_SEGMENT_ASSIGNMENTS:
+        if total > self._cap():
             # irreducible over-cap region: group identical (type, params)
             # ops and force a uniform choice per group
             from ..logger import search_logger as slog
@@ -452,7 +485,7 @@ class UnitySearch:
             slog.debug(
                 "assignment cap hit on irreducible region (%d ops, %d "
                 "assignments > %d): grouping identical ops",
-                len(seg), total, _MAX_SEGMENT_ASSIGNMENTS,
+                len(seg), total, self._cap(),
             )
             groups: Dict[Tuple, List[int]] = {}
             for j, _ in cand:
@@ -684,6 +717,13 @@ class UnitySearch:
             )
             best_obj = min(best_obj, obj)
             collector.append((obj, strategy, self.graph))
+        for strategy, obj, label in self._sample_candidates(lam):
+            slog.debug(
+                "candidate %s: obj=%.3g%s", label, obj,
+                " *best*" if obj < best_obj else "",
+            )
+            best_obj = min(best_obj, obj)
+            collector.append((obj, strategy, self.graph))
 
     def _event_objective(
         self, strategy: Strategy, graph: Graph, lam: float
@@ -871,6 +911,51 @@ class UnitySearch:
             obj = self._objective(time, mem, lam)
             yield s, obj, f"dp={dp} sp={sp} (ring attention)"
 
+    def _sample_candidates(self, lam: float):
+        """Sample parallelism (reference --enable-sample-parallel,
+        config.h:134: partition along non-batch sample dims): shard
+        inputs' dim 1 (sequence rows / flattened spatial) over a
+        'sample' axis.  Attention graphs get this via the richer
+        ring-attention sp candidates instead."""
+        if not self.enable_sample_parallel:
+            return
+        if any(op.op_type == OperatorType.MULTIHEAD_ATTENTION
+               for op in self.graph.ops):
+            return
+        sources = [op for op in self.graph.ops
+                   if op.op_type == OperatorType.INPUT]
+        if not sources or any(
+            op.outputs[0].shape.logical_rank < 3 for op in sources
+        ):
+            return
+        for sp in range(2, self.n + 1):
+            if self.n % sp:
+                continue
+            dp = self.n // sp
+            if any(
+                op.outputs[0].shape.logical_shape[1] % sp
+                or op.outputs[0].shape.logical_shape[0] % max(1, dp)
+                for op in sources
+            ):
+                continue
+            mesh_axes = {"sample": sp}
+            if dp > 1:
+                mesh_axes = {"data": dp, "sample": sp}
+            s = Strategy(mesh_axes=dict(mesh_axes))
+            chain = []
+            if dp > 1:
+                chain.append(("repartition", {"dim": 0, "degree": dp}))
+            chain.append(("repartition", {"dim": 1, "degree": sp}))
+            s.edge_ops["__inputs__"] = chain
+            try:
+                g = apply_strategy(self.graph, s)
+                assign_views(g, s.mesh_axes)
+            except (ShapeError, ValueError):
+                continue
+            res = self._sim.simulate(g, mesh_axes, training=True)
+            obj = self._objective(res.total_time, res.per_device_memory, lam)
+            yield s, obj, f"dp={dp} sample={sp} (sample parallel)"
+
     def _pp_candidates(self, lam: float):
         """Pipeline-parallel candidates: dp x pp meshes over the graph's
         homogeneous block stack (parallel/pipeline_plan.py), ranked with
@@ -1032,6 +1117,17 @@ class UnitySearch:
         return sim.per_device_memory(g, training=True, op_scale=op_scale)
 
 
+def _sync_mode(pst) -> str:
+    """ParameterSyncType -> Simulator.parameter_sync string."""
+    from ..fftype import ParameterSyncType
+
+    if pst == ParameterSyncType.PS:
+        return "ps"
+    if pst == ParameterSyncType.NONE:
+        return "none"
+    return "allreduce"
+
+
 def unity_optimize(model, num_devices: int) -> Strategy:
     """Entry used by FFModel.compile (reference GRAPH_OPTIMIZE_TASK_ID ->
     Graph::graph_optimize_task graph.cc:2046)."""
@@ -1058,6 +1154,14 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         budget=max(0, cfg.search_budget),
         memory_budget=cfg.memory_per_device if cfg.memory_search else None,
         rewrite_rules=rewrite_rules,
+        # backward/update overlap: credit gradient sync as mostly hidden
+        # behind remaining backward compute (reference config.h:130)
+        sync_overlap_fraction=(
+            0.7 if cfg.search_overlap_backward_update else None
+        ),
+        parameter_sync=_sync_mode(cfg.parameter_sync),
+        max_assignments=cfg.simulator_segment_size,
+        enable_sample_parallel=cfg.enable_sample_parallel,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()

@@ -74,6 +74,19 @@ class MachineModel:
     def reducescatter_time(self, size: int, group: Sequence[int]) -> float:
         return self.allgather_time(size, group)
 
+    def ps_link(self) -> Tuple[float, float]:
+        """(bandwidth, latency) for parameter-server gradient sync —
+        the reference's flat 2*size/BW sync estimate
+        (simulator.cc:786-813) rides one link to the server."""
+        if hasattr(self, "ici_bw"):  # TpuPodModel
+            return self.ici_bw, self.ici_lat
+        if hasattr(self, "link_bw"):  # NetworkedMachineModel (network.py:223)
+            return self.link_bw, self.link_lat
+        return (  # SimpleMachineModel
+            getattr(self, "intra_bw", 100e9),
+            getattr(self, "intra_lat", 1e-6),
+        )
+
     def alltoall_time(self, size: int, group: Sequence[int]) -> float:
         n = len(group)
         if n <= 1:

@@ -239,11 +239,24 @@ class Simulator:
         cost_model: Optional[OpCostModel] = None,
         overlap_fraction: float = 0.3,
         optimizer_slots: int = 2,  # adam m+v
+        sync_overlap_fraction: Optional[float] = None,
+        parameter_sync: str = "allreduce",
     ):
         self.machine = machine
         self.cost_model = cost_model or OpCostModel(machine)
         self.overlap_fraction = overlap_fraction
         self.optimizer_slots = optimizer_slots
+        # gradient-sync overlap with remaining backward compute
+        # (reference --search-overlap-backward-update, config.h:130):
+        # None -> same credit as other comm
+        self.sync_overlap_fraction = (
+            sync_overlap_fraction if sync_overlap_fraction is not None
+            else overlap_fraction
+        )
+        # "allreduce" (ring, NCCL-equivalent) | "ps" (parameter server:
+        # flat 2*size/BW, reference default_estimate_sync_cost
+        # simulator.cc:786-813 + ParameterSyncType::PS optimizer.h:47)
+        self.parameter_sync = parameter_sync
 
     # -- comm costs ------------------------------------------------------
     def _collective_time(self, kind: str, size: int, group_len: int,
@@ -292,6 +305,20 @@ class Simulator:
             )
         if t == OperatorType.ALLTOALL:
             return self._collective_time("alltoall", shard_bytes, op.params.degree)
+        if t == OperatorType.FUSED_PARALLEL:
+            # one boundary, but each fused member still moves its bytes
+            # (reference estimate_xfer_cost on FusedParallelOp walks the
+            # member ops); shape propagates member to member
+            from ..parallel.parallel_op import PARALLEL_OP_KINDS
+            from ..tensor import ParallelTensor
+
+            total = 0.0
+            shape = inp
+            for kind, params in op.params.ops:
+                sub = PARALLEL_OP_KINDS[kind](params, [ParallelTensor(shape)])
+                total += self.xfer_cost(sub, mesh_axes)
+                shape = sub.outputs[0].shape
+            return max(total, _KERNEL_OVERHEAD)
         return _KERNEL_OVERHEAD
 
     def partial_sum_cost(self, op: Op, mesh_axes: Dict[str, int]) -> float:
@@ -308,17 +335,28 @@ class Simulator:
             )
         return 0.0
 
+    def sync_time(self, size: int, rep: int) -> float:
+        """One weight's gradient sync under the configured
+        ParameterSyncType: ring all-reduce, the parameter-server
+        estimate 2*size/BW (reference simulator.cc:786-813), or free
+        under NONE (reference config.h:55: no sync)."""
+        if self.parameter_sync == "none":
+            return 0.0
+        if self.parameter_sync == "ps":
+            bw, lat = self.machine.ps_link()
+            return 2.0 * lat + 2.0 * size / bw
+        return self._collective_time("allreduce", size, rep)
+
     def grad_sync_cost(self, graph: Graph, mesh_axes: Dict[str, int]) -> float:
-        """Gradient all-reduce over each weight's replica axes (SPMD's
-        psum in backward == reference optimizer ncclAllReduce)."""
+        """Gradient sync over each weight's replica axes (SPMD's psum in
+        backward == reference optimizer ncclAllReduce; PS path
+        optimizer.h:47-58)."""
         total = 0.0
         for op in graph.ops:
             for w in op.weights:
                 rep = w.shape.replica_degree
                 if rep > 1 and w.create_gradients:
-                    total += self._collective_time(
-                        "allreduce", w.shape.shard_bytes(), rep
-                    )
+                    total += self.sync_time(w.shape.shard_bytes(), rep)
         return total
 
     # -- memory ----------------------------------------------------------
@@ -367,8 +405,13 @@ class Simulator:
             comm += ps
             breakdown[op.name] = t + ps
         sync = self.grad_sync_cost(graph, mesh_axes) if training else 0.0
-        # XLA overlaps collectives with independent compute
-        effective_comm = (comm + sync) * (1.0 - self.overlap_fraction)
+        # XLA overlaps collectives with independent compute; gradient
+        # sync gets its own credit when backward/update overlap is
+        # modeled (--search-overlap-backward-update)
+        effective_comm = (
+            comm * (1.0 - self.overlap_fraction)
+            + sync * (1.0 - self.sync_overlap_fraction)
+        )
         total = compute + effective_comm
         return SimResult(
             total_time=total,

@@ -26,11 +26,24 @@ from .parallel.parallel_op import (
     PARALLEL_OP_KINDS,
     AllToAllParams,
     CombineParams,
+    FusedParallelParams,
     ReductionParams,
     RepartitionParams,
     ReplicateParams,
 )
 from .pcg.graph import Graph
+
+
+def _fused_params(**pdict) -> FusedParallelParams:
+    """JSON {"ops": [[kind, {...}], ...]} -> nested frozen params
+    (reference FusedParallelOp, fused_parallel_op.cc — one boundary,
+    one fused resharding chain)."""
+    ops = tuple(
+        (kind, _PARAM_CLASSES[kind](**dict(pp)))
+        for kind, pp in pdict["ops"]
+    )
+    return FusedParallelParams(ops=ops)
+
 
 _PARAM_CLASSES = {
     "repartition": RepartitionParams,
@@ -38,6 +51,7 @@ _PARAM_CLASSES = {
     "replicate": ReplicateParams,
     "reduction": ReductionParams,
     "all_to_all": AllToAllParams,
+    "fused": _fused_params,
 }
 
 
