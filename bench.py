@@ -152,9 +152,12 @@ def main():
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
+        # value is the BERT leg's samples/s (round-over-round
+        # comparable); vs_baseline is the geomean of BOTH legs' vs-A100
+        # ratios; per-leg numbers live under "legs"
         "metric": (
-            "samples/sec/chip: BERT-base seq128 b64 token-ids + "
-            "ResNet-50 224px b64 (bf16; vs_baseline = geomean vs A100)"
+            "samples/sec/chip, BERT-base seq128 b64 token-ids bf16 "
+            "(vs_baseline = geomean of bert_base+resnet50 legs vs A100)"
             if on_tpu else "CPU smoke: BERT tiny + ResNet tiny"
         ),
         "value": bert["samples_per_sec_per_chip"],

@@ -209,6 +209,16 @@ class GraphExecutor:
 
         pipeline_done = False
         for op in self.order:
+            if (
+                op.op_type == OperatorType.CACHE
+                and getattr(op, "_load_cached", False)
+            ):
+                # replay the host-cached batch (reference load_cached
+                # forward, cache.cc:214-231), fed as an extra input
+                env[op.outputs[0].guid] = to_compute(
+                    inputs[f"__cache__{op.name}"]
+                )
+                continue
             if op.guid in self._block_guids:
                 if not pipeline_done:
                     out = self._run_pipeline_region(
@@ -305,26 +315,38 @@ class GraphExecutor:
         opt = self.optimizer
         lrep = self.label_replication
 
+        cache_ops = [
+            op for op in self.order if op.op_type == OperatorType.CACHE
+        ]
+
         def step(weights, opt_state, state, inputs, labels, rng):
             if lrep > 1:
                 # AggregateSpec emits sample-major [s0k0, s0k1, s1k0, ...]
                 labels = jnp.repeat(labels, lrep, axis=0)
 
             def loss_fn(w):
-                logits, new_state, aux, _ = self.run_forward(
+                logits, new_state, aux, env = self.run_forward(
                     w, state, inputs, training=True, rng=rng
                 )
                 loss_val = loss_obj(logits, labels)
                 for a in aux:
                     loss_val = loss_val + a
-                return loss_val, (logits, new_state)
+                # cache taps: each Cache op's live input batch, handed
+                # to the host for ring/score accounting (reference
+                # cache_update task, cache.cc:180-231)
+                taps = {
+                    op.name: env[op.inputs[0].guid] for op in cache_ops
+                }
+                return loss_val, (logits, new_state, taps)
 
-            (loss_val, (logits, new_state)), grads = jax.value_and_grad(
+            (loss_val, (logits, new_state, taps)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(weights)
             new_w, new_opt_state = opt.update(weights, grads, opt_state)
             m = metrics.compute(logits, labels)
             m["loss"] = loss_val
+            if taps:
+                m["__cache_taps__"] = taps
             return new_w, new_opt_state, new_state, m
 
         with self.mesh:

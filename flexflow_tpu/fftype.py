@@ -177,6 +177,9 @@ class OpUnary(enum.Enum):
     ELU = "elu"
     IDENTITY = "identity"
     RSQRT = "rsqrt"
+    SQRT = "sqrt"
+    ERF = "erf"
+    FLOOR = "floor"
     POW = "pow"
     SCALAR_MULTIPLY = "scalar_multiply"
     SCALAR_ADD = "scalar_add"

@@ -78,5 +78,21 @@ class GlorotUniform(Initializer):
         return jax.random.uniform(key, shape, jnp.float32, -scale, scale).astype(dtype)
 
 
+class ArrayInitializer(Initializer):
+    """Initialize from a fixed host array — used by frontends importing
+    explicit weights (e.g. torch functional F.linear/F.conv2d)."""
+
+    def __init__(self, array):
+        self.array = np.asarray(array)
+
+    def __call__(self, key, shape, dtype):
+        if tuple(self.array.shape) != tuple(shape):
+            raise ValueError(
+                f"ArrayInitializer shape {self.array.shape} != weight "
+                f"shape {tuple(shape)}"
+            )
+        return jnp.asarray(self.array, dtype)
+
+
 DEFAULT_WEIGHT_INIT = GlorotUniform()
 DEFAULT_BIAS_INIT = ZeroInitializer()

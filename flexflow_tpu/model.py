@@ -139,6 +139,9 @@ class FFModel:
         self._used_names: set = set()
         self._fwd_fn = None
         self._stop_training = False  # set by EarlyStopping-style callbacks
+        self._cache_ops: List[Op] = []
+        self._compiled_cache: Dict[str, Op] = {}
+        self._pending_taps = None  # one-step-late cache taps
 
     # ------------------------------------------------------------------
     # tensor / naming helpers
@@ -369,6 +372,15 @@ class FFModel:
     def rsqrt(self, x, name=None):
         return self._unary(OpUnary.RSQRT, x, name=name)
 
+    def sqrt(self, x, name=None):
+        return self._unary(OpUnary.SQRT, x, name=name)
+
+    def erf(self, x, name=None):
+        return self._unary(OpUnary.ERF, x, name=name)
+
+    def floor(self, x, name=None):
+        return self._unary(OpUnary.FLOOR, x, name=name)
+
     def pow(self, x, exponent: float, name=None):
         return self._unary(OpUnary.POW, x, scalar=exponent, name=name)
 
@@ -420,6 +432,34 @@ class FFModel:
 
     def flat(self, input, name=None):
         return self._add(Flat(None, [input], name=self._name("flat", name)))
+
+    def weight_tensor(self, array, trainable: bool = True, name=None):
+        """A standalone parameter as a tensor (reference OP_WEIGHT /
+        torch AttributeNode): initialized from `array`, trainable by
+        default."""
+        from .initializer import ArrayInitializer
+        from .ops.op import WeightSpec
+        from .ops.sources import SourceParams, WeightOp
+
+        arr = np.asarray(array)
+        shape = ParallelTensorShape.make(
+            arr.shape, DataType.from_any(str(arr.dtype))
+        )
+        op = WeightOp(SourceParams(shape, "weight", trainable), [],
+                      name=self._name("weight", name))
+        op.weight_specs = [
+            WeightSpec("value", shape, ArrayInitializer(arr))
+        ]
+        out = self._add(op)
+        out.create_gradients = trainable
+        return out
+
+    def expand(self, input, sizes: Sequence[int], name=None):
+        """Broadcast size-1 dims (torch Tensor.expand)."""
+        from .ops.shape import Expand, ExpandParams
+
+        p = ExpandParams(tuple(int(s) for s in sizes))
+        return self._add(Expand(p, [input], name=self._name("expand", name)))
 
     def reshape(self, input, shape: Sequence[int], name=None):
         p = ReshapeParams(tuple(shape))
@@ -655,6 +695,16 @@ class FFModel:
             op for op in self.layers.topo_order()
             if op.op_type == OperatorType.CACHE
         ]
+        # compiled clones by name; trace-time flags synced from the
+        # frontend handles (state/ring stays on the frontend op)
+        self._compiled_cache = {
+            op.name: op for op in self.operators.topo_order()
+            if op.op_type == OperatorType.CACHE
+        }
+        for fop in self._cache_ops:
+            cop = self._compiled_cache.get(fop.name)
+            if cop is not None:
+                cop.use_cached(fop._load_cached)
         for op in self.operators.topo_order():
             op._flash_min_seq = cfg.flash_min_seq
             # keep the live graph in sync with iter_config across
@@ -699,8 +749,69 @@ class FFModel:
         put_inputs = {
             k: jax.device_put(v, in_sh[k]) for k, v in inputs.items()
         }
+        # load_cached Cache ops replay their host ring through an extra
+        # feed (reference load_cached forward, cache.cc:214-231)
+        for fop in self._cache_ops:
+            if fop._load_cached:
+                cop = self._compiled_cache.get(fop.name)
+                if cop is not None:
+                    put_inputs[f"__cache__{fop.name}"] = jax.device_put(
+                        fop.cached_value(),
+                        self.executor.tensor_sharding(cop.inputs[0]),
+                    )
         put_labels = jax.device_put(labels, self.executor.label_sharding())
         return put_inputs, put_labels
+
+    def _update_caches(self, m):
+        """Fold cache taps into each frontend Cache op's host ring +
+        staleness score (reference cache_update, cache.cc:180-231).
+        Taps are processed one step LATE: converting this step's tap to
+        numpy would block on the device; holding it until the next call
+        overlaps the transfer with the next step's compute.  Flush
+        points (use_cached, recompile_on_condition) force currency."""
+        taps = m.pop("__cache_taps__", None) if isinstance(m, dict) else None
+        pending, self._pending_taps = self._pending_taps, taps
+        self._apply_taps(pending)
+        return m
+
+    def _apply_taps(self, taps):
+        if not taps or not self._cache_ops:
+            return
+        by_name = {op.name: op for op in self._cache_ops}
+        for name, v in taps.items():
+            op = by_name.get(name)
+            if op is not None and not op._is_legacy_score():
+                op.update(np.asarray(v))
+
+    def _flush_cache_taps(self):
+        pending, self._pending_taps = self._pending_taps, None
+        self._apply_taps(pending)
+
+    def use_cached(self, load_cached: bool, name: Optional[str] = None):
+        """Toggle Cache ops between passthrough and cached-batch replay
+        (reference Cache::use_cached, cache.cc:259); rebuilds the jitted
+        step since the flag is a trace-time constant."""
+        self._flush_cache_taps()
+        hit = False
+        for fop in self._cache_ops:
+            if name is not None and fop.name != name:
+                continue
+            hit = True
+            fop.use_cached(load_cached)
+            cop = self._compiled_cache.get(fop.name)
+            if cop is not None:
+                cop.use_cached(load_cached)
+        if name is not None and not hit:
+            raise ValueError(f"no Cache op named {name!r}")
+        if self.executor is not None and hit:
+            self._step_fn = self.executor.build_step()
+            self._eval_fn = self.executor.build_eval_step()
+            self._fwd_fn = self.executor.build_forward()
+            self._step_cache = {
+                self.iter_config.seq_length: (
+                    self._step_fn, self._eval_fn, self._fwd_fn,
+                )
+            }
 
     def set_iteration_config(self, seq_length: Optional[int]):
         """FFIterationConfig.seq_length threading (reference
@@ -734,7 +845,7 @@ class FFModel:
             self._weights, self._opt_state, self._state, put_inputs, put_labels,
             step_rng,
         )
-        return m
+        return self._update_caches(dict(m))
 
     def eval_step(self, inputs: Dict[str, np.ndarray], labels: np.ndarray):
         put_inputs, put_labels = self._device_put_batch(inputs, labels)
@@ -781,8 +892,10 @@ class FFModel:
                 m = self.train_step(batch, labels)
                 pm.update({k: float(v) for k, v in m.items() if k != "loss"})
                 for op in self._cache_ops:
+                    # legacy model-level score fns poll here; 4-arg
+                    # reference-style scorers already ran in train_step
                     fn = getattr(op, "score_fn", None)
-                    if fn is not None:
+                    if fn is not None and op._is_legacy_score():
                         op.update_score(float(fn(self)))
             jax.block_until_ready(jax.tree.leaves(self._weights)[0])
             dt = time.perf_counter() - t0
@@ -816,6 +929,14 @@ class FFModel:
             k: jax.device_put(v, self.executor.input_shardings()[k])
             for k, v in inputs.items()
         }
+        for fop in self._cache_ops:
+            if fop._load_cached:
+                cop = self._compiled_cache.get(fop.name)
+                if cop is not None:
+                    put[f"__cache__{fop.name}"] = jax.device_put(
+                        fop.cached_value(),
+                        self.executor.tensor_sharding(cop.inputs[0]),
+                    )
         return self._fwd_fn(self._weights, self._state, put)
 
     def zero_gradients(self):
@@ -865,6 +986,7 @@ class FFModel:
         """Fire r.alter() when r.trigger() holds (model.cc:2422)."""
         from .recompile import recompile_on_condition
 
+        self._flush_cache_taps()  # triggers read current cache scores
         return recompile_on_condition(self, r)
 
     def set_learning_rate(self, lr: float):

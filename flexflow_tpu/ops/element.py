@@ -39,6 +39,9 @@ _UNARY_FNS = {
     OpUnary.ELU: jax.nn.elu,
     OpUnary.IDENTITY: lambda x: x,
     OpUnary.RSQRT: jax.lax.rsqrt,
+    OpUnary.SQRT: jnp.sqrt,
+    OpUnary.ERF: jax.lax.erf,
+    OpUnary.FLOOR: jnp.floor,
     OpUnary.NEGATIVE: jnp.negative,
 }
 

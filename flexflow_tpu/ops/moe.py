@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..fftype import DataType, OperatorType
 from ..tensor import ParallelDim, ParallelTensorShape
@@ -221,23 +222,102 @@ class CacheParams:
     seed: int = 0
 
 
+def default_cache_score(cached_score, input_arr, cached_arr, vol):
+    """Reference default_score (cache.cc:38-55): EMA (gamma 0.99) of
+    exact batch-vs-cached equality — 1-ish if batches repeat, decaying
+    to 0 as they drift."""
+    gamma = 0.99
+    cached_score = cached_score * gamma
+    if np.array_equal(input_arr, cached_arr):
+        cached_score += 1.0 - gamma
+    return cached_score
+
+
 class Cache(Op):
-    """Expert-activation cache (reference src/ops/cache.cc): passes input
-    through while maintaining a host-side staleness score used by
-    recompile_on_condition (flexflow_tpu/recompile.py).  The jitted path
-    is identity; score accounting happens outside jit in FFModel.fit."""
+    """Expert-activation cache (reference src/ops/cache.cc).
+
+    Keeps a host-side ring of the last `num_batches` input batches.
+    Every training batch, a score function
+    ``score_f(cached_score, input, cached, vol) -> new score`` (the
+    reference's signature; default = exact-match EMA, cache.cc:38-55;
+    the MoE example's set-compare scorer moe.cc:40-63 drops in) is
+    folded over the batch vs its cached slot, then the slot is
+    refreshed — producing the staleness score that feeds
+    recompile_on_condition (cache_update task, cache.cc:180-231).
+
+    Forward is identity; with ``use_cached(True)`` the op instead
+    replays the CACHED batch for the current slot (the reference's
+    load_cached forward, cache.cc:214-231), fed into the jitted step as
+    an extra input."""
 
     op_type = OperatorType.CACHE
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.score_history = []
+        self.cache_score = 0.0
+        self.batch_ctr = 0
+        self._ring = [None] * self.params.num_batches
+        self._load_cached = False
+        self.score_fn = None  # legacy model-level fn OR 4-arg score_f
 
     def infer_output_shapes(self, input_shapes):
         return [input_shapes[0]]
 
     def forward(self, inputs, weights, *, training=False, rng=None):
         return [inputs[0]]
+
+    # -- host-side cache accounting (reference cache_update task) ------
+    def _score_f(self):
+        import inspect
+
+        fn = self.score_fn
+        if fn is not None:
+            try:
+                if len(inspect.signature(fn).parameters) >= 4:
+                    return fn
+            except (TypeError, ValueError):
+                pass
+        return default_cache_score
+
+    def update(self, batch: np.ndarray):
+        """Fold one training batch into the cache: score vs the cached
+        copy of this slot, then refresh the slot."""
+        batch = np.asarray(batch)
+        slot = self.batch_ctr
+        cached = self._ring[slot]
+        if cached is not None and cached.shape == batch.shape:
+            self.cache_score = float(
+                self._score_f()(self.cache_score, batch, cached, batch.size)
+            )
+        self._ring[slot] = batch.copy()
+        self.batch_ctr = (self.batch_ctr + 1) % self.params.num_batches
+        self.update_score(self.cache_score)
+
+    def cached_value(self) -> np.ndarray:
+        """The cached batch the load_cached forward replays."""
+        v = self._ring[self.batch_ctr]
+        if v is None:
+            return np.zeros(self.outputs[0].shape.logical_shape,
+                            self.outputs[0].dtype.np_dtype)
+        return v
+
+    def use_cached(self, c: bool):
+        """Reference Cache::use_cached (cache.cc:259)."""
+        self._load_cached = bool(c)
+
+    def _is_legacy_score(self) -> bool:
+        """True for the round-1 model-level `score_fn(ff)` convention
+        (polled in fit); reference-style 4-arg scorers run in update()."""
+        import inspect
+
+        fn = self.score_fn
+        if fn is None:
+            return False
+        try:
+            return len(inspect.signature(fn).parameters) < 4
+        except (TypeError, ValueError):
+            return True
 
     def update_score(self, score: float):
         self.score_history.append(float(score))
@@ -246,6 +326,7 @@ class Cache(Op):
 
     @property
     def trigger(self) -> float:
+        """Latest staleness score (the reference's cache_score EMA)."""
         if not self.score_history:
             return 0.0
-        return sum(self.score_history) / len(self.score_history)
+        return self.score_history[-1]

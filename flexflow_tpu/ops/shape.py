@@ -115,6 +115,46 @@ class Reshape(Op):
         return [jnp.reshape(inputs[0], out_shape)]
 
 
+@dataclasses.dataclass(frozen=True)
+class ExpandParams:
+    sizes: Tuple[int, ...]
+
+
+class Expand(Op):
+    """Broadcast size-1 dims up to `sizes` (torch Tensor.expand; the
+    reference's ExpandNode, python/flexflow/torch/model.py:1736).
+    Backward is the summing transpose of broadcast via autodiff."""
+
+    op_type = OperatorType.RESHAPE  # same family: metadata-only HLO
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        ddims = _data_dims(ishape)
+        target = list(self.params.sizes)
+        if len(target) != len(ddims):
+            raise ShapeError(
+                f"{self.name}: expand rank {len(target)} != input rank "
+                f"{len(ddims)}"
+            )
+        dims = []
+        for d, s in zip(ddims, target):
+            s = d.size if s == -1 else s
+            if d.size != s and d.size != 1:
+                raise ShapeError(
+                    f"{self.name}: cannot expand dim of size {d.size} to {s}"
+                )
+            if d.size == 1 and s != 1 and d.degree != 1:
+                raise ShapeError(f"{self.name}: cannot expand partitioned dim")
+            dims.append(ParallelDim(s, d.degree))
+        dims = tuple(dims) + (
+            ParallelDim(1, ishape.replica_degree, is_replica_dim=True),
+        )
+        return [ParallelTensorShape(dims, ishape.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [jnp.broadcast_to(inputs[0], self.outputs[0].shape.logical_shape)]
+
+
 class Flat(Op):
     """Flatten all but the sample dim (reference src/ops/flat.cc)."""
 

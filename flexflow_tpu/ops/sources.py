@@ -16,6 +16,8 @@ from .op import Op
 class SourceParams:
     shape: ParallelTensorShape
     kind: str = "input"  # "input" | "weight" | "noop"
+    # weight sources only: False freezes the value (torch buffers)
+    trainable: bool = True
 
 
 class InputOp(Op):
@@ -26,6 +28,32 @@ class InputOp(Op):
 
     def forward(self, inputs, weights, *, training=False, rng=None):
         raise RuntimeError("source ops are fed by the executor, not executed")
+
+
+class WeightOp(Op):
+    """A standalone (trainable) parameter surfaced as a tensor — the
+    reference's OP_WEIGHT node / torch-frontend AttributeNode
+    (python/flexflow/torch/model.py:2294): a bare nn.Parameter consumed
+    by elementwise ops."""
+
+    op_type = OperatorType.WEIGHT
+
+    def infer_output_shapes(self, input_shapes):
+        return [self.params.shape]
+
+    def make_weight_specs(self, input_shapes):
+        from ..initializer import DEFAULT_WEIGHT_INIT
+        from .op import WeightSpec
+
+        return [WeightSpec("value", self.params.shape, DEFAULT_WEIGHT_INIT)]
+
+    def num_trainable_weights(self) -> int:
+        # frozen buffers (torch requires_grad=False) live in the state
+        # pytree: no gradients, no optimizer updates, no weight decay
+        return 1 if self.params.trainable else 0
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [weights[0]]
 
 
 class NoOp(Op):

@@ -1,21 +1,30 @@
-"""torch.fx -> FFModel importer.
+"""torch.fx -> FFModel importer (+ serialized-IR file exchange).
 
 Reference: python/flexflow/torch/model.py — `PyTorchModel` traces an
 nn.Module with a customed fx tracer and lowers every fx node through a
 per-op Node subclass's `to_ff` (LinearNode.to_ff at model.py:285, ~60
-node kinds).  TPU-native redesign: one dispatch table lowering fx nodes
-straight to FFModel layer-API calls; weights transfer via
-`copy_weights` after compile (torch Linear stores [out, in] — ours is
-[in, out], transposed on the way in).
+node kinds), with a string-IR file format for out-of-process exchange
+(torch_to_file/`PyTorchModel.apply`, model.py:2442+).
+
+TPU-native redesign: lowering dispatches on SERIALIZABLE descriptions —
+a module-config dict for call_module nodes and a canonical function
+name for call_function/call_method — so the live fx path and the
+file-replay path (`torch_to_file` -> `file_to_ff`, which needs no torch
+at all) share one implementation.  Weights transfer via `copy_weights`
+after compile (torch Linear stores [out, in] — ours is [in, out],
+transposed on the way in); functional F.linear/F.conv2d weights arrive
+as arrays and become ArrayInitializers (exact parity by construction).
 """
 from __future__ import annotations
 
+import json
 import operator
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..fftype import ActiMode, DataType
+from ..initializer import ArrayInitializer
 from ..model import FFModel
 from ..tensor import ParallelTensor
 
@@ -30,19 +39,518 @@ except ImportError:  # pragma: no cover - torch is baked into this image
     HAS_TORCH = False
 
 
-def _act_of(module) -> ActiMode:
-    import torch.nn as nn
+# ---------------------------------------------------------------------------
+# module -> serializable config
+# ---------------------------------------------------------------------------
 
-    if isinstance(module, nn.ReLU):
-        return ActiMode.RELU
-    if isinstance(module, nn.GELU):
-        return ActiMode.GELU
-    if isinstance(module, nn.Sigmoid):
-        return ActiMode.SIGMOID
-    if isinstance(module, nn.Tanh):
-        return ActiMode.TANH
-    raise ValueError(f"unsupported activation module {module}")
+def module_config(m) -> Dict:
+    """Extract a JSON-serializable lowering config from an nn.Module
+    (the file format's call_module payload)."""
+    if isinstance(m, nn.Linear):
+        return {"kind": "linear", "out": m.out_features,
+                "bias": m.bias is not None}
+    if isinstance(m, nn.Conv2d):
+        assert m.padding_mode == "zeros"
+        pad = m.padding if isinstance(m.padding, tuple) else (m.padding,) * 2
+        return {"kind": "conv2d", "out": m.out_channels,
+                "kernel": list(m.kernel_size), "stride": list(m.stride),
+                "padding": [pad[0], pad[1]], "groups": m.groups,
+                "bias": m.bias is not None}
+    if isinstance(m, (nn.MaxPool2d, nn.AvgPool2d)):
+        k = m.kernel_size if isinstance(m.kernel_size, tuple) else (m.kernel_size,) * 2
+        s = m.stride if isinstance(m.stride, tuple) else (m.stride or m.kernel_size,) * 2
+        p = m.padding if isinstance(m.padding, tuple) else (m.padding,) * 2
+        return {"kind": "pool2d", "k": list(k), "s": list(s), "p": list(p),
+                "type": "max" if isinstance(m, nn.MaxPool2d) else "avg"}
+    if isinstance(m, nn.AdaptiveAvgPool2d):
+        o = m.output_size if isinstance(m.output_size, tuple) else (
+            m.output_size, m.output_size)
+        return {"kind": "adaptive_avg_pool2d", "out": [o[0], o[1]]}
+    if isinstance(m, nn.BatchNorm2d):
+        return {"kind": "batch_norm"}
+    if isinstance(m, nn.LayerNorm):
+        return {"kind": "layer_norm", "ndims": len(m.normalized_shape),
+                "affine": m.elementwise_affine, "eps": m.eps}
+    if isinstance(m, nn.Embedding):
+        return {"kind": "embedding", "num": m.num_embeddings,
+                "dim": m.embedding_dim}
+    if isinstance(m, nn.ReLU):
+        return {"kind": "unary", "fn": "relu"}
+    if isinstance(m, nn.GELU):
+        return {"kind": "unary", "fn": "gelu"}
+    if isinstance(m, nn.Sigmoid):
+        return {"kind": "unary", "fn": "sigmoid"}
+    if isinstance(m, nn.Tanh):
+        return {"kind": "unary", "fn": "tanh"}
+    if isinstance(m, nn.ELU):
+        return {"kind": "unary", "fn": "elu"}
+    if isinstance(m, nn.Softmax):
+        return {"kind": "softmax", "dim": m.dim if m.dim is not None else -1}
+    if isinstance(m, nn.Dropout):
+        return {"kind": "dropout", "p": m.p}
+    if isinstance(m, nn.Flatten):
+        return {"kind": "flatten", "start": m.start_dim, "end": m.end_dim}
+    if isinstance(m, nn.Identity):
+        return {"kind": "identity"}
+    if isinstance(m, nn.MultiheadAttention):
+        assert m.batch_first, "set batch_first=True for MHA import"
+        return {"kind": "mha", "embed": m.embed_dim, "heads": m.num_heads,
+                "dropout": m.dropout, "bias": m.in_proj_bias is not None,
+                "add_bias_kv": m.bias_k is not None}
+    raise ValueError(f"unsupported torch module in trace: {m}")
 
+
+_UNARY_FNS = {"relu": "relu", "gelu": "gelu", "sigmoid": "sigmoid",
+              "tanh": "tanh", "elu": "elu", "exp": "exp", "log": "log",
+              "sin": "sin", "cos": "cos", "sqrt": "sqrt", "rsqrt": "rsqrt",
+              "erf": "erf", "floor": "floor"}
+
+#: module-config kinds that own trainable weights (copy_weights targets)
+_WEIGHTED_KINDS = {"linear", "conv2d", "batch_norm", "layer_norm",
+                   "embedding", "mha"}
+
+
+def lower_module(ff: FFModel, cfg: Dict, a: List, name: str):
+    """Lower one call_module node from its serializable config — shared
+    by the live fx path and file replay."""
+    kind = cfg["kind"]
+    if kind == "linear":
+        return ff.dense(a[0], cfg["out"], use_bias=cfg["bias"], name=name)
+    if kind == "conv2d":
+        return ff.conv2d(
+            a[0], cfg["out"], cfg["kernel"][0], cfg["kernel"][1],
+            cfg["stride"][0], cfg["stride"][1], cfg["padding"][0],
+            cfg["padding"][1], groups=cfg["groups"], use_bias=cfg["bias"],
+            name=name,
+        )
+    if kind == "pool2d":
+        k, s, p = cfg["k"], cfg["s"], cfg["p"]
+        return ff.pool2d(a[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                         pool_type=cfg["type"], name=name)
+    if kind == "adaptive_avg_pool2d":
+        h, w = a[0].shape.logical_shape[2:4]
+        kh, kw = h // cfg["out"][0], w // cfg["out"][1]
+        return ff.pool2d(a[0], kh, kw, kh, kw, 0, 0, pool_type="avg",
+                         name=name)
+    if kind == "batch_norm":
+        return ff.batch_norm(a[0], relu=False, name=name)
+    if kind == "layer_norm":
+        rank = a[0].shape.logical_rank
+        axes = tuple(range(rank - cfg["ndims"], rank))
+        return ff.layer_norm(a[0], axes, cfg["affine"], cfg["eps"], name=name)
+    if kind == "embedding":
+        return ff.embedding(a[0], cfg["num"], cfg["dim"], name=name)
+    if kind == "unary":
+        return getattr(ff, _UNARY_FNS[cfg["fn"]])(a[0], name=name)
+    if kind == "softmax":
+        return ff.softmax(a[0], axis=cfg["dim"], name=name)
+    if kind == "dropout":
+        return ff.dropout(a[0], cfg["p"], name=name)
+    if kind == "flatten":
+        assert cfg["start"] == 1 and cfg["end"] == -1, (
+            "only full flatten supported"
+        )
+        return ff.flat(a[0], name=name)
+    if kind == "identity":
+        return a[0]
+    if kind == "mha":
+        out = ff.multihead_attention(
+            a[0], a[1], a[2], cfg["embed"], cfg["heads"],
+            dropout=cfg["dropout"], bias=cfg["bias"],
+            add_bias_kv=cfg["add_bias_kv"], name=name,
+        )
+        # torch MHA returns (output, attn_weights): hand back a tuple so
+        # the traced 'out, _ = attn(...)' unpack resolves via getitem(0)
+        # instead of slicing the batch dim
+        return (out, None)
+    raise ValueError(f"unsupported module config kind: {kind}")
+
+
+# ---------------------------------------------------------------------------
+# function / method lowering by canonical name
+# ---------------------------------------------------------------------------
+
+def _fn_names() -> Dict:
+    """Canonical name for every supported call_function target."""
+    t: Dict = {
+        operator.add: "add", operator.sub: "sub", operator.mul: "mul",
+        operator.truediv: "div", operator.floordiv: "floordiv",
+        operator.neg: "neg", operator.pow: "pow",
+        operator.getitem: "getitem",
+    }
+    if HAS_TORCH:
+        t.update({
+            torch.add: "add", torch.sub: "sub", torch.mul: "mul",
+            torch.div: "div", torch.pow: "pow", torch.neg: "neg",
+            torch.relu: "relu", F.relu: "relu", F.gelu: "gelu",
+            torch.sigmoid: "sigmoid", F.sigmoid: "sigmoid",
+            torch.tanh: "tanh", F.tanh: "tanh", F.elu: "elu",
+            torch.exp: "exp", torch.log: "log", torch.sin: "sin",
+            torch.cos: "cos", torch.sqrt: "sqrt", torch.rsqrt: "rsqrt",
+            torch.erf: "erf", torch.floor: "floor",
+            torch.maximum: "maximum", torch.minimum: "minimum",
+            torch.max: "maximum", torch.min: "minimum",
+            F.softmax: "softmax", torch.flatten: "flatten",
+            torch.cat: "cat", torch.split: "split",
+            torch.chunk: "chunk",
+            torch.matmul: "matmul", torch.bmm: "matmul",
+            torch.reshape: "reshape", torch.transpose: "transpose",
+            torch.permute: "permute", torch.mean: "mean",
+            torch.sum: "sum", torch.unsqueeze: "unsqueeze",
+            torch.squeeze: "squeeze", F.dropout: "dropout",
+            F.linear: "f_linear", F.conv2d: "f_conv2d",
+            F.adaptive_avg_pool2d: "adaptive_avg_pool2d",
+            F.avg_pool2d: "avg_pool2d", F.max_pool2d: "max_pool2d",
+        })
+    return t
+
+
+_FN_NAMES = _fn_names()
+
+_METHOD_ALIASES = {
+    "view": "reshape", "reshape": "reshape", "permute": "permute",
+    "transpose": "transpose", "flatten": "flatten",
+    "contiguous": "identity_m", "mean": "mean", "sum": "sum",
+    "size": "size", "pow": "pow", "sqrt": "sqrt", "rsqrt": "rsqrt",
+    "expand": "expand", "expand_as": "expand_as",
+    "unsqueeze": "unsqueeze", "squeeze": "squeeze", "chunk": "chunk",
+    "split": "split", "to": "to", "float": "to_float",
+    "type_as": "type_as", "relu": "relu", "sigmoid": "sigmoid",
+    "tanh": "tanh", "matmul": "matmul", "bmm": "matmul",
+    "add": "add", "sub": "sub", "mul": "mul", "div": "div",
+    "masked_fill": None, "detach": "identity_m",
+}
+
+
+class TracedArray(np.ndarray):
+    """np view carrying torch-parameter provenance: whether the source
+    tensor had requires_grad (buffers import as frozen weights)."""
+
+    trainable: bool = True
+
+
+def _traced_array(arr, trainable: bool) -> "TracedArray":
+    t = np.asarray(arr).view(TracedArray)
+    t.trainable = bool(trainable)
+    return t
+
+
+def _is_tensor(x) -> bool:
+    return isinstance(x, ParallelTensor)
+
+
+def _axis_arg(a, kw, pos, key="dim", default=None):
+    if key in kw:
+        return kw[key]
+    return a[pos] if len(a) > pos else default
+
+
+def _getitem(ff: FFModel, x, idx, name: str):
+    """getitem on a tensor: int / slice / tuple-of-slices lowering via
+    Split (+ reshape for int indexing) — reference GetItemNode
+    (model.py:1393) covers the same shapes."""
+    if isinstance(x, (tuple, list)):
+        return x[idx]
+    if not _is_tensor(x):
+        raise ValueError(f"getitem on unsupported value {type(x)}")
+    items = idx if isinstance(idx, tuple) else (idx,)
+    out = x
+    squeeze_axes = []
+    for axis, it in enumerate(items):
+        if isinstance(it, slice):
+            if it == slice(None):
+                continue
+            start = it.start or 0
+            size = out.shape.logical_shape[axis]
+            stop = size if it.stop is None else min(it.stop, size)
+            if (it.step or 1) != 1:
+                raise ValueError("strided tensor slicing is unsupported")
+            out = _slice_axis(ff, out, axis, start, stop, name)
+        elif isinstance(it, int):
+            size = out.shape.logical_shape[axis]
+            it = it % size
+            out = _slice_axis(ff, out, axis, it, it + 1, name)
+            squeeze_axes.append(axis)
+        else:
+            raise ValueError(f"unsupported tensor index {it!r}")
+    if squeeze_axes:
+        shape = [
+            s for ax, s in enumerate(out.shape.logical_shape)
+            if ax not in squeeze_axes
+        ]
+        out = ff.reshape(out, shape, name=f"{name}_sq")
+    return out
+
+
+def _slice_axis(ff, x, axis, start, stop, name):
+    size = x.shape.logical_shape[axis]
+    sizes = [s for s in (start, stop - start, size - stop) if s > 0]
+    if sizes == [size]:
+        return x
+    parts = ff.split(x, sizes, axis, name=f"{name}_ax{axis}")
+    if not isinstance(parts, (tuple, list)):
+        parts = [parts]
+    return parts[1 if start > 0 else 0]
+
+
+def _unsqueeze(ff, x, dim, name):
+    shape = list(x.shape.logical_shape)
+    dim = dim % (len(shape) + 1)
+    shape.insert(dim, 1)
+    return ff.reshape(x, shape, name=name)
+
+
+def _squeeze(ff, x, dim, name):
+    shape = list(x.shape.logical_shape)
+    if dim is None:
+        shape = [s for s in shape if s != 1]
+    else:
+        dim = dim % len(shape)
+        if shape[dim] != 1:
+            return x
+        shape.pop(dim)
+    return ff.reshape(x, shape, name=name)
+
+
+def lower_function(ff: FFModel, fname: str, a: List, kw: Dict, name: str):
+    """Lower one call_function node by canonical name — shared by the
+    fx path and file replay (reference FunctionNode kinds,
+    model.py:858-2293)."""
+    if fname in ("add", "sub", "mul", "div"):
+        # a bare nn.Parameter / buffer operand (reference AttributeNode,
+        # model.py:2294) becomes a weight-backed tensor — frozen when the
+        # source was a non-grad buffer
+        a = [
+            ff.weight_tensor(v, trainable=getattr(v, "trainable", True),
+                             name=f"{name}_w{i}")
+            if isinstance(v, np.ndarray) and v.ndim > 0 else v
+            for i, v in enumerate(a)
+        ]
+        if _is_tensor(a[0]) and _is_tensor(a[1]):
+            fn = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply,
+                  "div": ff.divide}[fname]
+            return fn(a[0], a[1], name=name)
+        tensor, scalar = (a[0], a[1]) if _is_tensor(a[0]) else (a[1], a[0])
+        if fname == "sub" and not _is_tensor(a[0]):
+            # scalar - x = -(x - scalar)
+            t = ff.scalar_sub(tensor, float(scalar), name=f"{name}_s")
+            return ff.scalar_multiply(t, -1.0, name=name)
+        if fname == "div" and not _is_tensor(a[0]):
+            # scalar / x = scalar * x^-1
+            t = ff.pow(tensor, -1.0, name=f"{name}_r")
+            return ff.scalar_multiply(t, float(scalar), name=name)
+        fn = {"add": ff.scalar_add, "sub": ff.scalar_sub,
+              "mul": ff.scalar_multiply, "div": ff.scalar_true_divide}[fname]
+        return fn(tensor, float(scalar), name=name)
+    if fname == "floordiv":
+        t = ff.scalar_true_divide(a[0], float(a[1]), name=f"{name}_d")
+        return ff.floor(t, name=name)
+    if fname == "neg":
+        return ff.scalar_multiply(a[0], -1.0, name=name)
+    if fname == "pow":
+        return ff.pow(a[0], float(a[1]), name=name)
+    if fname in _UNARY_FNS:
+        return getattr(ff, _UNARY_FNS[fname])(a[0], name=name)
+    if fname in ("maximum", "minimum"):
+        if len(a) == 1 or not _is_tensor(a[1] if len(a) > 1 else None):
+            raise ValueError(f"{fname} reduction form is unsupported")
+        return (ff.max if fname == "maximum" else ff.min)(
+            a[0], a[1], name=name
+        )
+    if fname == "softmax":
+        return ff.softmax(a[0], axis=_axis_arg(a, kw, 1, default=-1),
+                          name=name)
+    if fname == "flatten":
+        start = kw.get("start_dim", a[1] if len(a) > 1 else 0)
+        if start == 1:
+            return ff.flat(a[0], name=name)
+        shape = a[0].shape.logical_shape
+        total = int(np.prod(shape[start:]))
+        return ff.reshape(a[0], list(shape[:start]) + [total], name=name)
+    if fname == "cat":
+        axis = _axis_arg(a, kw, 1, default=0)
+        return ff.concat(list(a[0]), axis, name=name)
+    if fname == "split":
+        axis = _axis_arg(a, kw, 2, default=0)
+        spec = a[1]
+        if isinstance(spec, int):  # torch semantics: CHUNK SIZE
+            size = a[0].shape.logical_shape[axis]
+            sizes = [spec] * (size // spec)
+            if size % spec:
+                sizes.append(size % spec)
+        else:
+            sizes = list(spec)
+        return ff.split(a[0], sizes, axis, name=name)
+    if fname == "chunk":
+        axis = _axis_arg(a, kw, 2, default=0)
+        n = int(a[1])
+        size = a[0].shape.logical_shape[axis]
+        base = size // n
+        sizes = [base + (1 if i < size % n else 0) for i in range(n)]
+        return ff.split(a[0], sizes, axis, name=name)
+    if fname == "matmul":
+        if _is_tensor(a[1]):
+            return ff.batch_matmul(a[0], a[1], name=name)
+        w = np.asarray(a[1])  # constant weight: x @ W == dense
+        return _dense_from_array(ff, a[0], w, None, name, transpose=False)
+    if fname == "reshape":
+        return _reshape(ff, a[0], a[1], name)
+    if fname == "transpose":
+        return _transpose2(ff, a[0], a[1], a[2], name)
+    if fname == "permute":
+        return ff.transpose(a[0], list(a[1]), name=name)
+    if fname in ("mean", "sum"):
+        axes = _axis_arg(a, kw, 1)
+        if axes is None:
+            axes = list(range(a[0].shape.logical_rank))
+        if isinstance(axes, int):
+            axes = [axes]
+        fn = ff.mean if fname == "mean" else ff.reduce_sum
+        return fn(a[0], list(axes), keepdims=kw.get("keepdim", False),
+                  name=name)
+    if fname == "unsqueeze":
+        return _unsqueeze(ff, a[0], _axis_arg(a, kw, 1, default=0), name)
+    if fname == "squeeze":
+        return _squeeze(ff, a[0], _axis_arg(a, kw, 1), name)
+    if fname == "dropout":
+        return ff.dropout(a[0], kw.get("p", a[1] if len(a) > 1 else 0.5),
+                          name=name)
+    if fname == "getitem":
+        return _getitem(ff, a[0], a[1], name)
+    if fname == "f_linear":
+        w = np.asarray(a[1])
+        b = np.asarray(a[2]) if len(a) > 2 and a[2] is not None else kw.get("bias")
+        b = np.asarray(b) if b is not None else None
+        return _dense_from_array(ff, a[0], w, b, name, transpose=True)
+    if fname == "f_conv2d":
+        w = np.asarray(a[1])
+        b = a[2] if len(a) > 2 else kw.get("bias")
+        b = np.asarray(b) if b is not None else None
+        stride = kw.get("stride", a[3] if len(a) > 3 else 1)
+        padding = kw.get("padding", a[4] if len(a) > 4 else 0)
+        groups = kw.get("groups", a[6] if len(a) > 6 else 1)
+        stride = stride if isinstance(stride, (tuple, list)) else (stride,) * 2
+        padding = padding if isinstance(padding, (tuple, list)) else (padding,) * 2
+        out = ff.conv2d(
+            a[0], w.shape[0], w.shape[2], w.shape[3], stride[0], stride[1],
+            padding[0], padding[1], groups=int(groups),
+            use_bias=b is not None, name=name,
+        )
+        _pin_weights(out.owner_op, kernel=w, bias=b)
+        return out
+    if fname == "adaptive_avg_pool2d":
+        o = a[1] if isinstance(a[1], (tuple, list)) else (a[1], a[1])
+        h, w = a[0].shape.logical_shape[2:4]
+        return ff.pool2d(a[0], h // o[0], w // o[1], h // o[0], w // o[1],
+                         0, 0, pool_type="avg", name=name)
+    if fname in ("avg_pool2d", "max_pool2d"):
+        k = a[1] if isinstance(a[1], (tuple, list)) else (a[1],) * 2
+        s = kw.get("stride", a[2] if len(a) > 2 else None) or k
+        s = s if isinstance(s, (tuple, list)) else (s,) * 2
+        p = kw.get("padding", a[3] if len(a) > 3 else 0)
+        p = p if isinstance(p, (tuple, list)) else (p,) * 2
+        return ff.pool2d(a[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                         pool_type="avg" if fname == "avg_pool2d" else "max",
+                         name=name)
+    if fname == "to":
+        return _cast_like(ff, a, kw, name)
+    raise ValueError(f"unsupported torch function in trace: {fname}")
+
+
+def lower_method(ff: FFModel, mname: str, a: List, kw: Dict, name: str):
+    """Lower one call_method node (reference tensor-method nodes)."""
+    canon = _METHOD_ALIASES.get(mname)
+    if canon is None:
+        raise ValueError(f"unsupported tensor method in trace: {mname}")
+    x = a[0]
+    if canon == "identity_m":
+        return x
+    if canon == "size":
+        return (x.shape.logical_shape[a[1]] if len(a) > 1
+                else x.shape.logical_shape)
+    if canon == "reshape":
+        shape = a[1] if isinstance(a[1], (tuple, list)) else a[1:]
+        return _reshape(ff, x, shape, name)
+    if canon == "permute":
+        perm = a[1] if isinstance(a[1], (tuple, list)) else a[1:]
+        return ff.transpose(x, list(perm), name=name)
+    if canon == "transpose":
+        return _transpose2(ff, x, a[1], a[2], name)
+    if canon == "flatten":
+        start = a[1] if len(a) > 1 else 0
+        return lower_function(ff, "flatten", [x, start], {}, name)
+    if canon == "expand":
+        sizes = a[1] if isinstance(a[1], (tuple, list)) else a[1:]
+        return ff.expand(x, [int(s) for s in sizes], name=name)
+    if canon == "expand_as":
+        return ff.expand(x, a[1].shape.logical_shape, name=name)
+    if canon == "to":
+        return _cast_like(ff, a, kw, name)
+    if canon == "to_float":
+        return ff.cast(x, DataType.FLOAT, name=name)
+    if canon == "type_as":
+        return ff.cast(x, a[1].shape.dtype, name=name)
+    if canon in ("mean", "sum", "unsqueeze", "squeeze", "chunk", "split",
+                 "matmul", "pow", "sqrt", "rsqrt", "relu", "sigmoid",
+                 "tanh", "add", "sub", "mul", "div"):
+        return lower_function(ff, canon, a, kw, name)
+    raise ValueError(f"unsupported tensor method in trace: {mname}")
+
+
+def _reshape(ff, x, shape, name):
+    shape = [int(s) for s in shape]
+    if any(s == -1 for s in shape):
+        total = int(np.prod(x.shape.logical_shape))
+        known = -int(np.prod([s for s in shape if s != -1]))
+        shape = [total // known if s == -1 else s for s in shape]
+    return ff.reshape(x, shape, name=name)
+
+
+def _transpose2(ff, x, d0, d1, name):
+    perm = list(range(x.shape.logical_rank))
+    perm[d0], perm[d1] = perm[d1], perm[d0]
+    return ff.transpose(x, perm, name=name)
+
+
+def _cast_like(ff, a, kw, name):
+    target = kw.get("dtype", a[1] if len(a) > 1 else None)
+    if target is None:
+        return a[0]
+    if HAS_TORCH and isinstance(target, torch.dtype):
+        target = {
+            torch.float32: DataType.FLOAT, torch.float16: DataType.HALF,
+            torch.bfloat16: DataType.BF16, torch.int32: DataType.INT32,
+            torch.int64: DataType.INT64, torch.float64: DataType.DOUBLE,
+        }.get(target)
+        if target is None:
+            raise ValueError("unsupported torch dtype in .to()")
+    if isinstance(target, str):
+        target = DataType.from_any(target)
+    return ff.cast(a[0], target, name=name)
+
+
+def _dense_from_array(ff, x, w, b, name, transpose: bool):
+    """F.linear / matmul-with-constant: dense with pinned weights.
+    torch F.linear weight is [out, in]; plain matmul constant is
+    [in, out]."""
+    kernel = w.T.copy() if transpose else np.asarray(w)
+    out = ff.dense(x, kernel.shape[1], use_bias=b is not None, name=name)
+    _pin_weights(out.owner_op, kernel=kernel, bias=b)
+    return out
+
+
+def _pin_weights(op, kernel=None, bias=None):
+    by_name = {"kernel": kernel, "bias": bias}
+    op.weight_specs = [
+        s.__class__(s.name, s.shape, ArrayInitializer(by_name[s.name]))
+        if by_name.get(s.name) is not None else s
+        for s in op.weight_specs
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the importer
+# ---------------------------------------------------------------------------
 
 class PyTorchModel:
     """Wraps an nn.Module for lowering into an FFModel.
@@ -52,6 +560,10 @@ class PyTorchModel:
         out = pt.torch_to_ff(ffmodel, [input_tensor, ...])
         ffmodel.compile(...)
         pt.copy_weights(ffmodel)   # optional: exact torch parity
+    or the file route (reference model.py:2442+):
+        pt.torch_to_file("model.ir")
+        ...elsewhere, no torch needed:
+        outs = file_to_ff("model.ir", ffmodel, [input_tensor])
     """
 
     def __init__(self, module, seq_length: Optional[int] = None):
@@ -71,19 +583,43 @@ class PyTorchModel:
         outputs: List[ParallelTensor] = []
         modules = dict(self.traced.named_modules())
 
+        def resolve(x):
+            return env[x.name] if isinstance(x, torch.fx.Node) else x
+
         for node in self.traced.graph.nodes:
             if node.op == "placeholder":
                 env[node.name] = next(input_iter)
             elif node.op == "get_attr":
-                env[node.name] = _fetch_attr(self.module, node.target)
+                v = _fetch_attr(self.module, node.target)
+                if isinstance(v, torch.Tensor):
+                    v = _traced_array(v.detach().numpy(), v.requires_grad)
+                env[node.name] = v
             elif node.op == "call_module":
-                env[node.name] = self._lower_module(
-                    ff, node, modules[node.target], env
-                )
+                if node.kwargs:
+                    raise ValueError(
+                        f"unsupported module kwargs {list(node.kwargs)} on "
+                        f"{node.target} (e.g. MHA masks are not lowered)"
+                    )
+                m = modules[node.target]
+                cfg = module_config(m)
+                a = torch.fx.node.map_arg(list(node.args), resolve)
+                env[node.name] = lower_module(ff, cfg, a, node.name)
+                if cfg["kind"] in _WEIGHTED_KINDS:
+                    self._op_of_node[node.name] = node.name
             elif node.op == "call_function":
-                env[node.name] = self._lower_function(ff, node, env)
+                fname = _FN_NAMES.get(node.target)
+                if fname is None:
+                    raise ValueError(
+                        f"unsupported torch function in trace: {node.target}"
+                    )
+                a = torch.fx.node.map_arg(list(node.args), resolve)
+                kw = torch.fx.node.map_arg(dict(node.kwargs), resolve)
+                env[node.name] = lower_function(ff, fname, a, kw, node.name)
             elif node.op == "call_method":
-                env[node.name] = self._lower_method(ff, node, env)
+                a = torch.fx.node.map_arg(list(node.args), resolve)
+                kw = torch.fx.node.map_arg(dict(node.kwargs), resolve)
+                env[node.name] = lower_method(ff, node.target, a, kw,
+                                              node.name)
             elif node.op == "output":
                 args = node.args[0]
                 if isinstance(args, (tuple, list)):
@@ -93,212 +629,74 @@ class PyTorchModel:
         return outputs
 
     # ------------------------------------------------------------------
-    # call_module lowerings (reference model.py:248-1200 module nodes)
+    # serialized-IR exchange (reference PyTorchModel file format,
+    # model.py:2442+: string IR out, replay in — here JSON lines + an
+    # optional npz sidecar for get_attr constants)
     # ------------------------------------------------------------------
-    def _lower_module(self, ff: FFModel, node, m, env):
-        a = [env[x.name] if isinstance(x, torch.fx.Node) else x
-             for x in node.args]
-        name = node.name
-        if isinstance(m, nn.Linear):
-            out = ff.dense(a[0], m.out_features, use_bias=m.bias is not None,
-                           name=name)
-            self._op_of_node[node.name] = name
-            return out
-        if isinstance(m, nn.Conv2d):
-            assert m.padding_mode == "zeros"
-            pad = m.padding if isinstance(m.padding, tuple) else (m.padding, m.padding)
-            out = ff.conv2d(
-                a[0], m.out_channels, m.kernel_size[0], m.kernel_size[1],
-                m.stride[0], m.stride[1], pad[0], pad[1],
-                groups=m.groups, use_bias=m.bias is not None, name=name,
-            )
-            self._op_of_node[node.name] = name
-            return out
-        if isinstance(m, nn.MaxPool2d):
-            k = m.kernel_size if isinstance(m.kernel_size, tuple) else (m.kernel_size,) * 2
-            s = m.stride if isinstance(m.stride, tuple) else (m.stride or m.kernel_size,) * 2
-            p = m.padding if isinstance(m.padding, tuple) else (m.padding,) * 2
-            return ff.pool2d(a[0], k[0], k[1], s[0], s[1], p[0], p[1],
-                             pool_type="max", name=name)
-        if isinstance(m, nn.AvgPool2d):
-            k = m.kernel_size if isinstance(m.kernel_size, tuple) else (m.kernel_size,) * 2
-            s = m.stride if isinstance(m.stride, tuple) else (m.stride or m.kernel_size,) * 2
-            p = m.padding if isinstance(m.padding, tuple) else (m.padding,) * 2
-            return ff.pool2d(a[0], k[0], k[1], s[0], s[1], p[0], p[1],
-                             pool_type="avg", name=name)
-        if isinstance(m, nn.AdaptiveAvgPool2d):
-            osize = m.output_size if isinstance(m.output_size, tuple) else (
-                m.output_size, m.output_size)
-            h, w = a[0].shape.logical_shape[2:4]
-            kh, kw = h // osize[0], w // osize[1]
-            return ff.pool2d(a[0], kh, kw, kh, kw, 0, 0, pool_type="avg",
-                             name=name)
-        if isinstance(m, nn.BatchNorm2d):
-            out = ff.batch_norm(a[0], relu=False, name=name)
-            self._op_of_node[node.name] = name
-            return out
-        if isinstance(m, nn.LayerNorm):
-            rank = a[0].shape.logical_rank
-            ndims = len(m.normalized_shape)
-            axes = tuple(range(rank - ndims, rank))
-            out = ff.layer_norm(a[0], axes, m.elementwise_affine, m.eps,
-                                name=name)
-            self._op_of_node[node.name] = name
-            return out
-        if isinstance(m, nn.Embedding):
-            out = ff.embedding(a[0], m.num_embeddings, m.embedding_dim,
-                               name=name)
-            self._op_of_node[node.name] = name
-            return out
-        if isinstance(m, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh)):
-            act = _act_of(m)
-            fn = {ActiMode.RELU: ff.relu, ActiMode.GELU: ff.gelu,
-                  ActiMode.SIGMOID: ff.sigmoid, ActiMode.TANH: ff.tanh}[act]
-            return fn(a[0], name=name)
-        if isinstance(m, nn.Softmax):
-            return ff.softmax(a[0], axis=m.dim if m.dim is not None else -1,
-                              name=name)
-        if isinstance(m, nn.Dropout):
-            return ff.dropout(a[0], m.p, name=name)
-        if isinstance(m, nn.Flatten):
-            assert m.start_dim == 1 and m.end_dim == -1, (
-                "only full flatten supported"
-            )
-            return ff.flat(a[0], name=name)
-        if isinstance(m, nn.Identity):
-            return a[0]
-        if isinstance(m, nn.MultiheadAttention):
-            assert m.batch_first, "set batch_first=True for MHA import"
-            out = ff.multihead_attention(
-                a[0], a[1], a[2], m.embed_dim, m.num_heads,
-                dropout=m.dropout, bias=m.in_proj_bias is not None,
-                add_bias_kv=m.bias_k is not None, name=name,
-            )
-            self._op_of_node[node.name] = name
-            return out
-        raise ValueError(f"unsupported torch module in trace: {m}")
+    def torch_to_file(self, path: str):
+        modules = dict(self.traced.named_modules())
+        consts: Dict[str, np.ndarray] = {}
+        lines: List[str] = []
 
-    # ------------------------------------------------------------------
-    # call_function lowerings (reference model.py FunctionNode kinds)
-    # ------------------------------------------------------------------
-    def _lower_function(self, ff: FFModel, node, env):
-        # map_arg resolves Nodes nested inside lists/tuples (torch.cat)
-        a = torch.fx.node.map_arg(list(node.args), lambda n: env[n.name])
-        kw = torch.fx.node.map_arg(dict(node.kwargs), lambda n: env[n.name])
-        t = node.target
-        name = node.name
+        def enc(x):
+            if isinstance(x, torch.fx.Node):
+                return {"__ref__": x.name}
+            if isinstance(x, slice):
+                return {"__slice__": [x.start, x.stop, x.step]}
+            if isinstance(x, (list, tuple)):
+                return {"__list__": [enc(v) for v in x]}
+            if HAS_TORCH and isinstance(x, torch.dtype):
+                return {"__dtype__": str(x).replace("torch.", "")}
+            if x is None or isinstance(x, (bool, int, float, str)):
+                return x
+            raise ValueError(f"unserializable arg {x!r} in fx trace")
 
-        def is_tensor(x):
-            return isinstance(x, ParallelTensor)
-
-        if t in (operator.add, torch.add):
-            if is_tensor(a[0]) and is_tensor(a[1]):
-                return ff.add(a[0], a[1], name=name)
-            tensor, scalar = (a[0], a[1]) if is_tensor(a[0]) else (a[1], a[0])
-            return ff.scalar_add(tensor, float(scalar), name=name)
-        if t in (operator.sub, torch.sub):
-            if is_tensor(a[0]) and is_tensor(a[1]):
-                return ff.subtract(a[0], a[1], name=name)
-            return ff.scalar_sub(a[0], float(a[1]), name=name)
-        if t in (operator.mul, torch.mul):
-            if is_tensor(a[0]) and is_tensor(a[1]):
-                return ff.multiply(a[0], a[1], name=name)
-            tensor, scalar = (a[0], a[1]) if is_tensor(a[0]) else (a[1], a[0])
-            return ff.scalar_multiply(tensor, float(scalar), name=name)
-        if t in (operator.truediv, torch.div):
-            if is_tensor(a[0]) and is_tensor(a[1]):
-                return ff.divide(a[0], a[1], name=name)
-            return ff.scalar_true_divide(a[0], float(a[1]), name=name)
-        if t in (torch.relu, F.relu):
-            return ff.relu(a[0], name=name)
-        if t is F.gelu:
-            return ff.gelu(a[0], name=name)
-        if t in (torch.sigmoid, F.sigmoid):
-            return ff.sigmoid(a[0], name=name)
-        if t in (torch.tanh, F.tanh):
-            return ff.tanh(a[0], name=name)
-        if t is F.softmax:
-            return ff.softmax(a[0], axis=kw.get("dim", a[1] if len(a) > 1 else -1),
-                              name=name)
-        if t is torch.flatten:
-            return ff.flat(a[0], name=name)
-        if t is torch.cat:
-            tensors = a[0]
-            axis = kw.get("dim", a[1] if len(a) > 1 else 0)
-            return ff.concat(list(tensors), axis, name=name)
-        if t is torch.split:
-            axis = kw.get("dim", a[2] if len(a) > 2 else 0)
-            return ff.split(a[0], a[1], axis, name=name)
-        if t in (torch.matmul, torch.bmm):
-            return ff.batch_matmul(a[0], a[1], name=name)
-        if t is torch.reshape:
-            return ff.reshape(a[0], a[1], name=name)
-        if t is torch.transpose:
-            return self._transpose(ff, a[0], a[1], a[2], name)
-        if t is torch.permute:
-            return ff.transpose(a[0], a[1], name=name)
-        if t is torch.mean:
-            axes = kw.get("dim", a[1] if len(a) > 1 else None)
-            if axes is None:
-                axes = list(range(a[0].shape.logical_rank))
-            if isinstance(axes, int):
-                axes = [axes]
-            return ff.mean(a[0], axes, keepdims=kw.get("keepdim", False),
-                           name=name)
-        if t is F.dropout:
-            return ff.dropout(a[0], kw.get("p", a[1] if len(a) > 1 else 0.5),
-                              name=name)
-        if t is getattr(operator, "getitem"):
-            return a[0][a[1]]
-        raise ValueError(f"unsupported torch function in trace: {t}")
-
-    def _transpose(self, ff, x, d0, d1, name):
-        perm = list(range(x.shape.logical_rank))
-        perm[d0], perm[d1] = perm[d1], perm[d0]
-        return ff.transpose(x, perm, name=name)
-
-    # ------------------------------------------------------------------
-    # call_method lowerings
-    # ------------------------------------------------------------------
-    def _lower_method(self, ff: FFModel, node, env):
-        a = [env[x.name] if isinstance(x, torch.fx.Node) else x
-             for x in node.args]
-        m = node.target
-        name = node.name
-        self_t = a[0]
-        if m in ("view", "reshape"):
-            shape = a[1] if isinstance(a[1], (tuple, list)) else a[1:]
-            shape = [int(s) for s in shape]
-            if any(s == -1 for s in shape):
-                total = self_t.shape.num_elements() if hasattr(
-                    self_t.shape, "num_elements") else int(
-                        np.prod(self_t.shape.logical_shape))
-                known = -int(np.prod([s for s in shape if s != -1]))
-                shape = [total // known if s == -1 else s for s in shape]
-            return ff.reshape(self_t, shape, name=name)
-        if m == "permute":
-            perm = a[1] if isinstance(a[1], (tuple, list)) else a[1:]
-            return ff.transpose(self_t, list(perm), name=name)
-        if m == "transpose":
-            return self._transpose(ff, self_t, a[1], a[2], name)
-        if m == "flatten":
-            start = a[1] if len(a) > 1 else 0  # Tensor.flatten defaults to 0
-            if start == 1:
-                return ff.flat(self_t, name=name)
-            shape = self_t.shape.logical_shape
-            total = int(np.prod(shape[start:]))
-            return ff.reshape(self_t, list(shape[:start]) + [total], name=name)
-        if m == "contiguous":
-            return self_t
-        if m == "mean":
-            axes = a[1] if len(a) > 1 else list(range(self_t.shape.logical_rank))
-            if isinstance(axes, int):
-                axes = [axes]
-            return ff.mean(self_t, axes, name=name)
-        if m == "size":
-            return self_t.shape.logical_shape[a[1]] if len(a) > 1 else (
-                self_t.shape.logical_shape)
-        raise ValueError(f"unsupported tensor method in trace: {m}")
+        for node in self.traced.graph.nodes:
+            if node.op == "placeholder":
+                lines.append(json.dumps({"op": "input", "name": node.name}))
+            elif node.op == "get_attr":
+                v = _fetch_attr(self.module, node.target)
+                if isinstance(v, torch.Tensor):
+                    consts[node.name] = v.detach().numpy()
+                    lines.append(json.dumps(
+                        {"op": "const", "name": node.name,
+                         "trainable": bool(v.requires_grad)}))
+                else:
+                    lines.append(json.dumps(
+                        {"op": "literal", "name": node.name, "value": v}))
+            elif node.op in ("call_module", "call_function", "call_method"):
+                if node.op == "call_module" and node.kwargs:
+                    raise ValueError(
+                        f"unsupported module kwargs {list(node.kwargs)} on "
+                        f"{node.target}"
+                    )
+                rec = {
+                    "op": node.op,
+                    "name": node.name,
+                    "args": [enc(x) for x in node.args],
+                    "kwargs": {k: enc(v) for k, v in node.kwargs.items()},
+                }
+                if node.op == "call_module":
+                    rec["config"] = module_config(modules[node.target])
+                elif node.op == "call_function":
+                    fname = _FN_NAMES.get(node.target)
+                    if fname is None:
+                        raise ValueError(
+                            f"unsupported function {node.target} in trace"
+                        )
+                    rec["target"] = fname
+                else:
+                    rec["target"] = node.target
+                lines.append(json.dumps(rec))
+            elif node.op == "output":
+                args = node.args[0]
+                refs = ([a.name for a in args]
+                        if isinstance(args, (tuple, list)) else [args.name])
+                lines.append(json.dumps({"op": "output", "refs": refs}))
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        if consts:
+            np.savez(path + ".npz", **consts)
 
     # ------------------------------------------------------------------
     # weight transfer (reference: file-format apply; here direct)
@@ -333,6 +731,68 @@ class PyTorchModel:
                 entry["gamma"] = m.weight.detach().numpy().copy()
                 entry["beta"] = m.bias.detach().numpy().copy()
         ff.set_weights(weights)
+
+
+# ---------------------------------------------------------------------------
+# file replay (torch-free)
+# ---------------------------------------------------------------------------
+
+def file_to_ff(path: str, ff: FFModel,
+               inputs: Sequence[ParallelTensor]) -> List[ParallelTensor]:
+    """Replay a serialized fx IR (torch_to_file) into an FFModel — no
+    torch required (the reference's `PyTorchModel.apply` file route)."""
+    import os
+
+    consts = {}
+    if os.path.exists(path + ".npz"):
+        consts = dict(np.load(path + ".npz"))
+    env: Dict[str, object] = {}
+    input_iter = iter(inputs)
+    outputs: List[ParallelTensor] = []
+
+    def dec(x):
+        if isinstance(x, dict):
+            if "__ref__" in x:
+                return env[x["__ref__"]]
+            if "__slice__" in x:
+                return slice(*x["__slice__"])
+            if "__list__" in x:
+                return [dec(v) for v in x["__list__"]]
+            if "__dtype__" in x:
+                return x["__dtype__"]
+        return x
+
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            op = rec["op"]
+            if op == "input":
+                env[rec["name"]] = next(input_iter)
+            elif op == "const":
+                env[rec["name"]] = _traced_array(
+                    consts[rec["name"]], rec.get("trainable", True)
+                )
+            elif op == "literal":
+                env[rec["name"]] = rec["value"]
+            elif op == "output":
+                outputs.extend(env[r] for r in rec["refs"])
+            else:
+                a = [dec(x) for x in rec["args"]]
+                kw = {k: dec(v) for k, v in rec["kwargs"].items()}
+                name = rec["name"]
+                if op == "call_module":
+                    env[name] = lower_module(ff, rec["config"], a, name)
+                elif op == "call_function":
+                    # getitem indices serialize tuples as __list__
+                    if rec["target"] == "getitem" and isinstance(a[1], list):
+                        a[1] = tuple(a[1])
+                    env[name] = lower_function(ff, rec["target"], a, kw, name)
+                else:
+                    env[name] = lower_method(ff, rec["target"], a, kw, name)
+    return outputs
 
 
 def _fetch_attr(module, target: str):

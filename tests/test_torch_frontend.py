@@ -156,3 +156,210 @@ def test_resnet50_example_imports_and_trains(devices8):
         rng.randint(0, 10, 8).astype(np.int32),
     )
     assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# Round-2 breadth: the reference's remaining node kinds
+# (python/flexflow/torch/model.py:248-2441) — pow/sqrt/rsqrt/erf, expand,
+# unsqueeze/squeeze, getitem slicing, chunk, functional linear/conv,
+# floordiv/neg/maximum, .float()/type_as, sum — each verified by exact
+# alignment against the torch original.
+# ---------------------------------------------------------------------------
+
+def test_elementwise_math_node_parity():
+    torch.manual_seed(2)
+
+    class M(nn.Module):
+        def forward(self, x):
+            a = torch.sqrt(torch.relu(x) + 1.0)
+            b = torch.rsqrt(x * x + 1.0)
+            c = torch.erf(x)
+            d = torch.pow(x, 2.0) - a
+            e = -b
+            return torch.maximum(d, e) + c + x.float()
+
+    m = M()
+    ff, pt, outs = compile_from_torch(m, [24])
+    x = np.random.RandomState(3).randn(8, 24).astype(np.float32)
+    got = np.asarray(ff.forward({"x": x}))
+    want = m(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_shape_node_parity():
+    torch.manual_seed(3)
+
+    class M(nn.Module):
+        def forward(self, x):            # x: [b, 6, 10]
+            a = x[:, 1:5, :]             # getitem slicing
+            b = a.unsqueeze(1)           # [b, 1, 4, 10]
+            c = b.expand(-1, 3, -1, -1)  # broadcast
+            d = c.sum(1)                 # [b, 4, 10]
+            e = d.unsqueeze(2).squeeze(2)
+            p1, p2 = torch.chunk(e, 2, dim=1)
+            return (p1 * p2).flatten(1)
+
+    m = M()
+    ff, pt, outs = compile_from_torch(m, [6, 10])
+    x = np.random.RandomState(4).randn(8, 6, 10).astype(np.float32)
+    got = np.asarray(ff.forward({"x": x}))
+    want = m(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_functional_linear_conv_parity():
+    torch.manual_seed(4)
+    import torch.nn.functional as F
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.w1 = nn.Parameter(torch.randn(20, 12) * 0.1)
+            self.b1 = nn.Parameter(torch.zeros(20))
+            self.wc = nn.Parameter(torch.randn(8, 4, 3, 3) * 0.1)
+
+        def forward(self, x, img):
+            h = F.relu(F.linear(x, self.w1, self.b1))
+            c = F.conv2d(img, self.wc, stride=1, padding=1)
+            return h.sum(1) + c.mean([1, 2, 3])
+
+    m = M()
+    ff = FFModel(FFConfig(batch_size=8))
+    x_t = ff.create_tensor([8, 12], name="x")
+    img_t = ff.create_tensor([8, 4, 6, 6], name="img")
+    pt = PyTorchModel(m)
+    pt.torch_to_ff(ff, [x_t, img_t])
+    ff.compile(loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE)
+    # functional weights are pinned via ArrayInitializer at trace time
+    rs = np.random.RandomState(5)
+    x = rs.randn(8, 12).astype(np.float32)
+    img = rs.randn(8, 4, 6, 6).astype(np.float32)
+    got = np.asarray(ff.forward({"x": x, "img": img}))
+    want = m(torch.from_numpy(x), torch.from_numpy(img)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ir_file_roundtrip_torch_free_replay(tmp_path):
+    """torch_to_file -> file_to_ff replay matches the live lowering
+    (reference PyTorchModel file format, model.py:2442+)."""
+    torch.manual_seed(5)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.fc2 = nn.Linear(32, 4)
+            self.w = nn.Parameter(torch.randn(4) * 0.1)
+
+        def forward(self, x):
+            h = torch.relu(self.fc1(x))
+            h = self.fc2(h)
+            return h * self.w + h[:, 0:2].sum(1, keepdim=True)
+
+    m = M()
+    path = str(tmp_path / "model.ir")
+    pt = PyTorchModel(m)
+    pt.torch_to_file(path)
+
+    from flexflow_tpu.torch_frontend.model import file_to_ff
+
+    # live path
+    ff_a = FFModel(FFConfig(batch_size=8))
+    xa = ff_a.create_tensor([8, 16], name="x")
+    pt.torch_to_ff(ff_a, [xa])
+    ff_a.compile(loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE)
+    pt.copy_weights(ff_a)
+
+    # replayed path
+    ff_b = FFModel(FFConfig(batch_size=8))
+    xb = ff_b.create_tensor([8, 16], name="x")
+    file_to_ff(path, ff_b, [xb])
+    ff_b.compile(loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE)
+    ff_b.set_weights(ff_a.get_weights())
+
+    x = np.random.RandomState(6).randn(8, 16).astype(np.float32)
+    got_a = np.asarray(ff_a.forward({"x": x}))
+    got_b = np.asarray(ff_b.forward({"x": x}))
+    np.testing.assert_allclose(got_a, got_b, rtol=1e-6, atol=1e-6)
+    want = m(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got_a, want, rtol=2e-5, atol=2e-5)
+
+
+def test_mha_tuple_unpack_and_scalar_div_parity():
+    torch.manual_seed(6)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.attn = nn.MultiheadAttention(16, 2, batch_first=True)
+
+        def forward(self, x):
+            out, _ = self.attn(x, x, x)   # tuple unpack -> getitem(0)
+            return 2.0 / (out * out + 1.0)  # scalar-first division
+
+    m = M()
+    import jax
+
+    dev1 = jax.devices("cpu")[:1]
+    ff = FFModel(FFConfig(batch_size=4))
+    x_t = ff.create_tensor([4, 6, 16], name="x")
+    pt = PyTorchModel(m)
+    (out,) = pt.torch_to_ff(ff, [x_t])
+    assert out.shape.logical_shape == (4, 6, 16)  # batch dim intact
+    ff.compile(loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               devices=dev1)
+    x = np.random.RandomState(7).randn(4, 6, 16).astype(np.float32)
+    got = np.asarray(ff.forward({"x": x}))
+    assert got.shape == (4, 6, 16)
+    # scalar-first div must not silently compute x/2
+    class D(nn.Module):
+        def forward(self, x):
+            return 2.0 / x
+    ffd = FFModel(FFConfig(batch_size=4))
+    xd = ffd.create_tensor([4, 8], name="x")
+    PyTorchModel(D()).torch_to_ff(ffd, [xd])
+    ffd.compile(loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                devices=dev1)
+    xv = np.full((4, 8), 4.0, np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ffd.forward({"x": xv})), np.full((4, 8), 0.5), rtol=1e-6
+    )
+
+
+def test_frozen_buffer_not_trained():
+    """register_buffer constants import as FROZEN weights: no gradient
+    updates, no weight decay."""
+    torch.manual_seed(7)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+            self.register_buffer("scale", torch.full((8,), 3.0))
+
+        def forward(self, x):
+            return self.fc(x) * self.scale
+
+    from flexflow_tpu import SGDOptimizer
+
+    m = M()
+    ff = FFModel(FFConfig(batch_size=4, weight_decay=0.1))
+    x_t = ff.create_tensor([4, 8], name="x")
+    pt = PyTorchModel(m)
+    pt.torch_to_ff(ff, [x_t])
+    import jax
+
+    ff.compile(optimizer=SGDOptimizer(lr=0.5, weight_decay=0.1),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               devices=jax.devices("cpu")[:1])
+    # the buffer landed in state, not trainable weights
+    w_names = set(ff._weights)
+    buf_ops = [k for k in ff._state if k.startswith("mul")]
+    assert buf_ops, f"buffer op missing from state: {list(ff._state)}"
+    assert all(not k.startswith("mul") for k in w_names)
+    x = np.random.RandomState(8).randn(4, 8).astype(np.float32)
+    y = np.random.RandomState(9).randn(4, 8).astype(np.float32)
+    for _ in range(5):
+        ff.train_step({"x": x}, y)
+    buf = ff._state[buf_ops[0]]["value"]
+    np.testing.assert_allclose(np.asarray(buf), np.full(8, 3.0), rtol=1e-6)
