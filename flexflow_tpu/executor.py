@@ -315,8 +315,15 @@ class GraphExecutor:
         opt = self.optimizer
         lrep = self.label_replication
 
+        # replay-mode (_load_cached) ops are excluded: the reference's
+        # load_cached forward performs no cache refresh (cache.cc:214);
+        # block-region exclusion is defensive (plan_pipeline rejects
+        # CACHE inside blocks)
         cache_ops = [
-            op for op in self.order if op.op_type == OperatorType.CACHE
+            op for op in self.order
+            if op.op_type == OperatorType.CACHE
+            and not getattr(op, "_load_cached", False)
+            and op.guid not in self._block_guids
         ]
 
         def step(weights, opt_state, state, inputs, labels, rng):

@@ -151,6 +151,25 @@ def _channel_dim_index(op: Op) -> Optional[int]:
     return None  # attention: heads contract away (partials, not a dim)
 
 
+def axis_degrees(mesh_axes: Dict[str, int], axis_name: str) -> List[int]:
+    """All shard degrees realizable on a logical axis family: products
+    of subsets of mesh axes named `axis_name` or `axis_name<digit>`.
+
+    A factored mesh ({"model0": 2, "model1": 2}) is the TPU-native
+    expression of the reference's per-op MachineViews
+    (machine_view.h:31): different ops may shard at different degrees —
+    i.e. live on different submeshes — within one SPMD program."""
+    sizes = [
+        v for k, v in mesh_axes.items()
+        if k == axis_name
+        or (k.startswith(axis_name) and k[len(axis_name):].isdigit())
+    ]
+    degs = {1}
+    for s in sizes:
+        degs |= {d * s for d in degs}
+    return sorted(d for d in degs if d > 1)
+
+
 def op_options(
     op: Op,
     mesh_axes: Dict[str, int],
@@ -176,32 +195,30 @@ def op_options(
         g = xf.gate()
         if g is not None and not gates.get(g, False):
             continue
-        degree = mesh_axes.get(KIND_AXIS[xf.kind], 1)
-        if degree <= 1:
-            continue
-        limit = _shard_limit(op, xf.kind)
-        if limit <= 0 or limit % degree != 0:
-            continue
-        cfg = ShardConfig(**{xf.kind: degree})
-        add(XferChoice(cfg))
-        if xf.kind == "channel":
-            ci = _channel_dim_index(op)
-            if ci is not None:
-                # the reference rule's trailing Combine: gather the
-                # channel-sharded output back to degree 1
-                add(XferChoice(cfg, (
-                    ("combine", (("dim", ci), ("degree", degree))),
-                )))
-            else:
-                # attention: head contraction leaves partial sums
-                # (replica degree) — Reduction collapses them, the
-                # create_replicate_attention_reduce shape
+        for degree in axis_degrees(mesh_axes, KIND_AXIS[xf.kind]):
+            limit = _shard_limit(op, xf.kind)
+            if limit <= 0 or limit % degree != 0:
+                continue
+            cfg = ShardConfig(**{xf.kind: degree})
+            add(XferChoice(cfg))
+            if xf.kind == "channel":
+                ci = _channel_dim_index(op)
+                if ci is not None:
+                    # the reference rule's trailing Combine: gather the
+                    # channel-sharded output back to degree 1
+                    add(XferChoice(cfg, (
+                        ("combine", (("dim", ci), ("degree", degree))),
+                    )))
+                else:
+                    # attention: head contraction leaves partial sums
+                    # (replica degree) — Reduction collapses them, the
+                    # create_replicate_attention_reduce shape
+                    add(XferChoice(cfg, (
+                        ("reduction", (("degree", degree),)),
+                    )))
+            elif xf.kind in ("reduction", "attribute"):
+                # partial-sum output -> optional explicit Reduction
                 add(XferChoice(cfg, (
                     ("reduction", (("degree", degree),)),
                 )))
-        elif xf.kind in ("reduction", "attribute"):
-            # partial-sum output -> optional explicit Reduction
-            add(XferChoice(cfg, (
-                ("reduction", (("degree", degree),)),
-            )))
     return opts

@@ -638,6 +638,26 @@ class UnitySearch:
             axes["data"] = 1
         return axes
 
+    def _mesh_variants(self, dp: int, tp: int, ep: int):
+        """Mesh-axes candidates for one (dp, tp, ep) factorization: the
+        plain mesh, plus — for composite tp — a FACTORED model axis
+        ({"model0": a, "model1": b}) under which ops may shard at
+        different degrees, i.e. per-op submesh machine views (reference
+        machine_view.h:31; SURVEY §7 hard-part 4's mesh-realizable
+        subset)."""
+        yield self._mesh_axes(dp, tp, ep)
+        if tp > 3:
+            a = next((p for p in range(2, tp) if tp % p == 0), tp)
+            if a < tp:
+                axes = {}
+                if dp > 1:
+                    axes["data"] = dp
+                axes["model0"] = tp // a
+                axes["model1"] = a
+                if ep > 1:
+                    axes["expert"] = ep
+                yield axes
+
     def _build_strategy(self, mesh_axes: Dict[str, int], dp: int,
                         shard_configs: Dict[str, ShardConfig],
                         edges: Optional[Dict] = None) -> Strategy:
@@ -681,28 +701,30 @@ class UnitySearch:
         has_moe = any(op.op_type == OperatorType.GROUP_BY for op in self.graph.ops)
         best_obj = math.inf
         for dp, tp, ep in _factorizations(self.n, allow_expert=has_moe):
-            mesh_axes = self._mesh_axes(dp, tp, ep)
-            if tp > 1 and not self._options_by_op(mesh_axes):
-                continue  # no op can use the model axis
-            r = self._dp(mesh_axes, dp, lam)
-            if r is None:
-                continue
-            shard_configs, edges, time, mem = r
-            strategy = self._build_strategy(mesh_axes, dp, shard_configs, edges)
-            # validate + final rank with the strategy actually applied
-            try:
-                g = apply_strategy(self.graph, strategy)
-                assign_views(g, strategy.mesh_axes)
-            except (ShapeError, ValueError):
-                continue
-            obj = self._objective(time, mem, lam)
-            slog.debug(
-                "candidate dp=%d tp=%d ep=%d: time=%.3gms mem=%.1fMB obj=%.3g%s",
-                dp, tp, ep, time * 1e3, mem / 2**20, obj,
-                " *best*" if obj < best_obj else "",
-            )
-            best_obj = min(best_obj, obj)
-            collector.append((obj, strategy, self.graph))
+            for mesh_axes in self._mesh_variants(dp, tp, ep):
+                if tp > 1 and not self._options_by_op(mesh_axes):
+                    continue  # no op can use the model axis
+                r = self._dp(mesh_axes, dp, lam)
+                if r is None:
+                    continue
+                shard_configs, edges, time, mem = r
+                strategy = self._build_strategy(
+                    mesh_axes, dp, shard_configs, edges
+                )
+                # validate + final rank with the strategy actually applied
+                try:
+                    g = apply_strategy(self.graph, strategy)
+                    assign_views(g, strategy.mesh_axes)
+                except (ShapeError, ValueError):
+                    continue
+                obj = self._objective(time, mem, lam)
+                slog.debug(
+                    "candidate %s: time=%.3gms mem=%.1fMB obj=%.3g%s",
+                    mesh_axes, time * 1e3, mem / 2**20, obj,
+                    " *best*" if obj < best_obj else "",
+                )
+                best_obj = min(best_obj, obj)
+                collector.append((obj, strategy, self.graph))
         for strategy, obj, label in self._sp_candidates(lam):
             slog.debug(
                 "candidate %s: obj=%.3g%s", label, obj,

@@ -260,9 +260,14 @@ def _getitem(ff: FFModel, x, idx, name: str):
         if isinstance(it, slice):
             if it == slice(None):
                 continue
-            start = it.start or 0
             size = out.shape.logical_shape[axis]
-            stop = size if it.stop is None else min(it.stop, size)
+            start = it.start or 0
+            if start < 0:
+                start += size
+            stop = size if it.stop is None else it.stop
+            if stop < 0:
+                stop += size
+            stop = min(stop, size)
             if (it.step or 1) != 1:
                 raise ValueError("strided tensor slicing is unsupported")
             out = _slice_axis(ff, out, axis, start, stop, name)
@@ -498,12 +503,8 @@ def lower_method(ff: FFModel, mname: str, a: List, kw: Dict, name: str):
 
 
 def _reshape(ff, x, shape, name):
-    shape = [int(s) for s in shape]
-    if any(s == -1 for s in shape):
-        total = int(np.prod(x.shape.logical_shape))
-        known = -int(np.prod([s for s in shape if s != -1]))
-        shape = [total // known if s == -1 else s for s in shape]
-    return ff.reshape(x, shape, name=name)
+    # Reshape's own shape rule resolves -1 dims (ops/shape.py:63-71)
+    return ff.reshape(x, [int(s) for s in shape], name=name)
 
 
 def _transpose2(ff, x, d0, d1, name):

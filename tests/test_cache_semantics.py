@@ -51,7 +51,7 @@ def test_moe_style_set_compare_scorer(devices8):
     def moe_score(cached_score, input_arr, cached_arr, vol):
         gamma = 0.99
         cached_score *= gamma
-        b = vol // (16 // num_select) // num_select if False else input_arr.shape[0]
+        b = input_arr.shape[0]
         frac = (1.0 - gamma) / b
         for i in range(b):
             if set(np.asarray(input_arr[i]).ravel()[:num_select]) == set(
@@ -118,3 +118,74 @@ def test_cache_ring_cycles_slots():
     s = op.cache_score
     op.update(a)   # slot 1: a vs b -> decay only
     assert op.cache_score < s
+
+
+def test_replay_mode_training_does_not_refresh_ring(devices8):
+    """Training with load_cached on must NOT overwrite the ring with
+    live batches (reference load_cached forward performs no cache
+    refresh, cache.cc:214-231)."""
+    ff = _model()
+    ff.compile(optimizer=SGDOptimizer(lr=0.0), devices=devices8[:1])
+    op = ff._cache_ops[0]
+    xa = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    xb = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+    y = np.zeros(8, np.int64)
+    ff.train_step({"x": xa}, y)
+    ff.use_cached(True)  # flushes the pending tap: ring holds xa
+    np.testing.assert_array_equal(op.cached_value(), xa)
+    for _ in range(3):
+        ff.train_step({"x": xb}, y)  # live batches must not leak in
+    ff.use_cached(False)
+    np.testing.assert_array_equal(op.cached_value(), xa)
+
+
+def test_negative_slice_bounds_import():
+    """x[:, :-1] and x[:, -2:] lower correctly (causal-shift pattern)."""
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    from flexflow_tpu import LossType
+    from flexflow_tpu.torch_frontend import PyTorchModel
+
+    class M(nn.Module):
+        def forward(self, x):
+            return x[:, :-1] * x[:, 1:] + x[:, -2:-1]
+
+    import jax
+
+    m = M()
+    ff = FFModel(FFConfig(batch_size=4))
+    xt = ff.create_tensor([4, 6], name="x")
+    PyTorchModel(m).torch_to_ff(ff, [xt])
+    ff.compile(loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               devices=jax.devices("cpu")[:1])
+    x = np.random.RandomState(2).randn(4, 6).astype(np.float32)
+    got = np.asarray(ff.forward({"x": x}))
+    want = m(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_view_minus_one_import():
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    from flexflow_tpu import LossType
+    from flexflow_tpu.torch_frontend import PyTorchModel
+
+    class M(nn.Module):
+        def forward(self, x):  # [b, 4, 6]
+            return x.reshape(x.size(0), -1)
+
+    import jax
+
+    m = M()
+    ff = FFModel(FFConfig(batch_size=4))
+    xt = ff.create_tensor([4, 4, 6], name="x")
+    (out,) = PyTorchModel(m).torch_to_ff(ff, [xt])
+    assert out.shape.logical_shape == (4, 24)
+    ff.compile(loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               devices=jax.devices("cpu")[:1])

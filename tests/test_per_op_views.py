@@ -1,0 +1,101 @@
+"""Per-op machine-view placement (VERDICT r1 Missing #4).
+
+Reference: each op owns a MachineView (dim, degree, start, stride —
+machine_view.h:31) so different ops can live on different device
+subsets.  TPU-native realization: FACTORED mesh axes ("model0"/"model1")
+let ops shard at different degrees — i.e. occupy different submeshes —
+inside one SPMD program, with assign_axes factoring each tensor's
+degrees onto axis subsets (SURVEY §7 hard-part 4's mesh-realizable
+views).
+"""
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.ops.op import ShardConfig
+from flexflow_tpu.pcg.substitution import axis_degrees
+from flexflow_tpu.strategy import Strategy
+
+
+def test_axis_degrees_subset_products():
+    assert axis_degrees({"model": 4}, "model") == [4]
+    assert axis_degrees({"model0": 2, "model1": 2}, "model") == [2, 4]
+    assert axis_degrees({"model0": 2, "model1": 3}, "model") == [2, 3, 6]
+    assert axis_degrees({"data": 8}, "model") == []
+
+
+def _mixed_model(n):
+    ff = FFModel(FFConfig(batch_size=8, num_devices=n))
+    x = ff.create_tensor([8, 16], name="x")
+    t = ff.dense(x, 32, activation=ActiMode.RELU, name="fa")
+    t = ff.dense(t, 64, activation=ActiMode.RELU, name="fb")
+    t = ff.dense(t, 4, name="head")
+    ff.softmax(t)
+    return ff
+
+
+def test_mixed_degree_per_op_views_match_single_device(devices8):
+    """fa shards channel over model1 (degree 2), fb over model1+model0
+    (degree 4) — different submeshes, exact numerics."""
+    s = Strategy(mesh_axes={"data": 2, "model0": 2, "model1": 2})
+    s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": 2})]
+    s.shard_configs["fa"] = ShardConfig(channel=2)
+    s.edge_ops["fa.out0"] = [("combine", {"dim": 1, "degree": 2})]
+    s.shard_configs["fb"] = ShardConfig(channel=4)
+    s.edge_ops["fb.out0"] = [("combine", {"dim": 1, "degree": 4})]
+    ff = _mixed_model(8)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05), strategy=s,
+               devices=devices8[:8])
+    fa = next(op for op in ff.operators.ops if op.name == "fa")
+    fb = next(op for op in ff.operators.ops if op.name == "fb")
+    assert fa.weights[0].machine_view.used_axes() != \
+        fb.weights[0].machine_view.used_axes()
+
+    ff1 = _mixed_model(1)
+    ff1.compile(optimizer=SGDOptimizer(lr=0.05), devices=devices8[:1])
+    ff1.set_weights(ff.get_weights())
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": x})), np.asarray(ff1.forward({"x": x})),
+        rtol=2e-5, atol=2e-5,
+    )
+    y = np.random.RandomState(1).randint(0, 4, (8,))
+    l0 = float(ff.train_step({"x": x}, y)["loss"])
+    for _ in range(5):
+        m = ff.train_step({"x": x}, y)
+    assert float(m["loss"]) < l0
+
+
+def test_search_explores_factored_mesh_mixed_degrees():
+    """With one op only 2-shardable (width 6) and another 4-shardable,
+    the plain {"model": 4} mesh can't shard the first at all; the
+    factored variant lets the search assign DIFFERENT degrees per op."""
+    from flexflow_tpu.pcg.unity import UnitySearch
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+    from flexflow_tpu.sim.simulator import OpCostModel
+
+    ff = FFModel(FFConfig(batch_size=16))
+    x = ff.create_tensor([16, 2048], name="x")
+    # 1026 = 2*513: shardable at degree 2 only; 4096 shards at 4/8
+    t = ff.dense(x, 1026, activation=ActiMode.RELU, name="narrow")
+    t = ff.dense(t, 4096, activation=ActiMode.RELU, name="wide")
+    t = ff.dense(t, 4, name="head")
+    ff.softmax(t)
+    machine = TpuPodModel(topology=(2, 4))
+    search = UnitySearch(ff.layers, 8, machine, OpCostModel(machine),
+                         rewrite_max_variants=1, event_rerank=False)
+    collector = []
+    search._optimize_graph(0.0, collector)
+    collector.sort(key=lambda c: c[0])
+    best = collector[0][1]
+    assert any(k.startswith("model0") for k in best.mesh_axes), best.mesh_axes
+    degrees = {k: v.channel for k, v in best.shard_configs.items()
+               if v.channel > 1}
+    assert len(set(degrees.values())) >= 2, (
+        f"expected mixed per-op degrees, got {best.mesh_axes} {degrees}"
+    )
+    # and the winning mixed-degree strategy lowers end to end
+    from flexflow_tpu.strategy import apply_strategy, assign_views
+
+    g = apply_strategy(ff.layers, best)
+    assign_views(g, best.mesh_axes)
