@@ -195,8 +195,8 @@ def op_options(
         g = xf.gate()
         if g is not None and not gates.get(g, False):
             continue
+        limit = _shard_limit(op, xf.kind)
         for degree in axis_degrees(mesh_axes, KIND_AXIS[xf.kind]):
-            limit = _shard_limit(op, xf.kind)
             if limit <= 0 or limit % degree != 0:
                 continue
             cfg = ShardConfig(**{xf.kind: degree})

@@ -41,6 +41,21 @@ V5E_DEVICE = DeviceSpec(
 V5P_DEVICE = DeviceSpec()
 
 
+def detect_device_spec() -> DeviceSpec:
+    """Spec for the LIVE accelerator by device_kind — the reference
+    profiles the actual GPU (model.cu:38); calibrated analytic costs
+    need the actual chip's roofline too."""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return V5P_DEVICE
+    if "lite" in kind or "v5e" in kind:
+        return V5E_DEVICE
+    return V5P_DEVICE
+
+
 class MachineModel:
     """Interface consumed by the simulator/search."""
 
@@ -265,14 +280,17 @@ class TpuPodModel(MachineModel):
 
 
 def make_machine_model(config, num_devices: int) -> MachineModel:
-    """Build from FFConfig (--machine-model-version/-file parity)."""
+    """Build from FFConfig (--machine-model-version/-file parity).
+    Device roofline auto-matches the live chip (cpu -> v5p defaults,
+    keeping hermetic tests deterministic)."""
     if config.machine_model_file:
         return TpuPodModel.from_file(config.machine_model_file)
+    spec = detect_device_spec()
     if config.machine_model_version == 0:
         return SimpleMachineModel(
             num_nodes=max(1, config.num_nodes),
             devices_per_node=max(1, num_devices // max(1, config.num_nodes)),
+            device=spec,
         )
     # default TPU pod: 1-D ring topology of the right size
-    return TpuPodModel(topology=(num_devices,), device=V5E_DEVICE
-                       if num_devices == 1 else V5P_DEVICE)
+    return TpuPodModel(topology=(num_devices,), device=spec)

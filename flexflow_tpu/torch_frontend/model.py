@@ -267,7 +267,13 @@ def _getitem(ff: FFModel, x, idx, name: str):
             stop = size if it.stop is None else it.stop
             if stop < 0:
                 stop += size
-            stop = min(stop, size)
+            # torch clamps out-of-range bounds; empty slices stay empty
+            start = max(0, start)
+            stop = max(start, min(stop, size))
+            if stop == start:
+                raise ValueError(
+                    f"empty slice on axis {axis} is unsupported"
+                )
             if (it.step or 1) != 1:
                 raise ValueError("strided tensor slicing is unsupported")
             out = _slice_axis(ff, out, axis, start, stop, name)
