@@ -1,0 +1,81 @@
+"""Pre-search strategies for the north-star models and ship them as JSON
+artifacts (reference parity: examples/cpp/DLRM/strategies/*.pb — the
+reference distributes pre-searched strategy files so runs can skip the
+search; here `--import-strategy` loads them).
+
+Usage (hermetic CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python scripts/search_strategies.py --out examples/strategies -n 8
+
+Each JSON round-trips through Strategy.load + FFModel.compile(strategy=...)
+and records the graph rewrites the search applied.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer  # noqa: E402
+
+
+def _searched(build, n, batch, **cfg_kw):
+    cfg = FFConfig(batch_size=batch, num_devices=n, search_budget=500,
+                   **cfg_kw)
+    ff = FFModel(cfg)
+    build(ff, cfg)
+    import jax
+
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=jax.devices()[:n])
+    return ff
+
+
+def bert(ff, cfg):
+    from flexflow_tpu.models.transformer import build_bert
+
+    build_bert(ff, batch_size=cfg.batch_size, seq_length=64, hidden_size=256,
+               num_layers=4, num_heads=8, intermediate_size=1024)
+
+
+def inception(ff, cfg):
+    from flexflow_tpu.models.inception import build_inception_v3
+
+    build_inception_v3(ff, batch_size=cfg.batch_size, image_size=75,
+                       channel_scale=0.25)
+
+
+def dlrm(ff, cfg):
+    from flexflow_tpu.models.dlrm import build_dlrm
+
+    build_dlrm(ff, batch_size=cfg.batch_size)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="examples/strategies")
+    p.add_argument("-n", "--num-devices", type=int, default=8)
+    args = p.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    jobs = [
+        ("bert_encoder", bert, 16, {"enable_parameter_parallel": True}),
+        ("inception_v3", inception, 16, {"substitution_json": None}),
+        ("dlrm", dlrm, 16, {"enable_attribute_parallel": True}),
+    ]
+    for name, build, batch, kw in jobs:
+        ff = _searched(build, args.num_devices, batch, **kw)
+        path = os.path.join(args.out, f"{name}.json")
+        ff.strategy.save(path)
+        print(f"{name}: mesh={ff.strategy.mesh_axes} "
+              f"shards={len(ff.strategy.shard_configs)} "
+              f"rewrites={ff.strategy.rewrites} -> {path}")
+
+
+if __name__ == "__main__":
+    main()

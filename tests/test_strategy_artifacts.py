@@ -1,0 +1,50 @@
+"""Shipped pre-searched strategies load and run (reference parity:
+examples/cpp/DLRM/strategies/*.pb distributed with the repo and loaded
+via --import-strategy)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer  # noqa: E402
+from flexflow_tpu.strategy import Strategy  # noqa: E402
+
+ART = os.path.join(os.path.dirname(__file__), "..", "examples", "strategies")
+
+
+@pytest.mark.parametrize("name,builder,batch,cfg_kw", [
+    ("bert_encoder", "bert", 16, {"enable_parameter_parallel": True}),
+    ("inception_v3", "inception", 16, {}),
+    ("dlrm", "dlrm", 16, {"enable_attribute_parallel": True}),
+])
+def test_shipped_strategy_loads_and_trains(devices8, name, builder, batch,
+                                           cfg_kw):
+    path = os.path.join(ART, f"{name}.json")
+    assert os.path.exists(path), f"missing shipped strategy {path}"
+    s = Strategy.load(path)
+    assert s.total_devices == 8
+
+    import search_strategies as S
+
+    cfg = FFConfig(batch_size=batch, num_devices=8, **cfg_kw)
+    ff = FFModel(cfg)
+    getattr(S, builder)(ff, cfg)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy=s, devices=devices8)
+    rs = np.random.RandomState(0)
+    inputs = {}
+    for op in ff.layers.source_ops():
+        shp = op.outputs[0].shape.logical_shape
+        if op.outputs[0].dtype.np_dtype.kind == "i":
+            hi = 100
+            inputs[op.name] = rs.randint(0, hi, shp).astype(np.int32)
+        else:
+            inputs[op.name] = rs.randn(*shp).astype(np.float32)
+    n_cls = ff.layers.sink_op().outputs[0].shape.logical_shape[-1]
+    y = rs.randint(0, max(2, n_cls), (batch,))
+    m = ff.train_step(inputs, y)
+    assert np.isfinite(float(m["loss"]))
