@@ -86,6 +86,10 @@ class FFConfig:
     # activations into producers at compile; XLA fuses kernels anyway,
     # this shrinks the PCG/search space
     perform_fusion: bool = False
+    # rematerialise segment internals in backward (jax.checkpoint at
+    # single-tensor-boundary cuts): trades recompute FLOPs for HBM —
+    # a TPU-native capability the reference cannot express
+    remat: bool = False
     profiling: bool = False
     # gradient-sync cost model: ALL_REDUCE rings vs PS flat 2*size/BW
     # (reference ParameterSyncType config.h:55-59, simulator.cc:786-813)
@@ -160,6 +164,7 @@ class FFConfig:
         p.add_argument("--machine-model-file", type=str, default=None)
         p.add_argument("--simulator-segment-size", type=int, default=16777216)
         p.add_argument("--fusion", action="store_true")
+        p.add_argument("--remat", action="store_true")
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--flash-min-seq", dest="flash_min_seq", type=int,
                        default=DEFAULT_FLASH_MIN_SEQ)
@@ -195,6 +200,7 @@ class FFConfig:
             machine_model_file=args.machine_model_file,
             simulator_segment_size=args.simulator_segment_size,
             perform_fusion=args.fusion,
+            remat=args.remat,
             profiling=args.profiling,
             flash_min_seq=args.flash_min_seq,
             export_strategy_file=args.export_strategy,

@@ -79,6 +79,48 @@ class GraphExecutor:
             if pipeline_plan is not None
             else set()
         )
+        # rematerialisation plan: single-tensor-boundary segments whose
+        # internals are recomputed in backward (jax.checkpoint), saving
+        # only boundary activations — the HBM/FLOPs trade the reference
+        # cannot express (Legion keeps every region alive)
+        self._remat_plan = self._build_remat_plan() if remat else None
+
+    def _build_remat_plan(self):
+        """[(ops, in_guids, out_guids, pure)] per segment.  Impure
+        segments (inputs, cache, state, aux, pipeline blocks) run
+        inline; pure ones are wrapped in jax.checkpoint."""
+        OT = OperatorType
+        from .pcg.segments import external_inputs, split_segments
+
+        segments, _ = split_segments(self.graph)
+        pos_of = {}
+        for i, seg in enumerate(segments):
+            for op in seg:
+                pos_of[op.guid] = i
+        sink_out = self.sink.outputs[0].guid
+        consumers: Dict[int, List[int]] = {}
+        for op in self.graph.ops:
+            for t in op.inputs:
+                consumers.setdefault(t.guid, []).append(pos_of[op.guid])
+        impure_types = {OT.INPUT, OT.CACHE, OT.GROUP_BY, OT.AGGREGATE,
+                        OT.AGGREGATE_SPEC}
+        plan = []
+        for i, seg in enumerate(segments):
+            out_guids = [
+                t.guid
+                for op in seg
+                for t in op.outputs
+                if t.guid == sink_out
+                or any(c > i for c in consumers.get(t.guid, ()))
+            ]
+            pure = all(
+                op.op_type not in impure_types
+                and op.guid not in self._block_guids
+                and _num_trainable(op) == len(op.weight_specs)
+                for op in seg
+            )
+            plan.append((seg, external_inputs(seg), out_guids, pure))
+        return plan
 
     # -- shardings -------------------------------------------------------
     def tensor_sharding(self, pt) -> NamedSharding:
@@ -207,60 +249,95 @@ class GraphExecutor:
                 return x.astype(self.compute_dtype)
             return x
 
-        pipeline_done = False
-        for op in self.order:
-            if (
-                op.op_type == OperatorType.CACHE
-                and getattr(op, "_load_cached", False)
-            ):
-                # replay the host-cached batch (reference load_cached
-                # forward, cache.cc:214-231), fed as an extra input
-                env[op.outputs[0].guid] = to_compute(
-                    inputs[f"__cache__{op.name}"]
+        state_ctx = {
+            "pipeline_done": False,
+            "weights": weights,
+            "state": state,
+            "new_state": new_state,
+            "aux": aux_losses,
+            "inputs": inputs,
+            "training": training,
+            "rng": rng,
+            "to_compute": to_compute,
+        }
+        if self._remat_plan is not None and training:
+            for seg, in_guids, out_guids, pure in self._remat_plan:
+                if not pure:
+                    for op in seg:
+                        self._exec_op(op, env, state_ctx)
+                    continue
+
+                def seg_fn(*in_vals, _seg=seg, _in=in_guids, _out=out_guids):
+                    local = dict(zip(_in, in_vals))
+                    for op in _seg:
+                        self._exec_op(op, local, state_ctx)
+                    return tuple(local[g] for g in _out)
+
+                outs = jax.checkpoint(seg_fn)(
+                    *(env[g] for g in in_guids)
                 )
-                continue
-            if op.guid in self._block_guids:
-                if not pipeline_done:
-                    out = self._run_pipeline_region(
-                        weights, env, to_compute, training, rng
-                    )
-                    env[self.pipeline_plan.region_out_guid] = out
-                    pipeline_done = True
-                continue
-            if op.op_type == OperatorType.INPUT:
-                env[op.outputs[0].guid] = to_compute(inputs[op.name])
-                continue
-            ins = [env[t.guid] for t in op.inputs]
-            nt = _num_trainable(op)
-            ws: List[jax.Array] = []
-            for i, spec in enumerate(op.weight_specs):
-                src = weights if i < nt else state
-                ws.append(to_compute(src[op.name][spec.name]))
-            op_rng = None
-            if rng is not None:
-                op_rng = jax.random.fold_in(rng, op.guid)
-            results = op.forward(ins, ws, training=training, rng=op_rng)
-            outs = results[: len(op.outputs)]
-            extra = results[len(op.outputs):]
-            if extra:
-                for spec, val in zip(op.weight_specs[nt:], extra):
-                    new_state[op.name][spec.name] = val.astype(
-                        state[op.name][spec.name].dtype
-                    )
-            aux = getattr(op, "_last_aux", None)
-            if aux is not None:
-                aux_losses.append(aux)
-                op._last_aux = None
-            for pt, val in zip(op.outputs, outs):
-                if self._use_constraints:
-                    val = jax.lax.with_sharding_constraint(
-                        val, self.tensor_sharding(pt)
-                    )
-                env[pt.guid] = val
+                env.update(zip(out_guids, outs))
+        else:
+            for op in self.order:
+                self._exec_op(op, env, state_ctx)
         out = env[self.sink.outputs[0].guid]
         if self.compute_dtype is not None and jnp.issubdtype(out.dtype, jnp.floating):
             out = out.astype(jnp.float32)  # loss/metrics in full precision
         return out, new_state, aux_losses, env
+
+    def _exec_op(self, op: Op, env: Dict[int, jax.Array], ctx: Dict):
+        """Execute one PCG op into env — the shared body of the flat
+        interpreter and the remat segment functions."""
+        training = ctx["training"]
+        to_compute = ctx["to_compute"]
+        if (
+            op.op_type == OperatorType.CACHE
+            and getattr(op, "_load_cached", False)
+        ):
+            # replay the host-cached batch (reference load_cached
+            # forward, cache.cc:214-231), fed as an extra input
+            env[op.outputs[0].guid] = to_compute(
+                ctx["inputs"][f"__cache__{op.name}"]
+            )
+            return
+        if op.guid in self._block_guids:
+            if not ctx["pipeline_done"]:
+                out = self._run_pipeline_region(
+                    ctx["weights"], env, to_compute, training, ctx["rng"]
+                )
+                env[self.pipeline_plan.region_out_guid] = out
+                ctx["pipeline_done"] = True
+            return
+        if op.op_type == OperatorType.INPUT:
+            env[op.outputs[0].guid] = to_compute(ctx["inputs"][op.name])
+            return
+        ins = [env[t.guid] for t in op.inputs]
+        nt = _num_trainable(op)
+        ws: List[jax.Array] = []
+        for i, spec in enumerate(op.weight_specs):
+            src = ctx["weights"] if i < nt else ctx["state"]
+            ws.append(to_compute(src[op.name][spec.name]))
+        op_rng = None
+        if ctx["rng"] is not None:
+            op_rng = jax.random.fold_in(ctx["rng"], op.guid)
+        results = op.forward(ins, ws, training=training, rng=op_rng)
+        outs = results[: len(op.outputs)]
+        extra = results[len(op.outputs):]
+        if extra:
+            for spec, val in zip(op.weight_specs[nt:], extra):
+                ctx["new_state"][op.name][spec.name] = val.astype(
+                    ctx["state"][op.name][spec.name].dtype
+                )
+        aux = getattr(op, "_last_aux", None)
+        if aux is not None:
+            ctx["aux"].append(aux)
+            op._last_aux = None
+        for pt, val in zip(op.outputs, outs):
+            if self._use_constraints:
+                val = jax.lax.with_sharding_constraint(
+                    val, self.tensor_sharding(pt)
+                )
+            env[pt.guid] = val
 
     # -- pipeline region -------------------------------------------------
     def _run_pipeline_region(self, weights, env, to_compute, training, rng):

@@ -687,6 +687,7 @@ class FFModel:
             compute_dtype=(
                 cfg.compute_dtype if cfg.compute_dtype != "float32" else None
             ),
+            remat=cfg.remat,
             pipeline_plan=pipeline_plan,
         )
         # score hooks live on the FRONTEND ops (the user's handles);

@@ -5,9 +5,9 @@ fused_parallel_op}.cc — four primitives whose Legion partition copies
 perform all inter-device data movement (SURVEY §2.3).  TPU-first: these
 ops are **semantic identities** on the global logical array; what they
 change is the tensor's parallel shape (degrees/replica dims).  Lowering
-(flexflow_tpu/parallel/lowering.py) realizes each as a
-`lax.with_sharding_constraint` boundary, so XLA SPMD emits the actual
-collective:
+(view assignment in flexflow_tpu/parallel/machine.py, applied by the
+executor) realizes each as a `lax.with_sharding_constraint` boundary,
+so XLA SPMD emits the actual collective:
 
   Repartition -> sharding change (slice/all-to-all as needed)
   Combine     -> all-gather on the combined dim

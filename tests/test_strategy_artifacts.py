@@ -26,11 +26,9 @@ def test_shipped_strategy_loads_and_trains(devices8, name, builder, batch,
     s = Strategy.load(path)
     assert s.total_devices == 8
 
-    import search_strategies as S
-
     cfg = FFConfig(batch_size=batch, num_devices=8, **cfg_kw)
     ff = FFModel(cfg)
-    getattr(S, builder)(ff, cfg)
+    getattr(_SS, builder)(ff, cfg)
     ff.compile(optimizer=SGDOptimizer(lr=0.01),
                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
                strategy=s, devices=devices8)
