@@ -1,17 +1,19 @@
 """Benchmark: the BASELINE north star's two headline workloads on one chip.
 
-Leg 1 — BERT-base (12L, hidden 768, 12 heads, seq 128) trained from REAL
-token ids (embedding lookup -> encoder -> loss; `from_token_ids=True`),
-bf16, samples/sec/chip.
-Leg 2 — ResNet-50 (the torch.fx-imported bottleneck tower of
-examples/python/pytorch/resnet50_search.py, BASELINE.json configs[1])
-at 224px, bf16, compiled under the auto-searched strategy.
+Leg definitions are FROZEN in `bench_manifest.json` (version field bumps
+on any change, with the old->new delta explained in the leg's note) so
+round-over-round numbers stay comparable.
 
-Prints ONE JSON line; `legs` carries both workloads' numbers.
+Leg 1 — BERT-base trained from REAL token ids (embedding lookup ->
+encoder -> loss), bf16, samples/sec/chip.
+Leg 2 — ResNet-50 (the torch.fx-imported bottleneck tower of
+examples/python/pytorch/resnet50_search.py, BASELINE.json configs[1]),
+bf16, compiled under the auto-searched strategy, internal NHWC layout.
+Leg 3 — BERT-base at seq 2048: the long-context path.
+
+Prints ONE JSON line; `legs` carries all workloads' numbers.
 vs_baseline anchors to A100-NCCL per-GPU throughput (the reference repo
-publishes no absolute numbers, BASELINE.md:3-5): ~250 samples/s for
-BERT-base seq-128 fine-tune, ~2500 img/s for ResNet-50 mixed-precision
-training (DGX-A100 per-GPU MLPerf-era envelope).
+publishes no absolute numbers, BASELINE.md:3-5).
 """
 from __future__ import annotations
 
@@ -22,127 +24,53 @@ import time
 
 import numpy as np
 
-A100_BERT_BASE_SEQ128_SAMPLES_PER_SEC = 250.0
-A100_RESNET50_SAMPLES_PER_SEC = 2500.0
+_HERE = os.path.dirname(os.path.abspath(__file__))
+with open(os.path.join(_HERE, "bench_manifest.json")) as f:
+    MANIFEST = json.load(f)
+ANCHORS = MANIFEST["anchors"]
 
 
-def _steady_state(ff, inputs, labels, iters):
-    """Steady-state seconds for `iters` steps: device-resident batch,
-    long serial chain (each step consumes the previous step's donated
-    weights), one hard value fetch per window — under the axon tunnel,
-    block_until_ready alone returns early and per-step host round trips
-    add ~80ms the real (prefetched-dataloader) training never pays.
-    Two windows, best taken: one-off tunnel hiccups otherwise swing the
-    recorded number by ~10% run to run."""
-    import jax
+def _steady_state(ff, inputs, labels, iters, windows=None):
+    """Best-of-N windows of `iters` serial steps, ONE hard sync each.
+
+    The batch is device-resident and each step consumes the previous
+    step's donated weights, so the chain is serial on-device; fetching
+    the final loss drains it.  Window sizes are set in the manifest so
+    the single ~80ms tunnel round trip is <2% of the window
+    (manifest.timing.history records what the old 10-step/2-sync
+    windows cost r01/r02)."""
+    windows = windows or MANIFEST["timing"]["windows"]
 
     def window(n):
         t0 = time.perf_counter()
         for _ in range(n):
             m = ff.train_step(inputs, labels)
-        _ = float(m["loss"])
-        _ = np.asarray(jax.tree.leaves(ff._weights)[0]).ravel()[0]
+        _ = float(m["loss"])  # one hard sync: drains the serial chain
         return time.perf_counter() - t0
 
-    half = max(1, iters // 2)
-    best = min(window(half) / half, window(half) / half)
-    return best * iters
+    best = min(window(iters) for _ in range(windows))
+    return best / iters  # seconds per step
 
 
-def bench_bert(dev, on_tpu):
+def _build_bert_leg(dev, on_tpu, leg):
     import jax
 
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
     from flexflow_tpu.models.transformer import build_bert
 
     if on_tpu:
-        batch, seq, hidden, layers, heads, inter = 64, 128, 768, 12, 12, 3072
+        batch, seq = leg["batch"], leg["seq"]
+        hidden, layers = leg["hidden"], leg["layers"]
+        heads, inter = leg["heads"], leg["intermediate"]
+        iters = leg["iters"]
     else:
-        batch, seq, hidden, layers, heads, inter = 8, 32, 64, 2, 4, 128
+        batch, seq, hidden, layers, heads, inter, iters = 8, 32, 64, 2, 4, 128, 3
 
     cfg = FFConfig(batch_size=batch, num_devices=1,
-                   compute_dtype="bfloat16" if on_tpu else "float32")
+                   compute_dtype=leg["dtype"] if on_tpu else "float32")
     ff = FFModel(cfg)
     build_bert(ff, batch_size=batch, seq_length=seq, hidden_size=hidden,
                num_layers=layers, num_heads=heads, intermediate_size=inter,
-               from_token_ids=True)
-    ff.compile(
-        optimizer=SGDOptimizer(lr=0.01),
-        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
-        devices=[dev],
-    )
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, 30522, size=(batch, seq)).astype(np.int32)
-    y = rng.randint(0, 2, batch).astype(np.int32)
-    ids = jax.device_put(ids, ff.executor.input_shardings()["input"])
-    y = jax.device_put(y, ff.executor.label_sharding())
-
-    print("bench[bert]: compiled, warming up", file=sys.stderr)
-    t_c = time.perf_counter()
-    for _ in range(3):
-        m = ff.train_step({"input": ids}, y)
-    _ = float(m["loss"])
-    print(f"bench[bert]: warmup {time.perf_counter()-t_c:.1f}s",
-          file=sys.stderr)
-    iters = 50 if on_tpu else 5
-    dt = _steady_state(ff, {"input": ids}, y, iters)
-    sps = iters * batch / dt
-    leg = {
-        "workload": f"BERT-base seq{seq} b{batch} token-ids train, bf16",
-        "samples_per_sec_per_chip": round(sps, 2),
-        "vs_a100": round(sps / A100_BERT_BASE_SEQ128_SAMPLES_PER_SEC, 4),
-    }
-    if on_tpu:
-        # simulator fidelity: measured-cost-calibrated per-op model vs
-        # the real fused step (reference validates measure_operator_cost
-        # against execution; XLA fusion makes per-op sums conservative —
-        # the ratio is reported, not hidden)
-        try:
-            from flexflow_tpu.profiler import make_measure_fn
-            from flexflow_tpu.sim.machine_model import (
-                TpuPodModel,
-                detect_device_spec,
-            )
-            from flexflow_tpu.sim.simulator import OpCostModel, Simulator
-
-            machine = TpuPodModel(topology=(1,),
-                                  device=detect_device_spec())
-            cm = OpCostModel(machine,
-                             measure_fn=make_measure_fn(device=dev))
-            res = Simulator(machine, cm).simulate(
-                ff.operators, {"data": 1}, training=True
-            )
-            actual_ms = dt / iters * 1e3
-            leg["predicted_step_ms"] = round(res.total_time * 1e3, 2)
-            leg["actual_step_ms"] = round(actual_ms, 2)
-            leg["predicted_vs_actual"] = round(
-                res.total_time * 1e3 / actual_ms, 3
-            )
-        except Exception as e:  # pragma: no cover - diagnostics only
-            print(f"bench[bert]: prediction check failed: {e}",
-                  file=sys.stderr)
-    return leg
-
-
-def bench_bert_long(dev, on_tpu):
-    """Long-context leg: BERT-base at seq 2048 — the memory-efficient
-    attention path (XLA's fused flash-style rewrite; ring attention
-    takes over across chips via the sp strategy)."""
-    import jax
-
-    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
-    from flexflow_tpu.models.transformer import build_bert
-
-    if on_tpu:
-        batch, seq = 8, 2048
-    else:
-        batch, seq = 2, 128
-    cfg = FFConfig(batch_size=batch, num_devices=1,
-                   compute_dtype="bfloat16" if on_tpu else "float32")
-    ff = FFModel(cfg)
-    build_bert(ff, batch_size=batch, seq_length=seq, hidden_size=768,
-               num_layers=12 if on_tpu else 2, num_heads=12,
-               intermediate_size=3072 if on_tpu else 128,
                from_token_ids=True)
     ff.compile(
         optimizer=SGDOptimizer(lr=0.01),
@@ -156,42 +84,90 @@ def bench_bert_long(dev, on_tpu):
     )
     y = jax.device_put(rng.randint(0, 2, batch).astype(np.int32),
                        ff.executor.label_sharding())
-    print("bench[bert-long]: compiled, warming up", file=sys.stderr)
     for _ in range(3):
         m = ff.train_step({"input": ids}, y)
     _ = float(m["loss"])
-    iters = 20 if on_tpu else 3
     dt = _steady_state(ff, {"input": ids}, y, iters)
-    tokens_per_sec = iters * batch * seq / dt
+    return ff, batch, seq, dt
+
+
+def bench_bert(dev, on_tpu):
+    leg = MANIFEST["legs"]["bert_base"]
+    print("bench[bert]: compiling", file=sys.stderr)
+    ff, batch, seq, dt = _build_bert_leg(dev, on_tpu, leg)
+    sps = batch / dt
+    out = {
+        "workload": f"BERT-base seq{seq} b{batch} token-ids train, bf16",
+        "samples_per_sec_per_chip": round(sps, 2),
+        "vs_a100": round(
+            sps / ANCHORS["a100_bert_base_seq128_samples_per_sec"], 4
+        ),
+    }
+    if on_tpu:
+        # simulator fidelity: measured-cost-calibrated model vs the real
+        # fused step (reference validates measure_operator_cost against
+        # execution; the ratio is reported, not hidden)
+        try:
+            from flexflow_tpu.profiler import make_measure_fn
+            from flexflow_tpu.sim.machine_model import (
+                TpuPodModel,
+                detect_device_spec,
+            )
+            from flexflow_tpu.sim.simulator import OpCostModel, Simulator
+
+            machine = TpuPodModel(topology=(1,), device=detect_device_spec())
+            cm = OpCostModel(machine, measure_fn=make_measure_fn(device=dev))
+            res = Simulator(machine, cm).simulate(
+                ff.operators, {"data": 1}, training=True
+            )
+            actual_ms = dt * 1e3
+            out["predicted_step_ms"] = round(res.total_time * 1e3, 2)
+            out["actual_step_ms"] = round(actual_ms, 2)
+            out["predicted_vs_actual"] = round(
+                res.total_time * 1e3 / actual_ms, 3
+            )
+        except Exception as e:  # pragma: no cover - diagnostics only
+            print(f"bench[bert]: prediction check failed: {e}",
+                  file=sys.stderr)
+    return out
+
+
+def bench_bert_long(dev, on_tpu):
+    leg = MANIFEST["legs"]["bert_long_context"]
+    print("bench[bert-long]: compiling", file=sys.stderr)
+    ff, batch, seq, dt = _build_bert_leg(dev, on_tpu, leg)
     dtype = "bf16" if on_tpu else "f32"
     return {
         "workload": f"BERT-base seq{seq} b{batch} long-context train, {dtype}",
-        "samples_per_sec_per_chip": round(iters * batch / dt, 2),
-        "tokens_per_sec_per_chip": round(tokens_per_sec, 0),
+        "samples_per_sec_per_chip": round(batch / dt, 2),
+        "tokens_per_sec_per_chip": round(batch * seq / dt, 0),
     }
 
 
 def bench_resnet50(dev, on_tpu):
     import jax
 
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
-                                    "examples", "python", "pytorch"))
+    sys.path.insert(0, os.path.join(_HERE, "examples", "python", "pytorch"))
     from resnet50_search import ResNet50
 
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
     from flexflow_tpu.torch_frontend.model import PyTorchModel
 
+    leg = MANIFEST["legs"]["resnet50"]
     if on_tpu:
-        batch, px, classes = 64, 224, 1000
+        batch, px, classes, iters = (
+            leg["batch"], leg["px"], leg["classes"], leg["iters"]
+        )
     else:
-        batch, px, classes = 4, 32, 10
+        batch, px, classes, iters = 4, 32, 10, 3
 
     # auto-searched strategy per BASELINE.json configs[1] (single chip:
     # the search degenerates to the trivial mesh but the path runs;
     # calibration off keeps the bench inside its time box)
-    cfg = FFConfig(batch_size=batch, num_devices=1, search_budget=1000,
-                   search_algo="mcmc", search_calibrate=False,
-                   compute_dtype="bfloat16" if on_tpu else "float32")
+    cfg = FFConfig(batch_size=batch, num_devices=1,
+                   search_budget=leg["search_budget"],
+                   search_algo=leg["search_algo"], search_calibrate=False,
+                   compute_dtype=leg["dtype"] if on_tpu else "float32")
     ff = FFModel(cfg)
     x = ff.create_tensor([batch, 3, px, px], name="input")
     pt = PyTorchModel(ResNet50(classes=classes))
@@ -203,35 +179,32 @@ def bench_resnet50(dev, on_tpu):
         devices=[dev],
     )
     rng = np.random.RandomState(0)
-    xs = rng.randn(batch, 3, px, px).astype(np.float32)
-    ys = rng.randint(0, classes, batch).astype(np.int32)
-    xs = jax.device_put(xs, ff.executor.input_shardings()["input"])
-    ys = jax.device_put(ys, ff.executor.label_sharding())
+    xs = jax.device_put(rng.randn(batch, 3, px, px).astype(np.float32),
+                        ff.executor.input_shardings()["input"])
+    ys = jax.device_put(rng.randint(0, classes, batch).astype(np.int32),
+                        ff.executor.label_sharding())
 
     print("bench[resnet50]: compiled, warming up", file=sys.stderr)
-    t_c = time.perf_counter()
     for _ in range(3):
         m = ff.train_step({"input": xs}, ys)
     _ = float(m["loss"])
-    print(f"bench[resnet50]: warmup {time.perf_counter()-t_c:.1f}s",
-          file=sys.stderr)
-    iters = 20 if on_tpu else 3
     dt = _steady_state(ff, {"input": xs}, ys, iters)
-    sps = iters * batch / dt
+    sps = batch / dt
     return {
         "workload": f"ResNet-50 {px}px b{batch} fx-import train, bf16, "
-                    f"searched strategy",
+                    f"searched strategy, NHWC internal layout",
         "samples_per_sec_per_chip": round(sps, 2),
-        "vs_a100": round(sps / A100_RESNET50_SAMPLES_PER_SEC, 4),
+        "vs_a100": round(sps / ANCHORS["a100_resnet50_samples_per_sec"], 4),
     }
 
 
 def main():
+    import gc
+
     import jax
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    import gc
 
     bert = bench_bert(dev, on_tpu)
     gc.collect()  # drop the previous leg's weights/opt state from HBM
@@ -252,6 +225,7 @@ def main():
         "value": bert["samples_per_sec_per_chip"],
         "unit": "samples/s",
         "vs_baseline": round(geomean, 4) if on_tpu else 0.0,
+        "manifest_version": MANIFEST["version"],
         "legs": {"bert_base": bert, "resnet50": resnet,
                  "bert_long_context": bert_long},
     }

@@ -84,6 +84,17 @@ class GraphExecutor:
         # only boundary activations — the HBM/FLOPs trade the reference
         # cannot express (Legion keeps every region alive)
         self._remat_plan = self._build_remat_plan() if remat else None
+        # physical NHWC layout for CNN activations (pcg/layout.py): the
+        # logical shapes stay NCHW; conversions happen at exec time
+        from .pcg.layout import assign_layouts
+
+        self._t_layout, self._op_layout = assign_layouts(
+            graph, self._block_guids
+        )
+        for op in self.order:
+            op._data_layout = (
+                "nhwc" if self._op_layout.get(op.guid) == "nhwc" else "nchw"
+            )
 
     def _build_remat_plan(self):
         """[(ops, in_guids, out_guids, pure)] per segment.  Impure
@@ -125,6 +136,17 @@ class GraphExecutor:
     # -- shardings -------------------------------------------------------
     def tensor_sharding(self, pt) -> NamedSharding:
         return NamedSharding(self.mesh, view_to_spec(pt))
+
+    def _physical_sharding(self, pt) -> NamedSharding:
+        """Sharding for the value as stored in env: NHWC-stored tensors
+        get their logical NCHW spec permuted to match."""
+        from .pcg.layout import NHWC, TO_NHWC_PERM
+
+        spec = view_to_spec(pt)
+        if self._t_layout.get(pt.guid) == NHWC:
+            entries = list(spec) + [None] * (4 - len(spec))
+            spec = PartitionSpec(*(entries[i] for i in TO_NHWC_PERM))
+        return NamedSharding(self.mesh, spec)
 
     def weight_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
         out: Dict[str, Dict[str, NamedSharding]] = {}
@@ -281,6 +303,10 @@ class GraphExecutor:
             for op in self.order:
                 self._exec_op(op, env, state_ctx)
         out = env[self.sink.outputs[0].guid]
+        from .pcg.layout import NHWC, TO_NCHW_PERM
+
+        if self._t_layout.get(self.sink.outputs[0].guid) == NHWC:
+            out = jnp.transpose(out, TO_NCHW_PERM)  # callers see logical
         if self.compute_dtype is not None and jnp.issubdtype(out.dtype, jnp.floating):
             out = out.astype(jnp.float32)  # loss/metrics in full precision
         return out, new_state, aux_losses, env
@@ -311,7 +337,18 @@ class GraphExecutor:
         if op.op_type == OperatorType.INPUT:
             env[op.outputs[0].guid] = to_compute(ctx["inputs"][op.name])
             return
-        ins = [env[t.guid] for t in op.inputs]
+        from .pcg.layout import NHWC, TO_NCHW_PERM, TO_NHWC_PERM
+
+        want = self._op_layout.get(op.guid)
+        ins = []
+        for t in op.inputs:
+            v = env[t.guid]
+            have_nhwc = self._t_layout.get(t.guid) == NHWC
+            if want == "nhwc" and not have_nhwc and v.ndim == 4:
+                v = jnp.transpose(v, TO_NHWC_PERM)
+            elif want is None and have_nhwc:
+                v = jnp.transpose(v, TO_NCHW_PERM)
+            ins.append(v)
         nt = _num_trainable(op)
         ws: List[jax.Array] = []
         for i, spec in enumerate(op.weight_specs):
@@ -335,7 +372,7 @@ class GraphExecutor:
         for pt, val in zip(op.outputs, outs):
             if self._use_constraints:
                 val = jax.lax.with_sharding_constraint(
-                    val, self.tensor_sharding(pt)
+                    val, self._physical_sharding(pt)
                 )
             env[pt.guid] = val
 
@@ -350,6 +387,12 @@ class GraphExecutor:
         plan = self.pipeline_plan
         template = plan.blocks[0]
         act = env[plan.region_in_guid]
+        from .pcg.layout import NHWC, TO_NCHW_PERM
+
+        if self._t_layout.get(plan.region_in_guid) == NHWC:
+            # block template ops are pinned logical (assign_layouts skips
+            # block guids); materialize the region input to match
+            act = jnp.transpose(act, TO_NCHW_PERM)
         stacked = {
             k: to_compute(v) for k, v in weights["__pipeline__"].items()
         }
@@ -417,9 +460,17 @@ class GraphExecutor:
                     loss_val = loss_val + a
                 # cache taps: each Cache op's live input batch, handed
                 # to the host for ring/score accounting (reference
-                # cache_update task, cache.cc:180-231)
+                # cache_update task, cache.cc:180-231); materialized
+                # logical so the host ring never sees a physical layout
+                from .pcg.layout import NHWC, TO_NCHW_PERM
+
                 taps = {
-                    op.name: env[op.inputs[0].guid] for op in cache_ops
+                    op.name: (
+                        jnp.transpose(env[op.inputs[0].guid], TO_NCHW_PERM)
+                        if self._t_layout.get(op.inputs[0].guid) == NHWC
+                        else env[op.inputs[0].guid]
+                    )
+                    for op in cache_ops
                 }
                 return loss_val, (logits, new_state, taps)
 

@@ -191,16 +191,23 @@ class Conv2D(Op):
     def forward(self, inputs, weights, *, training=False, rng=None):
         (x,) = inputs
         p: Conv2DParams = self.params
+        # physical layout assigned by pcg/layout.py: NHWC puts channels
+        # on the MXU lanes (weights stay OIHW in the pytree; XLA folds
+        # the kernel relayout, which is tiny next to the activations)
+        nhwc = getattr(self, "_data_layout", "nchw") == "nhwc"
+        dn = ("NHWC", "OIHW", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
         y = lax.conv_general_dilated(
             x,
             weights[0],
             window_strides=p.stride,
             padding=[(p.padding[0], p.padding[0]), (p.padding[1], p.padding[1])],
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=dn,
             feature_group_count=p.groups,
         )
         if p.use_bias:
-            y = y + weights[1][None, :, None, None]
+            bias = weights[1]
+            y = y + (bias[None, None, None, :] if nhwc
+                     else bias[None, :, None, None])
         return [apply_activation(y, p.activation)]
 
     def flops(self):
@@ -248,9 +255,15 @@ class Pool2D(Op):
     def forward(self, inputs, weights, *, training=False, rng=None):
         (x,) = inputs
         p: Pool2DParams = self.params
-        pads = [(0, 0), (0, 0), (p.padding[0], p.padding[0]), (p.padding[1], p.padding[1])]
-        dims = (1, 1) + p.kernel
-        strides = (1, 1) + p.stride
+        hw_pads = [(p.padding[0], p.padding[0]), (p.padding[1], p.padding[1])]
+        if getattr(self, "_data_layout", "nchw") == "nhwc":
+            pads = [(0, 0)] + hw_pads + [(0, 0)]
+            dims = (1,) + p.kernel + (1,)
+            strides = (1,) + p.stride + (1,)
+        else:
+            pads = [(0, 0), (0, 0)] + hw_pads
+            dims = (1, 1) + p.kernel
+            strides = (1, 1) + p.stride
         if p.pool_type == "max":
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
             y = lax.reduce_window(x, init, lax.max, dims, strides, pads)

@@ -115,19 +115,40 @@ class BatchNorm(Op):
         (x,) = inputs
         p: BatchNormParams = self.params
         gamma, beta, rmean, rvar = weights
+        # channel position follows the physical layout (pcg/layout.py);
+        # NHWC keeps the reduction over the vector lanes
+        nhwc = getattr(self, "_data_layout", "nchw") == "nhwc"
+        axes = (0, 1, 2) if nhwc else (0, 2, 3)
+        bshape = (
+            (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+        )
         if training:
-            axes = (0, 2, 3)
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.mean(jnp.square(x - mean[None, :, None, None]), axis=axes)
-            new_rmean = p.momentum * rmean + (1 - p.momentum) * mean
-            new_rvar = p.momentum * rvar + (1 - p.momentum) * var
+            # one-pass stats (E[x^2] - E[x]^2): a single fused read of
+            # the activation instead of two; f32 accumulation so the
+            # subtraction stays stable under bf16 compute
+            mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+            # clamp: the subtraction can round negative for a
+            # near-constant channel with a large offset, and rsqrt of a
+            # negative poisons the step with NaN
+            var = jnp.maximum(
+                jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+                - jnp.square(mean),
+                0.0,
+            )
+            new_rmean = p.momentum * rmean + (1 - p.momentum) * mean.astype(
+                rmean.dtype
+            )
+            new_rvar = p.momentum * rvar + (1 - p.momentum) * var.astype(
+                rvar.dtype
+            )
         else:
             mean, var = rmean, rvar
             new_rmean, new_rvar = rmean, rvar
-        y = (x - mean[None, :, None, None]) * jax.lax.rsqrt(
-            var[None, :, None, None] + p.eps
-        )
-        y = y * gamma[None, :, None, None] + beta[None, :, None, None]
+        scale = gamma.astype(var.dtype) * jax.lax.rsqrt(var + p.eps)
+        shift = beta.astype(var.dtype) - mean * scale
+        y = x * scale.reshape(bshape).astype(x.dtype) + shift.reshape(
+            bshape
+        ).astype(x.dtype)
         if p.relu:
             y = jax.nn.relu(y)
         return [y.astype(x.dtype), new_rmean, new_rvar]

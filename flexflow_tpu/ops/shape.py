@@ -253,7 +253,12 @@ class Concat(Op):
         return [ParallelTensorShape(tuple(dims), first.dtype)]
 
     def forward(self, inputs, weights, *, training=False, rng=None):
-        return [jnp.concatenate(list(inputs), axis=self.params.axis)]
+        ax = self.params.axis
+        if getattr(self, "_data_layout", "nchw") == "nhwc":
+            from ..pcg.layout import NCHW_TO_NHWC_AXIS
+
+            ax = NCHW_TO_NHWC_AXIS[ax % 4]
+        return [jnp.concatenate(list(inputs), axis=ax)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -285,7 +290,12 @@ class Split(Op):
     def forward(self, inputs, weights, *, training=False, rng=None):
         x = inputs[0]
         idx = np.cumsum(self.params.sizes)[:-1]
-        return list(jnp.split(x, idx, axis=self.params.axis))
+        ax = self.params.axis
+        if getattr(self, "_data_layout", "nchw") == "nhwc":
+            from ..pcg.layout import NCHW_TO_NHWC_AXIS
+
+            ax = NCHW_TO_NHWC_AXIS[ax % len(x.shape)]
+        return list(jnp.split(x, idx, axis=ax))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,111 @@
+"""Internal NHWC physical layout for 4-D CNN activations.
+
+TPU convolutions want channels minormost: the MXU contracts over the
+last dim and the (8, 128) vector tiling puts lanes on channels, so an
+NCHW conv makes XLA wrap layout copies around every conv/pool/norm in
+the tower.  The reference keeps cuDNN's NCHW end to end
+(src/ops/conv_2d.cc); translating that literally costs ~2x on the conv
+forward (measured on-chip).  Instead the PCG keeps its logical NCHW
+shapes — reference API parity, shape rules untouched — and this pass
+assigns each 4-D activation edge a PHYSICAL layout:
+
+  * layout-preferring ops (Conv2D / Pool2D / BatchNorm) execute in NHWC
+    and emit NHWC;
+  * layout-agnostic pointwise ops (ElementUnary, Dropout, Cast, and
+    same-shape ElementBinary — the residual add) pass whatever arrives
+    straight through;
+  * axis-remappable ops (Concat / Split — the Inception branch joins)
+    stay in NHWC by remapping their axis at execution;
+  * every other consumer materializes logical NCHW.
+
+For a ResNet/Inception tower this inserts exactly one NCHW->NHWC
+transpose at the input and one NHWC->NCHW before the classifier head;
+the executor performs the conversions and permutes sharding specs for
+NHWC-stored tensors.
+"""
+from __future__ import annotations
+
+from typing import Dict, Set, Tuple
+
+from ..fftype import OperatorType
+
+LOGICAL = "nchw"
+NHWC = "nhwc"
+
+# logical NCHW axis -> physical NHWC position
+NCHW_TO_NHWC_AXIS = {0: 0, 1: 3, 2: 1, 3: 2}
+TO_NHWC_PERM = (0, 2, 3, 1)  # physical transpose logical->nhwc
+TO_NCHW_PERM = (0, 3, 1, 2)  # physical transpose nhwc->logical
+
+_PREFER = {OperatorType.CONV2D, OperatorType.POOL2D, OperatorType.BATCH_NORM}
+_AGNOSTIC = {OperatorType.ELEMENT_UNARY, OperatorType.DROPOUT,
+             OperatorType.CAST}
+_REMAP = {OperatorType.CONCAT, OperatorType.SPLIT}
+
+
+def _is_4d(pt) -> bool:
+    return pt.shape.logical_rank == 4
+
+
+def assign_layouts(
+    graph, block_guids: Set[int] = frozenset()
+) -> Tuple[Dict[int, str], Dict[int, str]]:
+    """One topo walk -> (tensor guid -> layout, op guid -> exec layout).
+
+    Tensor layouts: only NHWC entries are recorded; absent means
+    logical.  Op exec layouts: "nhwc" (executor converts 4-D inputs to
+    NHWC, forward runs with _data_layout="nhwc"), "pass" (pointwise —
+    inputs used exactly as stored), absent (logical — executor
+    materializes NCHW for any NHWC input).  Ops inside pipeline blocks
+    run their template forwards directly (executor
+    _run_pipeline_region), so they are pinned logical.
+    """
+    t_layout: Dict[int, str] = {}
+    op_layout: Dict[int, str] = {}
+    for op in graph.topo_order():
+        if op.guid in block_guids:
+            continue
+        ot = op.op_type
+        in_lay = [t_layout.get(t.guid, LOGICAL) for t in op.inputs]
+        if ot in _PREFER and op.inputs and all(_is_4d(t) for t in op.inputs):
+            op_layout[op.guid] = NHWC
+            for out in op.outputs:
+                if _is_4d(out):
+                    t_layout[out.guid] = NHWC
+        elif (
+            ot in _AGNOSTIC
+            and op.inputs
+            and _is_4d(op.inputs[0])
+            and in_lay[0] == NHWC
+        ):
+            # pointwise: value flows through in whatever layout it has
+            op_layout[op.guid] = "pass"
+            for out in op.outputs:
+                if _is_4d(out):
+                    t_layout[out.guid] = NHWC
+        elif (
+            ot == OperatorType.ELEMENT_BINARY
+            and len(op.inputs) == 2
+            and all(_is_4d(t) for t in op.inputs)
+            and op.inputs[0].shape.logical_shape
+            == op.inputs[1].shape.logical_shape
+            and all(l == NHWC for l in in_lay)
+        ):
+            # same-shape add/mul (residual join): no broadcasting, so the
+            # physical permutation is transparent
+            op_layout[op.guid] = "pass"
+            for out in op.outputs:
+                if _is_4d(out):
+                    t_layout[out.guid] = NHWC
+        elif (
+            ot in _REMAP
+            and op.inputs
+            and all(_is_4d(t) for t in op.inputs)
+            and all(_is_4d(t) for t in op.outputs)
+            and all(l == NHWC for l in in_lay)
+        ):
+            op_layout[op.guid] = NHWC
+            for out in op.outputs:
+                t_layout[out.guid] = NHWC
+        # else: logical — executor materializes NCHW for any NHWC input
+    return t_layout, op_layout

@@ -73,6 +73,11 @@ def measure_op_forward(
     through a tunneled runtime they return before execution finishes,
     and the per-call fetch latency would swamp microsecond kernels.
     """
+    # standalone inputs are built on the LOGICAL (NCHW) shapes; a
+    # compiled executor may have pinned this op to the physical NHWC
+    # layout (pcg/layout.py), so force logical for the measurement
+    saved_layout = getattr(op, "_data_layout", None)
+    op._data_layout = "nchw"
     try:
         key = jax.random.key(0)
         ins = []
@@ -128,6 +133,11 @@ def measure_op_forward(
         return (best - base) / (chain + 1)
     except Exception:
         return None
+    finally:
+        if saved_layout is None:
+            del op._data_layout
+        else:
+            op._data_layout = saved_layout
 
 
 def make_measure_fn(device=None, warmup: int = 1, repeats: int = 3,
