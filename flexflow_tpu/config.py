@@ -20,12 +20,15 @@ from .fftype import ParameterSyncType
 
 # single source of truth for the flash-attention crossover (see the
 # flash_min_seq field comment); attention ops fall back to this when
-# used outside FFModel.compile.  Effectively "XLA by default": measured
-# on-chip, XLA's fused attention beat the Pallas kernel at every length
-# tried (seq 128: 36.9 vs 47.9 ms/step; seq 8192: 163 vs 209 ms/step,
-# BERT-base-width, honest steady-state timing) — XLA applies its own
-# flash-style rewrite without materializing the score matrix.
-DEFAULT_FLASH_MIN_SEQ = 1 << 30
+# used outside FFModel.compile.  Measured on-chip (fwd+bwd, both
+# directions now real Pallas kernels, best-of-trials under a noisy
+# tunnel): seq 512/1024 XLA and flash tie within noise; seq 2048 flash
+# ~= XLA with none of the [s,s] score HBM traffic; seq 8192 flash wins
+# ~9x (63-124 ms vs 758-822 ms — XLA falls off the HBM cliff when the
+# score matrix stops fitting in fused form).  jax's bundled
+# pallas.ops.tpu.flash_attention measured 4-10x slower than this
+# kernel at every length on the same chip.
+DEFAULT_FLASH_MIN_SEQ = 2048
 
 
 @dataclasses.dataclass
