@@ -1,0 +1,129 @@
+"""Multi-host bring-up: the package-level equivalent of the reference's
+mpirun launcher path (/root/reference/python/flexflow/driver.py spawns
+`mpirun ... flexflow_python`; tests/multinode_helpers/mpi_wrapper1.sh
+wires per-rank env).  TPU-native there is no launcher to exec: every
+host runs the same script, `jax.distributed` joins them into one
+runtime, and XLA SPMD spans all chips.  This module owns that join plus
+the per-host batch-feeding helper the docs previously asked users to
+hand-write (docs/MULTI-NODE.md).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> bool:
+    """Join this process into the multi-host jax runtime.
+
+    Call first thing in the training script, before any other jax use.
+    Resolution order mirrors the launch recipes users actually have:
+
+      1. explicit args (manual bring-up / custom schedulers);
+      2. env vars ``FLEXFLOW_COORDINATOR`` / ``FLEXFLOW_NUM_PROCS`` /
+         ``FLEXFLOW_PROC_ID``, or the standard OMPI rank vars when
+         launched under mpirun (the reference's launcher convention);
+      3. no information at all -> ``jax.distributed.initialize()``,
+         which autodetects on Cloud TPU pods and is skipped entirely
+         when that autodetection cannot apply (single-process dev).
+
+    Returns True when a multi-process runtime was initialized, False
+    for the harmless single-process fallback.  Idempotent.
+    """
+    global _initialized
+    import jax
+
+    if _initialized:
+        return jax.process_count() > 1
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("FLEXFLOW_COORDINATOR")
+    if num_processes is None:
+        np_env = os.environ.get(
+            "FLEXFLOW_NUM_PROCS", os.environ.get("OMPI_COMM_WORLD_SIZE")
+        )
+        num_processes = int(np_env) if np_env else None
+    if process_id is None:
+        pid_env = os.environ.get(
+            "FLEXFLOW_PROC_ID", os.environ.get("OMPI_COMM_WORLD_RANK")
+        )
+        process_id = int(pid_env) if pid_env is not None else None
+
+    if coordinator_address is None and num_processes is not None \
+            and num_processes > 1:
+        raise ValueError(
+            "multi-process launch needs a coordinator: set "
+            "FLEXFLOW_COORDINATOR=<worker0-host:port> (or pass "
+            "coordinator_address=)"
+        )
+    if coordinator_address is not None:
+        # explicit configuration: a failure here must NOT degrade to N
+        # disjoint single-process runs — let it raise
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+        _initialized = True
+        return jax.process_count() > 1
+    try:
+        # TPU-pod autodetection path; no-op away from a pod
+        jax.distributed.initialize()
+        _initialized = True
+    except Exception:
+        # single-process dev environment (no cluster metadata): fine
+        _initialized = True
+        return False
+    return jax.process_count() > 1
+
+
+def shard_host_batch(
+    global_batch: Dict[str, np.ndarray],
+    shardings: Dict[str, object],
+):
+    """Assemble global device arrays from per-host data.
+
+    Each process holds (at least) the rows of the global batch that its
+    local devices own; `jax.make_array_from_process_local_data` takes
+    this host's slice and the global sharding and builds the global
+    array without any cross-host copy.  Single-host this degenerates to
+    a plain device_put.  Returns {name: global jax.Array}.
+    """
+    import jax
+
+    out = {}
+    for name, arr in global_batch.items():
+        sharding = shardings[name]
+        if jax.process_count() == 1:
+            out[name] = jax.device_put(arr, sharding)
+        else:
+            out[name] = jax.make_array_from_process_local_data(
+                sharding, arr
+            )
+    return out
+
+
+def local_batch_slice(global_batch_size: int) -> slice:
+    """Row range of the global batch this host should load (contiguous
+    batch-major layout, the SingleDataLoader convention): host i of P
+    feeds rows [i*B/P, (i+1)*B/P)."""
+    import jax
+
+    p, i = jax.process_count(), jax.process_index()
+    if global_batch_size % p != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} is not divisible by "
+            f"{p} processes — rows would be silently dropped"
+        )
+    per = global_batch_size // p
+    return slice(i * per, (i + 1) * per)

@@ -202,6 +202,7 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
                 0.7 if cfg.search_overlap_backward_update else None
             ),
             parameter_sync=_sync_mode(cfg.parameter_sync),
+            remat=cfg.remat,
         )
 
     search = MCMCSearch(

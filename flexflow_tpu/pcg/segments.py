@@ -29,6 +29,17 @@ def external_inputs(ops: List[Op]) -> List[int]:
     return out
 
 
+def last_use_positions(topo: List[Op]) -> Dict[int, int]:
+    """tensor guid -> topo position of its last consumer (shared by the
+    segment splitter and the simulator's liveness scan)."""
+    pos = {op.guid: i for i, op in enumerate(topo)}
+    last_use: Dict[int, int] = {}
+    for op in topo:
+        for t in op.inputs:
+            last_use[t.guid] = max(last_use.get(t.guid, -1), pos[op.guid])
+    return last_use
+
+
 def split_segments(graph: Graph) -> Tuple[List[List[Op]], List[Optional[int]]]:
     """Split topo order at single-tensor cuts.
 
@@ -36,11 +47,7 @@ def split_segments(graph: Graph) -> Tuple[List[List[Op]], List[Optional[int]]]:
     segment k+1 through exactly one tensor (the bottleneck); the final
     boundary is None."""
     topo = graph.topo_order()
-    pos = {op.guid: i for i, op in enumerate(topo)}
-    last_use: Dict[int, int] = {}
-    for op in topo:
-        for t in op.inputs:
-            last_use[t.guid] = max(last_use.get(t.guid, -1), pos[op.guid])
+    last_use = last_use_positions(topo)
     cuts: List[Tuple[int, int]] = []  # (topo position, crossing tensor guid)
     for i in range(len(topo) - 1):
         crossing = [

@@ -97,6 +97,7 @@ class UnitySearch:
         parameter_sync: str = "allreduce",
         max_assignments: Optional[int] = None,
         enable_sample_parallel: bool = False,
+        remat: bool = False,
     ):
         self.event_rerank = event_rerank
         self.event_topk = event_topk
@@ -133,11 +134,13 @@ class UnitySearch:
         self._options_memo: Dict[Tuple, Dict[int, List[XferChoice]]] = {}
         from ..sim.simulator import Simulator
 
+        self.remat = remat
         self._sim = Simulator(machine, cost_model,
                               overlap_fraction=overlap_fraction,
                               optimizer_slots=optimizer_slots,
                               sync_overlap_fraction=sync_overlap_fraction,
-                              parameter_sync=parameter_sync)
+                              parameter_sync=parameter_sync,
+                              remat=remat)
 
     # ------------------------------------------------------------------
     # graph splitting (reference find_split_node substitution.cc:2094)
@@ -1123,7 +1126,8 @@ class UnitySearch:
         g = apply_strategy(base, strategy)
         assign_views(g, strategy.mesh_axes)
         sim = Simulator(self.machine, self.cost_model,
-                        optimizer_slots=self.optimizer_slots)
+                        optimizer_slots=self.optimizer_slots,
+                        remat=self.remat)
         op_scale = None
         if strategy.pipeline:
             # each device holds only its stage's 1/S of the block stack
@@ -1184,6 +1188,7 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         parameter_sync=_sync_mode(cfg.parameter_sync),
         max_assignments=cfg.simulator_segment_size,
         enable_sample_parallel=cfg.enable_sample_parallel,
+        remat=cfg.remat,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()

@@ -61,6 +61,37 @@ class SimResult:
 
 _KERNEL_OVERHEAD = 2e-6  # per-op dispatch/fusion overhead (XLA fuses, small)
 
+# backward/forward cost ratio per op class (replaces the old flat 2x:
+# conv/matmul backward really is two same-size contractions, but an
+# embedding backward is one gradient scatter with no input grad, and
+# elementwise/pool/softmax backward is a single pass like forward)
+_BWD_RATIO_DEFAULT = 2.0
+_BWD_RATIO = {
+    OperatorType.CONV2D: 2.0,
+    OperatorType.LINEAR: 2.0,
+    OperatorType.BATCH_MATMUL: 2.0,
+    # flash backward recomputes scores in both the dq and dkv kernels
+    OperatorType.MULTIHEAD_ATTENTION: 2.5,
+    OperatorType.EMBEDDING: 1.0,
+    OperatorType.BATCH_NORM: 1.5,
+    OperatorType.LAYER_NORM: 1.5,
+    OperatorType.POOL2D: 1.0,
+    OperatorType.SOFTMAX: 1.0,
+    OperatorType.DROPOUT: 1.0,
+    OperatorType.CAST: 1.0,
+    OperatorType.ELEMENT_UNARY: 1.0,
+    OperatorType.ELEMENT_BINARY: 1.0,
+    OperatorType.CONCAT: 1.0,
+    OperatorType.SPLIT: 1.0,
+    OperatorType.FLAT: 0.5,
+    OperatorType.RESHAPE: 0.5,
+    OperatorType.TRANSPOSE: 1.0,
+}
+
+
+def backward_ratio(op: Op) -> float:
+    return _BWD_RATIO.get(op.op_type, _BWD_RATIO_DEFAULT)
+
 
 class OpCostModel:
     """(node_key)->cost cache with analytic roofline + measured override.
@@ -140,7 +171,7 @@ class OpCostModel:
         if measured is not None:
             self.measured_hits += 1
             cm.forward_time = measured
-            cm.backward_time = 2.0 * measured
+            cm.backward_time = backward_ratio(op) * measured
         self.cache[key] = cm
         return cm
 
@@ -182,7 +213,9 @@ class OpCostModel:
         fwd = max(t_compute, t_mem) + _KERNEL_OVERHEAD
         return CostMetrics(
             forward_time=fwd,
-            backward_time=2.0 * fwd if op.weights or op.inputs else 0.0,
+            backward_time=(
+                backward_ratio(op) * fwd if op.weights or op.inputs else 0.0
+            ),
             inputs_memory=in_bytes,
             outputs_memory=out_bytes,
             weights_memory=w_bytes,
@@ -241,11 +274,14 @@ class Simulator:
         optimizer_slots: int = 2,  # adam m+v
         sync_overlap_fraction: Optional[float] = None,
         parameter_sync: str = "allreduce",
+        remat: bool = False,
     ):
         self.machine = machine
         self.cost_model = cost_model or OpCostModel(machine)
         self.overlap_fraction = overlap_fraction
         self.optimizer_slots = optimizer_slots
+        # executor --remat: checkpointed segments change peak memory
+        self.remat = remat
         # gradient-sync overlap with remaining backward compute
         # (reference --search-overlap-backward-update, config.h:130):
         # None -> same credit as other comm
@@ -360,23 +396,123 @@ class Simulator:
         return total
 
     # -- memory ----------------------------------------------------------
+
+    #: outputs XLA recomputes inside fusions rather than materializing
+    #: as backward residuals — they cost transient workspace, not
+    #: step-long liveness
+    _FUSED_ACT_TYPES = frozenset({
+        OperatorType.ELEMENT_UNARY, OperatorType.ELEMENT_BINARY,
+        OperatorType.CAST, OperatorType.DROPOUT,
+    })
+
     def per_device_memory(self, graph: Graph, training: bool = True,
-                          op_scale=None) -> int:
-        """op_scale(op) -> float scales an op's contribution (pipeline
+                          op_scale=None, remat: Optional[bool] = None) -> int:
+        """Peak per-device bytes: weights (+grads+optimizer slots when
+        training) plus LIVE activations, not the sum of every tensor
+        ever produced (the r02 model summed all of them, so
+        memory_search optimized a systematically inflated objective).
+
+          * training, no remat: backward residuals = outputs of
+            non-fused ops persist to their backward; fused elementwise
+            outputs only cost transient workspace (max single one);
+          * training, remat: only single-tensor segment boundaries
+            persist (jax.checkpoint semantics, executor._build_remat_plan)
+            plus the largest segment's internals for recomputation;
+          * inference: a liveness scan — a tensor dies after its last
+            consumer.
+
+        op_scale(op) -> float scales an op's contribution (pipeline
         strategies pass 1/num_stages for block ops — each device holds
         only its stage's weights/activations)."""
-        weights = 0.0
-        acts = 0.0
-        for op in graph.ops:
-            s = op_scale(op) if op_scale is not None else 1.0
-            for w in op.weights:
-                weights += w.shape.shard_bytes() * s
-            for t in op.outputs:
-                acts += t.shape.shard_bytes() * s
+        remat = self.remat if remat is None else remat
+        scale = (lambda op: op_scale(op)) if op_scale is not None \
+            else (lambda op: 1.0)
+        weights = sum(
+            w.shape.shard_bytes() * scale(op)
+            for op in graph.ops for w in op.weights
+        )
         if training:
-            # grads + optimizer slots for weights; activations live for bwd
-            weights = weights * (2 + self.optimizer_slots)
+            # master copy + grads + optimizer slots
+            weights *= (2 + self.optimizer_slots)
+
+        if not training:
+            acts = self._liveness_peak(graph, scale)
+        elif remat:
+            acts = self._remat_peak(graph, scale)
+        else:
+            residuals = 0.0
+            transient = 0.0
+            for op in graph.ops:
+                for t in op.outputs:
+                    b = t.shape.shard_bytes() * scale(op)
+                    if op.op_type in self._FUSED_ACT_TYPES:
+                        transient = max(transient, b)
+                    else:
+                        residuals += b
+            acts = residuals + transient
         return int(weights + acts)
+
+    def _liveness_peak(self, graph: Graph, scale) -> float:
+        from ..pcg.segments import last_use_positions
+
+        topo = graph.topo_order()
+        last_use = last_use_positions(topo)
+        bytes_of: Dict[int, float] = {
+            t.guid: t.shape.shard_bytes() * scale(op)
+            for op in topo for t in op.outputs
+        }
+        live = peak = 0.0
+        for i, op in enumerate(topo):
+            for t in op.outputs:
+                live += bytes_of[t.guid]
+            peak = max(peak, live)
+            for t in op.inputs:
+                if last_use.get(t.guid) == i:
+                    live -= bytes_of.get(t.guid, 0.0)
+        return peak
+
+    def _remat_peak(self, graph: Graph, scale) -> float:
+        from ..pcg.segments import split_segments
+
+        impure = {OperatorType.INPUT, OperatorType.CACHE,
+                  OperatorType.GROUP_BY, OperatorType.AGGREGATE,
+                  OperatorType.AGGREGATE_SPEC}
+        segments, boundaries = split_segments(graph)
+        boundary_guids = {g for g in boundaries if g is not None}
+        bytes_of = {
+            t.guid: t.shape.shard_bytes() * scale(op)
+            for op in graph.ops for t in op.outputs
+        }
+        acts = sum(bytes_of[g] for g in boundary_guids)
+        worst_internal = 0.0
+        for seg in segments:
+            pure = all(op.op_type not in impure for op in seg)
+            internal = sum(
+                bytes_of[t.guid]
+                for op in seg for t in op.outputs
+                if t.guid not in boundary_guids
+                and op.op_type not in self._FUSED_ACT_TYPES
+            )
+            if pure:
+                # recomputed in backward: alive only while this
+                # segment's backward runs
+                worst_internal = max(worst_internal, internal)
+            else:
+                acts += internal  # runs inline, residuals persist
+        return acts + worst_internal
+
+    def optimizer_update_cost(self, graph: Graph) -> float:
+        """Weight-update pass: read master weight + grad, write weight,
+        touch each optimizer slot — pure HBM traffic in f32 (master
+        precision), one fused kernel under jit."""
+        numel = 0.0
+        for op in graph.ops:
+            for w in op.weights:
+                if w.create_gradients:
+                    sb = w.shape.shard_bytes()
+                    numel += sb / max(1, np.dtype(w.shape.dtype.np_dtype).itemsize)
+        bytes_moved = numel * 4.0 * (3 + self.optimizer_slots)
+        return bytes_moved / self.machine.device().hbm_bandwidth
 
     # -- top level -------------------------------------------------------
     def simulate(
@@ -384,8 +520,20 @@ class Simulator:
         graph: Graph,
         mesh_axes: Dict[str, int],
         training: bool = True,
+        segment_costs: Optional[Sequence[Tuple[Sequence[int], float]]] = None,
     ) -> SimResult:
-        compute = 0.0
+        """segment_costs: [(member op guids, fwd+bwd seconds)] from
+        profiler.measure_segment_costs — ops inside a measured region
+        take the measurement (fused-granularity calibration); everything
+        else stays analytic."""
+        measured_ops: Dict[int, float] = {}  # op guid -> its region's cost
+        seg_cost_total = 0.0
+        if segment_costs:
+            for guids, c in segment_costs:
+                seg_cost_total += c
+                for g in guids:
+                    measured_ops[g] = c
+        compute = seg_cost_total if training else seg_cost_total / 3.0
         comm = 0.0
         breakdown: Dict[str, float] = {}
         for op in graph.topo_order():
@@ -396,14 +544,19 @@ class Simulator:
                 comm += c
                 breakdown[op.name] = c
                 continue
-            cm = self.cost_model.cost(op)
-            t = cm.forward_time + (cm.backward_time if training else 0.0)
-            compute += t
             ps = self.partial_sum_cost(op, mesh_axes)
             if training and ps:
                 ps *= 2.0  # fwd psum + bwd mirrored all-gather/psum
             comm += ps
+            if op.guid in measured_ops:
+                breakdown[op.name] = ps
+                continue
+            cm = self.cost_model.cost(op)
+            t = cm.forward_time + (cm.backward_time if training else 0.0)
+            compute += t
             breakdown[op.name] = t + ps
+        if training:
+            compute += self.optimizer_update_cost(graph)
         sync = self.grad_sync_cost(graph, mesh_axes) if training else 0.0
         # XLA overlaps collectives with independent compute; gradient
         # sync gets its own credit when backward/update overlap is

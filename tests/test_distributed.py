@@ -1,0 +1,44 @@
+"""flexflow_tpu.distributed: multi-host bring-up helpers.
+
+Reference counterpart: python/flexflow/driver.py (mpirun launcher) +
+MULTI-NODE.md.  Single-process here; the per-host batch assembly runs
+against a real 8-device mesh sharding, and the env-var resolution is
+exercised without touching the network.
+"""
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from flexflow_tpu import distributed
+from flexflow_tpu.parallel.machine import make_mesh
+
+
+def test_initialize_single_process_fallback(monkeypatch):
+    monkeypatch.setattr(distributed, "_initialized", False)
+    assert distributed.initialize() is False  # one process -> False
+    # idempotent second call
+    assert distributed.initialize() is False
+
+
+def test_initialize_requires_coordinator(monkeypatch):
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.setenv("FLEXFLOW_NUM_PROCS", "4")
+    with pytest.raises(ValueError, match="coordinator"):
+        distributed.initialize()
+
+
+def test_shard_host_batch_against_global_sharding(devices8):
+    mesh = make_mesh({"data": 8}, devices8)
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    out = distributed.shard_host_batch({"input": x}, {"input": sharding})
+    arr = out["input"]
+    assert arr.shape == (16, 4)
+    assert arr.sharding == sharding
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # each device holds a 2-row shard
+    assert {s.data.shape for s in arr.addressable_shards} == {(2, 4)}
+
+
+def test_local_batch_slice_single_host():
+    assert distributed.local_batch_slice(64) == slice(0, 64)
