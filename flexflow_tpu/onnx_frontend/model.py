@@ -5,9 +5,9 @@ onnx protobuf graph and dispatches per node.op_type (handle_conv,
 handle_gemm/handle_matmul, handle_relu, handle_maxpool, handle_concat,
 handle_flatten, handle_add, ...).  Same design here: one handler per
 op_type string; initializer tensors become weights copied in after
-compile.  Gated: raises ImportError at construction when the `onnx`
-package is absent (it is not baked into this image — export models via
-the torch frontend instead).
+compile.  Parsing prefers the `onnx` package when installed and falls
+back to the vendored wire-format codec (protowire.py) otherwise, so
+serialized .onnx files import in dependency-free environments too.
 """
 from __future__ import annotations
 
@@ -18,14 +18,18 @@ import numpy as np
 from ..fftype import ActiMode
 from ..model import FFModel
 from ..tensor import ParallelTensor
+from . import protowire
 
 
 def _attrs(node) -> Dict[str, object]:
-    import onnx
-
     out = {}
     for a in node.attribute:
-        out[a.name] = onnx.helper.get_attribute_value(a)
+        if isinstance(a, protowire.Attribute):
+            out[a.name] = a.value
+        else:
+            import onnx
+
+            out[a.name] = onnx.helper.get_attribute_value(a)
     return out
 
 
@@ -33,22 +37,27 @@ class ONNXModel:
     def __init__(self, path_or_model):
         try:
             import onnx
-        except ImportError as e:  # pragma: no cover - onnx not in image
-            raise ImportError(
-                "the onnx package is required for the ONNX frontend; "
-                "this image does not bake it in — use the torch.fx "
-                "frontend (flexflow_tpu.torch_frontend) instead"
-            ) from e
-        if isinstance(path_or_model, (str, bytes)):
-            self.model = onnx.load(path_or_model)
+            import onnx.numpy_helper
+        except ImportError:
+            onnx = None
+        if isinstance(path_or_model, str):
+            self.model = (onnx.load(path_or_model) if onnx is not None
+                          else protowire.load_model(path_or_model))
+        elif isinstance(path_or_model, bytes):
+            self.model = (onnx.ModelProto.FromString(path_or_model)
+                          if onnx is not None
+                          else protowire.load_model(path_or_model))
         else:
             self.model = path_or_model
         self.graph = self.model.graph
         self.initializers: Dict[str, np.ndarray] = {}
-        import onnx.numpy_helper
-
         for init in self.graph.initializer:
-            self.initializers[init.name] = onnx.numpy_helper.to_array(init)
+            if isinstance(init, protowire.Tensor):
+                self.initializers[init.name] = init.array
+            else:
+                self.initializers[init.name] = onnx.numpy_helper.to_array(
+                    init
+                )
         self._weight_of_op: Dict[str, Dict[str, np.ndarray]] = {}
 
     # ------------------------------------------------------------------

@@ -389,18 +389,22 @@ def measure_segment_costs(
                 traceback.print_exc()
             return None, []
 
+    measured_regions = []
     for region in regions:
         t, member = _measure_region(region, chain)
         if t is not None:
             results.append((member, t))
+            measured_regions.append(region)
 
     # Renormalize: sums of per-region chains systematically undershoot
     # the one-program cost (per-cut scheduling/fusion effects the chain
-    # cannot see — measured ~0.8 ms/cut on BERT-base).  One whole-graph
-    # measurement with the same harness pins the absolute scale; the
-    # regions keep the relative attribution.
+    # cannot see — measured ~0.8 ms/cut on BERT-base).  One measurement
+    # of the UNION OF SUCCESSFUL regions with the same harness pins the
+    # absolute scale (failed regions stay analytic in the simulator —
+    # including them here would charge their cost twice); the regions
+    # keep the relative attribution.
     if len(results) > 1:
-        whole = [op for r in regions for op in r]
+        whole = [op for r in measured_regions for op in r]
         t_whole, _ = _measure_region(whole, max(8, chain // 4))
         s = sum(c for _, c in results)
         if t_whole is not None and s > 0:
