@@ -1,0 +1,62 @@
+"""Functional Keras CIFAR-10 CNN with callbacks.
+
+Mirrors the reference's examples/python/keras/func_cifar10_cnn.py
+(Conv-Conv-Pool x2 -> Dense head trained with SGD) plus the callback
+tier (LearningRateScheduler + EarlyStopping).  The dataset loader
+serves the real CIFAR-10 when a cache is present and class-structured
+synthetic images otherwise (no-egress images).
+
+Run: python func_cifar10_cnn.py [-e EPOCHS] [-b BATCH] [--num-samples N]
+"""
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.keras import (
+    Conv2D,
+    Dense,
+    EarlyStopping,
+    Flatten,
+    Input,
+    LearningRateScheduler,
+    MaxPooling2D,
+    Model,
+    datasets,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=4)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--num-samples", type=int, default=2048)
+    args, _ = p.parse_known_args()
+
+    (x_train, y_train), _ = datasets.cifar10.load_data(args.num_samples)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.ravel().astype(np.int32)
+
+    inp = Input(shape=(3, 32, 32))
+    t = Conv2D(32, (3, 3), padding="same", activation="relu")(inp)
+    t = Conv2D(32, (3, 3), padding="same", activation="relu")(t)
+    t = MaxPooling2D((2, 2), strides=(2, 2))(t)
+    t = Conv2D(64, (3, 3), padding="same", activation="relu")(t)
+    t = Conv2D(64, (3, 3), padding="same", activation="relu")(t)
+    t = MaxPooling2D((2, 2), strides=(2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(256, activation="relu")(t)
+    out = Dense(10, activation="softmax")(t)
+
+    model = Model(inp, out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=args.batch_size)
+
+    callbacks = [
+        LearningRateScheduler(lambda epoch, lr: lr * (0.9 ** epoch)),
+        EarlyStopping(monitor="accuracy", patience=3),
+    ]
+    model.fit(x_train, y_train, epochs=args.epochs, callbacks=callbacks)
+
+
+if __name__ == "__main__":
+    main()

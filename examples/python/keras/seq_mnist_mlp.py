@@ -1,0 +1,37 @@
+"""Sequential Keras MNIST MLP (reference examples/python/keras/
+seq_mnist_mlp.py shape): Dense stack with dropout, Adam optimizer.
+
+Run: python seq_mnist_mlp.py [-e EPOCHS] [-b BATCH] [--num-samples N]
+"""
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.keras import Dense, Dropout, Sequential, datasets
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=3)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--num-samples", type=int, default=4096)
+    args, _ = p.parse_known_args()
+
+    (x_train, y_train), _ = datasets.mnist.load_data(args.num_samples)
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+
+    model = Sequential([
+        Dense(256, activation="relu"),
+        Dropout(0.2),
+        Dense(128, activation="relu"),
+        Dense(10, activation="softmax"),
+    ], input_shape=(784,))
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

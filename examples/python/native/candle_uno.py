@@ -9,10 +9,21 @@ INPUT_DIMS = (942, 5270, 2048)
 
 
 def main():
-    cfg = FFConfig.from_args()
+    import argparse
+
+    # model-size knobs on top of the FFConfig flag set (reference
+    # candle_uno.cc defaults are 4192-wide stacks — ~485M params, too
+    # big for the CPU smoke tier)
+    mp = argparse.ArgumentParser(add_help=False)
+    mp.add_argument("--width", type=int, default=4192)
+    mp.add_argument("--feature-depth", type=int, default=8)
+    margs, rest = mp.parse_known_args()
+    cfg = FFConfig.from_args(rest)
     ff = FFModel(cfg)
     build_candle_uno(ff, batch_size=cfg.batch_size,
-                     input_dims=list(INPUT_DIMS))
+                     input_dims=list(INPUT_DIMS),
+                     dense_layers=[margs.width] * 4,
+                     dense_feature_layers=[margs.width] * margs.feature_depth)
     ff.compile(
         optimizer=SGDOptimizer(lr=0.001),
         loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,

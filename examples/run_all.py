@@ -1,0 +1,112 @@
+"""Smoke tier: train every example on the hermetic CPU mesh.
+
+Mirrors the role of the reference's tests/multi_gpu_tests.sh (train ~40
+example models end-to-end in CI, DP-only, small budgets): each script
+runs in its own process on an 8-device virtual CPU mesh with tiny
+epochs/batch so the whole tier finishes in minutes, and a non-zero exit
+from any script fails the tier.
+
+Run: python examples/run_all.py [--only SUBSTR] [--timeout SECONDS]
+"""
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# The JAX_PLATFORMS env var alone is not enough on hosts whose
+# sitecustomize registers a TPU backend at interpreter startup
+# (tests/conftest.py documents the trap); force the config before the
+# script's first jax use, then hand over argv.
+_BOOTSTRAP = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+path = sys.argv[1]
+sys.argv = sys.argv[1:]
+with open(path) as fh:
+    code = fh.read()
+exec(compile(code, path, "exec"), {"__name__": "__main__"})
+"""
+
+# script (relative to examples/) -> extra args tuned for a CPU smoke
+# run.  Native scripts run DP-only, mirroring multi_gpu_tests.sh's
+# batch=64*GPUs DP-only convention (an unbounded Unity search on the
+# wide multi-tower models runs for tens of minutes on CPU); the
+# pytorch leg keeps a small MCMC budget so the search path stays
+# exercised end-to-end.
+_DP = ["--only-data-parallel"]
+SCRIPTS = {
+    "python/native/mlp.py": ["-e", "2", *_DP],
+    "python/native/alexnet_cifar10.py": ["-e", "1", "-b", "32", *_DP],
+    "python/native/resnet.py": ["-e", "1", "-b", "8", *_DP],
+    "python/native/inception.py": ["-e", "1", "-b", "8", *_DP],
+    "python/native/resnext.py": ["-e", "1", "-b", "8", *_DP],
+    "python/native/dlrm.py": ["-e", "1", "-b", "32", *_DP],
+    "python/native/xdl.py": ["-e", "1", "-b", "32", *_DP],
+    "python/native/candle_uno.py": [
+        "-e", "1", "-b", "16", "--width", "512", "--feature-depth", "4", *_DP,
+    ],
+    "python/native/moe.py": ["-e", "1", "-b", "32", *_DP],
+    "python/native/transformer.py": ["-e", "1", "-b", "8", *_DP],
+    "python/keras/seq_mnist_mlp.py": ["-e", "1", "--num-samples", "512"],
+    "python/keras/func_cifar10_cnn.py": [
+        "-e", "1", "-b", "32", "--num-samples", "256",
+    ],
+    "python/keras/func_cifar10_cnn_concat.py": [
+        "-e", "1", "-b", "32", "--num-samples", "256",
+    ],
+    "python/pytorch/resnet50_search.py": [
+        "-e", "1", "-b", "4", "--budget", "4",
+    ],
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--only", default="", help="substring filter")
+    p.add_argument("--timeout", type=int, default=900)
+    args = p.parse_args()
+
+    env = dict(os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    failed = []
+    for rel, extra in SCRIPTS.items():
+        if args.only and args.only not in rel:
+            continue
+        script = os.path.join(HERE, rel)
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _BOOTSTRAP, script, *extra],
+                env=env, capture_output=True, text=True,
+                timeout=args.timeout,
+            )
+            rc, err = proc.returncode, proc.stderr
+        except subprocess.TimeoutExpired as e:
+            rc, err = -1, f"timed out after {args.timeout}s"
+        dt = time.perf_counter() - t0
+        status = "ok" if rc == 0 else f"FAIL rc={rc}"
+        print(f"{rel:45s} {dt:7.1f}s  {status}", flush=True)
+        if rc != 0:
+            failed.append(rel)
+            sys.stderr.write((err or "")[-2000:] + "\n")
+    if failed:
+        print(f"\n{len(failed)} failed: {failed}")
+        sys.exit(1)
+    print("\nall examples passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -112,6 +112,8 @@ class Tensor:
 @dataclasses.dataclass
 class ValueInfo:
     name: str = ""
+    # static dims from TypeProto.tensor_type.shape (None = symbolic)
+    shape: Optional[List[Optional[int]]] = None
 
 
 @dataclasses.dataclass
@@ -254,6 +256,20 @@ def _parse_value_info(buf: bytes) -> ValueInfo:
     for field, wt, v in _fields(buf):
         if field == 1:
             vi.name = v.decode()
+        elif field == 2:  # TypeProto
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:  # TypeProto.Tensor
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 2:  # TensorShapeProto
+                            dims: List[Optional[int]] = []
+                            for f4, _, v4 in _fields(v3):
+                                if f4 == 1:  # Dimension
+                                    dv: Optional[int] = None
+                                    for f5, _, v5 in _fields(v4):
+                                        if f5 == 1:  # dim_value
+                                            dv = v5
+                                    dims.append(dv)
+                            vi.shape = dims
     return vi
 
 
@@ -356,12 +372,32 @@ def encode_node(op_type: str, inputs, outputs, name: str = "",
     return out
 
 
-def encode_model(nodes: List[bytes], inputs: List[str], outputs: List[str],
+def _encode_value_info(name: str, shape=None) -> bytes:
+    out = _ld(1, name.encode())
+    if shape is not None:
+        dims = b"".join(
+            _ld(1, _vi(1, int(d)) if d is not None else b"") for d in shape
+        )
+        # TypeProto{ tensor_type{ elem_type=FLOAT, shape{dims} } }
+        tensor_type = _vi(1, 1) + _ld(2, dims)
+        out += _ld(2, _ld(1, tensor_type))
+    return out
+
+
+def encode_model(nodes: List[bytes], inputs, outputs,
                  initializers: Dict[str, np.ndarray]) -> bytes:
+    """inputs/outputs: names, or (name, shape) pairs to record static
+    tensor shapes (what InferenceEngine.from_onnx reads)."""
+
+    def vi_bytes(entry) -> bytes:
+        if isinstance(entry, str):
+            return _encode_value_info(entry)
+        return _encode_value_info(entry[0], entry[1])
+
     g = b"".join(_ld(1, n) for n in nodes)
     g += b"".join(
         _ld(5, encode_tensor(k, v)) for k, v in initializers.items()
     )
-    g += b"".join(_ld(11, _ld(1, s.encode())) for s in inputs)
-    g += b"".join(_ld(12, _ld(1, s.encode())) for s in outputs)
+    g += b"".join(_ld(11, vi_bytes(s)) for s in inputs)
+    g += b"".join(_ld(12, vi_bytes(s)) for s in outputs)
     return _vi(1, 8) + _ld(7, g)  # ir_version=8, graph

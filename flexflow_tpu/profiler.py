@@ -97,9 +97,17 @@ def measure_op_forward(
             def body(x, _):
                 out = op.forward([x] + rest, ws, training=False, rng=rng)
                 leaf = jax.tree_util.tree_leaves(out)[0]
-                # ties the next iteration's input to this output without
-                # letting XLA see that the value is unchanged
-                x2, _ = jax.lax.optimization_barrier((x, leaf))
+                # REAL dataflow from this iteration's output into the
+                # next iteration's input: a bare optimization_barrier
+                # gets split per element by XLA, the unused leaf is
+                # DCE'd, and LICM then hoists the loop-invariant op out
+                # of the scan — the chain times nothing.  x + 0.0*sum(y)
+                # is never folded for floats (NaN semantics).
+                eps = 0.0 * jnp.sum(leaf).astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    x2 = x + eps.astype(x.dtype)
+                else:
+                    x2 = x + eps.astype(jnp.int32).astype(x.dtype)
                 return x2, ()
 
             xn, _ = jax.lax.scan(body, first, None, length=chain)

@@ -1,67 +1,121 @@
 """Dynamic request batching (the Triton scheduler role: coalesce
 concurrent single requests into one device batch, bounded by
-max_batch_size and a flush timeout)."""
+max_batch_size and a flush timeout).
+
+Two-stage pipeline: the ASSEMBLER thread drains the request queue,
+concatenates up to max_batch samples, and *dispatches* the jitted
+forward (jax dispatch is asynchronous, so this returns immediately);
+the COMPLETER thread materializes results and scatters them back to
+waiters.  While batch N computes on the device, batch N+1 is being
+assembled and dispatched — device and host time overlap instead of
+serializing, the same double-buffering the dataloader uses for
+training.  Per-request latency (submit -> result ready) is tracked in a
+ring buffer; `latency_stats()` reports p50/p95/p99.
+"""
 from __future__ import annotations
 
 import queue
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
 
 class _Pending:
-    __slots__ = ("inputs", "event", "result", "error")
+    __slots__ = ("inputs", "event", "result", "error", "t_submit")
 
     def __init__(self, inputs):
         self.inputs = inputs
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[Exception] = None
+        self.t_submit = time.monotonic()
+
+    # -- future-style API (infer_async) ---------------------------------
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.event.wait(timeout):
+            raise TimeoutError("inference request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
 
 
 class DynamicBatcher:
-    """Background thread that drains the request queue, concatenates up
-    to max_batch samples, runs the engine once, and scatters results."""
+    """Assembler + completer threads around an InferenceEngine."""
 
     def __init__(self, engine, max_batch: int = 32,
-                 flush_timeout_s: float = 0.005):
+                 flush_timeout_s: float = 0.005,
+                 max_inflight: int = 2,
+                 latency_window: int = 1024):
         self.engine = engine
         self.max_batch = max_batch
         self.flush_timeout_s = flush_timeout_s
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        # bounded: backpressure keeps at most `max_inflight` batches on
+        # the device while the assembler keeps building the next one
+        self._inflight: "queue.Queue" = queue.Queue(maxsize=max_inflight)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        self._latencies = deque(maxlen=latency_window)
         self.batches_run = 0
+        self.requests_done = 0
+        self._assembler = threading.Thread(target=self._assemble_loop,
+                                           daemon=True)
+        self._completer = threading.Thread(target=self._complete_loop,
+                                           daemon=True)
+        self._assembler.start()
+        self._completer.start()
 
     # -- client API -----------------------------------------------------
     def infer(self, inputs: Dict[str, np.ndarray],
               timeout: Optional[float] = 30.0) -> np.ndarray:
         """Blocking single/partial-batch request; thread-safe."""
+        return self.infer_async(inputs).wait(timeout)
+
+    def infer_async(self, inputs: Dict[str, np.ndarray]) -> _Pending:
+        """Non-blocking submit; returns a future-style handle with
+        .wait(timeout)."""
         p = _Pending({k: np.asarray(v) for k, v in inputs.items()})
         self._queue.put(p)
-        if not p.event.wait(timeout):
-            raise TimeoutError("inference request timed out")
-        if p.error is not None:
-            raise p.error
-        return p.result
+        return p
+
+    def latency_stats(self) -> Dict[str, float]:
+        """p50/p95/p99/mean request latency (ms) over the ring window."""
+        lats = sorted(self._latencies)
+        if not lats:
+            return {"n": 0}
+
+        def pct(p):
+            return lats[min(len(lats) - 1, int(p * len(lats)))] * 1e3
+
+        return {
+            "n": len(lats),
+            "p50_ms": round(pct(0.50), 3),
+            "p95_ms": round(pct(0.95), 3),
+            "p99_ms": round(pct(0.99), 3),
+            "mean_ms": round(sum(lats) / len(lats) * 1e3, 3),
+        }
 
     def close(self):
         self._stop.set()
-        self._thread.join(timeout=5)
+        self._assembler.join(timeout=5)
+        self._completer.join(timeout=5)
         # fail anything still queued so callers don't sit out their timeout
-        while True:
-            try:
-                p = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            p.error = RuntimeError("DynamicBatcher closed")
-            p.event.set()
+        for q in (self._queue, self._inflight):
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                pendings = [item] if isinstance(item, _Pending) \
+                    else item[1]
+                for p in pendings:
+                    p.error = RuntimeError("DynamicBatcher closed")
+                    p.event.set()
 
-    # -- worker ---------------------------------------------------------
-    def _loop(self):
+    # -- assembler stage ------------------------------------------------
+    def _assemble_loop(self):
         while not self._stop.is_set():
             try:
                 first = self._queue.get(timeout=0.05)
@@ -82,23 +136,56 @@ class DynamicBatcher:
                     break
                 batch.append(nxt)
                 total += len(next(iter(nxt.inputs.values())))
-            self._run(batch)
+            self._dispatch(batch)
 
-    def _run(self, batch: List[_Pending]):
+    def _dispatch(self, batch: List[_Pending]):
         try:
             keys = list(batch[0].inputs.keys())
             joined = {
                 k: np.concatenate([p.inputs[k] for p in batch]) for k in keys
             }
-            out = self.engine.infer(joined)
-            self.batches_run += 1
-            start = 0
-            for p in batch:
-                n = len(next(iter(p.inputs.values())))
-                p.result = out[start:start + n]
-                start += n
-                p.event.set()
+            n = len(next(iter(joined.values())))
+            if n > self.engine.chunk_cap():
+                # oversize request(s): engine.infer chunks synchronously
+                out = self.engine.infer(joined)
+                self.batches_run += 1
+                start = 0
+                now = time.monotonic()
+                for p in batch:
+                    k = len(next(iter(p.inputs.values())))
+                    p.result = out[start:start + k]
+                    start += k
+                    self._latencies.append(now - p.t_submit)
+                    self.requests_done += 1
+                    p.event.set()
+                return
+            dev_out = self.engine.dispatch(joined, n)  # async launch
+            self._inflight.put((dev_out, batch, n))  # blocks at capacity
         except Exception as e:
             for p in batch:
                 p.error = e
                 p.event.set()
+
+    # -- completer stage ------------------------------------------------
+    def _complete_loop(self):
+        while not self._stop.is_set() or not self._inflight.empty():
+            try:
+                dev_out, batch, n = self._inflight.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                out = np.asarray(dev_out)[:n]  # waits for the device
+                self.batches_run += 1
+                start = 0
+                now = time.monotonic()
+                for p in batch:
+                    k = len(next(iter(p.inputs.values())))
+                    p.result = out[start:start + k]
+                    start += k
+                    self._latencies.append(now - p.t_submit)
+                    self.requests_done += 1
+                    p.event.set()
+            except Exception as e:
+                for p in batch:
+                    p.error = e
+                    p.event.set()

@@ -12,6 +12,18 @@ from ..fftype import CompMode
 from ..model import FFModel
 
 
+def _value_info_shape(vi):
+    """Static dims (None for symbolic) from a graph input, covering both
+    the vendored protowire.ValueInfo and onnx's ValueInfoProto."""
+    shape = getattr(vi, "shape", None)
+    if shape is not None or not hasattr(vi, "type"):
+        return shape
+    dims = []
+    for d in vi.type.tensor_type.shape.dim:
+        dims.append(d.dim_value if d.dim_value > 0 else None)
+    return dims or None
+
+
 def _bucket(n: int, max_batch: int, multiple: int = 1) -> int:
     """Next power of two >= n, rounded up to `multiple` (the mesh's
     data-axis size — every bucket must shard evenly).  The cap is the
@@ -52,19 +64,39 @@ class InferenceEngine:
         cfg = FFConfig(batch_size=batch_size)
         ff = FFModel(cfg)
         om = ONNXModel(path)
-        om.apply(ff, batch_size=batch_size)
+        tensors = []
+        for vi in om.graph.input:
+            if vi.name in om.initializers:
+                continue
+            shape = _value_info_shape(vi)
+            if not shape or any(d is None for d in shape[1:]):
+                raise ValueError(
+                    f"ONNX input {vi.name!r} needs a static shape to "
+                    f"serve (got {shape}); re-export with fixed dims"
+                )
+            tensors.append(
+                ff.create_tensor([batch_size] + [int(d) for d in shape[1:]],
+                                 name=vi.name)
+            )
+        om.apply(ff, tensors)
         ff.compile(comp_mode=CompMode.INFERENCE, strategy=strategy,
                    devices=devices)
         om.copy_weights(ff)
         return cls(ff, max_batch=batch_size, **kwargs)
+
+    def chunk_cap(self) -> int:
+        """Largest request slice one jitted forward takes: max_batch
+        rounded down to the mesh's data-axis multiple (single source of
+        the sharding invariant for infer() and the DynamicBatcher)."""
+        dp = self.ff.mesh.shape.get("data", 1) if self.ff.mesh else 1
+        return max((self.max_batch // dp) * dp, dp)
 
     # ------------------------------------------------------------------
     def infer(self, inputs: Dict[str, np.ndarray]) -> np.ndarray:
         """One batch (any size <= max_batch * k — larger requests are
         chunked); returns the sink output as numpy."""
         n = len(next(iter(inputs.values())))
-        dp = self.ff.mesh.shape.get("data", 1) if self.ff.mesh else 1
-        chunk_cap = max((self.max_batch // dp) * dp, dp)
+        chunk_cap = self.chunk_cap()
         outs: List[np.ndarray] = []
         start = 0
         while start < n:
@@ -76,6 +108,14 @@ class InferenceEngine:
         return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     def _infer_bucketed(self, chunk: Dict[str, np.ndarray], n: int) -> np.ndarray:
+        return np.asarray(self.dispatch(chunk, n))[:n]
+
+    def dispatch(self, chunk: Dict[str, np.ndarray], n: int):
+        """ASYNC half of a bucketed forward: pad to the bucket, device_put,
+        launch the jitted forward, and return the device array WITHOUT
+        waiting — jax dispatch is asynchronous, so the caller can overlap
+        assembling the next batch with this one's device time (the
+        DynamicBatcher's pipeline).  `np.asarray(result)[:n]` completes it."""
         import jax
 
         dp = self.ff.mesh.shape.get("data", 1) if self.ff.mesh else 1
@@ -88,8 +128,7 @@ class InferenceEngine:
             padded[k] = v
         sh = self.ff.executor.input_shardings()
         put = {k: jax.device_put(v, sh[k]) for k, v in padded.items()}
-        out = self._fwd(self.ff._weights, self.ff._state, put)
-        return np.asarray(out)[:n]
+        return self._fwd(self.ff._weights, self.ff._state, put)
 
     def input_names(self) -> Sequence[str]:
         return list(self._input_names)

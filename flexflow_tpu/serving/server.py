@@ -37,6 +37,14 @@ def serve_http(batcher, host: str = "127.0.0.1", port: int = 8000,
                 served = getattr(batcher, "batches_run",
                                  getattr(batcher, "requests_served", 0))
                 self._send(200, {"status": "ok", "requests": served})
+            elif self.path == "/v2/stats":
+                stats = {
+                    "batches_run": getattr(batcher, "batches_run", 0),
+                    "requests_done": getattr(batcher, "requests_done", 0),
+                }
+                if hasattr(batcher, "latency_stats"):
+                    stats["latency"] = batcher.latency_stats()
+                self._send(200, stats)
             else:
                 self._send(404, {"error": "not found"})
 

@@ -175,12 +175,17 @@ class OpCostModel:
         self.cache[key] = cm
         return cm
 
+    #: bump when the measurement harness changes semantics — v2: the
+    #: r01/r02 chained-scan timing was DCE'd by XLA (barrier split) and
+    #: persisted near-zero garbage that must never be replayed
+    MEASURE_CACHE_VERSION = 2
+
     def _measured(self, op: Op, key: Tuple) -> Optional[float]:
         if self.measure_fn is None or op.is_parallel_op():
             return None
         if op.flops() < self.MEASURE_MIN_FLOPS:
             return None
-        skey = f"{self.device_key}|{key!r}"
+        skey = f"v{self.MEASURE_CACHE_VERSION}|{self.device_key}|{key!r}"
         if skey in self._persistent:
             return self._persistent[skey]
         measured = self.measure_fn(op)

@@ -91,3 +91,81 @@ def test_http_endpoint(engine):
     finally:
         server.shutdown()
         batcher.close()
+
+
+def test_infer_async_and_latency_stats(engine):
+    # generous flush window: all 12 requests are queued before the
+    # assembler's deadline, so coalescing is deterministic
+    batcher = DynamicBatcher(engine, max_batch=32, flush_timeout_s=0.25)
+    try:
+        rng = np.random.RandomState(7)
+        handles = [
+            batcher.infer_async({"x": rng.randn(2, 8).astype(np.float32)})
+            for _ in range(12)
+        ]
+        outs = [h.wait(30.0) for h in handles]
+        assert all(o.shape == (2, 4) for o in outs)
+        stats = batcher.latency_stats()
+        assert stats["n"] == 12
+        assert 0 < stats["p50_ms"] <= stats["p95_ms"] <= stats["p99_ms"]
+        assert batcher.requests_done == 12
+        # coalescing really happened: strictly fewer device batches
+        # than requests (24 samples / max_batch 32 -> 1-2 batches)
+        assert batcher.batches_run < 12
+    finally:
+        batcher.close()
+
+
+def test_batcher_oversize_request_chunks(engine):
+    batcher = DynamicBatcher(engine, max_batch=32)
+    try:
+        rng = np.random.RandomState(8)
+        xs = rng.randn(80, 8).astype(np.float32)  # > bucket cap
+        out = batcher.infer({"x": xs})
+        want = engine.infer({"x": xs})
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+    finally:
+        batcher.close()
+
+
+def test_stats_endpoint(engine):
+    batcher = DynamicBatcher(engine, max_batch=32)
+    server = serve_http(batcher, port=0, block=False)
+    try:
+        port = server.server_address[1]
+        rng = np.random.RandomState(9)
+        batcher.infer({"x": rng.randn(3, 8).astype(np.float32)})
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v2/stats", timeout=10
+        ) as r:
+            stats = json.loads(r.read())
+        assert stats["requests_done"] >= 1
+        assert "latency" in stats and stats["latency"]["n"] >= 1
+    finally:
+        server.shutdown()
+        batcher.close()
+
+
+def test_from_onnx_serves(devices8, tmp_path):
+    """ONNX file -> InferenceEngine.from_onnx -> bucketed inference,
+    parity against direct numpy (the Triton backend's model source)."""
+    from flexflow_tpu.onnx_frontend import protowire as pw
+    from flexflow_tpu.serving.engine import InferenceEngine as IE
+
+    rng = np.random.RandomState(11)
+    w = rng.randn(4, 8).astype(np.float32)
+    nodes = [
+        pw.encode_node("Gemm", ["x", "w"], ["y"], name="fc", transB=1),
+        pw.encode_node("Softmax", ["y"], ["p"], name="sm", axis=-1),
+    ]
+    data = pw.encode_model(nodes, [("x", [None, 8])], [("p", [None, 4])],
+                           {"w": w})
+    path = tmp_path / "m.onnx"
+    path.write_bytes(data)
+    eng = IE.from_onnx(str(path), batch_size=16, devices=devices8[:1])
+    xs = rng.randn(5, 8).astype(np.float32)
+    got = eng.infer({"x": xs})
+    logits = xs @ w.T
+    want = np.exp(logits - logits.max(-1, keepdims=True))
+    want /= want.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
