@@ -90,14 +90,18 @@ def initialize(
 def shard_host_batch(
     global_batch: Dict[str, np.ndarray],
     shardings: Dict[str, object],
+    global_batch_size: Optional[int] = None,
 ):
     """Assemble global device arrays from per-host data.
 
-    Each process holds (at least) the rows of the global batch that its
-    local devices own; `jax.make_array_from_process_local_data` takes
-    this host's slice and the global sharding and builds the global
-    array without any cross-host copy.  Single-host this degenerates to
-    a plain device_put.  Returns {name: global jax.Array}.
+    For batch-sharded inputs each process passes only the rows its
+    devices own (`local_batch_slice`); for replicated tensors (e.g.
+    labels when the sink keeps them whole) it passes the full array.
+    `jax.make_array_from_process_local_data` builds the global array
+    either way without cross-host copies — `global_batch_size` (the
+    GLOBAL row count) disambiguates the two in multi-process runs.
+    Single-host this degenerates to a plain device_put.
+    Returns {name: global jax.Array}.
     """
     import jax
 
@@ -106,19 +110,42 @@ def shard_host_batch(
         sharding = shardings[name]
         if jax.process_count() == 1:
             out[name] = jax.device_put(arr, sharding)
-        else:
-            out[name] = jax.make_array_from_process_local_data(
-                sharding, arr
+            continue
+        if global_batch_size is None:
+            raise ValueError(
+                "multi-process shard_host_batch needs global_batch_size "
+                "(the GLOBAL row count) to tell host-local slices from "
+                "replicated full arrays"
             )
+        gshape = (global_batch_size,) + tuple(arr.shape[1:])
+        out[name] = jax.make_array_from_process_local_data(
+            sharding, arr, global_shape=gshape
+        )
     return out
 
 
-def local_batch_slice(global_batch_size: int) -> slice:
+def local_batch_slice(global_batch_size: int, sharding=None) -> slice:
     """Row range of the global batch this host should load (contiguous
     batch-major layout, the SingleDataLoader convention): host i of P
-    feeds rows [i*B/P, (i+1)*B/P)."""
+    feeds rows [i*B/P, (i+1)*B/P).
+
+    Pass the tensor's sharding to get the right answer for
+    batch-unsharded inputs too: when the BATCH dim is not partitioned
+    (this framework's INPUT tensors are replicated — the repartition
+    parallel op inside the graph does the sharding — and a
+    tensor-parallel input can shard features but not rows), every host
+    must feed the full batch and the slice is [0, B)."""
     import jax
 
+    if sharding is not None:
+        spec = getattr(sharding, "spec", None)
+        batch_unsharded = (
+            spec is None or len(spec) == 0 or spec[0] is None
+        )
+        if batch_unsharded or getattr(
+            sharding, "is_fully_replicated", False
+        ):
+            return slice(0, global_batch_size)
     p, i = jax.process_count(), jax.process_index()
     if global_batch_size % p != 0:
         raise ValueError(

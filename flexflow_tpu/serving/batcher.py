@@ -58,6 +58,8 @@ class DynamicBatcher:
         self._inflight: "queue.Queue" = queue.Queue(maxsize=max_inflight)
         self._stop = threading.Event()
         self._latencies = deque(maxlen=latency_window)
+        self._lat_lock = threading.Lock()
+        self._carry: Optional[_Pending] = None  # overflow from coalescing
         self.batches_run = 0
         self.requests_done = 0
         self._assembler = threading.Thread(target=self._assemble_loop,
@@ -82,7 +84,8 @@ class DynamicBatcher:
 
     def latency_stats(self) -> Dict[str, float]:
         """p50/p95/p99/mean request latency (ms) over the ring window."""
-        lats = sorted(self._latencies)
+        with self._lat_lock:  # appends race from the worker threads
+            lats = sorted(self._latencies)
         if not lats:
             return {"n": 0}
 
@@ -99,34 +102,55 @@ class DynamicBatcher:
 
     def close(self):
         self._stop.set()
-        self._assembler.join(timeout=5)
-        self._completer.join(timeout=5)
-        # fail anything still queued so callers don't sit out their timeout
-        for q in (self._queue, self._inflight):
-            while True:
-                try:
-                    item = q.get_nowait()
-                except queue.Empty:
-                    break
-                pendings = [item] if isinstance(item, _Pending) \
-                    else item[1]
-                for p in pendings:
-                    p.error = RuntimeError("DynamicBatcher closed")
-                    p.event.set()
+
+        def drain():
+            if self._carry is not None:
+                p, self._carry = self._carry, None
+                p.error = RuntimeError("DynamicBatcher closed")
+                p.event.set()
+            for q in (self._queue, self._inflight):
+                while True:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    pendings = [item] if isinstance(item, _Pending) \
+                        else item[1]
+                    for p in pendings:
+                        p.error = RuntimeError("DynamicBatcher closed")
+                        p.event.set()
+
+        # a worker stuck in a cold-bucket compile can outlive the join
+        # timeout and enqueue AFTER a one-shot drain — keep draining
+        # until both threads are really gone (bounded), then once more
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and (
+            self._assembler.is_alive() or self._completer.is_alive()
+        ):
+            drain()
+            self._assembler.join(timeout=0.2)
+            self._completer.join(timeout=0.2)
+        drain()
 
     # -- assembler stage ------------------------------------------------
     def _assemble_loop(self):
         while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
             batch: List[_Pending] = [first]
             total = len(next(iter(first.inputs.values())))
+            # never coalesce past what one jitted forward can take, or
+            # the dispatch degrades to the synchronous chunked path
+            cap = min(self.max_batch, self.engine.chunk_cap())
             # absolute deadline from the FIRST request, so a steady
             # trickle can't defer the flush past the configured bound
             deadline = time.monotonic() + self.flush_timeout_s
-            while total < self.max_batch:
+            while total < cap:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
@@ -134,8 +158,12 @@ class DynamicBatcher:
                     nxt = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
+                n = len(next(iter(nxt.inputs.values())))
+                if total + n > cap:
+                    self._carry = nxt  # overflow: heads the next batch
+                    break
                 batch.append(nxt)
-                total += len(next(iter(nxt.inputs.values())))
+                total += n
             self._dispatch(batch)
 
     def _dispatch(self, batch: List[_Pending]):
@@ -146,18 +174,9 @@ class DynamicBatcher:
             }
             n = len(next(iter(joined.values())))
             if n > self.engine.chunk_cap():
-                # oversize request(s): engine.infer chunks synchronously
-                out = self.engine.infer(joined)
-                self.batches_run += 1
-                start = 0
-                now = time.monotonic()
-                for p in batch:
-                    k = len(next(iter(p.inputs.values())))
-                    p.result = out[start:start + k]
-                    start += k
-                    self._latencies.append(now - p.t_submit)
-                    self.requests_done += 1
-                    p.event.set()
+                # single oversize request: engine.infer chunks it
+                # synchronously (coalescing never builds past the cap)
+                self._scatter(batch, self.engine.infer(joined))
                 return
             dev_out = self.engine.dispatch(joined, n)  # async launch
             self._inflight.put((dev_out, batch, n))  # blocks at capacity
@@ -174,18 +193,22 @@ class DynamicBatcher:
             except queue.Empty:
                 continue
             try:
-                out = np.asarray(dev_out)[:n]  # waits for the device
-                self.batches_run += 1
-                start = 0
-                now = time.monotonic()
-                for p in batch:
-                    k = len(next(iter(p.inputs.values())))
-                    p.result = out[start:start + k]
-                    start += k
-                    self._latencies.append(now - p.t_submit)
-                    self.requests_done += 1
-                    p.event.set()
+                self._scatter(batch, np.asarray(dev_out)[:n])  # waits
             except Exception as e:
                 for p in batch:
                     p.error = e
                     p.event.set()
+
+    def _scatter(self, batch: List[_Pending], out: np.ndarray):
+        """Slice a completed batch back to its waiters + account."""
+        self.batches_run += 1
+        start = 0
+        now = time.monotonic()
+        for p in batch:
+            k = len(next(iter(p.inputs.values())))
+            p.result = out[start:start + k]
+            start += k
+            with self._lat_lock:
+                self._latencies.append(now - p.t_submit)
+            self.requests_done += 1
+            p.event.set()

@@ -1,0 +1,81 @@
+"""Serving latency benchmark: concurrent clients against the pipelined
+DynamicBatcher; prints p50/p95/p99 request latency and throughput.
+
+Run on the chip: python scripts/serving_latency.py
+CPU smoke:       JAX_PLATFORMS=cpu python scripts/serving_latency.py --clients 4 --requests 50
+"""
+import argparse
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer  # noqa: E402
+from flexflow_tpu.fftype import ActiMode, CompMode  # noqa: E402
+from flexflow_tpu.serving import DynamicBatcher, InferenceEngine  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=16)
+    p.add_argument("--requests", type=int, default=200, help="per client")
+    p.add_argument("--max-batch", type=int, default=64)
+    args = p.parse_args()
+
+    ff = FFModel(FFConfig(batch_size=args.max_batch))
+    x = ff.create_tensor([args.max_batch, 256], name="x")
+    t = ff.dense(x, 1024, activation=ActiMode.RELU)
+    t = ff.dense(t, 1024, activation=ActiMode.RELU)
+    t = ff.dense(t, 16)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               comp_mode=CompMode.INFERENCE)
+    engine = InferenceEngine(ff, max_batch=args.max_batch)
+    batcher = DynamicBatcher(engine, max_batch=args.max_batch,
+                             flush_timeout_s=0.002)
+
+    # warm every bucket the clients will hit
+    for b in (1, 2, 4, 8, 16, 32, args.max_batch):
+        engine.infer({"x": np.zeros((b, 256), np.float32)})
+
+    errors = []
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(args.requests):
+            n = int(rng.choice([1, 1, 1, 2, 4]))  # mostly single-sample
+            try:
+                out = batcher.infer({"x": rng.randn(n, 256).astype(np.float32)})
+                assert out.shape == (n, 16)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(args.clients)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    dt = time.perf_counter() - t0
+    total = args.clients * args.requests
+    stats = batcher.latency_stats()
+    batcher.close()
+    if errors:
+        print(f"FAILED: {errors[0]}")
+        sys.exit(1)
+    print(f"requests: {total}  wall: {dt:.2f}s  "
+          f"throughput: {total / dt:.0f} req/s  "
+          f"batches: {batcher.batches_run} "
+          f"(avg {stats.get('n', 0) and total / batcher.batches_run:.1f} req/batch)")
+    print(f"latency ms: p50={stats.get('p50_ms')} p95={stats.get('p95_ms')} "
+          f"p99={stats.get('p99_ms')} mean={stats.get('mean_ms')}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Worker for the 2-process jax.distributed CPU test: argv = [rank, port].
+
+Spawned by tests/test_distributed.py::test_two_process_training — the
+multi-node path the reference proves via mpi_wrapper scripts
+(/root/reference/tests/multinode_helpers/mpi_wrapper1.sh): both ranks
+join one runtime, build an 8-device global mesh (4 local each), feed
+per-host batches through flexflow_tpu.distributed, and train.
+"""
+import os
+import sys
+
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import os as _os
+sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+from flexflow_tpu import distributed as ffdist
+
+multi = ffdist.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=2,
+    process_id=rank,
+)
+assert multi, "expected multi-process runtime"
+assert jax.process_count() == 2
+assert len(jax.devices()) == 8, len(jax.devices())
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.fftype import ActiMode
+
+B = 32
+ff = FFModel(FFConfig(batch_size=B, num_devices=8))
+x = ff.create_tensor([B, 16], name="x")
+t = ff.dense(x, 64, activation=ActiMode.RELU, name="fc1")
+t = ff.dense(t, 4, name="head")
+ff.softmax(t)
+ff.compile(optimizer=SGDOptimizer(lr=0.1),
+           loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+           devices=jax.devices())
+
+# per-host data: this host loads only its slice of the global batch
+rng = np.random.RandomState(0)  # same global data on both ranks
+gx = rng.randn(B, 16).astype(np.float32)
+gy = rng.randint(0, 4, B).astype(np.int32)
+shardings = dict(ff.executor.input_shardings())
+lab_sh = ff.executor.label_sharding()
+sl_x = ffdist.local_batch_slice(B, shardings["x"])
+sl_y = ffdist.local_batch_slice(B, lab_sh)
+arrs = ffdist.shard_host_batch(
+    {"x": gx[sl_x], "y": gy[sl_y]},
+    {"x": shardings["x"], "y": lab_sh},
+    global_batch_size=B,
+)
+batch = {"x": arrs["x"]}
+y = arrs["y"]
+
+losses = []
+for _ in range(5):
+    m = ff.train_step({"x": batch["x"]}, y)
+    losses.append(float(m["loss"]))
+print(f"rank {rank}: losses {losses[0]:.4f} -> {losses[-1]:.4f}", flush=True)
+assert losses[-1] < losses[0], "loss must decrease"
+print(f"rank {rank}: OK", flush=True)
