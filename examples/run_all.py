@@ -94,7 +94,7 @@ def main():
                 timeout=args.timeout,
             )
             rc, err = proc.returncode, proc.stderr
-        except subprocess.TimeoutExpired as e:
+        except subprocess.TimeoutExpired:
             rc, err = -1, f"timed out after {args.timeout}s"
         dt = time.perf_counter() - t0
         status = "ok" if rc == 0 else f"FAIL rc={rc}"
