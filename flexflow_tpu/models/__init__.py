@@ -6,4 +6,4 @@ from .mlp import build_mlp_unify
 from .moe import build_moe_encoder, build_moe_mlp
 from .nmt import build_nmt
 from .resnet import build_resnet50, build_resnext50
-from .transformer import build_bert, build_transformer
+from .transformer import build_bert, build_gpt, build_transformer

@@ -107,3 +107,50 @@ def bert_sp_strategy(num_devices: int, sp: int = 4):
     chain.append(("repartition", {"dim": 1, "degree": sp}))
     s.edge_ops["__inputs__"] = chain
     return s
+
+
+def build_gpt(
+    ff: FFModel,
+    batch_size: int = 8,
+    seq_length: int = 1024,
+    hidden_size: int = 768,
+    num_layers: int = 12,
+    num_heads: int = 12,
+    intermediate_size: int = 3072,
+    vocab_size: int = 50257,
+    dropout: float = 0.0,
+):
+    """Decoder-only causal LM (pre-LN GPT-2 shape) — a model family
+    BEYOND the reference's zoo (its transformer example is encoder-only,
+    examples/cpp/Transformer/transformer.cc): token ids + position ids
+    -> embeddings -> N x [LN -> causal attention -> residual;
+    LN -> GELU MLP -> residual] -> final LN -> untied LM head.
+
+    Layer names reuse the attn_{i}/ffn1_{i} convention so
+    bert_tp_strategy/bert_sp_strategy apply unchanged (causal ring
+    attention handles the sharded-sequence case).  Train with
+    labels = ids shifted left one position (next-token prediction);
+    the sparse-CE loss consumes [b, s, vocab] logits and [b, s] ids.
+    """
+    ids = ff.create_tensor([batch_size, seq_length], dtype="int32",
+                           name="input")
+    pos = ff.create_tensor([batch_size, seq_length], dtype="int32",
+                           name="positions")
+    t = ff.embedding(ids, vocab_size, hidden_size, name="tok_embed")
+    pe = ff.embedding(pos, seq_length, hidden_size, name="pos_embed")
+    t = ff.add(t, pe, name="embed_sum")
+    for i in range(num_layers):
+        a = ff.layer_norm(t, axes=[-1], name=f"ln1_{i}")
+        a = ff.multihead_attention(
+            a, a, a, hidden_size, num_heads, dropout=dropout,
+            causal=True, name=f"attn_{i}",
+        )
+        t = ff.add(t, a, name=f"attn_res_{i}")
+        h = ff.layer_norm(t, axes=[-1], name=f"ln2_{i}")
+        h = ff.dense(h, intermediate_size, activation=ActiMode.GELU,
+                     name=f"ffn1_{i}")
+        h = ff.dense(h, hidden_size, name=f"ffn2_{i}")
+        t = ff.add(t, h, name=f"ffn_res_{i}")
+    t = ff.layer_norm(t, axes=[-1], name="final_ln")
+    logits = ff.dense(t, vocab_size, use_bias=False, name="lm_head")
+    return logits
