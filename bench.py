@@ -123,7 +123,12 @@ def _fidelity(ff, dev, dt, tag):
         from flexflow_tpu.sim.simulator import OpCostModel, Simulator
 
         machine = TpuPodModel(topology=(1,), device=detect_device_spec())
-        seg_costs = measure_segment_costs(ff, device=dev)
+        calib = MANIFEST.get("calibration", {})
+        seg_costs = measure_segment_costs(
+            ff, device=dev,
+            max_regions=calib.get("max_regions", 16),
+            repeats=calib.get("repeats", 3),
+        )
         covered = sum(len(g) for g, _ in seg_costs)
         res = Simulator(machine, OpCostModel(machine)).simulate(
             ff.operators, {"data": 1}, training=True,
