@@ -1,9 +1,10 @@
 """Decoder-only causal LM (models/transformer.py build_gpt).
 
 Covers: next-token training convergence on the CPU mesh, causality of
-the logits (token t's logits must not depend on tokens > t), and the
+the logits (token t's logits must not depend on tokens > t), the
 dp x tp / dp x sp strategies reusing the bert helpers (causal ring
-attention under a sharded sequence).
+attention under a sharded sequence), and dp x pp GPipe over the
+decoder blocks.
 """
 import numpy as np
 import pytest
@@ -26,12 +27,13 @@ def _data(rng, n, seq, vocab):
     return ids, pos, labels
 
 
-def _build(devices, n_dev, batch, seq=16, vocab=32, strategy=None):
+def _build(devices, n_dev, batch, seq=16, vocab=32, strategy=None,
+           num_layers=2, lr=0.5):
     ff = FFModel(FFConfig(batch_size=batch, num_devices=n_dev))
     build_gpt(ff, batch_size=batch, seq_length=seq, hidden_size=32,
-              num_layers=2, num_heads=4, intermediate_size=64,
+              num_layers=num_layers, num_heads=4, intermediate_size=64,
               vocab_size=vocab)
-    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+    ff.compile(optimizer=SGDOptimizer(lr=lr),
                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
                strategy=strategy, devices=devices[:n_dev])
     return ff
@@ -78,3 +80,25 @@ def test_gpt_parallel_matches_single(devices8, strategy_fn):
     np.testing.assert_allclose(out1, outN, rtol=2e-4, atol=2e-4)
     m = ffN.train_step({"input": ids, "positions": pos}, labels)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_gpt_pipeline_strategy(devices8):
+    """Causal LM under dp2 x pp4 GPipe: the fourth parallelism family
+    (after dp/tp/sp) composing with the decoder blocks."""
+    from flexflow_tpu.strategy import Strategy
+
+    rng = np.random.RandomState(3)
+    batch = 8
+    s = Strategy(
+        mesh_axes={"data": 2, "pipe": 4},
+        pipeline={"degree": 4, "num_microbatches": 4, "axis": "pipe",
+                  "dp_axis": "data"},
+    )
+    s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": 2})]
+    ff = _build(devices8, 8, batch=batch, strategy=s, num_layers=4, lr=0.3)
+    ids, pos, labels = _data(rng, batch, 16, 32)
+    losses = [
+        float(ff.train_step({"input": ids, "positions": pos}, labels)["loss"])
+        for _ in range(10)
+    ]
+    assert losses[-1] < losses[0], losses
