@@ -137,6 +137,14 @@ class OperatorType(enum.Enum):
     CACHE = "cache"
     # Recurrent (reference legacy nmt/ LSTM)
     LSTM = "lstm"
+    # Size-changing replication/reduction in the reference's convention
+    # (replicate.cc:74-75 size *= degree; reduction.cc:74-77 size /=
+    # degree): d stacked copies along a dim / fold-sum of d slices.
+    # Compute ops here (NOT in the parallel set — our strategy IR's
+    # Replicate/Reduction use the implicit replica dim instead); used by
+    # the TASO catalog rules (pcg/taso.py).
+    REPLICATE_STACK = "replicate_stack"
+    REDUCTION_FOLD = "reduction_fold"
     # Fusion
     FUSED = "fused"
     # Parallel ops (the parallelism IR, reference src/parallel_ops/)

@@ -157,6 +157,89 @@ class AllToAll(ParallelOpBase):
 
 
 @dataclasses.dataclass(frozen=True)
+class StackReplicateParams:
+    axis: int  # row-major logical axis
+    degree: int
+
+
+class StackReplicate(Op):
+    """`degree` copies of the input stacked (concatenated) along `axis`
+    — the reference Replicate's actual logical semantics
+    (replicate.cc:74-75: dims[replicate_dim].size *= degree).  A compute
+    op, not a sharding annotation: when the stacked axis is sharded at
+    `degree`, each shard holds one copy, which is physical replication.
+    Used by the TASO substitution catalog (pcg/taso.py)."""
+
+    op_type = OperatorType.REPLICATE_STACK
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        p: StackReplicateParams = self.params
+        dd = [d for d in ishape.dims if not d.is_replica_dim]
+        ax = p.axis % len(dd)
+        dim = dd[ax]
+        new_size = dim.size * p.degree
+        if new_size % dim.degree != 0:
+            raise ShapeError(f"{self.name}: stacked size not shardable")
+        return [_replace_dim(ishape, ax, dataclasses.replace(dim, size=new_size))]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        ax = self.params.axis % x.ndim
+        return [jnp.concatenate([x] * self.params.degree, axis=ax)]
+
+    def flops(self):
+        return 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FoldReduceParams:
+    axis: int  # row-major logical axis
+    degree: int
+
+
+class FoldReduce(Op):
+    """Sum of `degree` equal slices along `axis` — the reference
+    Reduction's logical semantics (reduction.cc:74-77:
+    dims[reduction_dim].size /= degree): partial sums laid out along a
+    dim are folded.  Inverse-composes with StackReplicate and with
+    Concat (a concat axis is a stack of partials — what lets the TASO
+    catalog trade elementwise adds for concat+reduce)."""
+
+    op_type = OperatorType.REDUCTION_FOLD
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        p: FoldReduceParams = self.params
+        dd = [d for d in ishape.dims if not d.is_replica_dim]
+        ax = p.axis % len(dd)
+        dim = dd[ax]
+        if dim.size % p.degree != 0:
+            raise ShapeError(f"{self.name}: size {dim.size} not divisible by fold {p.degree}")
+        new_size = dim.size // p.degree
+        if new_size % dim.degree != 0:
+            raise ShapeError(f"{self.name}: folded size not shardable")
+        return [_replace_dim(ishape, ax, dataclasses.replace(dim, size=new_size))]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        import jax.numpy as jnp
+
+        (x,) = inputs
+        p: FoldReduceParams = self.params
+        ax = p.axis % x.ndim
+        parts = jnp.split(x, p.degree, axis=ax)
+        out = parts[0]
+        for part in parts[1:]:
+            out = out + part
+        return [out]
+
+    def flops(self):
+        return float(self.inputs[0].shape.num_elements())
+
+
+@dataclasses.dataclass(frozen=True)
 class FusedParallelParams:
     ops: Tuple = ()  # tuple of (kind, params) pairs
 

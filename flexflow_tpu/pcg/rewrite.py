@@ -304,6 +304,47 @@ class CancelInverseParallel(RewriteRule):
         return {prod.outputs[0].guid: src, op.outputs[0].guid: src}
 
 
+class CancelSplitConcat(RewriteRule):
+    """Concat(Split(x)) with the same axis, outputs in order and
+    unconsumed elsewhere, is the identity — drop both (the reference's
+    Graph::simplify / remove-trivial-ops family, graph.cc; the TASO
+    closure needs it so branch-merge chains can terminate: merge two
+    linears -> split -> [relu,relu] -> concat becomes one linear+relu
+    once taso_rule_543 hoists the relu past the concat)."""
+
+    name = "cancel_split_concat"
+
+    def find_matches(self, graph: Graph) -> List[Match]:
+        counts = _consumer_counts(graph)
+        out = []
+        for op in graph.topo_order():
+            if op.op_type != OperatorType.CONCAT or not op.inputs:
+                continue
+            prod = op.inputs[0].owner_op
+            if prod is None or prod.op_type != OperatorType.SPLIT:
+                continue
+            if len(op.inputs) != len(prod.outputs):
+                continue
+            if any(t.owner_op is not prod or t.owner_idx != k
+                   for k, t in enumerate(op.inputs)):
+                continue
+            rank = op.inputs[0].shape.logical_rank
+            if op.params.axis % rank != prod.params.axis % rank:
+                continue
+            if any(counts.get(t.guid, 0) != 1 for t in prod.outputs):
+                continue
+            out.append(Match(self, (prod, op)))
+        return out
+
+    def build_replacement(self, match, ext, new_graph):
+        prod, cat = match.ops
+        src = ext[prod.inputs[0].guid]
+        out = {cat.outputs[0].guid: src}
+        for t in prod.outputs:
+            out.setdefault(t.guid, src)  # unreferenced externally (checked)
+        return out
+
+
 def generate_rewrite_rules() -> List[RewriteRule]:
     """Built-in rewrite catalog (reference generate_all_pcg_xfers +
     TASO JSON rules)."""
@@ -313,6 +354,7 @@ def generate_rewrite_rules() -> List[RewriteRule]:
         MergeParallelOps(OperatorType.LINEAR),
         MergeParallelOps(OperatorType.CONV2D),
         CancelInverseParallel(),
+        CancelSplitConcat(),
     ]
 
 
@@ -323,11 +365,25 @@ _RULE_FACTORIES = {
 }
 
 
-def load_rewrite_rules(path: str) -> List[RewriteRule]:
+def load_rewrite_rules(path: str, degrees=(2,)) -> List[RewriteRule]:
     """JSON-loadable rewrite rules (reference substitution_loader.cc).
-    Schema: {"rewrites": [{"type": "fuse_activation", "op_type":
-    "linear"}, {"type": "merge_parallel", "op_type": "conv2d"},
-    {"type": "cancel_inverse_parallel_ops"}]}"""
+
+    Two schemas are accepted:
+      * the reference's TASO RuleCollection format
+        (substitutions/graph_subst_3_v2.json — 640 pattern rules),
+        detected by its "_t": "RuleCollection" tag and compiled by
+        pcg/taso.py into generic pattern rules at the given parallel
+        degrees;
+      * this repo's own list format: {"rewrites": [{"type":
+        "fuse_activation", "op_type": "linear"}, {"type":
+        "merge_parallel", "op_type": "conv2d"},
+        {"type": "cancel_inverse_parallel_ops"}]}.
+    """
+    from .taso import is_taso_rule_file, load_taso_rules
+
+    if is_taso_rule_file(path):
+        rules, _report = load_taso_rules(path, degrees=degrees)
+        return list(rules)
     with open(path) as f:
         d = json.load(f)
     out = []
@@ -343,13 +399,28 @@ def rules_by_name(rules: Optional[Sequence[RewriteRule]] = None) -> Dict[str, Re
     return {r.name: r for r in (rules if rules is not None else generate_rewrite_rules())}
 
 
+# Parallel degrees at which TASO catalog rules are instantiated.  The
+# reference derives its considered_parallel_degrees from the machine at
+# hand (substitution.cc:1773-1778), but a strategy records
+# degree-qualified rule names ("taso_rule_N@16"), so the replay host
+# must build the IDENTICAL list — a canonical environment-independent
+# set keeps shipped artifacts loadable anywhere.  Degrees that don't
+# divide the actual mesh simply never match (PatternRule checks the
+# op's concrete degree).
+CATALOG_DEGREES: Tuple[int, ...] = (2, 4, 8, 16)
+
+
 def rules_for_config(cfg) -> List[RewriteRule]:
     """THE rule list for a given FFConfig — search and compile-time
     replay must build the identical ordered list or strategy.rewrites'
-    (name, match index) pairs replay a different match."""
+    (name, match index) pairs replay a different match.  (This is why
+    the TASO catalog degrees are a fixed constant, not derived from the
+    replaying host's device count.)"""
     rules = generate_rewrite_rules()
     if getattr(cfg, "substitution_json", None):
-        rules = rules + load_rewrite_rules(cfg.substitution_json)
+        rules = rules + load_rewrite_rules(
+            cfg.substitution_json, degrees=CATALOG_DEGREES
+        )
     return rules
 
 

@@ -1,0 +1,827 @@
+"""TASO pattern-graph substitution rules: loader + generic match/apply.
+
+Reference: the substitution JSON schema (substitution_loader.h:143-179,
+substitution_loader.cc), rule -> GraphXfer conversion at a concrete
+parallel degree (create_xfer/create_xfers, substitution.cc:1456-1680),
+GraphXfer matching (can_match/match, substitution.cc:235-414) and dst
+instantiation (create_new_operator, substitution.cc:832-1120).  The
+shipped catalog `substitutions/graph_subst_3_v2.json` holds 640
+srcOp->dstOp pattern rules over {Partition, Combine, Replicate,
+Reduction, Linear, Relu, EwAdd, EwMul, Concat, Split}.
+
+This module parses that exact file format into a neutral rule IR
+(`TasoRule`) and compiles each rule into a generic `PatternRule` — a
+`RewriteRule` (pcg/rewrite.py) whose src pattern is matched by
+backtracking subgraph isomorphism and whose dst subgraph is built from
+the pattern, so the whole catalog participates in `enumerate_variants`
+/ the Unity search like any built-in rewrite.
+
+Deliberate divergences from the reference, all load-bearing:
+
+  * PM_ACTI values in the catalog are TASO-native (0=NONE, 1=SIGMOID,
+    2=RELU, 3=TANH); the reference compares them raw against ffconst
+    AC_MODE_* (10..14, ffconst.h:4-10) so its linear rules can never
+    match (can_match substitution.cc:252 vs linear.cc:746-754).  We
+    remap so they can fire.
+  * PM_NUMDIM is answered by no reference op's get_int_parameter
+    (model.cc:1043-1057), so every concat rule asserts/never matches
+    there.  Here it is the tensor's logical rank.
+  * Catalog dims are TASO/Legion column-major (0 = innermost); our
+    tensors are row-major logical (tensor.py), converted per-match via
+    the concrete tensor's rank.
+  * Catalog OP_REPLICATE / OP_REDUCE carry the reference's
+    size-changing semantics (replicate.cc:74-75: size *= degree;
+    reduction.cc:74-77: size /= degree — d stacked copies / fold-sum of
+    d slices), which is what lets the catalog trade an elementwise add
+    for concat+reduce.  They map to the first-class StackReplicate /
+    FoldReduce compute ops (parallel/parallel_op.py), NOT to our
+    replica-dim Replicate/Reduction (which are size-preserving
+    annotations with different semantics).
+  * Like the reference (get_num_inputs substitution.cc:1416: OP_LINEAR
+    -> 1), a linear's declared weight input is dropped; rules whose src
+    pattern becomes disconnected by that truncation are rejected
+    (`convert_rules` reports them) instead of matching arbitrary
+    unrelated subgraphs.
+
+Compute-restructuring rules (linear/concat reassociation) are exact
+function-family equivalences up to weight re-packing — the weight
+tensors are per-op here (as in the reference), so the rewritten model
+trains the same function class at the same FLOPs; parallel-op-only
+rules are exact numerical identities.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..fftype import ActiMode, OperatorType, OpBinary, OpUnary
+from ..ops.op import Op
+from ..tensor import ParallelTensor
+from .graph import Graph
+from .rewrite import Match, RewriteRule, clone_op
+
+
+# --------------------------------------------------------------------------
+# Rule IR + parser (reference substitution_loader.{h,cc})
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TensorRef:
+    """A pattern tensor: output `ts_id` of pattern op `op_id`, or an
+    external input when op_id < 0 (reference sl::Tensor)."""
+
+    op_id: int
+    ts_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TasoOp:
+    """One pattern operator (reference sl::Operator)."""
+
+    type: str  # catalog name, e.g. "OP_PARTITION"
+    inputs: Tuple[TensorRef, ...]
+    params: Tuple[Tuple[str, int], ...]  # ordered (key, value)
+
+    def at(self, key: str) -> Optional[int]:
+        for k, v in self.params:
+            if k == key:
+                return v
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class MapOutput:
+    src_op_id: int
+    src_ts_id: int
+    dst_op_id: int
+    dst_ts_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TasoRule:
+    name: str
+    src_ops: Tuple[TasoOp, ...]
+    dst_ops: Tuple[TasoOp, ...]
+    mapped_outputs: Tuple[MapOutput, ...]
+
+
+def _parse_op(j: dict) -> TasoOp:
+    return TasoOp(
+        type=j["type"],
+        inputs=tuple(TensorRef(t["opId"], t["tsId"]) for t in j.get("input", [])),
+        params=tuple((p["key"], p["value"]) for p in j.get("para", [])),
+    )
+
+
+def parse_rule_collection(path: str) -> List[TasoRule]:
+    """Parse the reference's substitution JSON (RuleCollection schema).
+    Faithful: returns every rule in the file, including ones this
+    engine later rejects as unusable."""
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("_t") != "RuleCollection" or "rule" not in d:
+        raise ValueError(f"{path}: not a TASO RuleCollection file")
+    rules = []
+    for rj in d["rule"]:
+        rules.append(
+            TasoRule(
+                name=rj["name"],
+                src_ops=tuple(_parse_op(o) for o in rj["srcOp"]),
+                dst_ops=tuple(_parse_op(o) for o in rj["dstOp"]),
+                mapped_outputs=tuple(
+                    MapOutput(m["srcOpId"], m["srcTsId"], m["dstOpId"], m["dstTsId"])
+                    for m in rj["mappedOutput"]
+                ),
+            )
+        )
+    return rules
+
+
+def is_taso_rule_file(path: str) -> bool:
+    try:
+        with open(path) as f:
+            head = f.read(4096)
+        return '"RuleCollection"' in head
+    except OSError:
+        return False
+
+
+# --------------------------------------------------------------------------
+# Catalog-op semantics tables
+# --------------------------------------------------------------------------
+
+# reference get_num_inputs (substitution.cc:1416-1454): binary ops take
+# 2, concat takes PM_NUM_INPUTS, everything else (incl. linear, whose
+# declared weight input is dropped) takes 1.
+def _num_inputs(op: TasoOp) -> int:
+    if op.type in ("OP_EW_ADD", "OP_EW_SUB", "OP_EW_MUL", "OP_EW_DIV",
+                   "OP_EW_MAX", "OP_EW_MIN"):
+        return 2
+    if op.type == "OP_CONCAT":
+        n = op.at("PM_NUM_INPUTS")
+        if n is None:
+            raise UnsupportedRule("concat without PM_NUM_INPUTS")
+        return n
+    return 1
+
+
+def _num_outputs(op: TasoOp) -> int:
+    if op.type == "OP_SPLIT":
+        n = op.at("PM_NUM_OUTPUTS")
+        if n is None:
+            raise UnsupportedRule("split without PM_NUM_OUTPUTS")
+        return n
+    return 1
+
+
+# TASO-native ActiMode (the generator's enum), see module docstring.
+_TASO_ACTI = {0: ActiMode.NONE, 1: ActiMode.SIGMOID, 2: ActiMode.RELU,
+              3: ActiMode.TANH}
+
+_PARALLEL_TYPES = {"OP_PARTITION": OperatorType.REPARTITION,
+                   "OP_COMBINE": OperatorType.COMBINE,
+                   "OP_REPLICATE": OperatorType.REPLICATE_STACK,
+                   "OP_REDUCE": OperatorType.REDUCTION_FOLD}
+
+_EW_BINARY = {"OP_EW_ADD": OpBinary.ADD, "OP_EW_SUB": OpBinary.SUB,
+              "OP_EW_MUL": OpBinary.MUL, "OP_EW_DIV": OpBinary.DIV,
+              "OP_EW_MAX": OpBinary.MAX, "OP_EW_MIN": OpBinary.MIN}
+
+_EW_UNARY = {"OP_RELU": OpUnary.RELU, "OP_SIGMOID": OpUnary.SIGMOID,
+             "OP_TANH": OpUnary.TANH, "OP_EXP": OpUnary.EXP,
+             "OP_IDENTITY": OpUnary.IDENTITY}
+
+SUPPORTED_TYPES = (set(_PARALLEL_TYPES) | set(_EW_BINARY) | set(_EW_UNARY)
+                   | {"OP_LINEAR", "OP_CONCAT", "OP_SPLIT"})
+
+
+class UnsupportedRule(ValueError):
+    """Rule cannot be compiled into this IR; carries the reason."""
+
+
+def _logical_rank(t: ParallelTensor) -> int:
+    return t.shape.logical_rank
+
+
+def _col_to_row(dim: int, rank: int) -> int:
+    """Catalog column-major dim -> row-major logical index."""
+    if dim < 0 or dim >= rank:
+        raise UnsupportedRule(f"dim {dim} out of range for rank {rank}")
+    return rank - 1 - dim
+
+
+# --------------------------------------------------------------------------
+# The generic pattern rule
+# --------------------------------------------------------------------------
+
+class PatternRule(RewriteRule):
+    """A catalog rule compiled at a concrete parallel degree.
+
+    Matching mirrors GraphXfer::can_match (substitution.cc:235): per
+    pattern op, op-type + parameter constraints + exact input wiring
+    (pattern input slot j must be the matched producer's output, or a
+    consistently-bound external).  Matches are found by backtracking in
+    pattern dependency order over type-indexed candidates.
+    """
+
+    def __init__(self, rule: TasoRule, degree: int):
+        self.rule = rule
+        self.degree = degree
+        self.name = f"{rule.name}@{degree}"
+        self._src = self._compile_side(rule.src_ops)
+        self._dst = self._compile_side(rule.dst_ops)
+        self._validate()
+
+    # -- compilation -----------------------------------------------------
+    def _compile_side(self, ops: Sequence[TasoOp]):
+        compiled = []
+        for i, op in enumerate(ops):
+            if op.type not in SUPPORTED_TYPES:
+                raise UnsupportedRule(f"op type {op.type}")
+            n_in = _num_inputs(op)
+            inputs = op.inputs[:n_in]
+            if len(inputs) < n_in:
+                raise UnsupportedRule(f"{op.type} missing inputs")
+            for ref in inputs:
+                if ref.op_id >= i:
+                    raise UnsupportedRule("pattern not in dependency order")
+            compiled.append((op, inputs))
+        return compiled
+
+    def _validate(self):
+        # uses_parallel decides degree-instantiation (see convert_rules)
+        self.uses_parallel = any(
+            op.type in _PARALLEL_TYPES
+            for op, _ in (self._src + self._dst)
+        )
+        # reference create_xfers skips trivial 1->1 rules
+        if len(self._src) == 1 and len(self._dst) == 1:
+            raise UnsupportedRule("trivial 1->1 rule")
+        # src pattern must stay connected after weight-input truncation
+        # (treating shared externals as connections), else matching would
+        # pair unrelated subgraphs
+        n = len(self._src)
+        adj = [set() for _ in range(n)]
+        ext_users: Dict[int, List[int]] = {}
+        for i, (op, inputs) in enumerate(self._src):
+            for ref in inputs:
+                if ref.op_id >= 0:
+                    adj[i].add(ref.op_id)
+                    adj[ref.op_id].add(i)
+                else:
+                    ext_users.setdefault(ref.op_id, []).append(i)
+        for users in ext_users.values():
+            for u in users[1:]:
+                adj[users[0]].add(u)
+                adj[u].add(users[0])
+        seen = {0}
+        stack = [0]
+        while stack:
+            x = stack.pop()
+            for y in adj[x]:
+                if y not in seen:
+                    seen.add(y)
+                    stack.append(y)
+        if len(seen) != n:
+            raise UnsupportedRule("src pattern disconnected after truncation")
+        # attribute-carrying dst ops need exactly one same-type src op to
+        # copy params from (reference find_opx_with_type asserts this,
+        # substitution.cc:1521-1533)
+        src_linears = [i for i, (op, _) in enumerate(self._src)
+                       if op.type == "OP_LINEAR"]
+        for op, _ in self._dst:
+            if op.type == "OP_LINEAR" and len(src_linears) != 1:
+                raise UnsupportedRule(
+                    f"dst linear needs exactly 1 src linear, have {len(src_linears)}"
+                )
+        self._src_linear_idx = src_linears[0] if src_linears else None
+        # every external the dst consumes must be bound by the src match
+        # (truncation can strip the only src use of an external — the
+        # reference would hit the mappedInputs assert at
+        # substitution.cc:813; reject statically instead)
+        src_exts = {r.op_id for _, inputs in self._src for r in inputs
+                    if r.op_id < 0}
+        for _, inputs in self._dst:
+            for r in inputs:
+                if r.op_id < 0 and r.op_id not in src_exts:
+                    raise UnsupportedRule("dst uses external unbound by src")
+        # every src output consumed by another src op is internal; the
+        # remaining (externally visible) ones must be covered by
+        # mappedOutput or apply() would drop consumers.  Checked lazily in
+        # apply via KeyError -> None, but reject statically when NO output
+        # of the sink src op is mapped (rule can never apply).
+        mapped = {(m.src_op_id, m.src_ts_id) for m in self.rule.mapped_outputs}
+        internally_used = {(r.op_id, r.ts_id)
+                           for _, inputs in self._src for r in inputs
+                           if r.op_id >= 0}
+        sinks = [i for i in range(n)
+                 if not any(r.op_id == i for _, inputs in self._src
+                            for r in inputs)]
+        for s in sinks:
+            outs = range(_num_outputs(self._src[s][0]))
+            if not any((s, t) in mapped or (s, t) in internally_used
+                       for t in outs):
+                raise UnsupportedRule(f"sink src op {s} output unmapped")
+
+    # -- matching --------------------------------------------------------
+    def _op_matches(self, pat: TasoOp, op: Op) -> bool:
+        t = pat.type
+        if t in _PARALLEL_TYPES:
+            if op.op_type != _PARALLEL_TYPES[t]:
+                return False
+            deg = pat.at("PM_PARALLEL_DEGREE")
+            if deg is not None and op.params.degree != self.degree:
+                return False
+            dim = pat.at("PM_PARALLEL_DIM")
+            if dim is not None:
+                rank = _logical_rank(op.inputs[0])
+                if dim >= rank:
+                    return False
+                want = _col_to_row(dim, rank)
+                actual = (op.params.dim if t in ("OP_PARTITION", "OP_COMBINE")
+                          else op.params.axis)
+                if actual % rank != want:
+                    return False
+            return True
+        if t in _EW_UNARY:
+            return (op.op_type == OperatorType.ELEMENT_UNARY
+                    and op.params.op == _EW_UNARY[t])
+        if t in _EW_BINARY:
+            return (op.op_type == OperatorType.ELEMENT_BINARY
+                    and op.params.op == _EW_BINARY[t])
+        if t == "OP_LINEAR":
+            if op.op_type != OperatorType.LINEAR:
+                return False
+            acti = pat.at("PM_ACTI")
+            if acti is not None:
+                want = _TASO_ACTI.get(acti)
+                if want is None or op.params.activation != want:
+                    return False
+            return True
+        if t == "OP_CONCAT":
+            if op.op_type != OperatorType.CONCAT:
+                return False
+            n = pat.at("PM_NUM_INPUTS")
+            if n is not None and len(op.inputs) != n:
+                return False
+            rank = _logical_rank(op.inputs[0])
+            numdim = pat.at("PM_NUMDIM")
+            if numdim is not None and rank != numdim:
+                return False
+            axis = pat.at("PM_AXIS")
+            if axis is not None:
+                if axis >= rank or op.params.axis % rank != _col_to_row(axis, rank):
+                    return False
+            return True
+        if t == "OP_SPLIT":
+            if op.op_type != OperatorType.SPLIT:
+                return False
+            n = pat.at("PM_NUM_OUTPUTS")
+            if n is not None and len(op.outputs) != n:
+                return False
+            rank = _logical_rank(op.inputs[0])
+            axis = pat.at("PM_AXIS")
+            if axis is not None:
+                if axis >= rank or op.params.axis % rank != _col_to_row(axis, rank):
+                    return False
+            return True
+        return False
+
+    def find_matches(self, graph: Graph) -> List[Match]:
+        by_type: Dict[str, List[Op]] = {}
+        topo = graph.topo_order()
+        for op in topo:
+            by_type.setdefault(op.op_type.value, []).append(op)
+        # quick reject: every pattern type must occur in the graph
+        for pat, _ in self._src:
+            t = pat.type
+            key = (_PARALLEL_TYPES[t].value if t in _PARALLEL_TYPES else
+                   "element_unary" if t in _EW_UNARY else
+                   "element_binary" if t in _EW_BINARY else
+                   t[3:].lower())
+            if key not in by_type:
+                return []
+
+        out: List[Match] = []
+        n = len(self._src)
+        assignment: List[Optional[Op]] = [None] * n
+        used: set = set()
+        ext: Dict[int, int] = {}  # external id -> tensor guid
+
+        def candidates(pat: TasoOp) -> List[Op]:
+            t = pat.type
+            if t in _PARALLEL_TYPES:
+                return by_type.get(_PARALLEL_TYPES[t].value, [])
+            if t in _EW_UNARY:
+                return by_type.get("element_unary", [])
+            if t in _EW_BINARY:
+                return by_type.get("element_binary", [])
+            return by_type.get(t[3:].lower(), [])
+
+        def wire_ok(i: int, op: Op, new_ext: Dict[int, int]) -> bool:
+            pat, inputs = self._src[i]
+            if len(op.inputs) != len(inputs):
+                return False
+            for j, ref in enumerate(inputs):
+                actual = op.inputs[j]
+                if ref.op_id >= 0:
+                    prod = assignment[ref.op_id]
+                    if (actual.owner_op is not prod
+                            or actual.owner_idx != ref.ts_id):
+                        return False
+                else:
+                    bound = ext.get(ref.op_id, new_ext.get(ref.op_id))
+                    if bound is None:
+                        new_ext[ref.op_id] = actual.guid
+                    elif bound != actual.guid:
+                        return False
+            return True
+
+        def backtrack(i: int):
+            if i == n:
+                out.append(Match(self, tuple(assignment)))
+                return
+            pat, _ = self._src[i]
+            for op in candidates(pat):
+                if op.guid in used or not self._op_matches(pat, op):
+                    continue
+                new_ext: Dict[int, int] = {}
+                if not wire_ok(i, op, new_ext):
+                    continue
+                assignment[i] = op
+                used.add(op.guid)
+                ext.update(new_ext)
+                backtrack(i + 1)
+                assignment[i] = None
+                used.discard(op.guid)
+                for k in new_ext:
+                    ext.pop(k, None)
+
+        backtrack(0)
+        return out
+
+    # -- replacement -----------------------------------------------------
+    def _make_dst_op(self, pat: TasoOp, new_inputs: List[ParallelTensor],
+                     match: Match, name: str) -> Op:
+        from ..ops.dense import Linear
+        from ..ops.element import (ElementBinary, ElementBinaryParams,
+                                   ElementUnary, ElementUnaryParams)
+        from ..ops.shape import Concat, ConcatParams, Split, SplitParams
+        from ..parallel.parallel_op import (Combine, CombineParams,
+                                            FoldReduce, FoldReduceParams,
+                                            Repartition, RepartitionParams,
+                                            StackReplicate,
+                                            StackReplicateParams)
+
+        t = pat.type
+        if t in _PARALLEL_TYPES:
+            rank = _logical_rank(new_inputs[0])
+            dim = pat.at("PM_PARALLEL_DIM")
+            if dim is None:
+                raise UnsupportedRule(f"{t} without PM_PARALLEL_DIM")
+            row = _col_to_row(dim, rank)  # raises -> apply returns None
+            cls, pcls, key = {
+                "OP_PARTITION": (Repartition, RepartitionParams, "dim"),
+                "OP_COMBINE": (Combine, CombineParams, "dim"),
+                "OP_REPLICATE": (StackReplicate, StackReplicateParams, "axis"),
+                "OP_REDUCE": (FoldReduce, FoldReduceParams, "axis"),
+            }[t]
+            return cls(pcls(**{key: row, "degree": self.degree}), new_inputs,
+                       name=name)
+        if t in _EW_UNARY:
+            return ElementUnary(ElementUnaryParams(op=_EW_UNARY[t]),
+                                new_inputs, name=name)
+        if t in _EW_BINARY:
+            return ElementBinary(ElementBinaryParams(op=_EW_BINARY[t]),
+                                 new_inputs, name=name)
+        if t == "OP_LINEAR":
+            matched = match.ops[self._src_linear_idx]
+            acti = pat.at("PM_ACTI")
+            params = matched.params
+            if acti is not None:
+                want = _TASO_ACTI.get(acti)
+                if want is None:
+                    raise UnsupportedRule(f"unknown PM_ACTI {acti}")
+                params = dataclasses.replace(params, activation=want)
+            return clone_op(matched, new_inputs, name=name, params=params)
+        if t == "OP_CONCAT":
+            rank = _logical_rank(new_inputs[0])
+            axis = pat.at("PM_AXIS")
+            if axis is None:
+                raise UnsupportedRule("concat without PM_AXIS")
+            return Concat(ConcatParams(axis=_col_to_row(axis, rank)),
+                          new_inputs, name=name)
+        if t == "OP_SPLIT":
+            rank = _logical_rank(new_inputs[0])
+            axis = pat.at("PM_AXIS")
+            nout = _num_outputs(pat)
+            if axis is None:
+                raise UnsupportedRule("split without PM_AXIS")
+            row = _col_to_row(axis, rank)
+            size = new_inputs[0].shape.logical_shape[row]
+            if size % nout != 0:
+                # reference: op = INVALID_NODE (substitution.cc:884-890)
+                raise UnsupportedRule("split size not divisible")
+            return Split(SplitParams(sizes=(size // nout,) * nout, axis=row),
+                         new_inputs, name=name)
+        raise UnsupportedRule(f"dst op type {t}")
+
+    def build_replacement(self, match: Match, ext: Dict[int, ParallelTensor],
+                          new_graph: Graph) -> Dict[int, ParallelTensor]:
+        # re-derive external bindings exactly as matching did
+        ext_bind: Dict[int, ParallelTensor] = {}
+        for i, (pat, inputs) in enumerate(self._src):
+            op = match.ops[i]
+            for j, ref in enumerate(inputs):
+                if ref.op_id < 0 and ref.op_id not in ext_bind:
+                    ext_bind[ref.op_id] = ext[op.inputs[j].guid]
+        base = match.ops[0].name
+        new_ops: List[Op] = []
+        for i, (pat, inputs) in enumerate(self._dst):
+            new_inputs = []
+            for ref in inputs:
+                if ref.op_id < 0:
+                    if ref.op_id not in ext_bind:
+                        raise UnsupportedRule(
+                            f"dst references unbound external {ref.op_id}")
+                    new_inputs.append(ext_bind[ref.op_id])
+                else:
+                    new_inputs.append(new_ops[ref.op_id].outputs[ref.ts_id])
+            # keep the matched linear's name when the rule has a unique
+            # dst linear (weights then transfer by name across rewrite)
+            if (pat.type == "OP_LINEAR"
+                    and sum(1 for p, _ in self._dst if p.type == "OP_LINEAR") == 1):
+                name = match.ops[self._src_linear_idx].name
+            else:
+                name = f"{base}.{self.rule.name}.{i}"
+            op = self._make_dst_op(pat, new_inputs, match, name)
+            new_graph.add_op(op)
+            new_ops.append(op)
+        out: Dict[int, ParallelTensor] = {}
+        for m in self.rule.mapped_outputs:
+            old = match.ops[m.src_op_id].outputs[m.src_ts_id]
+            out[old.guid] = new_ops[m.dst_op_id].outputs[m.dst_ts_id]
+        return out
+
+
+# --------------------------------------------------------------------------
+# Catalog conversion + per-rule numerical verification
+# --------------------------------------------------------------------------
+
+# bump when matching/realization semantics change: invalidates the
+# verification cache
+ENGINE_VERSION = 2
+
+
+def verify_rule(prule: PatternRule) -> str:
+    """Numerically verify one compiled rule under the realized
+    semantics: instantiate its src pattern, self-match, apply, and
+    compare probe outputs.  Returns a verdict string:
+
+      "exact"        — rewrite is a numerical identity;
+      "family"       — shapes preserved but a linear's input changed
+                       (weight-repacking equivalence: same function
+                       class, same FLOPs — TASO verified it with weight
+                       tensors the schema then drops);
+      "fail: ..."    — could not be validated; rule must not be used.
+
+    TASO verifies every generated rule against concrete tensors; the
+    reference ingests the JSON unverified.  Since our realization of
+    Replicate/Reduction fixes a concrete intra-dim layout
+    (StackReplicate/FoldReduce, block order), a handful of catalog
+    rules whose equivalence only holds in the parallel-tensor algebra
+    (degree as a device axis, layout-free) do not survive — this gate
+    rejects exactly those.
+    """
+    import numpy as np
+
+    inst = instantiate_src(prule, probes=True)
+    if inst is None:
+        return "fail: could not instantiate src pattern"
+    g, _ = inst
+    matches = prule.find_matches(g)
+    if not matches:
+        return "fail: src pattern does not self-match"
+    g2 = None
+    for m in matches:
+        g2 = prule.apply(g, m)
+        if g2 is not None:
+            break
+    if g2 is None:
+        return "fail: apply rejected by shape rules"
+
+    def run(graph, feeds):
+        vals, out = {}, {}
+        for op in graph.topo_order():
+            if op.op_type == OperatorType.INPUT:
+                vals[op.outputs[0].guid] = feeds[op.name]
+                continue
+            ws = []
+            for spec in op.weight_specs:
+                key = (op.name, spec.name)
+                shape = tuple(dd.size for dd in spec.shape.dims
+                              if not dd.is_replica_dim)
+                ws.append(np.random.RandomState(
+                    abs(hash(key)) % 2**31).randn(*shape).astype(np.float32) * 0.1)
+            ins = [vals[t.guid] for t in op.inputs]
+            res = op.forward(ins, ws)
+            for t, v in zip(op.outputs, res):
+                vals[t.guid] = np.asarray(v)
+            if op.name.startswith("probe"):
+                out[op.name] = np.asarray(res[0])
+        return out
+
+    feeds = {
+        op.name: np.random.RandomState(7).randn(
+            *op.outputs[0].shape.logical_shape).astype(np.float32)
+        for op in g.ops if op.op_type == OperatorType.INPUT
+    }
+    try:
+        o1, o2 = run(g, feeds), run(g2, feeds)
+    except Exception as e:  # op forward on logical arrays must not fail
+        return f"fail: execution error {type(e).__name__}"
+    if set(o1) != set(o2) or any(o1[k].shape != o2[k].shape for k in o1):
+        return "fail: probe shape mismatch"
+    if all(np.allclose(o1[k], o2[k], rtol=1e-4, atol=1e-4) for k in o1):
+        return "exact"
+    src_lin = [(tuple(op.inputs[0].shape.logical_shape))
+               for op in g.ops if op.op_type == OperatorType.LINEAR]
+    dst_lin = [(tuple(op.inputs[0].shape.logical_shape))
+               for op in g2.ops if op.op_type == OperatorType.LINEAR]
+    if sorted(src_lin) != sorted(dst_lin):
+        return "family"
+    return "fail: numeric mismatch"
+
+
+def _verify_cache_path() -> str:
+    import os
+
+    base = os.environ.get("FLEXFLOW_TPU_CACHE_DIR",
+                          os.path.expanduser("~/.cache/flexflow_tpu"))
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, "taso_verify.json")
+
+
+def _verified_verdicts(path: str, rules: Sequence[TasoRule]) -> Dict[str, str]:
+    """Per-rule verdicts for a catalog file, cached on disk keyed by
+    (file identity, engine version).  Verification is degree-independent
+    (run at degree 2, the catalog's template degree)."""
+    import os
+
+    key = f"{os.path.abspath(path)}:{os.path.getmtime(path)}:v{ENGINE_VERSION}"
+    cache_file = _verify_cache_path()
+    cache = {}
+    try:
+        with open(cache_file) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if key in cache:
+        return cache[key]
+    verdicts: Dict[str, str] = {}
+    for r in rules:
+        try:
+            pr = PatternRule(r, degree=2)
+        except UnsupportedRule as e:
+            verdicts[r.name] = f"skip: {e.args[0] if e.args else 'unsupported'}"
+            continue
+        verdicts[r.name] = verify_rule(pr)
+    cache = {key: verdicts}  # keep only the latest file identity
+    try:
+        with open(cache_file, "w") as f:
+            json.dump(cache, f)
+    except OSError:
+        pass
+    return verdicts
+
+
+def convert_rules(
+    rules: Sequence[TasoRule],
+    degrees: Sequence[int] = (2,),
+    verdicts: Optional[Dict[str, str]] = None,
+) -> Tuple[List[PatternRule], Dict[str, int]]:
+    """Compile parsed rules into PatternRules.
+
+    Parallel-op rules are instantiated once per degree (reference
+    create_xfers is called per considered degree, substitution.cc:1779-
+    1786); purely algebraic rules are degree-independent and
+    instantiated once.  When `verdicts` is given (see
+    `_verified_verdicts`), only rules verified "exact" or "family" are
+    kept.  Returns (rules, report) where report counts skip reasons —
+    the honest accounting of what the engine can and cannot ingest.
+    """
+    out: List[PatternRule] = []
+    report: Dict[str, int] = {"converted": 0, "instantiated": 0}
+
+    def skip(reason: str):
+        key = f"skip: {reason}"
+        report[key] = report.get(key, 0) + 1
+
+    for r in rules:
+        if verdicts is not None:
+            v = verdicts.get(r.name, "fail: unverified")
+            if v.startswith("skip: "):
+                skip(v[6:])
+                continue
+            if v.startswith("fail"):
+                skip(f"verification ({v})")
+                continue
+        try:
+            first = PatternRule(r, degree=int(degrees[0]) if degrees else 2)
+        except UnsupportedRule as e:
+            skip(e.args[0] if e.args else "unsupported")
+            continue
+        report["converted"] += 1
+        out.append(first)
+        if first.uses_parallel:
+            for d in list(degrees)[1:]:
+                out.append(PatternRule(r, degree=int(d)))
+    report["instantiated"] = len(out)
+    return out, report
+
+
+def load_taso_rules(
+    path: str, degrees: Sequence[int] = (2,), verify: bool = True
+) -> Tuple[List[PatternRule], Dict[str, int]]:
+    rules = parse_rule_collection(path)
+    verdicts = _verified_verdicts(path, rules) if verify else None
+    return convert_rules(rules, degrees, verdicts=verdicts)
+
+
+# --------------------------------------------------------------------------
+# Pattern instantiation (test harness: realize a rule's src pattern as a
+# concrete graph so match/apply/numerics can be validated per rule)
+# --------------------------------------------------------------------------
+
+def _make_src_op(prule: PatternRule, pat: TasoOp,
+                 new_inputs: List[ParallelTensor], name: str) -> Op:
+    """Concrete op for a SRC pattern node (no match to copy attrs from:
+    linears get synthetic params)."""
+    if pat.type == "OP_LINEAR":
+        from ..ops.dense import Linear, LinearParams
+
+        acti = pat.at("PM_ACTI")
+        return Linear(
+            LinearParams(out_channels=8, use_bias=True,
+                         activation=_TASO_ACTI.get(acti, ActiMode.NONE)
+                         if acti is not None else ActiMode.NONE),
+            new_inputs, name=name)
+    fake = Match(prule, ())
+    return prule._make_dst_op(pat, new_inputs, fake, name)
+
+
+def instantiate_src(
+    prule: PatternRule, probes: bool = True
+) -> Optional[Tuple[Graph, List[str]]]:
+    """Build a concrete Graph realizing the rule's src pattern, trying a
+    small family of external shapes/degrees until shape rules accept.
+    Appends an identity probe op per mapped src output (so the rewritten
+    graph keeps a same-named handle to compare against).  Returns
+    (graph, ext_input_names) or None if no seed config builds."""
+    from ..ops.element import ElementUnary, ElementUnaryParams
+    from ..ops.sources import InputOp, SourceParams
+    from ..tensor import ParallelTensorShape
+
+    ext_ids = sorted({r.op_id for _, inputs in prule._src for r in inputs
+                      if r.op_id < 0})
+    d = prule.degree
+    seed_cfgs = [
+        ((1, 1, 1), 1), ((1, 1, 1), d), ((d, d, d), d), ((d, d, 1), d),
+        ((1, d, 1), 1), ((d, 1, 1), d), ((1, 1, d), d), ((d, d, d), 1),
+        ((1, d, d), d), ((d, d, 1), 1),
+    ]
+    size = 8 * d  # divisible through chained partitions up to d*d
+    for degrees, rep in seed_cfgs:
+        try:
+            g = Graph()
+            ext_map: Dict[int, ParallelTensor] = {}
+            names = []
+            for e in ext_ids:
+                shape = ParallelTensorShape.make(
+                    (size, size, size), degrees=degrees, replica_degree=rep)
+                inp = InputOp(SourceParams(shape=shape), [],
+                              name=f"ext{-e}")
+                g.add_op(inp)
+                ext_map[e] = inp.outputs[0]
+                names.append(inp.name)
+            ops: List[Op] = []
+            for i, (pat, inputs) in enumerate(prule._src):
+                new_inputs = [
+                    ext_map[r.op_id] if r.op_id < 0
+                    else ops[r.op_id].outputs[r.ts_id]
+                    for r in inputs
+                ]
+                op = _make_src_op(prule, pat, new_inputs, f"pat{i}")
+                g.add_op(op)
+                ops.append(op)
+            if probes:
+                for k, m in enumerate(prule.rule.mapped_outputs):
+                    t = ops[m.src_op_id].outputs[m.src_ts_id]
+                    g.add_op(ElementUnary(
+                        ElementUnaryParams(op=OpUnary.IDENTITY), [t],
+                        name=f"probe{k}"))
+            return g, names
+        except (ValueError, KeyError, IndexError):
+            continue
+    return None

@@ -829,6 +829,10 @@ class UnitySearch:
             if not collector:
                 return None
             collector.sort(key=lambda c: c[0])
+            # diagnostic: winning analytic objective, read by tests and
+            # search reporting (not serialized with the strategy)
+            for obj, strategy, _g in collector:
+                strategy.search_cost = obj
             if not self.event_rerank:
                 return collector[0][1]
             # re-rank the analytic top-K with the event simulator's
