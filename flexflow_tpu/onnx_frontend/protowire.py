@@ -43,6 +43,8 @@ _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
 def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
     out = shift = 0
     while True:
+        if i >= len(buf):
+            raise ValueError("truncated protobuf: varint runs past end")
         b = buf[i]
         i += 1
         out |= (b & 0x7F) << shift
@@ -52,7 +54,9 @@ def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
 
 
 def _fields(buf: bytes):
-    """Yield (field_number, wire_type, value) records."""
+    """Yield (field_number, wire_type, value) records.  A record whose
+    payload runs past the buffer raises ValueError instead of silently
+    yielding a short slice (truncated/corrupt file)."""
     i, n = 0, len(buf)
     while i < n:
         key, i = _read_varint(buf, i)
@@ -68,6 +72,8 @@ def _fields(buf: bytes):
             v, i = buf[i:i + 4], i + 4
         else:
             raise ValueError(f"unsupported wire type {wt} (field {field})")
+        if i > n:
+            raise ValueError("truncated protobuf: record runs past end")
         yield field, wt, v
 
 
@@ -223,7 +229,9 @@ def _parse_attribute(buf: bytes) -> Attribute:
     elif atype == 2 or (atype == 0 and i64 is not None):
         a.value = i64
     elif atype == 3 or (atype == 0 and s is not None):
-        a.value = s.decode()
+        # bytes, matching onnx.helper.get_attribute_value: handlers see
+        # the same type whichever parser decoded the model
+        a.value = s
     elif atype == 4 or (atype == 0 and t is not None):
         a.value = t.array
     elif atype == 6 or (atype == 0 and floats):
@@ -231,7 +239,7 @@ def _parse_attribute(buf: bytes) -> Attribute:
     elif atype == 7 or (atype == 0 and ints):
         a.value = list(ints)
     elif atype == 8 or (atype == 0 and strings):
-        a.value = [x.decode() for x in strings]
+        a.value = list(strings)  # bytes, like the onnx package
     return a
 
 

@@ -60,6 +60,7 @@ class DynamicBatcher:
         self._latencies = deque(maxlen=latency_window)
         self._lat_lock = threading.Lock()
         self._carry: Optional[_Pending] = None  # overflow from coalescing
+        self._carry_lock = threading.Lock()  # close() vs assembler
         self.batches_run = 0
         self.requests_done = 0
         self._assembler = threading.Thread(target=self._assemble_loop,
@@ -77,9 +78,19 @@ class DynamicBatcher:
 
     def infer_async(self, inputs: Dict[str, np.ndarray]) -> _Pending:
         """Non-blocking submit; returns a future-style handle with
-        .wait(timeout)."""
+        .wait(timeout).  Raises after close() — the assembler is gone
+        and the request would otherwise wait out its full timeout."""
+        if self._stop.is_set():
+            raise RuntimeError("DynamicBatcher is closed")
         p = _Pending({k: np.asarray(v) for k, v in inputs.items()})
         self._queue.put(p)
+        # enqueue-then-recheck: close() may have finished its final
+        # drain between the check above and the put — fail the request
+        # ourselves rather than park it for its full wait timeout
+        # (idempotent if the drain also saw it)
+        if self._stop.is_set():
+            p.error = RuntimeError("DynamicBatcher is closed")
+            p.event.set()
         return p
 
     def latency_stats(self) -> Dict[str, float]:
@@ -90,7 +101,13 @@ class DynamicBatcher:
             return {"n": 0}
 
         def pct(p):
-            return lats[min(len(lats) - 1, int(p * len(lats)))] * 1e3
+            # nearest-rank: ceil(p*n)-th order statistic (int(p*n) is
+            # upward-biased — p95 of a 20-sample window would always be
+            # the max)
+            import math
+
+            i = min(len(lats) - 1, max(0, math.ceil(p * len(lats)) - 1))
+            return lats[i] * 1e3
 
         return {
             "n": len(lats),
@@ -104,8 +121,9 @@ class DynamicBatcher:
         self._stop.set()
 
         def drain():
-            if self._carry is not None:
+            with self._carry_lock:
                 p, self._carry = self._carry, None
+            if p is not None:
                 p.error = RuntimeError("DynamicBatcher closed")
                 p.event.set()
             for q in (self._queue, self._inflight):
@@ -135,9 +153,9 @@ class DynamicBatcher:
     # -- assembler stage ------------------------------------------------
     def _assemble_loop(self):
         while not self._stop.is_set():
-            if self._carry is not None:
+            with self._carry_lock:
                 first, self._carry = self._carry, None
-            else:
+            if first is None:
                 try:
                     first = self._queue.get(timeout=0.05)
                 except queue.Empty:
@@ -160,7 +178,14 @@ class DynamicBatcher:
                     break
                 n = len(next(iter(nxt.inputs.values())))
                 if total + n > cap:
-                    self._carry = nxt  # overflow: heads the next batch
+                    with self._carry_lock:
+                        if self._stop.is_set():
+                            # close() already drained; fail it here
+                            # rather than parking it forever
+                            nxt.error = RuntimeError("DynamicBatcher closed")
+                            nxt.event.set()
+                        else:
+                            self._carry = nxt  # overflow: heads next batch
                     break
                 batch.append(nxt)
                 total += n

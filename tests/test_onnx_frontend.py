@@ -205,3 +205,26 @@ def test_onnx_prefers_installed_package_path():
         assert [n.op_type for n in om.graph.node][0] == "Gemm"
     finally:
         os.unlink(path)
+
+
+def test_wire_truncated_raises_clear_error():
+    """A truncated/corrupt buffer raises ValueError('truncated...')
+    instead of silently misparsing short slices (ADVICE r03)."""
+    data, _ = _mlp_model_bytes(np.random.RandomState(0))
+    with pytest.raises(ValueError, match="truncated"):
+        pw.load_model(data[: len(data) - 7])
+    # a varint that runs off the end
+    with pytest.raises(ValueError, match="truncated"):
+        list(pw._fields(b"\x08\xff"))
+
+
+def test_wire_string_attributes_are_bytes():
+    """STRING/STRINGS attributes decode to bytes, matching
+    onnx.helper.get_attribute_value (ADVICE r03: a handler comparing
+    against b"..." must behave the same under either parser)."""
+    attr = pw._ld(1, b"mode") + pw._ld(4, b"constant") + pw._vi(20, 3)
+    a = pw._parse_attribute(attr)
+    assert a.value == b"constant"
+    attrs = pw._ld(1, b"names") + pw._ld(9, b"a") + pw._ld(9, b"b") + pw._vi(20, 8)
+    a2 = pw._parse_attribute(attrs)
+    assert a2.value == [b"a", b"b"]

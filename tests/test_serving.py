@@ -169,3 +169,28 @@ def test_from_onnx_serves(devices8, tmp_path):
     want = np.exp(logits - logits.max(-1, keepdims=True))
     want /= want.sum(-1, keepdims=True)
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_infer_after_close_raises(engine):
+    """Submitting after close() fails fast instead of burning the full
+    wait timeout on a dead assembler (ADVICE r03)."""
+    batcher = DynamicBatcher(engine, max_batch=8)
+    batcher.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        batcher.infer_async({"x": np.zeros((2, 8), np.float32)})
+
+
+def test_latency_percentiles_nearest_rank():
+    """p95 of a small window is not simply the max (nearest-rank
+    indexing; ADVICE r03)."""
+    b = DynamicBatcher.__new__(DynamicBatcher)  # stats only, no threads
+    import threading
+    from collections import deque
+
+    b._latencies = deque([i / 1000.0 for i in range(1, 21)])  # 1..20ms
+    b._lat_lock = threading.Lock()
+    stats = b.latency_stats()
+    assert stats["n"] == 20
+    assert stats["p50_ms"] == 10.0  # ceil(.5*20)=10th order stat
+    assert stats["p95_ms"] == 19.0  # ceil(.95*20)=19th, not the max
+    assert stats["p99_ms"] == 20.0
