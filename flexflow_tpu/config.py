@@ -50,6 +50,10 @@ class FFConfig:
     search_budget: int = 0
     search_alpha: float = 0.05
     search_algo: str = "unity"  # "unity" (default, OSDI'22 path) | "mcmc" (SysML'19 legacy)
+    # MCMC propagate move (reference FF_USE_PROPAGATE, model.cc:3180-
+    # 3258): a rewrite may spread to structurally identical ops — big
+    # convergence win on deep nets with repeated layers
+    search_propagate: bool = True
     only_data_parallel: bool = False
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
@@ -145,6 +149,8 @@ class FFConfig:
         p.add_argument("-ll:fsize", dest="fsize_mb", type=int, default=16384)
         p.add_argument("--budget", "--search-budget", dest="budget", type=int, default=0)
         p.add_argument("--alpha", "--search-alpha", dest="alpha", type=float, default=0.05)
+        p.add_argument("--no-propagate", dest="search_propagate",
+                       action="store_false", default=True)
         p.add_argument("--search-algo", dest="search_algo", type=str, default="unity",
                        choices=("unity", "mcmc"))
         p.add_argument("--only-data-parallel", action="store_true")
@@ -188,6 +194,7 @@ class FFConfig:
             memory_per_device=args.fsize_mb * 1024**2,
             search_budget=args.budget,
             search_alpha=args.alpha,
+            search_propagate=args.search_propagate,
             search_algo=args.search_algo,
             only_data_parallel=args.only_data_parallel,
             enable_parameter_parallel=args.enable_parameter_parallel,

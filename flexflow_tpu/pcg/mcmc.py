@@ -89,6 +89,9 @@ class MCMCSearch:
         memory_budget: Optional[int] = None,
         memory_lambda: float = 1.0,
         seed: int = 0,
+        propagate: bool = True,
+        propagation_chance: float = 0.25,
+        continue_chance: float = 0.7,
     ):
         self.graph = graph
         self.n = num_devices
@@ -98,6 +101,16 @@ class MCMCSearch:
         self.memory_budget = memory_budget
         self.memory_lambda = memory_lambda
         self.rng = random.Random(seed)
+        # FF_USE_PROPAGATE (reference model.cc:3180-3258): a rewrite may
+        # spread the changed op's config to adoptable neighbors, walking
+        # while randf() < CONTINUE_PROPAGATION_CHANCE.  Our per-op state
+        # is the shard flag, so the analogue copies the flipped value to
+        # structurally identical candidates (same kind+limits — the 12
+        # identical encoder layers of a deep net), which is the case the
+        # reference optimization accelerates.
+        self.propagate = propagate
+        self.propagation_chance = propagation_chance
+        self.continue_chance = continue_chance
         self.candidates = find_candidates(graph)
         has_experts = any(c.kind == "expert" for c in self.candidates)
         self.factorizations = _factorizations(
@@ -123,11 +136,35 @@ class MCMCSearch:
         s = Strategy(mesh_axes=self._mesh_axes(dp, tp, ep))
         if dp > 1:
             s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
+        # Megatron column->row pairing: a channel(tp)-sharded linear
+        # leaves its output feature-sharded; a DIRECTLY consuming linear
+        # must contract over that sharding (reduction=tp), not re-shard
+        # channel — channel+channel on adjacent linears is an illegal
+        # degree blow-up (the reference expresses the same pairing as
+        # create_partition_linear_combine vs create_replicate_linear_
+        # combine xfers, substitution.cc:1755-1820).  Walking topo order
+        # alternates col,row,col,row through a sharded run.
+        by_name = {op.name: op for op in self.graph.ops}
+        is_col = {}  # name -> got channel=tp (output feature-sharded)
         for c in self.candidates:
             if not flags.get(c.name):
                 continue
             if c.kind == "channel" and tp > 1 and c.max_sizes["channel"] % tp == 0:
-                s.shard_configs[c.name] = ShardConfig(channel=tp)
+                op = by_name.get(c.name)
+                prod = (op.inputs[0].owner_op
+                        if op is not None and op.inputs else None)
+                while prod is not None and prod.op_type in (
+                    OperatorType.ELEMENT_UNARY, OperatorType.DROPOUT,
+                ):
+                    prod = (prod.inputs[0].owner_op
+                            if prod.inputs else None)
+                if (op is not None and op.op_type == OperatorType.LINEAR
+                        and prod is not None and is_col.get(prod.name)):
+                    s.shard_configs[c.name] = ShardConfig(reduction=tp)
+                else:
+                    s.shard_configs[c.name] = ShardConfig(channel=tp)
+                    if op is not None and op.op_type == OperatorType.LINEAR:
+                        is_col[c.name] = True
             elif c.kind == "attribute" and tp > 1 and c.max_sizes["attribute"] % tp == 0:
                 s.shard_configs[c.name] = ShardConfig(attribute=tp)
             elif c.kind == "expert" and ep > 1 and c.max_sizes["expert"] % ep == 0:
@@ -156,15 +193,40 @@ class MCMCSearch:
         current = self._build(dp, tp, ep, flags)
         current_cost = self.evaluate(current)
         best, best_cost = current, current_cost
+        self.best_iteration = -1  # evals needed to reach the winner
         state = (dp, tp, ep, dict(flags))
         for it in range(self.budget):
             ndp, ntp, nep, nflags = state[0], state[1], state[2], dict(state[3])
             move = self.rng.random()
             if move < 0.25 or not self.candidates:
                 ndp, ntp, nep = self.rng.choice(self.factorizations)
+            elif (self.propagate
+                  and move < 0.25 + 0.75 * self.propagation_chance):
+                # propagate move (reference FFModel::propagate,
+                # model.cc:3180-3258): spread a randomly selected op's
+                # CURRENT config to a walk of adoptable neighbors —
+                # here, structurally identical candidates — continuing
+                # while randf() < CONTINUE_PROPAGATION_CHANCE.  This
+                # harmonizes a half-sharded run of identical layers in
+                # one accepted move instead of one flip per eval.
+                c = self.rng.choice(self.candidates)
+                val = nflags.get(c.name, False)
+                sig = (c.kind, tuple(sorted(c.max_sizes.items())))
+                peers = [
+                    p for p in self.candidates
+                    if p.name != c.name
+                    and (p.kind, tuple(sorted(p.max_sizes.items()))) == sig
+                ]
+                for p in peers:  # graph order, like the BFS walk
+                    nflags[p.name] = val
+                    if self.rng.random() >= self.continue_chance:
+                        break
             else:
                 c = self.rng.choice(self.candidates)
                 nflags[c.name] = not nflags.get(c.name, False)
+            if (ndp, ntp, nep) == state[:3] and nflags == state[3]:
+                continue  # no-op move (e.g. propagate with no peers to
+                # change): don't burn a simulator eval on it
             cand = self._build(ndp, ntp, nep, nflags)
             cost = self.evaluate(cand)
             self.history.append((it, cost))
@@ -177,6 +239,7 @@ class MCMCSearch:
                 state = (ndp, ntp, nep, nflags)
                 if cost < best_cost:
                     best, best_cost = cand, cost
+                    self.best_iteration = it
         return best
 
 
@@ -214,6 +277,7 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
         memory_budget=cfg.memory_per_device if cfg.memory_search else None,
         memory_lambda=cfg.memory_lambda,
         seed=cfg.seed,
+        propagate=cfg.search_propagate,
     )
     best = search.optimize()
     cost_model.save_persistent()

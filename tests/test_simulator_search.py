@@ -124,3 +124,70 @@ def test_mcmc_strategy_runs_e2e(devices8):
     out = np.asarray(ff.forward({"x": xs}))
     assert out.shape == (16, 64)
     assert np.isfinite(out).all()
+
+
+def _deep_mlp(layers=24):
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor([64, 1024], name="x")
+    t = x
+    for i in range(layers):
+        t = ff.dense(t, 1024, activation=ActiMode.RELU, name=f"enc{i}")
+    ff.dense(t, 8, name="head")
+    return ff
+
+
+def test_mcmc_megatron_pairing_makes_adjacent_shards_legal():
+    """_build's column->row pairing: consecutively sharded linears get
+    channel, reduction, channel, ... — without it, channel+channel on
+    adjacent linears is an illegal degree blow-up, and the cost
+    improves monotonically as more of the run is sharded."""
+    machine = TpuPodModel(topology=(8,))
+    ff = _deep_mlp(12)
+    s = MCMCSearch(ff.layers, 8, lambda: Simulator(machine), budget=1)
+    costs = []
+    for k in (0, 2, 6, 12):
+        flags = {f"enc{i}": True for i in range(k)}
+        st = s._build(4, 2, 1, flags)
+        c = s.evaluate(st)
+        assert c != float("inf"), f"k={k} infeasible"
+        costs.append(c)
+    assert costs[-1] < costs[0]  # all-sharded beats none under dp4xtp2
+    st = s._build(4, 2, 1, {f"enc{i}": True for i in range(4)})
+    kinds = [(n, ("channel" if v.channel > 1 else "reduction"))
+             for n, v in sorted(st.shard_configs.items())]
+    assert kinds == [("enc0", "channel"), ("enc1", "reduction"),
+                     ("enc2", "channel"), ("enc3", "reduction")]
+
+
+def test_mcmc_propagate_converges_faster_on_deep_net():
+    """FF_USE_PROPAGATE (reference model.cc:3180-3258): the propagate
+    move harmonizes a run of structurally identical layers toward one
+    config in a single evaluation.  On a 24-layer net at matched budget
+    it must win (better cost, or equal cost no later) on a majority of
+    seeds and never lose badly in aggregate."""
+    machine = TpuPodModel(topology=(8,))
+    ff = _deep_mlp(24)
+
+    def sim_factory():
+        return Simulator(machine)
+
+    wins, costs_p, costs_n = 0, [], []
+    seeds = range(1, 11)
+    for seed in seeds:
+        sp = MCMCSearch(ff.layers, 8, sim_factory, budget=60, alpha=0.05,
+                        seed=seed, propagate=True, continue_chance=0.9)
+        bp = sp.optimize()
+        cp = sp.evaluate(bp)
+        sn = MCMCSearch(ff.layers, 8, sim_factory, budget=60, alpha=0.05,
+                        seed=seed, propagate=False)
+        bn = sn.optimize()
+        cn = sn.evaluate(bn)
+        costs_p.append(cp)
+        costs_n.append(cn)
+        if cp < cn * (1 - 1e-9) or (
+            abs(cp - cn) <= 1e-9 * cn
+            and sp.best_iteration <= sn.best_iteration
+        ):
+            wins += 1
+    assert wins >= 6, (wins, costs_p, costs_n)
+    assert sum(costs_p) <= sum(costs_n) * 1.08
