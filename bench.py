@@ -104,16 +104,36 @@ def bench_bert(dev, on_tpu):
         ),
     }
     if on_tpu:
-        out.update(_fidelity(ff, dev, dt, "bert"))
+        out["mfu"] = _mfu(ff, dt)
+        out.update(_fidelity(ff, dev, dt, "bert", leg))
     return out
 
 
-def _fidelity(ff, dev, dt, tag):
+def _mfu(ff, dt):
+    """Model FLOPs utilization against the bench chip's bf16 roofline
+    peak (sim/machine_model.py detect_device_spec).  Forward FLOPs come
+    from the ops' own cost hooks; training charges backward at 2x
+    forward (the standard dL/dx + dL/dw accounting — embedding scatter
+    and elementwise ops count their own hooks).  VERDICT r03 Missing #4:
+    vs_a100 alone flattered soft anchors; MFU is anchor-free."""
+    try:
+        from flexflow_tpu.sim.machine_model import detect_device_spec
+
+        spec = detect_device_spec()
+        fwd = sum(op.flops() for op in ff.operators.compute_ops())
+        return round(3.0 * fwd / (dt * spec.peak_flops), 4)
+    except Exception:  # pragma: no cover - diagnostics only
+        return None
+
+
+def _fidelity(ff, dev, dt, tag, leg=None):
     """Simulator fidelity vs the measured step: segment-granularity
     calibration (profiler.measure_segment_costs times the executor's own
     fused segment bodies — the r02 per-op harness was blind to XLA
     fusion and predicted 0.45x..3.6x).  The ratio is reported, not
-    hidden (reference validates measure_operator_cost the same way)."""
+    hidden (reference validates measure_operator_cost the same way).
+    Per-leg `calibration` overrides in the manifest take precedence
+    (v5: the bert leg needs finer binning than the global default)."""
     try:
         from flexflow_tpu.profiler import measure_segment_costs
         from flexflow_tpu.sim.machine_model import (
@@ -123,7 +143,8 @@ def _fidelity(ff, dev, dt, tag):
         from flexflow_tpu.sim.simulator import OpCostModel, Simulator
 
         machine = TpuPodModel(topology=(1,), device=detect_device_spec())
-        calib = MANIFEST.get("calibration", {})
+        calib = dict(MANIFEST.get("calibration", {}))
+        calib.update((leg or {}).get("calibration", {}))
         seg_costs = measure_segment_costs(
             ff, device=dev,
             max_regions=calib.get("max_regions", 16),
@@ -151,11 +172,14 @@ def bench_bert_long(dev, on_tpu):
     print("bench[bert-long]: compiling", file=sys.stderr)
     ff, batch, seq, dt = _build_bert_leg(dev, on_tpu, leg)
     dtype = "bf16" if on_tpu else "f32"
-    return {
+    out = {
         "workload": f"BERT-base seq{seq} b{batch} long-context train, {dtype}",
         "samples_per_sec_per_chip": round(batch / dt, 2),
         "tokens_per_sec_per_chip": round(batch * seq / dt, 0),
     }
+    if on_tpu:
+        out["mfu"] = _mfu(ff, dt)
+    return out
 
 
 def bench_resnet50(dev, on_tpu):
@@ -211,8 +235,153 @@ def bench_resnet50(dev, on_tpu):
         "vs_a100": round(sps / ANCHORS["a100_resnet50_samples_per_sec"], 4),
     }
     if on_tpu:
-        out.update(_fidelity(ff, dev, dt, "resnet50"))
+        out["mfu"] = _mfu(ff, dt)
+        out.update(_fidelity(ff, dev, dt, "resnet50", leg))
     return out
+
+
+def bench_dlrm(dev, on_tpu):
+    """DLRM (BASELINE configs[3]): the attribute-parallel embedding
+    workload — single-chip this measures the four 1M-row gather +
+    grad-scatter paths plus the interaction MLPs (reference dlrm.cc
+    prints THROUGHPUT the same way).  No A100 anchor exists for this
+    exact config; the leg tracks round-over-round regressions."""
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.dlrm import build_dlrm
+
+    leg = MANIFEST["legs"]["dlrm"]
+    if on_tpu:
+        batch, tables, rows, iters = (
+            leg["batch"], leg["tables"], leg["rows_per_table"], leg["iters"]
+        )
+    else:
+        batch, tables, rows, iters = 16, 2, 1000, 3
+
+    print("bench[dlrm]: compiling", file=sys.stderr)
+    cfg = FFConfig(batch_size=batch, num_devices=1,
+                   compute_dtype=leg["dtype"] if on_tpu else "float32")
+    ff = FFModel(cfg)
+    build_dlrm(ff, batch_size=batch, embedding_size=[rows] * tables,
+               sparse_feature_size=leg["sparse_feature_size"],
+               dense_feature_dim=leg["dense_feature_dim"],
+               mlp_bot=leg["mlp_bot"], mlp_top=leg["mlp_top"])
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        devices=[dev],
+    )
+    rng = np.random.RandomState(0)
+    shardings = ff.executor.input_shardings()
+    inputs = {
+        f"sparse_input_{i}": jax.device_put(
+            rng.randint(0, rows, size=(batch, 1)).astype(np.int32),
+            shardings[f"sparse_input_{i}"])
+        for i in range(tables)
+    }
+    inputs["dense_input"] = jax.device_put(
+        rng.randn(batch, leg["dense_feature_dim"]).astype(np.float32),
+        shardings["dense_input"])
+    y = jax.device_put(
+        rng.rand(batch, leg["mlp_top"][-1]).astype(np.float32),
+        ff.executor.label_sharding())
+    for _ in range(3):
+        m = ff.train_step(inputs, y)
+    _ = float(m["loss"])
+    dt = _steady_state(ff, inputs, y, iters)
+    out = {
+        "workload": f"DLRM b{batch} {tables}x{rows}-row tables train "
+                    f"(embedding gather/scatter path)",
+        "samples_per_sec_per_chip": round(batch / dt, 2),
+    }
+    if on_tpu:
+        out["mfu"] = _mfu(ff, dt)
+    return out
+
+
+def bench_moe_dispatch(dev, on_tpu):
+    """MoE dispatch microbench: sort-based group_by+combine (the Pallas-
+    era TPU trick, ops/moe_dispatch.py) vs the one-hot-matmul dispatch
+    it replaces (group_by.cu's scatter in dense form), fixed
+    tokens x experts.  Reports microseconds per dispatch+combine and the
+    speedup (VERDICT r03 Missing #3)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.moe_dispatch import sort_combine, sort_group_by
+
+    leg = MANIFEST["legs"]["moe_dispatch"]
+    if on_tpu:
+        tokens, experts, k, d = (leg["tokens"], leg["experts"], leg["k"],
+                                 leg["d_model"])
+        iters, windows = leg["iters"], MANIFEST["timing"]["windows"]
+    else:
+        tokens, experts, k, d, iters, windows = 256, 8, 2, 64, 3, 1
+
+    capacity = max(1, int(leg["capacity_factor"] * tokens * k // experts))
+    rng = np.random.RandomState(0)
+    data = jax.device_put(
+        rng.randn(tokens, d).astype(np.float32), dev)
+    assign = jax.device_put(
+        rng.randint(0, experts, size=(tokens, k)).astype(np.int32), dev)
+
+    @jax.jit
+    def sort_path(data, assign):
+        grouped = sort_group_by(data, assign, experts, capacity)
+        rows, keep = sort_combine(grouped, assign, capacity)
+        return jnp.sum(rows)
+
+    @jax.jit
+    def onehot_path(data, assign):
+        # dense dispatch: [tokens*k, experts*cap] one-hot matmul (what
+        # sort-based dispatch replaces; reference group_by.cu scatter)
+        flat = assign.reshape(-1)
+        bk = flat.shape[0]
+        # position-within-expert via cumsum over one-hot (dense ranks)
+        oh = jax.nn.one_hot(flat, experts, dtype=data.dtype)  # [bk, n]
+        rank = (jnp.cumsum(oh, axis=0) - oh) * oh  # rank per token
+        r = jnp.sum(rank, axis=1).astype(jnp.int32)
+        keep = r < capacity
+        slot_oh = (oh[:, :, None]
+                   * jax.nn.one_hot(jnp.minimum(r, capacity - 1), capacity,
+                                    dtype=data.dtype)[:, None, :])
+        slot_oh = slot_oh.reshape(bk, experts * capacity)
+        slot_oh = slot_oh * keep[:, None].astype(data.dtype)
+        rows = jnp.repeat(data, k, axis=0)
+        grouped = slot_oh.T @ rows  # [n*cap, d]
+        back = slot_oh @ grouped  # combine
+        return jnp.sum(back)
+
+    # both paths implement the same capacity-bounded dispatch: checked
+    # once so the microbench compares equal work; recorded in the JSON
+    # so a silent divergence can't masquerade as a speedup
+    s1 = float(sort_path(data, assign))
+    s2 = float(onehot_path(data, assign))
+    paths_match = bool(np.isclose(s1, s2, rtol=1e-3))
+
+    def time_fn(fn):
+        _ = float(fn(data, assign))  # compile + warm
+
+        def window():
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = fn(data, assign)
+            _ = float(r)
+            return (time.perf_counter() - t0) / iters
+
+        return min(window() for _ in range(windows))
+
+    t_sort = time_fn(sort_path)
+    t_onehot = time_fn(onehot_path)
+    return {
+        "workload": f"MoE dispatch+combine {tokens} tok x {experts} experts "
+                    f"k={k} cap_factor={leg['capacity_factor']}",
+        "sort_dispatch_us": round(t_sort * 1e6, 1),
+        "one_hot_dispatch_us": round(t_onehot * 1e6, 1),
+        "sort_vs_one_hot_speedup": round(t_onehot / t_sort, 2),
+        "paths_match": paths_match,
+    }
 
 
 def main():
@@ -228,12 +397,16 @@ def main():
     resnet = bench_resnet50(dev, on_tpu)
     gc.collect()
     bert_long = bench_bert_long(dev, on_tpu)
+    gc.collect()
+    dlrm = bench_dlrm(dev, on_tpu)
+    gc.collect()
+    moe = bench_moe_dispatch(dev, on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
         # value is the BERT leg's samples/s (round-over-round
-        # comparable); vs_baseline is the geomean of BOTH legs' vs-A100
-        # ratios; per-leg numbers live under "legs"
+        # comparable); vs_baseline is the geomean of BOTH headline
+        # legs' vs-A100 ratios; per-leg numbers live under "legs"
         "metric": (
             "samples/sec/chip, BERT-base seq128 b64 token-ids bf16 "
             "(vs_baseline = geomean of bert_base+resnet50 legs vs A100)"
@@ -244,7 +417,8 @@ def main():
         "vs_baseline": round(geomean, 4) if on_tpu else 0.0,
         "manifest_version": MANIFEST["version"],
         "legs": {"bert_base": bert, "resnet50": resnet,
-                 "bert_long_context": bert_long},
+                 "bert_long_context": bert_long, "dlrm": dlrm,
+                 "moe_dispatch": moe},
     }
     print(json.dumps(result))
 
