@@ -21,7 +21,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer  # noqa: E402
 
 
-def _searched(build, n, batch, **cfg_kw):
+def _searched(build, n, batch, loss=None, **cfg_kw):
     cfg = FFConfig(batch_size=batch, num_devices=n, search_budget=500,
                    **cfg_kw)
     ff = FFModel(cfg)
@@ -29,7 +29,7 @@ def _searched(build, n, batch, **cfg_kw):
     import jax
 
     ff.compile(optimizer=SGDOptimizer(lr=0.01),
-               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               loss_type=loss or LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
                devices=jax.devices()[:n])
     return ff
 
@@ -64,12 +64,118 @@ JOBS = [
 ]
 
 
+# -- v5p-32 target-scale artifacts (VERDICT r03 Missing #2) ---------------
+#
+# All five BASELINE configs searched at 16 chips under the v5p-32
+# 3D-torus machine file (examples/machines/v5p32.json).  The search is
+# purely analytic, so the graphs are built at the BASELINE's REAL
+# workload scale (searching a toy batch at 16 chips degenerates: grad
+# sync dominates tiny compute and "replicate everything" wins).
+# tests/test_strategy_artifacts.py re-applies each artifact to a
+# structurally identical reduced-size graph on a hermetic 16-device CPU
+# mesh and trains one step.  `search` builds the search-scale graph;
+# `validate` the CPU-sized one — SAME layer names, different shapes.
+
+V5P32_MACHINE = os.path.join(os.path.dirname(__file__), "..",
+                             "examples", "machines", "v5p32.json")
+
+
+def _v5p32_models():
+    from flexflow_tpu.models.alexnet import build_alexnet
+    from flexflow_tpu.models.dlrm import build_dlrm
+    from flexflow_tpu.models.inception import build_inception_v3
+    from flexflow_tpu.models.resnet import build_resnet50
+    from flexflow_tpu.models.transformer import build_bert
+
+    return {
+        "alexnet": dict(
+            search=lambda ff: build_alexnet(ff, batch_size=1024,
+                                            image_size=229,
+                                            num_classes=1000),
+            validate=lambda ff: build_alexnet(ff, batch_size=32,
+                                              image_size=64,
+                                              num_classes=100),
+            cfg={},
+            loss=None,
+        ),
+        "resnet50": dict(
+            search=lambda ff: build_resnet50(ff, batch_size=512,
+                                             image_size=224,
+                                             num_classes=1000),
+            validate=lambda ff: build_resnet50(ff, batch_size=32,
+                                               image_size=64,
+                                               num_classes=100),
+            cfg={},
+            loss=None,
+        ),
+        "bert_base": dict(
+            search=lambda ff: build_bert(ff, batch_size=256, seq_length=128,
+                                         hidden_size=768, num_layers=12,
+                                         num_heads=12,
+                                         intermediate_size=3072),
+            # batch must satisfy the artifact's pipeline payload
+            # (dp=4 x 64 microbatches searched at b256): keep b256,
+            # shrink seq/hidden instead
+            validate=lambda ff: build_bert(ff, batch_size=256, seq_length=16,
+                                           hidden_size=96, num_layers=12,
+                                           num_heads=12,
+                                           intermediate_size=384),
+            cfg={"enable_parameter_parallel": True},
+            loss=None,
+        ),
+        "dlrm": dict(
+            search=lambda ff: build_dlrm(ff, batch_size=4096,
+                                         embedding_size=[1000000] * 4),
+            validate=lambda ff: build_dlrm(ff, batch_size=64,
+                                           embedding_size=[10000] * 4),
+            cfg={"enable_attribute_parallel": True},
+            loss=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        ),
+        "inception_v3": dict(
+            search=lambda ff: build_inception_v3(ff, batch_size=128,
+                                                 image_size=299,
+                                                 num_classes=1000),
+            # b128 = the searched batch (pipeline payload dp=8 x 16
+            # microbatches); 75px/0.25-scale keeps the CPU step small
+            validate=lambda ff: build_inception_v3(ff, batch_size=128,
+                                                   image_size=75,
+                                                   channel_scale=0.25),
+            cfg={},
+            loss=None,
+        ),
+    }
+
+
+def search_v5p32_strategy(name: str, job: dict):
+    """Search one BASELINE config at full workload scale on the v5p-32
+    machine model, WITHOUT compiling an executor (the searched shapes
+    exceed a CPU host; only the analytic search sees them)."""
+    from flexflow_tpu.pcg.search import unity_search
+
+    cfg = FFConfig(batch_size=64, num_devices=16, search_budget=500,
+                   machine_model_file=V5P32_MACHINE, **job["cfg"])
+    ff = FFModel(cfg)
+    job["search"](ff)
+    return unity_search(ff, 16)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="examples/strategies")
     p.add_argument("-n", "--num-devices", type=int, default=8)
+    p.add_argument("--jobs", choices=["default", "v5p32"], default="default")
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
+
+    if args.jobs == "v5p32":
+        for name, job in _v5p32_models().items():
+            strategy = search_v5p32_strategy(name, job)
+            path = os.path.join(args.out, f"{name}.json")
+            strategy.save(path)
+            print(f"{name}: mesh={strategy.mesh_axes} "
+                  f"shards={len(strategy.shard_configs)} "
+                  f"rewrites={strategy.rewrites} -> {path}")
+        return
 
     for name, build, batch, kw in JOBS:
         ff = _searched(globals()[build], args.num_devices, batch, **kw)

@@ -1,0 +1,76 @@
+"""Subprocess body: validate every shipped v5p-32 strategy artifact on a
+hermetic 16-device CPU mesh (the driver's dryrun pattern — conftest pins
+the main test process to 8 devices, so 16 needs its own interpreter).
+
+For each artifact: load -> apply to the structurally identical
+reduced-size graph (scripts/search_strategies._v5p32_models 'validate'
+builders: SAME op names as the searched full-scale graph) -> compile ->
+one train step -> assert finite loss.  Prints one OK line per artifact.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=16"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.join(_HERE, "..", "..")
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+
+import numpy as np  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer  # noqa: E402
+from flexflow_tpu.strategy import Strategy  # noqa: E402
+
+import search_strategies as _SS  # noqa: E402
+
+
+def main():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 16, f"need 16 virtual devices, have {len(devs)}"
+    art_dir = os.path.join(_ROOT, "examples", "strategies", "v5p32")
+    only = sys.argv[1:] or None
+    for name, job in _SS._v5p32_models().items():
+        if only and name not in only:
+            continue
+        path = os.path.join(art_dir, f"{name}.json")
+        assert os.path.exists(path), f"missing artifact {path}"
+        s = Strategy.load(path)
+        assert s.total_devices == 16, (name, s.mesh_axes)
+        cfg = FFConfig(batch_size=32, num_devices=16, **job["cfg"])
+        ff = FFModel(cfg)
+        job["validate"](ff)
+        loss = job["loss"] or LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+        ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=loss,
+                   strategy=s, devices=devs[:16])
+        rs = np.random.RandomState(0)
+        inputs = {}
+        for op in ff.layers.source_ops():
+            shp = op.outputs[0].shape.logical_shape
+            if op.outputs[0].dtype.np_dtype.kind == "i":
+                inputs[op.name] = rs.randint(0, 100, shp).astype(np.int32)
+            else:
+                inputs[op.name] = rs.randn(*shp).astype(np.float32)
+        sink_shape = ff.layers.sink_op().outputs[0].shape.logical_shape
+        if loss == LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+            y = rs.rand(*sink_shape).astype(np.float32)
+        else:
+            y = rs.randint(0, max(2, sink_shape[-1]),
+                           sink_shape[:-1]).astype(np.int32)
+        m = ff.train_step(inputs, y)
+        val = float(m["loss"])
+        assert np.isfinite(val), (name, val)
+        print(f"v5p32[{name}]: mesh={s.mesh_axes} loss={val:.4f} OK",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
