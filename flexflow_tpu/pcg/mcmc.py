@@ -255,15 +255,24 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
     # across candidate evaluations (reference simulator.cc:550-560)
     cost_model = make_cost_model(cfg, machine)
 
+    from ..sim.calibrate import load_overlap_constants
     from .unity import _sync_mode
 
+    fitted = load_overlap_constants()
+
     def sim_factory():
+        kw = {}
+        if fitted is not None:
+            kw["overlap_fraction"] = fitted["overlap_fraction"]
+            kw["compute_scale"] = fitted.get("compute_scale", 1.0)
         return Simulator(
             machine,
             cost_model,
             sync_overlap_fraction=(
-                0.7 if cfg.search_overlap_backward_update else None
+                fitted["sync_overlap_fraction"] if fitted is not None
+                else (0.7 if cfg.search_overlap_backward_update else None)
             ),
+            **kw,
             parameter_sync=_sync_mode(cfg.parameter_sync),
             remat=cfg.remat,
         )

@@ -280,10 +280,14 @@ class Simulator:
         sync_overlap_fraction: Optional[float] = None,
         parameter_sync: str = "allreduce",
         remat: bool = False,
+        compute_scale: float = 1.0,
     ):
         self.machine = machine
         self.cost_model = cost_model or OpCostModel(machine)
         self.overlap_fraction = overlap_fraction
+        # fitted backend calibration (sim/calibrate.py): scales the
+        # analytic compute term to measured reality; 1.0 = roofline
+        self.compute_scale = compute_scale
         self.optimizer_slots = optimizer_slots
         # executor --remat: checkpointed segments change peak memory
         self.remat = remat
@@ -539,6 +543,8 @@ class Simulator:
                 for g in guids:
                     measured_ops[g] = c
         compute = seg_cost_total if training else seg_cost_total / 3.0
+        analytic_compute = 0.0  # compute_scale applies ONLY here —
+        # measured segment costs are already real backend seconds
         comm = 0.0
         breakdown: Dict[str, float] = {}
         for op in graph.topo_order():
@@ -558,10 +564,10 @@ class Simulator:
                 continue
             cm = self.cost_model.cost(op)
             t = cm.forward_time + (cm.backward_time if training else 0.0)
-            compute += t
+            analytic_compute += t
             breakdown[op.name] = t + ps
         if training:
-            compute += self.optimizer_update_cost(graph)
+            analytic_compute += self.optimizer_update_cost(graph)
         sync = self.grad_sync_cost(graph, mesh_axes) if training else 0.0
         # XLA overlaps collectives with independent compute; gradient
         # sync gets its own credit when backward/update overlap is
@@ -570,6 +576,7 @@ class Simulator:
             comm * (1.0 - self.overlap_fraction)
             + sync * (1.0 - self.sync_overlap_fraction)
         )
+        compute = compute + analytic_compute * self.compute_scale
         total = compute + effective_comm
         return SimResult(
             total_time=total,

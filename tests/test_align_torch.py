@@ -141,3 +141,173 @@ def test_align_batch_matmul(devices8):
         np.asarray(ff.forward({"a": a, "b": b})),
         torch.bmm(torch.from_numpy(a), torch.from_numpy(b)).numpy(),
         rtol=RTOL, atol=ATOL)
+
+
+# -- r04 additions (VERDICT Weak #7): attention, MoE quartet, GPT block --
+
+def _mha_weights_from_torch(tm, num_heads):
+    """torch nn.MultiheadAttention in_proj/out_proj -> our per-head
+    wq/wk/wv [E, H, C] and wo [H, C, E] layout."""
+    E = tm.embed_dim
+    C = E // num_heads
+    ipw = tm.in_proj_weight.detach().numpy()       # [3E, E]
+    ipb = tm.in_proj_bias.detach().numpy()         # [3E]
+    opw = tm.out_proj.weight.detach().numpy()      # [E, E]
+    opb = tm.out_proj.bias.detach().numpy()        # [E]
+    wq, wk, wv = ipw[:E], ipw[E:2 * E], ipw[2 * E:]
+    bq, bk, bv = ipb[:E], ipb[E:2 * E], ipb[2 * E:]
+
+    def per_head(w):  # [E_out, E_in] -> [E_in, H, C]
+        return w.reshape(num_heads, C, E).transpose(2, 0, 1)
+
+    return {
+        "wq": per_head(wq), "wk": per_head(wk), "wv": per_head(wv),
+        "bq": bq.reshape(num_heads, C), "bk": bk.reshape(num_heads, C),
+        "bv": bv.reshape(num_heads, C),
+        "wo": opw.reshape(E, num_heads, C).transpose(1, 2, 0),
+        "bo": opb,
+    }
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_align_multihead_attention(devices8, causal):
+    torch.manual_seed(4)
+    B, S, E, H = 4, 10, 32, 4
+    tm = nn.MultiheadAttention(E, H, bias=True, batch_first=True)
+    ff = FFModel(FFConfig(batch_size=B))
+    x = ff.create_tensor([B, S, E], name="x")
+    ff.multihead_attention(x, x, x, E, H, bias=True, causal=causal,
+                           name="attn")
+    _compile(ff, devices8)
+    ff.set_weights({"attn": _mha_weights_from_torch(tm, H)})
+    xs = np.random.RandomState(4).randn(B, S, E).astype(np.float32)
+    xt = torch.from_numpy(xs)
+    mask = (torch.triu(torch.ones(S, S), diagonal=1).bool()
+            if causal else None)
+    want = tm(xt, xt, xt, attn_mask=mask, need_weights=False)[0]
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": xs})),
+        want.detach().numpy(), rtol=1e-4, atol=1e-4)
+
+
+def _torch_moe_dispatch(x, scores, assign, n, cap):
+    """Reference dispatch semantics in plain torch: flat token-slot
+    order is the priority (rank within expert by flat index), tokens
+    beyond capacity dropped; combine renormalizes scores over ALL k
+    (dropped slots keep their denominator share and contribute zero)."""
+    b, k = assign.shape
+    d = x.shape[1]
+    flat = assign.reshape(-1)
+    grouped = torch.zeros(n, cap, d)
+    rank = torch.zeros(b * k, dtype=torch.long)
+    counts = torch.zeros(n, dtype=torch.long)
+    for i in range(b * k):
+        e = int(flat[i])
+        rank[i] = counts[e]
+        counts[e] += 1
+        if rank[i] < cap:
+            grouped[e, rank[i]] = x[i // k]
+    return grouped, rank
+
+
+def test_align_moe_quartet(devices8):
+    """topk -> group_by -> experts_dense -> aggregate vs a plain-torch
+    replica of the reference's capacity-bounded dispatch
+    (group_by.cu/aggregate.cu semantics)."""
+    torch.manual_seed(5)
+    B, D, N, K, HID = 16, 8, 4, 2, 12
+    ALPHA = 1.0
+    import math
+    CAP = max(1, int(math.ceil(ALPHA * K * B / N)))
+
+    ff = FFModel(FFConfig(batch_size=B))
+    x = ff.create_tensor([B, D], name="x")
+    logits = ff.create_tensor([B, N], name="logits")
+    sm = ff.softmax(logits)
+    values, assign = ff.top_k(sm, K)
+    grouped = ff.group_by(x, assign, N, ALPHA, name="grp")
+    hidden = ff.experts_dense(grouped, HID, name="experts")
+    ff.aggregate(values, assign, sm, hidden, N, name="agg")
+    _compile(ff, devices8)
+
+    rs = np.random.RandomState(5)
+    ew = rs.randn(N, D, HID).astype(np.float32) * 0.3
+    eb = rs.randn(N, HID).astype(np.float32) * 0.1
+    ff.set_weights({"experts": {"kernel": ew, "bias": eb}})
+
+    xs = rs.randn(B, D).astype(np.float32)
+    lg = rs.randn(B, N).astype(np.float32)
+    got = np.asarray(ff.forward({"x": xs, "logits": lg}))
+
+    smt = torch.softmax(torch.from_numpy(lg), dim=-1)
+    scores, assign_t = torch.topk(smt, K, dim=-1)
+    grouped_t, rank = _torch_moe_dispatch(
+        torch.from_numpy(xs), scores, assign_t, N, CAP)
+    hid = torch.einsum("ncd,ndo->nco", grouped_t, torch.from_numpy(ew)) \
+        + torch.from_numpy(eb)[:, None, :]
+    norm = scores / (scores.sum(-1, keepdim=True) + 1e-9)
+    out = torch.zeros(B, HID)
+    flat = assign_t.reshape(-1)
+    for i in range(B * K):
+        if rank[i] < CAP:
+            out[i // K] += norm.reshape(-1)[i] * hid[int(flat[i]), rank[i]]
+    np.testing.assert_allclose(got, out.numpy(), rtol=1e-4, atol=1e-4)
+
+
+class _TorchGPT2Block(nn.Module):
+    """Pre-LN GPT-2 block; GELU tanh-approx to match jax.nn.gelu."""
+
+    def __init__(self, E, H):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(E)
+        self.attn = nn.MultiheadAttention(E, H, bias=True, batch_first=True)
+        self.ln2 = nn.LayerNorm(E)
+        self.fc1 = nn.Linear(E, 4 * E)
+        self.fc2 = nn.Linear(4 * E, E)
+        self.act = nn.GELU(approximate="tanh")
+
+    def forward(self, x):
+        S = x.shape[1]
+        mask = torch.triu(torch.ones(S, S), diagonal=1).bool()
+        h = self.ln1(x)
+        a = self.attn(h, h, h, attn_mask=mask, need_weights=False)[0]
+        x = x + a
+        return x + self.fc2(self.act(self.fc1(self.ln2(x))))
+
+
+def test_align_gpt2_block(devices8):
+    """A full causal pre-LN transformer block aligns end-to-end
+    (reference tests/align runs a whole mt5 encoder; this is the GPT
+    analogue)."""
+    torch.manual_seed(6)
+    B, S, E, H = 2, 12, 32, 4
+    tm = _TorchGPT2Block(E, H)
+
+    ff = FFModel(FFConfig(batch_size=B))
+    x = ff.create_tensor([B, S, E], name="x")
+    h = ff.layer_norm(x, axes=[-1], name="ln1")
+    a = ff.multihead_attention(h, h, h, E, H, bias=True, causal=True,
+                               name="attn")
+    t = ff.add(x, a)
+    m = ff.layer_norm(t, axes=[-1], name="ln2")
+    m = ff.dense(m, 4 * E, name="fc1")
+    m = ff.gelu(m)
+    m = ff.dense(m, E, name="fc2")
+    ff.add(t, m)
+    _compile(ff, devices8)
+
+    ff.set_weights({
+        "ln1": {"gamma": tm.ln1.weight.detach().numpy(),
+                "beta": tm.ln1.bias.detach().numpy()},
+        "ln2": {"gamma": tm.ln2.weight.detach().numpy(),
+                "beta": tm.ln2.bias.detach().numpy()},
+        "attn": _mha_weights_from_torch(tm.attn, H),
+        "fc1": {"kernel": tm.fc1.weight.detach().numpy().T,
+                "bias": tm.fc1.bias.detach().numpy()},
+        "fc2": {"kernel": tm.fc2.weight.detach().numpy().T,
+                "bias": tm.fc2.bias.detach().numpy()},
+    })
+    xs = np.random.RandomState(6).randn(B, S, E).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"x": xs})),
+        tm(torch.from_numpy(xs)).detach().numpy(), rtol=1e-4, atol=1e-4)
