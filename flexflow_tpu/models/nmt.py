@@ -1,15 +1,23 @@
 """Seq2seq NMT builder (reference legacy nmt/ subtree: standalone LSTM
-encoder-decoder machine translation with hand-written parallel ops,
-nmt/rnn.h, nmt/nmt.cc — pre-FFModel code rebuilt here on the layer
-API).
+encoder-decoder machine translation, nmt/rnn.h + nmt/nmt.cc — per-cell
+Legion ops there, rebuilt here on the layer API with fused lax.scan
+LSTMs).
 
-Teacher-forced training: source tokens -> embed -> encoder LSTM stack;
-target tokens -> embed -> decoder LSTM stack (conditioned on the
-encoder's final context by feature concat) -> vocab projection.
+Teacher-forced training: source tokens -> embed -> encoder LSTM stack
+producing per-position states; target tokens -> embed -> decoder LSTM
+stack; Luong-style dot-product attention over the encoder states
+(scores = dec @ enc^T -> softmax -> context; concat + tanh projection)
+-> vocab projection.  The reference's own nmt/ has no attention (it
+predates it); attention here is built from first-class PCG ops
+(batch_matmul/softmax/concat/dense), so the strategy search sees and
+shards it like any other subgraph.  `greedy_decode` provides the
+inference loop (the reference only ships the training path).
 """
 from __future__ import annotations
 
-from ..fftype import AggrMode
+import numpy as np
+
+from ..fftype import ActiMode, AggrMode
 from ..model import FFModel
 
 
@@ -23,6 +31,7 @@ def build_nmt(
     embed_dim: int = 64,
     hidden_size: int = 128,
     num_layers: int = 2,
+    attention: bool = True,
 ):
     src = ff.create_tensor([batch_size, src_len], dtype="int32", name="src")
     tgt = ff.create_tensor([batch_size, tgt_len], dtype="int32", name="tgt")
@@ -32,7 +41,8 @@ def build_nmt(
     for i in range(num_layers):
         enc = ff.lstm(enc, hidden_size, return_sequences=True,
                       name=f"enc_lstm_{i}")
-    # context: mean over source positions -> broadcast to target length
+    # summary context (the reference's encoder->decoder hand-off role):
+    # mean over source positions, broadcast onto decoder states
     ctx = ff.mean(enc, axes=[1], keepdims=True, name="enc_context")
 
     dec = ff.embedding(tgt, tgt_vocab, embed_dim, aggr=AggrMode.NONE,
@@ -40,7 +50,38 @@ def build_nmt(
     for i in range(num_layers):
         dec = ff.lstm(dec, hidden_size, return_sequences=True,
                       name=f"dec_lstm_{i}")
-    # condition decoder states on encoder context (broadcast add)
     dec = ff.add(dec, ctx, name="condition")
+
+    if attention:
+        # Luong dot-product attention over encoder states, in PCG ops:
+        # [B,T,H] @ [B,H,S] -> [B,T,S] -> softmax_S -> @ [B,S,H]
+        enc_t = ff.transpose(enc, [0, 2, 1], name="enc_T")
+        scores = ff.batch_matmul(dec, enc_t, name="attn_scores")
+        attn = ff.softmax(scores, axis=-1, name="attn_weights")
+        context = ff.batch_matmul(attn, enc, name="attn_context")
+        comb = ff.concat([dec, context], axis=2, name="attn_concat")
+        dec = ff.dense(comb, hidden_size, activation=ActiMode.TANH,
+                       name="attn_comb")
+
     logits = ff.dense(dec, tgt_vocab, name="vocab_proj")
     return ff.softmax(logits, name="softmax")
+
+
+def greedy_decode(ff: FFModel, src_tokens, bos_id: int = 1,
+                  tgt_len: int = None) -> np.ndarray:
+    """Greedy autoregressive decoding with the compiled training graph:
+    re-runs the fixed-shape forward per step, feeding back argmaxes
+    (an O(T^2) utility loop — correct, not the serving path)."""
+    src_tokens = np.asarray(src_tokens, np.int32)
+    batch = src_tokens.shape[0]
+    if tgt_len is None:
+        tgt_len = next(
+            op for op in ff.layers.source_ops() if op.name == "tgt"
+        ).outputs[0].shape.logical_shape[1]
+    buf = np.zeros((batch, tgt_len), np.int32)
+    buf[:, 0] = bos_id
+    for t in range(1, tgt_len):
+        probs = np.asarray(
+            ff.forward({"src": src_tokens, "tgt": buf}), np.float32)
+        buf[:, t] = probs[:, t - 1].argmax(-1).astype(np.int32)
+    return buf

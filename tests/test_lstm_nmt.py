@@ -86,3 +86,47 @@ def test_keras_datasets_synthetic_shapes():
     # deterministic across calls
     (xtr2, ytr2), _ = datasets.cifar10.load_data(num_samples=64)
     np.testing.assert_array_equal(xtr, xtr2)
+
+
+def test_nmt_attention_trains_and_decodes(devices8):
+    """The attention NMT (Luong dot-product over encoder states, built
+    from first-class PCG ops) trains on a next-token copy task; the
+    greedy decoding loop runs the compiled graph autoregressively."""
+    from flexflow_tpu.models.nmt import greedy_decode
+    from flexflow_tpu.optimizer import AdamOptimizer
+
+    V = 12
+    cfg = FFConfig(batch_size=16, num_devices=8)
+    ff = FFModel(cfg)
+    build_nmt(ff, batch_size=16, src_len=6, tgt_len=6, src_vocab=V,
+              tgt_vocab=V, embed_dim=24, hidden_size=32, num_layers=1,
+              attention=True)
+    # attention subgraph really present
+    kinds = [op.name for op in ff.layers.ops]
+    assert "attn_weights" in kinds and "attn_context" in kinds
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+               devices=devices8)
+    rng = np.random.RandomState(1)
+    n = 64
+    src = rng.randint(2, V, size=(n, 6)).astype(np.int32)
+    # teacher forcing: tgt_in = [BOS, y_0..y_4], labels = src — position
+    # t must be read off the ENCODER via attention
+    tgt_in = np.concatenate(
+        [np.ones((n, 1), np.int32), src[:, :-1]], axis=1)
+    hist = ff.fit({"src": src, "tgt": tgt_in}, src, epochs=40,
+                  verbose=False)
+    assert hist[-1].sparse_cce_loss < 0.75 * hist[0].sparse_cce_loss
+
+    # teacher-forced prediction beats chance after training
+    probs = np.asarray(ff.forward({"src": src[:16], "tgt": tgt_in[:16]}),
+                       np.float32)
+    tf_acc = float(np.mean(probs.argmax(-1) == src[:16]))
+    assert tf_acc > 2.0 / V, f"teacher-forced acc {tf_acc}"
+
+    # greedy decode mechanism: shapes, valid ids, BOS fixed, determinism
+    out = greedy_decode(ff, src[:16], bos_id=1)
+    assert out.shape == (16, 6) and out.dtype == np.int32
+    assert (out >= 0).all() and (out < V).all() and (out[:, 0] == 1).all()
+    np.testing.assert_array_equal(out, greedy_decode(ff, src[:16], bos_id=1))
