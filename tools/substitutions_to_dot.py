@@ -41,6 +41,48 @@ def to_dot(xfers) -> str:
     return "\n".join(lines)
 
 
+def taso_to_dot(rules, limit=None) -> str:
+    """Render parsed TASO pattern rules (pcg/taso.py) — srcOp and dstOp
+    subgraphs side by side, externals as ellipses (reference
+    tools/substitutions_to_dot over substitutions/graph_subst_3_v2.json)."""
+    lines = [
+        "digraph taso_rules {",
+        "  rankdir=LR;",
+        '  node [shape=box, fontname="monospace"];',
+    ]
+    for i, r in enumerate(rules if limit is None else rules[:limit]):
+        lines.append(f'  subgraph cluster_{i} {{ label="{r.name}";')
+        for side, ops in (("s", r.src_ops), ("d", r.dst_ops)):
+            ext_seen = set()
+            for j, op in enumerate(ops):
+                params = ",".join(f"{k[3:]}={v}" for k, v in op.params)
+                lines.append(
+                    f'    r{i}{side}{j} [label="{op.type[3:]}'
+                    + (f'\\n{params}' if params else "")
+                    + ('"];' if side == "s" else '", style=filled, '
+                       'fillcolor=lightgrey];')
+                )
+                for ref in op.inputs:
+                    if ref.op_id < 0:
+                        ext = f"r{i}{side}x{-ref.op_id}"
+                        if ref.op_id not in ext_seen:
+                            ext_seen.add(ref.op_id)
+                            lines.append(
+                                f'    {ext} [label="in{-ref.op_id}", '
+                                "shape=ellipse];")
+                        lines.append(f"    {ext} -> r{i}{side}{j};")
+                    else:
+                        lines.append(
+                            f"    r{i}{side}{ref.op_id} -> r{i}{side}{j};")
+        for m in r.mapped_outputs:
+            lines.append(
+                f"    r{i}s{m.src_op_id} -> r{i}d{m.dst_op_id} "
+                "[style=dotted, constraint=false];")
+        lines.append("  }")
+    lines.append("}")
+    return "\n".join(lines)
+
+
 def main():
     from flexflow_tpu.pcg.substitution import (
         generate_all_pcg_xfers,
@@ -48,7 +90,15 @@ def main():
     )
 
     if len(sys.argv) > 1:
-        xfers = load_substitution_rules(sys.argv[1])
+        path = sys.argv[1]
+        from flexflow_tpu.pcg.taso import (is_taso_rule_file,
+                                           parse_rule_collection)
+
+        if is_taso_rule_file(path):
+            limit = int(sys.argv[2]) if len(sys.argv) > 2 else None
+            print(taso_to_dot(parse_rule_collection(path), limit))
+            return
+        xfers = load_substitution_rules(path)
     else:
         xfers = generate_all_pcg_xfers()
     print(to_dot(xfers))
