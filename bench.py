@@ -389,7 +389,20 @@ def main():
 
     import jax
 
-    dev = jax.devices()[0]
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:
+        # tunnel/backend outage: emit a diagnostic JSON line instead of
+        # a stacktrace so the capture records WHY there are no numbers
+        print(json.dumps({
+            "metric": "bench unavailable: TPU backend init failed",
+            "value": 0.0,
+            "unit": "samples/s",
+            "vs_baseline": 0.0,
+            "manifest_version": MANIFEST["version"],
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }))
+        return
     on_tpu = dev.platform != "cpu"
 
     bert = bench_bert(dev, on_tpu)
