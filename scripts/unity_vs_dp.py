@@ -47,8 +47,14 @@ def main():
     p.add_argument("-b", "--batch-size", type=int, default=64)
     p.add_argument("--run", action="store_true",
                    help="also execute both strategies and time them")
+    p.add_argument("--substitution-json", default=None,
+                   help="TASO RuleCollection file (e.g. the reference's "
+                        "graph_subst_3_v2.json): its verified rules join "
+                        "the rewrite enumeration")
     args = p.parse_args()
 
+    from flexflow_tpu.pcg.rewrite import (generate_rewrite_rules,
+                                          load_rewrite_rules)
     from flexflow_tpu.pcg.unity import UnitySearch
     from flexflow_tpu.sim.machine_model import TpuPodModel
     from flexflow_tpu.sim.simulator import OpCostModel, Simulator
@@ -70,7 +76,15 @@ def main():
 
     dp = data_parallel_strategy(args.num_devices)
     t0 = time.perf_counter()
-    unity = UnitySearch(ff.layers, args.num_devices, machine, cm).optimize()
+    unity = UnitySearch(
+        ff.layers, args.num_devices, machine, cm,
+        rewrite_rules=(
+            generate_rewrite_rules()
+            + load_rewrite_rules(args.substitution_json)
+            if args.substitution_json else None
+        ),
+        rewrite_depth=3, rewrite_max_variants=24,
+    ).optimize()
     search_s = time.perf_counter() - t0
     if unity is None:
         print(f"workload={args.workload} n={args.num_devices}: no valid "
