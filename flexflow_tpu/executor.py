@@ -426,6 +426,11 @@ class GraphExecutor:
             num_microbatches=plan.num_microbatches,
             pp_axis=plan.pp_axis,
             dp_axis=plan.dp_axis,
+            # --remat extends to the pipeline region: block internals
+            # are recomputed in backward, so in-flight microbatches
+            # cost one boundary activation each instead of the block's
+            # full residuals
+            remat=self.remat and training,
         )
 
     # -- train step ------------------------------------------------------

@@ -88,6 +88,7 @@ def pipelined_apply(
     num_microbatches: int,
     pp_axis: str = "pp",
     dp_axis: str = "data",
+    remat: bool = False,
 ):
     """Apply a stack of identical blocks as a dp x pp pipelined SPMD
     computation.
@@ -96,16 +97,27 @@ def pipelined_apply(
     layer).  stacked_params: pytree with leading dim L = num blocks,
     sharded over ``pp`` (L % pp == 0).  x: [batch, ...] sharded over
     ``data``.  Differentiable end to end.
+
+    remat=True checkpoints each block: autodiff through the schedule
+    then stores only per-(tick, block) boundary activations instead of
+    every block's internals (attention scores, ffn hiddens) for every
+    in-flight microbatch — the activation-memory lever that lets deep
+    pipelines raise num_microbatches (smaller bubble) without raising
+    peak HBM.  Same schedule, same collectives; backward recomputes
+    block internals (the standard TPU pipeline recipe — an interleaved
+    1F1B would cap in-flight microbatches at S instead of M but costs
+    ~2x compute under lockstep SPMD masking, a bad trade here).
     """
     pp = mesh.shape[pp_axis]
     layers = jax.tree.leaves(stacked_params)[0].shape[0]
     if layers % pp:
         raise ValueError(f"{layers} blocks not divisible by pp={pp}")
+    body_block = jax.checkpoint(block_fn) if remat else block_fn
 
     def stage_fn(local_params, act):
         # run this stage's L/pp blocks in order
         def body(a, p):
-            return block_fn(p, a), None
+            return body_block(p, a), None
 
         out, _ = lax.scan(body, act, local_params)
         return out
