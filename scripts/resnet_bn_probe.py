@@ -81,7 +81,7 @@ from resnet50_search import ResNet50
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.torch_frontend.model import PyTorchModel
 
-def build_and_time(batch=256, px=224):
+def build_and_time(batch=leg["batch"], px=leg["px"]):
     cfg = FFConfig(batch_size=batch, num_devices=1, compute_dtype="bfloat16")
     ff = FFModel(cfg)
     x = ff.create_tensor([batch, 3, px, px], name="input")
@@ -99,38 +99,51 @@ def build_and_time(batch=256, px=224):
         m = ff.train_step({"input": xs}, ys)
     _ = float(m["loss"])
     dt = bench._steady_state(ff, {"input": xs}, ys, 40)
-    return ff, dt
+    return ff, dt, xs, ys
 
-ff, dt = build_and_time()
-print(f"baseline: {dt*1e3:.2f} ms/step ({256/dt:.0f} img/s)", flush=True)
+B = leg["batch"]
+ff, dt, xs, ys = build_and_time()
+print(f"baseline: {dt*1e3:.2f} ms/step ({B/dt:.0f} img/s)", flush=True)
+
+# cost analysis of the train step: lower the executor's jitted step
+# with the live argument pytrees (signature: weights, opt_state, state,
+# inputs, labels, rng — model.train_step's call)
+try:
+    m = ff  # FFModel holds the live pytrees
+    step = m.executor._step_fn
+    import jax.random as jr
+    lowered = step.lower(m._weights, m._opt_state, m._state,
+                         {"input": xs}, ys, jr.key(0))
+    an = lowered.compile().cost_analysis()
+except Exception as e:
+    an = None
+    print("cost_analysis unavailable:", e, flush=True)
+if an:
+    ba = an.get("bytes accessed", None)
+    fl = an.get("flops", None)
+    print(f"bytes accessed/step: {ba}", flush=True)
+    if ba:
+        print(f"  = {ba/dt/1e9:.0f} GB/s effective (chip HBM ~819 GB/s)",
+              flush=True)
+    print(f"flops/step: {fl}", flush=True)
 
 # no-BN ceiling: the native builder (models/resnet.py mirrors the
 # reference resnet.cc, which has no BatchNorm)
 from flexflow_tpu.models.resnet import build_resnet50
-cfg = FFConfig(batch_size=256, num_devices=1, compute_dtype="bfloat16")
+cfg = FFConfig(batch_size=B, num_devices=1, compute_dtype="bfloat16")
 ff2 = FFModel(cfg)
-build_resnet50(ff2, batch_size=256, image_size=224, num_classes=1000)
+build_resnet50(ff2, batch_size=B, image_size=leg["px"], num_classes=leg["classes"])
 ff2.compile(optimizer=SGDOptimizer(lr=0.1),
             loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
             devices=[dev])
 r = np.random.RandomState(0)
-xs = jax.device_put(r.randn(256, 3, 224, 224).astype(np.float32),
+xs = jax.device_put(r.randn(B, 3, leg["px"], leg["px"]).astype(np.float32),
                     ff2.executor.input_shardings()["input"])
-ys = jax.device_put(r.randint(0, 1000, 256).astype(np.int32),
+ys = jax.device_put(r.randint(0, leg["classes"], B).astype(np.int32),
                     ff2.executor.label_sharding())
 for _ in range(3):
     m = ff2.train_step({"input": xs}, ys)
 _ = float(m["loss"])
 dt2 = bench._steady_state(ff2, {"input": xs}, ys, 40)
-print(f"no-BN ceiling: {dt2*1e3:.2f} ms/step ({256/dt2:.0f} img/s); "
+print(f"no-BN ceiling: {dt2*1e3:.2f} ms/step ({B/dt2:.0f} img/s); "
       f"BN/elementwise share = {(dt-dt2)/dt*100:.1f}%", flush=True)
-
-# cost analysis of the train step
-try:
-    fn = ff.executor._train_fn  # jitted
-    an = fn.lower(*ff.executor._last_args).compile().cost_analysis()  # may not exist
-except Exception as e:
-    an = None
-    print("cost_analysis unavailable:", e, flush=True)
-if an:
-    print("bytes accessed:", an.get("bytes accessed", None), flush=True)

@@ -53,7 +53,8 @@ def main():
                         "the rewrite enumeration")
     args = p.parse_args()
 
-    from flexflow_tpu.pcg.rewrite import (generate_rewrite_rules,
+    from flexflow_tpu.pcg.rewrite import (CATALOG_DEGREES,
+                                          generate_rewrite_rules,
                                           load_rewrite_rules)
     from flexflow_tpu.pcg.unity import UnitySearch
     from flexflow_tpu.sim.machine_model import TpuPodModel
@@ -78,12 +79,18 @@ def main():
     t0 = time.perf_counter()
     unity = UnitySearch(
         ff.layers, args.num_devices, machine, cm,
+        # same rule list + degrees the compile-time replay builds
+        # (rules_for_config / CATALOG_DEGREES) so recorded rewrite
+        # traces stay replayable; depth/variant overrides only apply
+        # when the catalog widens the rule pool
         rewrite_rules=(
             generate_rewrite_rules()
-            + load_rewrite_rules(args.substitution_json)
+            + load_rewrite_rules(args.substitution_json,
+                                 degrees=CATALOG_DEGREES)
             if args.substitution_json else None
         ),
-        rewrite_depth=3, rewrite_max_variants=24,
+        **({"rewrite_depth": 3, "rewrite_max_variants": 24}
+           if args.substitution_json else {}),
     ).optimize()
     search_s = time.perf_counter() - t0
     if unity is None:
