@@ -85,7 +85,7 @@ def build_and_time(batch=leg["batch"], px=leg["px"]):
     cfg = FFConfig(batch_size=batch, num_devices=1, compute_dtype="bfloat16")
     ff = FFModel(cfg)
     x = ff.create_tensor([batch, 3, px, px], name="input")
-    (out,) = PyTorchModel(ResNet50(classes=1000)).torch_to_ff(ff, [x])
+    (out,) = PyTorchModel(ResNet50(classes=leg["classes"])).torch_to_ff(ff, [x])
     ff.softmax(out)
     ff.compile(optimizer=SGDOptimizer(lr=0.1),
                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
@@ -93,7 +93,7 @@ def build_and_time(batch=leg["batch"], px=leg["px"]):
     r = np.random.RandomState(0)
     xs = jax.device_put(r.randn(batch, 3, px, px).astype(np.float32),
                         ff.executor.input_shardings()["input"])
-    ys = jax.device_put(r.randint(0, 1000, batch).astype(np.int32),
+    ys = jax.device_put(r.randint(0, leg["classes"], batch).astype(np.int32),
                         ff.executor.label_sharding())
     for _ in range(3):
         m = ff.train_step({"input": xs}, ys)

@@ -16,12 +16,15 @@ import time
 import numpy as np
 
 
-def build(workload: str, batch: int):
+def build(workload: str, batch: int, substitution_json=None):
     from flexflow_tpu import FFConfig, FFModel
     from flexflow_tpu.models import build_mlp_unify
     from flexflow_tpu.models.transformer import build_transformer
 
-    ff = FFModel(FFConfig(batch_size=batch))
+    # substitution_json must reach FFConfig too: compile-time replay of
+    # a recorded catalog rewrite builds its rule list from the config
+    ff = FFModel(FFConfig(batch_size=batch,
+                          substitution_json=substitution_json))
     if workload == "mlp":
         build_mlp_unify(ff, batch_size=batch, input_dim=256,
                         hidden_dims=[2048] * 4 + [16])
@@ -65,7 +68,8 @@ def main():
         data_parallel_strategy,
     )
 
-    ff, _, _ = build(args.workload, args.batch_size)
+    ff, _, _ = build(args.workload, args.batch_size,
+                     args.substitution_json)
     machine = TpuPodModel()
     cm = OpCostModel(machine)
     sim = Simulator(machine, cm)
@@ -112,7 +116,8 @@ def main():
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 
     for name, strategy in [("data-parallel", dp), ("unity", unity)]:
-        m, d, l = build(args.workload, args.batch_size)
+        m, d, l = build(args.workload, args.batch_size,
+                        args.substitution_json)
         loss = (LossType.SPARSE_CATEGORICAL_CROSSENTROPY
                 if args.workload == "mlp"
                 else LossType.MEAN_SQUARED_ERROR_AVG_REDUCE)
