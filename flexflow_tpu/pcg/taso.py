@@ -53,7 +53,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..fftype import ActiMode, OperatorType, OpBinary, OpUnary
 from ..ops.op import Op

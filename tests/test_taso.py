@@ -26,7 +26,6 @@ from flexflow_tpu.pcg.taso import (
     PatternRule,
     UnsupportedRule,
     convert_rules,
-    instantiate_src,
     load_taso_rules,
     parse_rule_collection,
     verify_rule,
