@@ -154,3 +154,45 @@ def build_gpt(
     t = ff.layer_norm(t, axes=[-1], name="final_ln")
     logits = ff.dense(t, vocab_size, use_bias=False, name="lm_head")
     return logits
+
+
+def gpt_generate(ff: FFModel, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0):
+    """Autoregressive generation with the compiled fixed-shape GPT
+    graph: right-pad the prompt to the model's seq_length, re-run the
+    forward per emitted token, and feed back the sampled id
+    (temperature 0 = greedy argmax).  The causal mask makes padding
+    beyond the current position irrelevant to the next-token logits.
+    O(T^2) utility loop like models/nmt.greedy_decode — correct, not a
+    KV-cache serving path.
+
+    prompt_ids: [batch, prompt_len] ints.  Returns [batch,
+    prompt_len + max_new_tokens] (truncated at the model's seq_length).
+    """
+    import numpy as np
+
+    prompt_ids = np.asarray(prompt_ids, np.int32)
+    batch, plen = prompt_ids.shape
+    ids_src = next(op for op in ff.layers.source_ops()
+                   if op.name == "input")
+    seq_len = ids_src.outputs[0].shape.logical_shape[1]
+    total = min(seq_len, plen + max_new_tokens)
+    buf = np.zeros((batch, seq_len), np.int32)
+    buf[:, :plen] = prompt_ids
+    pos = np.tile(np.arange(seq_len, dtype=np.int32), (batch, 1))
+    rng = np.random.RandomState(seed)
+    for t in range(plen, total):
+        logits = np.asarray(
+            ff.forward({"input": buf, "positions": pos}), np.float32)
+        step = logits[:, t - 1]  # next-token distribution at position t-1
+        if temperature > 0.0:
+            z = step / temperature
+            z = z - z.max(-1, keepdims=True)
+            p = np.exp(z)
+            p /= p.sum(-1, keepdims=True)
+            nxt = np.array([rng.choice(p.shape[-1], p=p[b])
+                            for b in range(batch)], np.int32)
+        else:
+            nxt = step.argmax(-1).astype(np.int32)
+        buf[:, t] = nxt
+    return buf[:, :total]
