@@ -322,3 +322,107 @@ def test_cancel_split_concat_rule():
     kinds = [op.op_type for op in g2.ops]
     assert OperatorType.SPLIT not in kinds
     assert OperatorType.CONCAT not in kinds
+
+
+def test_random_graph_rewrites_preserve_forward():
+    """Property test on REAL graphs (not the synthesized patterns the
+    loader self-verifies on): random rank-3 op soups; every match an
+    'exact'-verified algebraic rule finds must apply into a graph that
+    computes the SAME function (weights transferred by name).  Guards
+    the matcher against false-positive matches."""
+    import jax
+
+    from flexflow_tpu.fftype import ActiMode
+
+    prules, _ = load_taso_rules(CATALOG, degrees=(2,))
+    algebraic = [p for p in prules if not p.uses_parallel
+                 and verify_rule(p) == "exact"]
+    assert len(algebraic) >= 40
+
+    checked = 0
+    for seed in range(6):
+        rs = np.random.RandomState(seed)
+        ff = FFModel(FFConfig(batch_size=4, num_devices=1))
+        tensors = [ff.create_tensor([4, 4, 8], name=f"in{k}")
+                   for k in range(3)]
+        same = [t for t in tensors]  # all [4,4,8] so far
+        for step in range(10):
+            k = rs.randint(0, 5)
+            if k == 0:
+                # catalog shape: chain of ews with a SHARED operand
+                # (rules 304-312/326-342 reassociate these)
+                x, y, z = (same[i] for i in rs.randint(0, len(same), 3))
+                op = ff.add if rs.rand() < 0.5 else ff.multiply
+                c = op(x, y)
+                t = op(z, c) if rs.rand() < 0.5 else op(c, z)
+                same.append(c)
+            elif k == 1:
+                # catalog shape: concat(relu, relu) on the feature axis
+                # (rules 428/453/543 hoist the relu)
+                x, y = (same[i] for i in rs.randint(0, len(same), 2))
+                t = ff.concat([ff.relu(x, inplace=False),
+                               ff.relu(y, inplace=False)], axis=2)
+            elif k == 2:
+                x, y = (same[i] for i in rs.randint(0, len(same), 2))
+                op = ff.add if rs.rand() < 0.5 else ff.multiply
+                c1, c2 = op(x, y), op(y, same[rs.randint(0, len(same))])
+                t = ff.concat([c1, c2], axis=2)
+            elif k == 3:
+                t = ff.dense(same[rs.randint(0, len(same))], 8,
+                             name=f"d{seed}_{step}")
+                same.append(t)
+            else:
+                t = ff.relu(same[rs.randint(0, len(same))],
+                            inplace=False)
+                same.append(t)
+            tensors.append(t)
+
+        g = ff.layers
+        feeds = {f"in{k}": np.random.RandomState(100 + k)
+                 .randn(4, 4, 8).astype(np.float32) for k in range(3)}
+
+        def run(graph):
+            vals = {}
+            outs = {}
+            consumed = set()
+            for op in graph.ops:
+                for t in op.inputs:
+                    consumed.add(t.guid)
+            for op in graph.topo_order():
+                if op.op_type == OperatorType.INPUT:
+                    vals[op.outputs[0].guid] = feeds[op.name]
+                    continue
+                ws = []
+                for spec in op.weight_specs:
+                    shape = tuple(d.size for d in spec.shape.dims
+                                  if not d.is_replica_dim)
+                    ws.append(np.random.RandomState(
+                        abs(hash((op.name, spec.name))) % 2**31)
+                        .randn(*shape).astype(np.float32) * 0.2)
+                res = op.forward([vals[t.guid] for t in op.inputs], ws)
+                for t, v in zip(op.outputs, res):
+                    vals[t.guid] = np.asarray(v)
+                    if t.guid not in consumed:
+                        outs[t.guid] = vals[t.guid]
+            return outs
+
+        base = run(g)
+        for rule in algebraic:
+            for m in rule.find_matches(g):
+                g2 = rule.apply(g, m)
+                if g2 is None:
+                    continue
+                checked += 1
+                got = run(g2)
+                # compare the survivors' dangling outputs by VALUE
+                # multiset (guids change across the rewrite)
+                base_vals = sorted(
+                    np.asarray(v).sum() for v in base.values())
+                got_vals = sorted(
+                    np.asarray(v).sum() for v in got.values())
+                # rewritten graph may fuse dangling intermediates; every
+                # rewritten output must appear among the originals
+                for gv in got_vals:
+                    assert any(np.isclose(gv, bv, rtol=1e-3, atol=1e-3)
+                               for bv in base_vals), (rule.name, seed)
+    assert checked >= 5, f"property test exercised only {checked} applies"
