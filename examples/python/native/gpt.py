@@ -43,9 +43,10 @@ def main():
     # emitted token under the causal mask)
     from flexflow_tpu.models.transformer import gpt_generate
 
-    prompt = ids[:4, : seq // 2]
+    # prompt batch must match the compiled (dp-sharded) batch
+    prompt = ids[:batch, : seq // 2]
     out = gpt_generate(ff, prompt, max_new_tokens=seq // 2)
-    want = seq_ids[:4, : out.shape[1]]
+    want = seq_ids[:batch, : out.shape[1]]
     acc = float(np.mean(out[:, seq // 2:] == want[:, seq // 2:]))
     print(f"generate: continued {out.shape[1] - seq // 2} tokens, "
           f"progression accuracy {acc:.2f}")

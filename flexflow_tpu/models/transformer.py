@@ -172,10 +172,13 @@ def gpt_generate(ff: FFModel, prompt_ids, max_new_tokens: int,
     import numpy as np
 
     prompt_ids = np.asarray(prompt_ids, np.int32)
-    batch, plen = prompt_ids.shape
     ids_src = next(op for op in ff.layers.source_ops()
                    if op.name == "input")
     seq_len = ids_src.outputs[0].shape.logical_shape[1]
+    prompt_ids = prompt_ids[:, :seq_len]  # docstring contract
+    batch, plen = prompt_ids.shape
+    if plen < 1:
+        raise ValueError("gpt_generate needs a non-empty prompt")
     total = min(seq_len, plen + max_new_tokens)
     buf = np.zeros((batch, seq_len), np.int32)
     buf[:, :plen] = prompt_ids
