@@ -737,6 +737,27 @@ class PyTorchModel:
             elif isinstance(m, nn.BatchNorm2d):
                 entry["gamma"] = m.weight.detach().numpy().copy()
                 entry["beta"] = m.bias.detach().numpy().copy()
+            elif isinstance(m, nn.MultiheadAttention):
+                # packed in_proj [3E, E] / out_proj [E, E] -> per-head
+                # wq/wk/wv [E, H, C], wo [H, C, E] (ops/attention.py)
+                E, H = m.embed_dim, m.num_heads
+                C = E // H
+                ipw = m.in_proj_weight.detach().numpy()
+
+                def per_head(w):
+                    return w.reshape(H, C, E).transpose(2, 0, 1).copy()
+
+                entry["wq"] = per_head(ipw[:E])
+                entry["wk"] = per_head(ipw[E:2 * E])
+                entry["wv"] = per_head(ipw[2 * E:])
+                entry["wo"] = (m.out_proj.weight.detach().numpy()
+                               .reshape(E, H, C).transpose(1, 2, 0).copy())
+                if m.in_proj_bias is not None:
+                    ipb = m.in_proj_bias.detach().numpy()
+                    entry["bq"] = ipb[:E].reshape(H, C).copy()
+                    entry["bk"] = ipb[E:2 * E].reshape(H, C).copy()
+                    entry["bv"] = ipb[2 * E:].reshape(H, C).copy()
+                    entry["bo"] = m.out_proj.bias.detach().numpy().copy()
         ff.set_weights(weights)
 
 

@@ -363,3 +363,45 @@ def test_frozen_buffer_not_trained():
         ff.train_step({"x": x}, y)
     buf = ff._state[buf_ops[0]]["value"]
     np.testing.assert_allclose(np.asarray(buf), np.full(8, 3.0), rtol=1e-6)
+
+
+def test_fx_transformer_block_weight_transfer(devices8):
+    """fx-import a torch transformer block containing
+    nn.MultiheadAttention and transfer ALL weights (incl. the packed
+    in_proj/out_proj -> per-head mapping): forward parity with torch
+    (the reference's tests/align mt5-encoder role through the
+    frontend)."""
+    import torch
+    import torch.nn as nn
+
+    class Block(nn.Module):
+        def __init__(self, E=32, H=4):
+            super().__init__()
+            self.ln1 = nn.LayerNorm(E)
+            self.attn = nn.MultiheadAttention(E, H, batch_first=True)
+            self.ln2 = nn.LayerNorm(E)
+            self.fc1 = nn.Linear(E, 2 * E)
+            self.fc2 = nn.Linear(2 * E, E)
+
+        def forward(self, x):
+            h = self.ln1(x)
+            a, _ = self.attn(h, h, h)
+            x = x + a
+            return x + self.fc2(torch.relu(self.fc1(self.ln2(x))))
+
+    torch.manual_seed(11)
+    tm = Block()
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.torch_frontend.model import PyTorchModel
+
+    ff = FFModel(FFConfig(batch_size=2))
+    x = ff.create_tensor([2, 6, 32], name="input")
+    pt = PyTorchModel(tm)
+    (out,) = pt.torch_to_ff(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices8[:1])
+    pt.copy_weights(ff)
+
+    xs = np.random.RandomState(11).randn(2, 6, 32).astype(np.float32)
+    got = np.asarray(ff.forward({"input": xs}))
+    want = tm(torch.from_numpy(xs)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
