@@ -737,6 +737,22 @@ class PyTorchModel:
             elif isinstance(m, nn.BatchNorm2d):
                 entry["gamma"] = m.weight.detach().numpy().copy()
                 entry["beta"] = m.bias.detach().numpy().copy()
+                # running stats live in the op-state pytree (weights[2:]
+                # of a has_aux_state op), not in get_weights — pretrained
+                # eval-mode parity needs them transferred too
+                st = (ff._state or {}).get(op_name)
+                if st is not None:
+                    import jax as _jax
+
+                    for sname, tv in (("running_mean", m.running_mean),
+                                      ("running_var", m.running_var)):
+                        if sname in st and tv is not None:
+                            old = st[sname]
+                            st[sname] = _jax.device_put(
+                                np.asarray(tv.detach().numpy(),
+                                           old.dtype),
+                                old.sharding,
+                            )
             elif isinstance(m, nn.MultiheadAttention):
                 # packed in_proj [3E, E] / out_proj [E, E] -> per-head
                 # wq/wk/wv [E, H, C], wo [H, C, E] (ops/attention.py)

@@ -405,3 +405,33 @@ def test_fx_transformer_block_weight_transfer(devices8):
     got = np.asarray(ff.forward({"input": xs}))
     want = tm(torch.from_numpy(xs)).detach().numpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_fx_batchnorm_running_stats_transfer(devices8):
+    """copy_weights transfers BatchNorm running stats into the op-state
+    pytree: eval-mode forward parity with a torch model whose stats
+    were trained (previously the stats stayed at init mean=0/var=1)."""
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.torch_frontend.model import PyTorchModel
+
+    torch.manual_seed(3)
+    tm = nn.Sequential(nn.Conv2d(3, 8, 3, padding=1),
+                       nn.BatchNorm2d(8), nn.ReLU())
+    tm.train()
+    for _ in range(5):
+        tm(torch.randn(4, 3, 8, 8))
+    tm.eval()
+
+    ff = FFModel(FFConfig(batch_size=2))
+    x = ff.create_tensor([2, 3, 8, 8], name="input")
+    pt = PyTorchModel(tm)
+    pt.torch_to_ff(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices8[:1])
+    pt.copy_weights(ff)
+    xs = np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"input": xs})),
+        tm(torch.from_numpy(xs)).detach().numpy(), rtol=1e-4, atol=1e-4)
