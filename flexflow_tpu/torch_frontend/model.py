@@ -754,18 +754,26 @@ class PyTorchModel:
                                 old.sharding,
                             )
             elif isinstance(m, nn.MultiheadAttention):
-                # packed in_proj [3E, E] / out_proj [E, E] -> per-head
-                # wq/wk/wv [E, H, C], wo [H, C, E] (ops/attention.py)
+                # packed in_proj [3E, E] (or separate q/k/v_proj_weight
+                # when kdim/vdim differ) / out_proj [E, E] -> per-head
+                # wq/wk/wv [E_in, H, C], wo [H, C, E] (ops/attention.py)
                 E, H = m.embed_dim, m.num_heads
                 C = E // H
-                ipw = m.in_proj_weight.detach().numpy()
 
-                def per_head(w):
-                    return w.reshape(H, C, E).transpose(2, 0, 1).copy()
+                def per_head(w):  # [E_out=H*C, E_in] -> [E_in, H, C]
+                    e_in = w.shape[1]
+                    return w.reshape(H, C, e_in).transpose(2, 0, 1).copy()
 
-                entry["wq"] = per_head(ipw[:E])
-                entry["wk"] = per_head(ipw[E:2 * E])
-                entry["wv"] = per_head(ipw[2 * E:])
+                if m.in_proj_weight is not None:
+                    ipw = m.in_proj_weight.detach().numpy()
+                    wq, wk, wv = ipw[:E], ipw[E:2 * E], ipw[2 * E:]
+                else:  # kdim/vdim != embed_dim: torch stores them split
+                    wq = m.q_proj_weight.detach().numpy()
+                    wk = m.k_proj_weight.detach().numpy()
+                    wv = m.v_proj_weight.detach().numpy()
+                entry["wq"] = per_head(wq)
+                entry["wk"] = per_head(wk)
+                entry["wv"] = per_head(wv)
                 entry["wo"] = (m.out_proj.weight.detach().numpy()
                                .reshape(E, H, C).transpose(1, 2, 0).copy())
                 if m.in_proj_bias is not None:
@@ -774,6 +782,12 @@ class PyTorchModel:
                     entry["bk"] = ipb[E:2 * E].reshape(H, C).copy()
                     entry["bv"] = ipb[2 * E:].reshape(H, C).copy()
                     entry["bo"] = m.out_proj.bias.detach().numpy().copy()
+                if m.bias_k is not None and "bias_k" in entry:
+                    # appended bias token, torch [1, 1, E] -> [1, H, C]
+                    entry["bias_k"] = (m.bias_k.detach().numpy()
+                                       .reshape(1, H, C).copy())
+                    entry["bias_v"] = (m.bias_v.detach().numpy()
+                                       .reshape(1, H, C).copy())
         ff.set_weights(weights)
 
 

@@ -435,3 +435,37 @@ def test_fx_batchnorm_running_stats_transfer(devices8):
     np.testing.assert_allclose(
         np.asarray(ff.forward({"input": xs})),
         tm(torch.from_numpy(xs)).detach().numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_fx_mha_bias_kv_weight_transfer(devices8):
+    """add_bias_kv MultiheadAttention transfers its appended bias token
+    weights too (review r04: previously left at random init, silently
+    diverging from torch)."""
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.torch_frontend.model import PyTorchModel
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.attn = nn.MultiheadAttention(16, 4, bias=True,
+                                              add_bias_kv=True,
+                                              batch_first=True)
+
+        def forward(self, x):
+            return self.attn(x, x, x)[0]
+
+    torch.manual_seed(5)
+    tm = M()
+    ff = FFModel(FFConfig(batch_size=2))
+    x = ff.create_tensor([2, 6, 16], name="input")
+    pt = PyTorchModel(tm)
+    pt.torch_to_ff(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices8[:1])
+    pt.copy_weights(ff)
+    xs = np.random.RandomState(5).randn(2, 6, 16).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ff.forward({"input": xs})),
+        tm(torch.from_numpy(xs)).detach().numpy(), rtol=1e-4, atol=1e-4)
