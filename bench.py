@@ -384,24 +384,48 @@ def bench_moe_dispatch(dev, on_tpu):
     }
 
 
+def _outage_line(reason: str):
+    # tunnel/backend outage: emit a diagnostic JSON line instead of a
+    # stacktrace/hang so the capture records WHY there are no numbers
+    print(json.dumps({
+        "metric": "bench unavailable: TPU backend init failed",
+        "value": 0.0,
+        "unit": "samples/s",
+        "vs_baseline": 0.0,
+        "manifest_version": MANIFEST["version"],
+        "error": reason[:300],
+    }))
+
+
 def main():
     import gc
+    import socket
 
     import jax
+
+    if (os.environ.get("PALLAS_AXON_POOL_IPS")
+            and not os.environ.get("JAX_PLATFORMS", "").startswith("cpu")):
+        # With the axon relay dead, device init HANGS (the interposer
+        # dials the relay regardless of platform), so probe the relay's
+        # loopback port with a plain TCP connect first — no jax client,
+        # no wedge risk for concurrent chip jobs.
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.settimeout(2.0)
+        relay_up = s.connect_ex(("127.0.0.1", 8082)) == 0
+        s.close()
+        if not relay_up:
+            _outage_line("axon relay (127.0.0.1:8082) is down")
+            return
+    elif os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # honor the env var through jax.config: under the axon
+        # sitecustomize the env var alone routes through an interposer
+        # that can hang on a dead relay (see tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
 
     try:
         dev = jax.devices()[0]
     except Exception as e:
-        # tunnel/backend outage: emit a diagnostic JSON line instead of
-        # a stacktrace so the capture records WHY there are no numbers
-        print(json.dumps({
-            "metric": "bench unavailable: TPU backend init failed",
-            "value": 0.0,
-            "unit": "samples/s",
-            "vs_baseline": 0.0,
-            "manifest_version": MANIFEST["version"],
-            "error": f"{type(e).__name__}: {e}"[:300],
-        }))
+        _outage_line(f"{type(e).__name__}: {e}")
         return
     on_tpu = dev.platform != "cpu"
 
