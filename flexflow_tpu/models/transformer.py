@@ -157,7 +157,8 @@ def build_gpt(
 
 
 def gpt_generate(ff: FFModel, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, seed: int = 0):
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: int = 0, top_p: float = 0.0):
     """Autoregressive generation with the compiled fixed-shape GPT
     graph: right-pad the prompt to the model's seq_length, re-run the
     forward per emitted token, and feed back the sampled id
@@ -166,12 +167,20 @@ def gpt_generate(ff: FFModel, prompt_ids, max_new_tokens: int,
     O(T^2) utility loop like models/nmt.greedy_decode — correct, not a
     KV-cache serving path.
 
+    Sampling controls compose the usual way: logits/temperature, then
+    top_k (keep the k most likely ids, 0 = off), then top_p nucleus
+    filtering (smallest sorted prefix with mass >= top_p, 0 = off);
+    both apply only when temperature > 0.
+
     prompt_ids: [batch, prompt_len] ints.  Returns [batch,
     prompt_len + max_new_tokens] (truncated at the model's seq_length).
     """
     import numpy as np
 
     prompt_ids = np.asarray(prompt_ids, np.int32)
+    if top_k < 0 or not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"invalid sampling filter: top_k={top_k} "
+                         f"top_p={top_p}")
     ids_src = next(op for op in ff.layers.source_ops()
                    if op.name == "input")
     seq_len = ids_src.outputs[0].shape.logical_shape[1]
@@ -190,12 +199,100 @@ def gpt_generate(ff: FFModel, prompt_ids, max_new_tokens: int,
         step = logits[:, t - 1]  # next-token distribution at position t-1
         if temperature > 0.0:
             z = step / temperature
+            if top_k and top_k < z.shape[-1]:
+                # keep the k most likely ids per row
+                kth = np.partition(z, -top_k, axis=-1)[:, -top_k, None]
+                z = np.where(z < kth, -np.inf, z)
             z = z - z.max(-1, keepdims=True)
             p = np.exp(z)
             p /= p.sum(-1, keepdims=True)
+            if top_p and 0.0 < top_p < 1.0:
+                # nucleus: smallest sorted prefix with mass >= top_p
+                order = np.argsort(-p, axis=-1)
+                sp = np.take_along_axis(p, order, -1)
+                drop_sorted = np.cumsum(sp, axis=-1) - sp >= top_p
+                drop = np.zeros_like(drop_sorted)
+                np.put_along_axis(drop, order, drop_sorted, -1)
+                p = np.where(drop, 0.0, p)
+                p /= p.sum(-1, keepdims=True)
             nxt = np.array([rng.choice(p.shape[-1], p=p[b])
                             for b in range(batch)], np.int32)
         else:
             nxt = step.argmax(-1).astype(np.int32)
         buf[:, t] = nxt
     return buf[:, :total]
+
+
+def gpt_beam_search(ff: FFModel, prompt_ids, max_new_tokens: int,
+                    beam_size: int = 4, length_penalty: float = 0.0,
+                    eos_id: int = -1):
+    """Beam-search decoding on the compiled fixed-shape GPT graph
+    (beyond the reference: its legacy nmt/ decoder is greedy-only).
+
+    Beams ride the model's batch dimension: all `beam_size` hypotheses
+    of one prompt decode in a single forward per step, so the compiled
+    batch size must be >= beam_size (extra rows are padding).  Scores
+    are summed token log-probs; `length_penalty` applies the GNMT
+    normalization ((5+len)/6)^lp to final scores; `eos_id` >= 0
+    freezes finished beams (they compete with their frozen score).
+
+    prompt_ids: [prompt_len] or [1, prompt_len] ints (single prompt).
+    Returns (tokens [total_len], score float).
+    """
+    import numpy as np
+
+    prompt_ids = np.asarray(prompt_ids, np.int32).reshape(1, -1)
+    ids_src = next(op for op in ff.layers.source_ops()
+                   if op.name == "input")
+    model_batch = ids_src.outputs[0].shape.logical_shape[0]
+    seq_len = ids_src.outputs[0].shape.logical_shape[1]
+    if beam_size > model_batch:
+        raise ValueError(
+            f"beam_size {beam_size} exceeds compiled batch {model_batch}")
+    prompt_ids = prompt_ids[:, :seq_len]
+    plen = prompt_ids.shape[1]
+    if plen < 1:
+        raise ValueError("gpt_beam_search needs a non-empty prompt")
+    total = min(seq_len, plen + max_new_tokens)
+
+    buf = np.zeros((model_batch, seq_len), np.int32)
+    buf[:beam_size, :plen] = prompt_ids  # every beam starts from the prompt
+    pos = np.tile(np.arange(seq_len, dtype=np.int32), (model_batch, 1))
+    scores = np.full(beam_size, -np.inf, np.float64)
+    scores[0] = 0.0  # step 1: only one distinct hypothesis exists
+    alive = np.ones(beam_size, bool)
+    gen_len = np.zeros(beam_size, np.int64)  # emitted tokens per beam
+
+    for t in range(plen, total):
+        logits = np.asarray(
+            ff.forward({"input": buf, "positions": pos}), np.float32)
+        step = logits[:beam_size, t - 1]
+        z = step - step.max(-1, keepdims=True)
+        lp = z - np.log(np.exp(z).sum(-1, keepdims=True))  # [beam, vocab]
+        vocab = lp.shape[-1]
+        cand = scores[:, None] + np.where(alive[:, None], lp, -np.inf)
+        if eos_id >= 0 and not alive.all():
+            # a finished beam competes as one stay-put candidate
+            cand[~alive, :] = -np.inf
+            cand[~alive, 0] = scores[~alive]
+        flat = cand.reshape(-1)
+        top = np.argsort(-flat)[:beam_size]
+        src_beam, tok = top // vocab, (top % vocab).astype(np.int32)
+        new_buf = buf[:beam_size][src_beam].copy()
+        new_alive = alive[src_beam].copy()
+        new_buf[new_alive, t] = tok[new_alive]  # frozen beams keep padding
+        gen_len = gen_len[src_beam] + new_alive  # explicit per-beam length
+        if eos_id >= 0:
+            new_alive &= tok != eos_id
+        buf[:beam_size] = new_buf
+        scores = flat[top]
+        alive = new_alive
+        if eos_id >= 0 and not alive.any():
+            break
+    if length_penalty > 0.0:
+        norm = ((5.0 + np.maximum(gen_len, 1).astype(np.float64)) / 6.0) \
+            ** length_penalty
+        best = int(np.argmax(scores / norm))
+    else:
+        best = int(np.argmax(scores))
+    return buf[best, :total].copy(), float(scores[best])
