@@ -520,3 +520,19 @@ class GraphExecutor:
 
         with self.mesh:
             return jax.jit(fwd)
+
+    def build_decode_step(self):
+        """Inference forward that RETURNS the updated op-state pytree —
+        the KV-cache decode contract (attention ops in decode mode carry
+        k/v caches + position in state; the caller threads state between
+        steps).  State is donated: each step reuses the cache buffers
+        in place on device."""
+
+        def step(weights, state, inputs):
+            logits, new_state, _, _ = self.run_forward(
+                weights, state, inputs, training=False, rng=None
+            )
+            return logits, new_state
+
+        with self.mesh:
+            return jax.jit(step, donate_argnums=(1,))

@@ -289,13 +289,16 @@ class FFModel:
         add_zero_attn: bool = False,
         causal: bool = False,
         name: Optional[str] = None,
+        decode_max_seq: int = 0,
     ) -> ParallelTensor:
         p = MultiHeadAttentionParams(
             embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
             add_zero_attn, causal,
         )
         return self._add(
-            MultiHeadAttention(p, [query, key, value], name=self._name("attention", name))
+            MultiHeadAttention(p, [query, key, value],
+                               name=self._name("attention", name),
+                               decode_max_seq=decode_max_seq)
         )
 
     def batch_matmul(
@@ -920,6 +923,56 @@ class FFModel:
     # single jitted step; kept as explicit methods for API compatibility.
     def init_operators(self):
         return None
+
+    def decode_step(self, inputs: Dict[str, np.ndarray]):
+        """One incremental-decode forward: runs the compiled graph with
+        the current op state and threads the returned state (KV caches +
+        positions advance).  Build the graph with decode-mode attention
+        (decode_max_seq > 0) and call reset_decode_state() before each
+        new sequence batch."""
+        if getattr(self, "_decode_fn", None) is None:
+            self._decode_fn = self.executor.build_decode_step()
+            limits = [
+                op._decode_max_seq
+                for op in self.operators.topo_order()
+                if getattr(op, "_decode_max_seq", 0)
+            ]
+            self._decode_limit = min(limits) if limits else 0
+            self._decode_pos = 0
+        # host-side overflow guard: on device dynamic_update_slice would
+        # silently clamp the write index and corrupt the last cache row
+        step = max(
+            (int(np.asarray(v).shape[1]) for v in inputs.values()
+             if np.asarray(v).ndim >= 2), default=1,
+        )
+        if self._decode_limit and self._decode_pos + step > self._decode_limit:
+            raise ValueError(
+                f"decode_step past decode_max_seq={self._decode_limit} "
+                f"(position {self._decode_pos}); call reset_decode_state() "
+                "to start a new sequence"
+            )
+        put = {
+            k: jax.device_put(v, self.executor.input_shardings()[k])
+            for k, v in inputs.items()
+        }
+        logits, self._state = self._decode_fn(self._weights, self._state, put)
+        self._decode_pos += step
+        return logits
+
+    def reset_decode_state(self):
+        """Zero the decode caches (k_cache/v_cache/cache_pos state
+        entries) so the next decode_step starts a fresh sequence."""
+        import jax.numpy as jnp
+
+        names = ("k_cache", "v_cache", "cache_pos")
+        self._state = {
+            op: {
+                k: (jnp.zeros_like(v) if k in names else v)
+                for k, v in entries.items()
+            }
+            for op, entries in self._state.items()
+        }
+        self._decode_pos = 0
 
     def forward(self, inputs: Dict[str, np.ndarray],
                 seq_length: Optional[int] = None):

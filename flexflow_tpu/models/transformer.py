@@ -119,6 +119,8 @@ def build_gpt(
     intermediate_size: int = 3072,
     vocab_size: int = 50257,
     dropout: float = 0.0,
+    max_positions: int = 0,
+    decode_max_seq: int = 0,
 ):
     """Decoder-only causal LM (pre-LN GPT-2 shape) — a model family
     BEYOND the reference's zoo (its transformer example is encoder-only,
@@ -137,13 +139,17 @@ def build_gpt(
     pos = ff.create_tensor([batch_size, seq_length], dtype="int32",
                            name="positions")
     t = ff.embedding(ids, vocab_size, hidden_size, name="tok_embed")
-    pe = ff.embedding(pos, seq_length, hidden_size, name="pos_embed")
+    # max_positions decouples the position table from the graph's seq
+    # length so a seq-1 KV-cache decode graph shares the trained table
+    pe = ff.embedding(pos, max_positions or seq_length, hidden_size,
+                      name="pos_embed")
     t = ff.add(t, pe, name="embed_sum")
     for i in range(num_layers):
         a = ff.layer_norm(t, axes=[-1], name=f"ln1_{i}")
         a = ff.multihead_attention(
             a, a, a, hidden_size, num_heads, dropout=dropout,
             causal=True, name=f"attn_{i}",
+            decode_max_seq=decode_max_seq,
         )
         t = ff.add(t, a, name=f"attn_res_{i}")
         h = ff.layer_norm(t, axes=[-1], name=f"ln2_{i}")
@@ -178,9 +184,7 @@ def gpt_generate(ff: FFModel, prompt_ids, max_new_tokens: int,
     import numpy as np
 
     prompt_ids = np.asarray(prompt_ids, np.int32)
-    if top_k < 0 or not 0.0 <= top_p <= 1.0:
-        raise ValueError(f"invalid sampling filter: top_k={top_k} "
-                         f"top_p={top_p}")
+    validate_sampling(top_k, top_p)
     ids_src = next(op for op in ff.layers.source_ops()
                    if op.name == "input")
     seq_len = ids_src.outputs[0].shape.logical_shape[1]
@@ -197,30 +201,47 @@ def gpt_generate(ff: FFModel, prompt_ids, max_new_tokens: int,
         logits = np.asarray(
             ff.forward({"input": buf, "positions": pos}), np.float32)
         step = logits[:, t - 1]  # next-token distribution at position t-1
-        if temperature > 0.0:
-            z = step / temperature
-            if top_k and top_k < z.shape[-1]:
-                # keep the k most likely ids per row
-                kth = np.partition(z, -top_k, axis=-1)[:, -top_k, None]
-                z = np.where(z < kth, -np.inf, z)
-            z = z - z.max(-1, keepdims=True)
-            p = np.exp(z)
-            p /= p.sum(-1, keepdims=True)
-            if top_p and 0.0 < top_p < 1.0:
-                # nucleus: smallest sorted prefix with mass >= top_p
-                order = np.argsort(-p, axis=-1)
-                sp = np.take_along_axis(p, order, -1)
-                drop_sorted = np.cumsum(sp, axis=-1) - sp >= top_p
-                drop = np.zeros_like(drop_sorted)
-                np.put_along_axis(drop, order, drop_sorted, -1)
-                p = np.where(drop, 0.0, p)
-                p /= p.sum(-1, keepdims=True)
-            nxt = np.array([rng.choice(p.shape[-1], p=p[b])
-                            for b in range(batch)], np.int32)
-        else:
-            nxt = step.argmax(-1).astype(np.int32)
-        buf[:, t] = nxt
+        buf[:, t] = sample_next(step, temperature, rng, top_k, top_p)
     return buf[:, :total]
+
+
+def validate_sampling(top_k: int, top_p: float):
+    if top_k < 0 or not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"invalid sampling filter: top_k={top_k} "
+                         f"top_p={top_p}")
+
+
+def sample_next(step_logits, temperature: float, rng, top_k: int = 0,
+                top_p: float = 0.0):
+    """Sample next-token ids from [batch, vocab] logits (numpy host
+    path shared by gpt_generate and the KV-cache decoder): temperature,
+    then top_k, then top_p nucleus; temperature 0 = greedy."""
+    import numpy as np
+
+    if temperature <= 0.0:
+        return step_logits.argmax(-1).astype(np.int32)
+    # float32, matching the pre-extraction inline path: seeded runs
+    # recorded against it stay reproducible (np.random.choice converts
+    # p to double internally, so the f32 sum-to-1 rounding is tolerated)
+    z = np.asarray(step_logits, np.float32) / temperature
+    if top_k and top_k < z.shape[-1]:
+        # keep the k most likely ids per row
+        kth = np.partition(z, -top_k, axis=-1)[:, -top_k, None]
+        z = np.where(z < kth, -np.inf, z)
+    z = z - z.max(-1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(-1, keepdims=True)
+    if top_p and 0.0 < top_p < 1.0:
+        # nucleus: smallest sorted prefix with mass >= top_p
+        order = np.argsort(-p, axis=-1)
+        sp = np.take_along_axis(p, order, -1)
+        drop_sorted = np.cumsum(sp, axis=-1) - sp >= top_p
+        drop = np.zeros_like(drop_sorted)
+        np.put_along_axis(drop, order, drop_sorted, -1)
+        p = np.where(drop, 0.0, p)
+        p /= p.sum(-1, keepdims=True)
+    return np.array([rng.choice(p.shape[-1], p=p[b])
+                     for b in range(p.shape[0])], np.int32)
 
 
 def gpt_beam_search(ff: FFModel, prompt_ids, max_new_tokens: int,

@@ -61,6 +61,15 @@ class MultiHeadAttentionParams:
 class MultiHeadAttention(Op):
     op_type = OperatorType.MULTIHEAD_ATTENTION
 
+    def __init__(self, params, inputs, name="", shard=None,
+                 decode_max_seq: int = 0):
+        from .op import ShardConfig
+
+        # must exist before Op.__init__ runs make_weight_specs
+        self._decode_max_seq = int(decode_max_seq)
+        super().__init__(params, inputs, name=name,
+                         shard=shard or ShardConfig())
+
     def infer_output_shapes(self, input_shapes):
         q, k, v = input_shapes
         p: MultiHeadAttentionParams = self.params
@@ -104,6 +113,31 @@ class MultiHeadAttention(Op):
         )
         return [ParallelTensorShape(dims, q.dtype)]
 
+    # -- KV-cache decode mode -------------------------------------------
+    # Set op._decode_max_seq = N (before compile) to run this attention
+    # as an incremental decoder: per-step q/k/v of seq length 1, k/v
+    # appended into fixed-shape [b, N, h, d] cache state carried through
+    # the op-state pytree (the BatchNorm running-stats convention), so
+    # generation is O(T) instead of re-running the full forward per
+    # token.  The reference has no incremental decoding at all (its
+    # legacy nmt/ re-runs the graph; triton/ is an incomplete
+    # prototype) — this is TPU-native serving machinery.
+    def _decode_n(self) -> int:
+        return int(getattr(self, "_decode_max_seq", 0) or 0)
+
+    def ctor_kwargs(self) -> dict:
+        n = self._decode_n()
+        return {"decode_max_seq": n} if n else {}
+
+    def num_trainable_weights(self) -> int:
+        n = 4
+        p: MultiHeadAttentionParams = self.params
+        if p.use_bias:
+            n += 4
+        if p.add_bias_kv:
+            n += 2
+        return n
+
     def make_weight_specs(self, input_shapes):
         q, k, v = input_shapes
         p: MultiHeadAttentionParams = self.params
@@ -144,6 +178,38 @@ class MultiHeadAttention(Op):
                 WeightSpec("bias_k", w((1, p.num_heads, p.k_channels), 1), init),
                 WeightSpec("bias_v", w((1, p.num_heads, p.v_channels), 1), init),
             ]
+        n = self._decode_n()
+        if n > 0:
+            if p.add_bias_kv or p.add_zero_attn:
+                raise ShapeError(
+                    f"{self.name}: kv-append options unsupported in "
+                    "decode mode"
+                )
+            if qd[1].degree != 1:
+                raise ShapeError(
+                    f"{self.name}: decode mode needs an unsharded seq dim"
+                )
+
+            def cache(d_head):
+                dims = (
+                    ParallelDim(qd[0].size, qd[0].degree),
+                    ParallelDim(n),
+                    ParallelDim(p.num_heads, c),
+                    ParallelDim(d_head),
+                    ParallelDim(1, q.replica_degree, is_replica_dim=True),
+                )
+                return ParallelTensorShape(dims, dt)
+
+            pos_shape = ParallelTensorShape(
+                (ParallelDim(1),
+                 ParallelDim(1, q.total_degree, is_replica_dim=True)),
+                DataType.INT32,
+            )
+            specs += [
+                WeightSpec("k_cache", cache(p.k_channels), zero),
+                WeightSpec("v_cache", cache(p.v_channels), zero),
+                WeightSpec("cache_pos", pos_shape, zero),
+            ]
         return specs
 
     def forward(self, inputs, weights, *, training=False, rng=None):
@@ -174,11 +240,56 @@ class MultiHeadAttention(Op):
             kh = jnp.concatenate([kh, jnp.zeros((bsz, 1, h, dk), kh.dtype)], axis=1)
             vh = jnp.concatenate([vh, jnp.zeros((bsz, 1, h, dv), vh.dtype)], axis=1)
         scale = 1.0 / np.sqrt(p.k_channels)
+        if self._decode_n() > 0:
+            k_cache, v_cache, pos = weights[-3], weights[-2], weights[-1]
+            ctx, k_cache, v_cache, pos = self._attend_decode(
+                qh, kh, vh, k_cache, v_cache, pos, scale
+            )
+            out = jnp.einsum("bqhd,hde->bqe", ctx, wo)
+            if bo is not None:
+                out = out + bo[None, None]
+            return [out.astype(q.dtype), k_cache, v_cache, pos]
         ctx = self._attend(qh, kh, vh, scale, training=training, rng=rng)
         out = jnp.einsum("bqhd,hde->bqe", ctx, wo)
         if bo is not None:
             out = out + bo[None, None]
         return [out.astype(q.dtype)]
+
+    def _attend_decode(self, qh, kh, vh, k_cache, v_cache, pos, scale):
+        """Incremental attention: append this step's k/v at position
+        `pos` (a [1] int32 carried in op state), attend the new queries
+        over the cache prefix.  q/k/v seq length is the step size
+        (usually 1); causality across steps comes from masking cache
+        positions beyond pos, within-step causality from the usual
+        triangular mask."""
+        p: MultiHeadAttentionParams = self.params
+        s = qh.shape[1]
+        pos0 = pos.reshape(())  # scalar current length
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, kh.astype(k_cache.dtype), (0, pos0, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, vh.astype(v_cache.dtype), (0, pos0, 0, 0)
+        )
+        n = k_cache.shape[1]
+        key_pos = jnp.arange(n, dtype=jnp.int32)  # absolute cache slots
+        q_pos = pos0 + jnp.arange(s, dtype=jnp.int32)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", qh, k_cache.astype(qh.dtype)
+        ) * scale
+        mask = key_pos[None, :] <= q_pos[:, None]  # [s, n]
+        if not p.causal:
+            # bidirectional within the visible prefix (encoder-style
+            # caches): every written slot is attendable
+            mask = jnp.broadcast_to(
+                key_pos[None, :] < pos0 + s, (s, n)
+            )
+        scores = jnp.where(
+            mask[None, None], scores, jnp.finfo(scores.dtype).min
+        )
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(qh.dtype))
+        return ctx, k_cache, v_cache, (pos0 + s).reshape(1)
 
     # -- attention core dispatch ----------------------------------------
     def _seq_degree(self) -> int:

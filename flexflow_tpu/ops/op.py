@@ -101,6 +101,14 @@ class Op:
         ]
 
     # -- to override ----------------------------------------------------
+    def ctor_kwargs(self) -> dict:
+        """Extra constructor kwargs a reconstruction must pass.  Ops are
+        re-instantiated as type(op)(params, inputs, name=, shard=,
+        **ctor_kwargs()) by apply_strategy / clone_op / search variant
+        enumeration; ops carrying construction-time flags beyond
+        (params, shard) override this (MultiHeadAttention decode mode)."""
+        return {}
+
     def infer_output_shapes(
         self, input_shapes: Sequence[ParallelTensorShape]
     ) -> List[ParallelTensorShape]:

@@ -50,6 +50,7 @@ def clone_op(op: Op, new_inputs, name=None, shard=None, params=None) -> Op:
         new_inputs,
         name=name or op.name,
         shard=shard if shard is not None else op.shard,
+        **op.ctor_kwargs(),
     )
     old_by_name = {s.name: s for s in op.weight_specs}
     new_op.weight_specs = [

@@ -548,7 +548,8 @@ class UnitySearch:
                 try:
                     new_inputs = [ParallelTensor(shapes[t.guid]) for t in op.inputs]
                     new_op = type(op)(
-                        op.params, new_inputs, name=op.name, shard=choice.shard,
+                        op.params, new_inputs, name=op.name,
+                        shard=choice.shard, **op.ctor_kwargs(),
                     )
                 except (ShapeError, ValueError):
                     ok = False

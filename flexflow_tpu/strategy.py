@@ -170,7 +170,8 @@ def apply_strategy(graph: Graph, strategy: Strategy) -> Graph:
             pt = tensor_map[t.guid]
             new_inputs.append(pt)
         shard = strategy.shard_configs.get(op.name, ShardConfig())
-        new_op = type(op)(op.params, new_inputs, name=op.name, shard=shard)
+        new_op = type(op)(op.params, new_inputs, name=op.name, shard=shard,
+                          **op.ctor_kwargs())
         # carry user-supplied initializers and grad flags from the frontend op
         old_by_name = {s.name: s for s in op.weight_specs}
         new_op.weight_specs = [

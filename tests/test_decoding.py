@@ -1,0 +1,120 @@
+"""KV-cache incremental decoding (flexflow_tpu/decoding.py).
+
+The decode twin must reproduce the O(T^2) re-forward generation
+exactly: same weights, same math, one attention row at a time.  Covers
+the host-loop driver, the single-program lax.scan driver, weight
+transfer/introspection, and cache-state reset between sequences.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.decoding import (
+    gpt_generate_cached,
+    gpt_generate_scan,
+    make_gpt_decoder,
+)
+from flexflow_tpu.models.transformer import build_gpt, gpt_generate
+
+V, S, B = 32, 12, 4
+
+
+def _trained_gpt(devices8, steps=40):
+    ff = FFModel(FFConfig(batch_size=B, num_devices=1))
+    build_gpt(ff, batch_size=B, seq_length=S, hidden_size=32,
+              num_layers=2, num_heads=4, intermediate_size=64,
+              vocab_size=V)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devices8[:1])
+    rng = np.random.RandomState(0)
+    start = rng.randint(0, V, (B, 1))
+    step = rng.randint(1, 6, (B, 1))
+    seq_ids = (start + step * np.arange(S + 1)) % V
+    ids = seq_ids[:, :-1].astype(np.int32)
+    labels = seq_ids[:, 1:].astype(np.int32)
+    pos = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S)).copy()
+    for _ in range(steps):
+        ff.train_step({"input": ids, "positions": pos}, labels)
+    return ff, ids
+
+
+def test_cached_decode_matches_full_forward(devices8):
+    ff, ids = _trained_gpt(devices8)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    prompt = ids[:, :5]
+    full = gpt_generate(ff, prompt, max_new_tokens=6)
+    cached = gpt_generate_cached(ffd, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(full, cached)
+
+
+def test_scan_decode_matches_full_forward(devices8):
+    ff, ids = _trained_gpt(devices8)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    prompt = ids[:, :5]
+    full = gpt_generate(ff, prompt, max_new_tokens=6)
+    scanned = gpt_generate_scan(ffd, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(full, scanned)
+
+
+def test_cache_reset_between_sequences(devices8):
+    """A second generation with a different prompt must not see stale
+    cache rows from the first."""
+    ff, ids = _trained_gpt(devices8)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    p1, p2 = ids[:, :5], ids[:, 3:8]
+    out2_fresh = gpt_generate_cached(ffd, p2, 4)
+    _ = gpt_generate_cached(ffd, p1, 4)
+    out2_again = gpt_generate_cached(ffd, p2, 4)
+    np.testing.assert_array_equal(out2_fresh, out2_again)
+
+
+def test_cached_sampling_runs(devices8):
+    ff, ids = _trained_gpt(devices8, steps=5)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    prompt = ids[:, :4]
+    out = gpt_generate_cached(ffd, prompt, 5, temperature=0.8,
+                              top_k=8, top_p=0.9, seed=3)
+    assert out.shape == (B, 9)
+    assert (out >= 0).all() and (out < V).all()
+    np.testing.assert_array_equal(out[:, :4], prompt)
+    # scan path with temperature
+    s = gpt_generate_scan(ffd, prompt, 5, temperature=0.8, seed=3)
+    assert s.shape == (B, 9) and (s >= 0).all() and (s < V).all()
+
+
+def test_decoder_introspection_rejects_non_gpt(devices8):
+    ff = FFModel(FFConfig(batch_size=2, num_devices=1))
+    x = ff.create_tensor([2, 8], name="x")
+    ff.dense(x, 4)
+    with pytest.raises(ValueError):
+        make_gpt_decoder(ff)
+
+
+def test_decode_graph_rejects_kv_append():
+    """decode mode refuses add_bias_kv/add_zero_attn (the cache layout
+    has no slot for appended bias rows)."""
+    from flexflow_tpu.ops.op import ShapeError
+
+    ff = FFModel(FFConfig(batch_size=2, num_devices=1))
+    t = ff.create_tensor([2, 1, 32], name="x")
+    with pytest.raises(ShapeError):
+        ff.multihead_attention(t, t, t, 32, 4, add_bias_kv=True,
+                               decode_max_seq=16)
+
+
+def test_decode_overflow_guard(devices8):
+    """Stepping past decode_max_seq raises instead of silently
+    clamping the cache write (device dynamic_update_slice clamps)."""
+    ff, ids = _trained_gpt(devices8, steps=1)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    ffd.reset_decode_state()
+    for t in range(S):
+        ffd.decode_step({"input": ids[:, t:t + 1],
+                         "positions": np.full((B, 1), t, np.int32)})
+    with pytest.raises(ValueError, match="decode_max_seq"):
+        ffd.decode_step({"input": ids[:, :1],
+                         "positions": np.full((B, 1), S - 1, np.int32)})
+    ffd.reset_decode_state()  # guard resets with the caches
+    ffd.decode_step({"input": ids[:, :1],
+                     "positions": np.zeros((B, 1), np.int32)})
