@@ -112,6 +112,10 @@ class CheckpointManager:
         ff._opt_state = state["opt_state"]
         ff._state = state["op_state"]
         ff._rng = jax.random.wrap_key_data(state["rng"])
+        # restored cache_pos may be mid-sequence; rebuild the host-side
+        # decode guard from the device value (ADVICE r4)
+        if hasattr(ff, "sync_decode_pos"):
+            ff.sync_decode_pos()
         return int(step)
 
     def restore_meta(self, step: Optional[int] = None) -> Dict[str, Any]:

@@ -187,13 +187,17 @@ def gpt_generate_scan(ffd: FFModel, prompt_ids, max_new_tokens: int,
     prompt_pad = np.zeros((batch, total), np.int32)
     prompt_pad[:, :plen] = prompt_ids[:, :total]
 
-    cache_key = (plen, total, float(temperature))
+    # prompt length is a traced operand, so one compiled program serves
+    # every plen at a given total — a serving loop over varying prompts
+    # does not recompile or leak compilations (ADVICE r4).  The cache is
+    # additionally FIFO-bounded as a backstop against many totals.
+    cache_key = (total, float(temperature))
     fns = getattr(ffd, "_scan_gen_cache", None)
     if fns is None:
         fns = ffd._scan_gen_cache = {}
     if cache_key not in fns:
 
-        def generate(weights, state, prompt, key):
+        def generate(weights, state, prompt, plen_t, key):
             def body(carry, t):
                 state, tok = carry
                 logits, new_state, _, _ = ex.run_forward(
@@ -210,7 +214,8 @@ def gpt_generate_scan(ffd: FFModel, prompt_ids, max_new_tokens: int,
                 else:
                     nxt = jnp.argmax(step, axis=-1).astype(jnp.int32)
                 # during prefill the next token is the given prompt id
-                nxt = jnp.where(t + 1 < plen, prompt[:, (t + 1) % total], nxt)
+                nxt = jnp.where(t + 1 < plen_t,
+                                prompt[:, (t + 1) % total], nxt)
                 return (new_state, nxt), nxt
 
             (state, _), toks = jax.lax.scan(
@@ -219,12 +224,15 @@ def gpt_generate_scan(ffd: FFModel, prompt_ids, max_new_tokens: int,
             # final state is dropped: one generate call = one sequence
             return jnp.swapaxes(toks, 0, 1)  # [batch, total-1]
 
+        while len(fns) >= 8:
+            fns.pop(next(iter(fns)))
         with ex.mesh:
             fns[cache_key] = jax.jit(generate)
 
     key = jax.random.key(seed)
     toks = np.asarray(fns[cache_key](
-        ffd._weights, ffd._state, jnp.asarray(prompt_pad), key))
+        ffd._weights, ffd._state, jnp.asarray(prompt_pad),
+        jnp.int32(plen), key))
     out = np.zeros((batch, total), np.int32)
     out[:, 0] = prompt_pad[:, 0]
     out[:, 1:] = toks

@@ -214,8 +214,19 @@ class GraphExecutor:
                 nt = _num_trainable(op)
                 for i, (spec, pt) in enumerate(zip(op.weight_specs, op.weights)):
                     key, sub = jax.random.split(key)
+                    dtype = pt.dtype.np_dtype
+                    if (
+                        i >= nt
+                        and spec.name in ("k_cache", "v_cache")
+                        and self.compute_dtype is not None
+                    ):
+                        # decode caches live in the compute dtype: their
+                        # values are produced in it anyway, and an f32
+                        # cache would double HBM footprint and add a
+                        # full-cache cast per token (ADVICE r4)
+                        dtype = self.compute_dtype
                     arr = spec.initializer(
-                        sub, pt.shape.logical_shape, pt.dtype.np_dtype
+                        sub, pt.shape.logical_shape, dtype
                     )
                     short = spec.name
                     if i < nt:

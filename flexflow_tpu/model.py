@@ -842,6 +842,7 @@ class FFModel:
     def train_step(self, inputs: Dict[str, np.ndarray], labels: np.ndarray,
                    seq_length: Optional[int] = None):
         """One jitted iteration: forward + loss + backward + metrics + update."""
+        self._check_not_decode_graph("train_step()")
         self.set_iteration_config(seq_length)
         put_inputs, put_labels = self._device_put_batch(inputs, labels)
         self._rng, step_rng = jax.random.split(self._rng)
@@ -852,6 +853,7 @@ class FFModel:
         return self._update_caches(dict(m))
 
     def eval_step(self, inputs: Dict[str, np.ndarray], labels: np.ndarray):
+        self._check_not_decode_graph("eval_step()")
         put_inputs, put_labels = self._device_put_batch(inputs, labels)
         return self._eval_fn(self._weights, self._state, put_inputs, put_labels)
 
@@ -938,7 +940,7 @@ class FFModel:
                 if getattr(op, "_decode_max_seq", 0)
             ]
             self._decode_limit = min(limits) if limits else 0
-            self._decode_pos = 0
+            self.sync_decode_pos()
         # host-side overflow guard: on device dynamic_update_slice would
         # silently clamp the write index and corrupt the last cache row
         step = max(
@@ -959,6 +961,20 @@ class FFModel:
         self._decode_pos += step
         return logits
 
+    def sync_decode_pos(self):
+        """Rebuild the host-side overflow-guard counter from the device
+        cache_pos entries.  Called after any external `_state` swap
+        (checkpoint restore, weight transfer) so the decode_step guard
+        never trusts a stale shadow counter."""
+        pos = 0
+        for entries in (self._state or {}).values():
+            cp = entries.get("cache_pos")
+            if cp is not None:
+                arr = np.asarray(cp).reshape(-1)
+                if arr.size:
+                    pos = max(pos, int(arr[0]))
+        self._decode_pos = pos
+
     def reset_decode_state(self):
         """Zero the decode caches (k_cache/v_cache/cache_pos state
         entries) so the next decode_step starts a fresh sequence."""
@@ -974,8 +990,28 @@ class FFModel:
         }
         self._decode_pos = 0
 
+    def _check_not_decode_graph(self, caller: str):
+        """Plain forward/eval/train on a decode-mode graph would run
+        decode attention but mis-thread the caches — forward/eval drop
+        the updates (stale cache_pos=0 forever), train appends every
+        step until cache_pos hits decode_max_seq and the write silently
+        clamps.  The flag is a graph invariant, computed once."""
+        flag = getattr(self, "_is_decode_graph", None)
+        if flag is None:
+            flag = self._is_decode_graph = any(
+                getattr(op, "_decode_max_seq", 0)
+                for op in self.operators.topo_order()
+            )
+        if flag:
+            raise RuntimeError(
+                f"{caller} on a decode-mode graph (decode_max_seq > 0) "
+                "would discard the KV-cache updates; use decode_step() "
+                "(or gpt_generate_cached / gpt_generate_scan)"
+            )
+
     def forward(self, inputs: Dict[str, np.ndarray],
                 seq_length: Optional[int] = None):
+        self._check_not_decode_graph("forward()")
         self.set_iteration_config(seq_length)
         if self._fwd_fn is None:
             self._fwd_fn = self.executor.build_forward()

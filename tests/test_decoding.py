@@ -103,6 +103,66 @@ def test_decode_graph_rejects_kv_append():
                                decode_max_seq=16)
 
 
+def test_forward_refuses_decode_graph(devices8):
+    """forward()/eval on a decode graph would drop the cache updates
+    and compute against cache_pos=0 forever — it must raise."""
+    ff, ids = _trained_gpt(devices8, steps=1)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    with pytest.raises(RuntimeError, match="decode_step"):
+        ffd.forward({"input": ids[:, :1],
+                     "positions": np.zeros((B, 1), np.int32)})
+
+
+def test_decode_guard_syncs_from_device_state(devices8):
+    """The host-side overflow-guard counter rebuilds from the device
+    cache_pos after an external state swap (checkpoint restore path)."""
+    ff, ids = _trained_gpt(devices8, steps=1)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    ffd.reset_decode_state()
+    for t in range(3):
+        ffd.decode_step({"input": ids[:, t:t + 1],
+                         "positions": np.full((B, 1), t, np.int32)})
+    saved = ffd._state
+    ffd.reset_decode_state()
+    ffd._state = saved          # external swap, shadow counter stale at 0
+    ffd.sync_decode_pos()       # what checkpoint.restore now does
+    assert ffd._decode_pos == 3
+
+
+def test_decode_cache_uses_compute_dtype(devices8):
+    """KV caches materialize in the compute dtype (bf16) — an f32 cache
+    would double HBM footprint and cast the whole cache every token."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.models.transformer import build_gpt
+
+    ff = FFModel(FFConfig(batch_size=2, num_devices=1,
+                          compute_dtype="bfloat16"))
+    build_gpt(ff, batch_size=2, seq_length=8, hidden_size=16,
+              num_layers=1, num_heads=2, intermediate_size=32,
+              vocab_size=V, decode_max_seq=8)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devices8[:1])
+    caches = [v for entries in ff._state.values()
+              for k, v in entries.items() if k in ("k_cache", "v_cache")]
+    assert caches and all(c.dtype == jnp.bfloat16 for c in caches)
+
+
+def test_scan_generate_one_program_per_total(devices8):
+    """Prompt length is a traced operand: two different plens with the
+    same total reuse one compiled scan program."""
+    ff, ids = _trained_gpt(devices8, steps=1)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    gpt_generate_scan(ffd, ids[:, :4], max_new_tokens=5)   # total 9
+    gpt_generate_scan(ffd, ids[:, :6], max_new_tokens=3)   # total 9
+    assert len(ffd._scan_gen_cache) == 1
+    # and the varying-plen outputs still match the host-loop driver
+    a = gpt_generate_scan(ffd, ids[:, :6], max_new_tokens=3)
+    b = gpt_generate_cached(ffd, ids[:, :6], max_new_tokens=3)
+    np.testing.assert_array_equal(a, b)
+
+
 def test_decode_overflow_guard(devices8):
     """Stepping past decode_max_seq raises instead of silently
     clamping the cache write (device dynamic_update_slice clamps)."""
