@@ -67,6 +67,10 @@ class FFConfig:
     # credit gradient sync as mostly hidden behind remaining backward
     # compute in search costing (reference config.h:130)
     search_overlap_backward_update: bool = False
+    # TASO catalog (JSON or binary .pb, auto-detected).  None = default-
+    # on: resolve via rewrite.default_substitution_catalog() ($env, an
+    # in-repo substitutions/ dir, a colocated reference checkout);
+    # ""/"none" = explicitly off.
     substitution_json: Optional[str] = None
     # calibrate search costs by timing real jitted kernels on the chip
     # (reference inner_measure_operator_cost, model.cu:38-75).

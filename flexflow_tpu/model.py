@@ -641,6 +641,17 @@ class FFModel:
             else:
                 strategy = data_parallel_strategy(num_devices)
         self.strategy = strategy
+        if strategy.catalog is None and any(
+            str(n).startswith("taso_rule_") for n, _ in strategy.rewrites
+        ):
+            # pin the catalog identity the trace was searched with so
+            # replay on another host can't silently resolve different
+            # rules (rewrite.rules_for_replay checks the hash)
+            from .pcg.rewrite import catalog_fingerprint, catalog_for_config
+
+            path = catalog_for_config(cfg)
+            if path:
+                strategy.catalog = catalog_fingerprint(path)
         if cfg.export_strategy_file:
             strategy.save(cfg.export_strategy_file)
 
@@ -650,10 +661,10 @@ class FFModel:
         # parallel-op boundaries
         compiled_frontend = self.layers
         if strategy.rewrites:
-            from .pcg.rewrite import apply_rewrites, rules_for_config
+            from .pcg.rewrite import apply_rewrites, rules_for_replay
 
             compiled_frontend = apply_rewrites(
-                compiled_frontend, strategy.rewrites, rules_for_config(cfg)
+                compiled_frontend, strategy.rewrites, rules_for_replay(cfg, strategy)
             )
         if cfg.perform_fusion:
             # reference --fusion (apply_fusion model.cc:2495): fold

@@ -411,6 +411,65 @@ def rules_by_name(rules: Optional[Sequence[RewriteRule]] = None) -> Dict[str, Re
 CATALOG_DEGREES: Tuple[int, ...] = (2, 4, 8, 16)
 
 
+def default_substitution_catalog() -> Optional[str]:
+    """Default TASO catalog path for runs that don't pass
+    --substitution-json, so the flagship joint-search feature is live
+    (not opt-in) whenever a catalog is findable.  Per-rule verification
+    verdicts are disk-cached (taso._verified_verdicts), so the
+    default-on load costs one JSON/pb parse after the first run.
+
+    Resolution order (first hit wins):
+      1. $FLEXFLOW_TPU_SUBSTITUTIONS — a catalog file path; set EMPTY
+         to disable default-on entirely;
+      2. <repo-root>/substitutions/ then ./substitutions/ — first
+         graph_subst*.pb / graph_subst*.json;
+      3. a colocated reference checkout's shipped catalog (dev/CI
+         layout: /root/reference/substitutions/graph_subst_3_v2.pb).
+    """
+    import glob
+    import os
+
+    env = os.environ.get("FLEXFLOW_TPU_SUBSTITUTIONS")
+    if env is not None:
+        return env or None
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for d in (os.path.join(repo_root, "substitutions"), "substitutions"):
+        for pat in ("graph_subst*.pb", "graph_subst*.json"):
+            hits = sorted(glob.glob(os.path.join(d, pat)))
+            if hits:
+                return hits[0]
+    ref = "/root/reference/substitutions/graph_subst_3_v2.pb"
+    if os.path.exists(ref):
+        return ref
+    return None
+
+
+def catalog_for_config(cfg) -> Optional[str]:
+    """The substitution catalog a config resolves to: an explicit
+    --substitution-json wins ("none"/"" disables), else the default-on
+    resolution above."""
+    explicit = getattr(cfg, "substitution_json", None)
+    if explicit is not None:
+        return None if explicit in ("", "none") else explicit
+    return default_substitution_catalog()
+
+
+def catalog_fingerprint(path: str) -> Dict[str, object]:
+    """Identity of a catalog file for strategy replay checks: replay
+    resolves (rule name, match index) pairs, so the replaying host must
+    see byte-identical rules compiled by the same engine semantics."""
+    import hashlib
+    import os
+
+    from .taso import ENGINE_VERSION
+
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    return {"path": os.path.abspath(path), "sha256": digest,
+            "engine": ENGINE_VERSION}
+
+
 def rules_for_config(cfg) -> List[RewriteRule]:
     """THE rule list for a given FFConfig — search and compile-time
     replay must build the identical ordered list or strategy.rewrites'
@@ -418,11 +477,61 @@ def rules_for_config(cfg) -> List[RewriteRule]:
     the TASO catalog degrees are a fixed constant, not derived from the
     replaying host's device count.)"""
     rules = generate_rewrite_rules()
-    if getattr(cfg, "substitution_json", None):
-        rules = rules + load_rewrite_rules(
-            cfg.substitution_json, degrees=CATALOG_DEGREES
-        )
+    catalog = catalog_for_config(cfg)
+    if catalog:
+        rules = rules + load_rewrite_rules(catalog, degrees=CATALOG_DEGREES)
     return rules
+
+
+def rules_for_replay(cfg, strategy) -> List[RewriteRule]:
+    """Rule list for replaying an imported strategy's rewrite trace.
+
+    Default-on catalog resolution is environment-dependent (env var,
+    cwd, colocated checkouts), so a strategy whose trace references
+    taso_rule_* records the catalog's identity at search time
+    (Strategy.catalog) and replay pins to it: the recorded path is used
+    when the config doesn't name one explicitly, and whatever file
+    resolves must hash to the recorded sha256 under the same engine
+    version — otherwise match indices would silently select different
+    subgraphs, so we fail loudly instead."""
+    import os
+
+    from .taso import ENGINE_VERSION
+
+    rec = getattr(strategy, "catalog", None)
+    needs = any(str(n).startswith("taso_rule_")
+                for n, _ in getattr(strategy, "rewrites", []))
+    if not needs:
+        return rules_for_config(cfg)
+    path = catalog_for_config(cfg)
+    if rec:
+        if getattr(cfg, "substitution_json", None) in (None, "", "none"):
+            path = rec["path"] if os.path.exists(rec["path"]) else path
+        if path is None:
+            raise ValueError(
+                "strategy references TASO catalog rules but no catalog "
+                f"is findable (searched with {rec['path']})"
+            )
+        fp = catalog_fingerprint(path)
+        if fp["sha256"] != rec.get("sha256"):
+            raise ValueError(
+                f"catalog {path} differs from the one this strategy was "
+                "searched with — rewrite match indices would not replay"
+            )
+        if rec.get("engine") != ENGINE_VERSION:
+            raise ValueError(
+                "strategy was searched under TASO engine "
+                f"v{rec.get('engine')}, this host runs v{ENGINE_VERSION} "
+                "— re-run the search"
+            )
+    elif path is None:
+        raise ValueError(
+            "strategy references TASO catalog rules but no catalog is "
+            "findable (set --substitution-json)"
+        )
+    return generate_rewrite_rules() + load_rewrite_rules(
+        path, degrees=CATALOG_DEGREES
+    )
 
 
 def apply_rewrites(

@@ -82,9 +82,19 @@ def load_substitution_rules(path: str) -> List[GraphXfer]:
     """JSON rule collection (reference substitution_loader.cc + TASO
     schema substitutions/graph_subst_3_v2.json).  Schema:
       {"rules": [{"name": str, "op_type": "linear", "kind": "channel"}]}
+    TASO RuleCollection files (JSON or binary .pb) carry no per-op
+    shard-option xfers — they load through pcg/taso.py instead — so
+    they resolve to [] here.
     """
-    with open(path) as f:
-        d = json.load(f)
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        from .taso_pb import looks_like_pb
+
+        if looks_like_pb(path):
+            return []  # binary TASO catalog
+        raise
     out = []
     for r in d.get("rules", []):
         t = _OP_TYPE_NAMES.get(r["op_type"])

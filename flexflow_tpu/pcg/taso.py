@@ -115,11 +115,22 @@ def _parse_op(j: dict) -> TasoOp:
 
 
 def parse_rule_collection(path: str) -> List[TasoRule]:
-    """Parse the reference's substitution JSON (RuleCollection schema).
-    Faithful: returns every rule in the file, including ones this
-    engine later rejects as unusable."""
-    with open(path) as f:
-        d = json.load(f)
+    """Parse the reference's substitution catalog — either the JSON
+    twin (RuleCollection schema) or the binary .pb the reference
+    actually ships/loads (decoded by pcg/taso_pb.py).  Faithful:
+    returns every rule in the file, including ones this engine later
+    rejects as unusable."""
+    from .taso_pb import looks_like_pb, pb_to_dict
+
+    d = None
+    if looks_like_pb(path):
+        try:
+            d = pb_to_dict(path)
+        except ValueError:
+            d = None  # mis-sniff (0x0A is '\n'): fall back to JSON
+    if d is None:
+        with open(path) as f:
+            d = json.load(f)
     if d.get("_t") != "RuleCollection" or "rule" not in d:
         raise ValueError(f"{path}: not a TASO RuleCollection file")
     rules = []
@@ -139,11 +150,18 @@ def parse_rule_collection(path: str) -> List[TasoRule]:
 
 
 def is_taso_rule_file(path: str) -> bool:
+    from .taso_pb import looks_like_pb
+
+    if looks_like_pb(path):
+        # binary files reaching the rule loaders are catalogs or
+        # errors either way — let parse_rule_collection produce the
+        # clean diagnosis rather than fully parsing twice here
+        return True
     try:
         with open(path) as f:
             head = f.read(4096)
         return '"RuleCollection"' in head
-    except OSError:
+    except (OSError, UnicodeDecodeError):
         return False
 
 

@@ -1,0 +1,151 @@
+"""Reader for the reference's BINARY substitution catalog (.pb).
+
+The reference ships its 640-rule TASO catalog twice: as
+`substitutions/graph_subst_3_v2.pb` (proto2 wire bytes, what
+`GraphSearchHelper` actually loads) and as a JSON twin produced by
+`tools/protobuf_to_json/protobuf_to_json.cc`.  This module reads the
+binary form directly with the vendored protobuf wire codec
+(onnx_frontend/protowire.py) — no protobuf dependency — and emits the
+EXACT dict structure the reference's converter emits, so the two forms
+parse to identical rules.
+
+Schema (reference tools/protobuf_to_json/rules.proto):
+  Parameter.key=1/.value=2; Tensor.opId=1/.tsId=2;
+  Operator.type=1/.input=2/.para=3;
+  MapOutput.srcOpId=1/.dstOpId=2/.srcTsId=3/.dstTsId=4;
+  Rule.srcOp=1/.dstOp=2/.mappedOutput=3; RuleCollection.rule=1.
+
+Enum name tables mirror protobuf_to_json.cc:14-119 — including its
+"OP_CONSTANT_POOl" typo (line 74), kept verbatim so a .pb parse is
+byte-for-byte the converter's JSON output.  PM_ACTI/PM_PAD values stay
+raw ints: the converter casts them to enums it never registers a
+serializer for, so nlohmann emits the underlying int.
+"""
+from __future__ import annotations
+
+from typing import List, Union
+
+from ..onnx_frontend.protowire import _fields, _signed
+
+# protobuf_to_json.cc:14-46 (OpType), index == enum value
+OP_TYPE_NAMES: List[str] = [
+    "OP_INPUT", "OP_WEIGHT", "OP_ANY", "OP_CONV2D", "OP_DROPOUT",
+    "OP_LINEAR", "OP_POOL2D_MAX", "OP_POOL2D_AVG", "OP_RELU",
+    "OP_SIGMOID", "OP_TANH", "OP_BATCHNORM", "OP_CONCAT", "OP_SPLIT",
+    "OP_RESHAPE", "OP_TRANSPOSE", "OP_EW_ADD", "OP_EW_MUL", "OP_MATMUL",
+    "OP_MUL", "OP_ENLARGE", "OP_MERGE_GCONV", "OP_CONSTANT_IMM",
+    "OP_CONSTANT_ICONV", "OP_CONSTANT_ONE", "OP_CONSTANT_POOl",
+    "OP_PARTITION", "OP_COMBINE", "OP_REPLICATE", "OP_REDUCE",
+    "OP_EMBEDDING",
+]
+
+# protobuf_to_json.cc:81-99 (ParamType)
+PARAM_NAMES: List[str] = [
+    "PM_OP_TYPE", "PM_NUM_INPUTS", "PM_NUM_OUTPUTS", "PM_GROUP",
+    "PM_KERNEL_H", "PM_KERNEL_W", "PM_STRIDE_H", "PM_STRIDE_W",
+    "PM_PAD", "PM_ACTI", "PM_NUMDIM", "PM_AXIS", "PM_PERM",
+    "PM_OUTSHUFFLE", "PM_MERGE_GCONV_COUNT", "PM_PARALLEL_DIM",
+    "PM_PARALLEL_DEGREE",
+]
+
+
+def _enum_name(table: List[str], value: int, what: str) -> str:
+    if 0 <= value < len(table):
+        return table[value]
+    raise ValueError(f"catalog .pb: unknown {what} enum value {value}")
+
+
+def _msg(v, wt, what: str) -> bytes:
+    """Embedded messages must be length-delimited; anything else means
+    the stream isn't this schema (raise the clean not-a-catalog error
+    instead of letting _fields choke on an int)."""
+    if wt != 2:
+        raise ValueError(f"catalog .pb: {what} field is not a message")
+    return v
+
+
+def _parse_tensor(buf: bytes) -> dict:
+    t = {"_t": "Tensor", "opId": 0, "tsId": 0}
+    for field, _wt, v in _fields(buf):
+        if field == 1:
+            t["opId"] = _signed(v)
+        elif field == 2:
+            t["tsId"] = v
+    return t
+
+
+def _parse_param(buf: bytes) -> dict:
+    key = value = 0
+    for field, _wt, v in _fields(buf):
+        if field == 1:
+            key = v
+        elif field == 2:
+            value = _signed(v)
+    return {"_t": "Parameter",
+            "key": _enum_name(PARAM_NAMES, key, "ParamType"),
+            "value": value}
+
+
+def _parse_operator(buf: bytes) -> dict:
+    o = {"_t": "Operator", "type": "OP_ANY", "input": [], "para": []}
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            o["type"] = _enum_name(OP_TYPE_NAMES, v, "OpType")
+        elif field == 2:
+            o["input"].append(_parse_tensor(_msg(v, wt, "Operator.input")))
+        elif field == 3:
+            o["para"].append(_parse_param(_msg(v, wt, "Operator.para")))
+    return o
+
+
+def _parse_map_output(buf: bytes) -> dict:
+    m = {"_t": "MapOutput", "srcOpId": 0, "dstOpId": 0,
+         "srcTsId": 0, "dstTsId": 0}
+    names = {1: "srcOpId", 2: "dstOpId", 3: "srcTsId", 4: "dstTsId"}
+    for field, _wt, v in _fields(buf):
+        if field in names:
+            m[names[field]] = v
+    return m
+
+
+def _parse_rule(buf: bytes) -> dict:
+    r = {"_t": "Rule", "srcOp": [], "dstOp": [], "mappedOutput": []}
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            r["srcOp"].append(_parse_operator(_msg(v, wt, "Rule.srcOp")))
+        elif field == 2:
+            r["dstOp"].append(_parse_operator(_msg(v, wt, "Rule.dstOp")))
+        elif field == 3:
+            r["mappedOutput"].append(
+                _parse_map_output(_msg(v, wt, "Rule.mappedOutput")))
+    return r
+
+
+def pb_to_dict(src: Union[str, bytes]) -> dict:
+    """Parse a serialized GraphSubst.RuleCollection (path or bytes)
+    into the converter's JSON-schema dict, rules named taso_rule_{i}
+    (protobuf_to_json.cc:209-213)."""
+    if isinstance(src, str):
+        with open(src, "rb") as fh:
+            src = fh.read()
+    rules = []
+    for field, wt, v in _fields(src):
+        if field == 1:
+            rules.append(_parse_rule(_msg(v, wt, "RuleCollection.rule")))
+    for i, r in enumerate(rules):
+        r["name"] = f"taso_rule_{i}"
+    return {"_t": "RuleCollection", "rule": rules}
+
+
+def looks_like_pb(path: str) -> bool:
+    """Binary-vs-JSON sniff on the RAW first byte: a RuleCollection
+    wire stream opens with the field-1 length-delimited key 0x0A.
+    0x0A is also '\\n', so a JSON file led by a newline sniffs as pb —
+    parse_rule_collection therefore falls back to JSON when the pb
+    parse fails, rather than trusting this sniff as final."""
+    try:
+        with open(path, "rb") as fh:
+            head = fh.read(1)
+    except OSError:
+        return False
+    return head == b"\x0a"

@@ -1172,11 +1172,12 @@ def unity_optimize(model, num_devices: int) -> Strategy:
     cfg = model.config
     machine = make_machine_model(cfg, num_devices)
     cost_model = make_cost_model(cfg, machine)
-    from .rewrite import rules_for_config
+    from .rewrite import catalog_for_config, rules_for_config
 
     xfers = generate_all_pcg_xfers()
-    if cfg.substitution_json:
-        xfers = xfers + load_substitution_rules(cfg.substitution_json)
+    catalog = catalog_for_config(cfg)
+    if catalog:
+        xfers = xfers + load_substitution_rules(catalog)
     rewrite_rules = rules_for_config(cfg)
     # fitted overlap constants (sim/calibrate.py) take precedence over
     # the hand-set priors when a calibration has been persisted

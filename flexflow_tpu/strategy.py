@@ -76,6 +76,12 @@ class Strategy:
     # reference's vestigial PIPELINE_* hooks, model.h:190-192, made
     # first-class)
     pipeline: Optional[Dict] = None
+    # identity of the TASO catalog the rewrites were searched with
+    # ({"path", "sha256", "engine"}), recorded whenever `rewrites`
+    # references catalog rules: replay resolves rule names to match
+    # INDICES, so the replaying host must load byte-identical rules or
+    # fail loudly (rewrite.rules_for_replay checks this)
+    catalog: Optional[Dict] = None
 
     # -- serialization ---------------------------------------------------
     def to_json(self) -> str:
@@ -88,6 +94,7 @@ class Strategy:
                 "edge_ops": self.edge_ops,
                 "rewrites": [list(r) for r in self.rewrites],
                 "pipeline": self.pipeline,
+                "catalog": self.catalog,
             },
             indent=2,
         )
@@ -106,6 +113,7 @@ class Strategy:
             },
             rewrites=[list(r) for r in d.get("rewrites", [])],
             pipeline=d.get("pipeline"),
+            catalog=d.get("catalog"),
         )
 
     def save(self, path: str):

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Convert a binary TASO substitution catalog (.pb) to its JSON twin.
+
+Drop-in for the reference's tools/protobuf_to_json converter
+(protobuf_to_json.cc) with no protobuf dependency: the wire bytes are
+decoded by flexflow_tpu/pcg/taso_pb.py and written in the exact same
+JSON schema (rules named taso_rule_{i}, 2-space indent).
+
+Usage: python tools/pb_to_json.py <input.pb> <output.json>
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv):
+    if len(argv) != 3:
+        print(f"Usage: {argv[0]} <input-file> <output-file>",
+              file=sys.stderr)
+        return 1
+    from flexflow_tpu.pcg.taso_pb import pb_to_dict
+
+    d = pb_to_dict(argv[1])
+    print(f"Loaded {len(d['rule'])} rules.")
+    with open(argv[2], "w") as f:
+        json.dump(d, f, indent=2)
+        f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
