@@ -123,6 +123,7 @@ class OperatorType(enum.Enum):
     RESHAPE = "reshape"
     TRANSPOSE = "transpose"
     REVERSE = "reverse"
+    PAD = "pad"
     # Reductions / misc
     REDUCE_SUM = "reduce_sum"
     MEAN = "mean"

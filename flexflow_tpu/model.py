@@ -91,6 +91,8 @@ from .ops.shape import (
     Gather,
     GatherParams,
     Mean,
+    Pad,
+    PadParams,
     Reduce,
     ReduceParams,
     Reshape,
@@ -416,8 +418,9 @@ class FFModel:
         p = LayerNormParams(tuple(axes), elementwise_affine, eps)
         return self._add(LayerNorm(p, [input], name=self._name("layer_norm", name)))
 
-    def batch_norm(self, input, relu: bool = True, name=None):
-        p = BatchNormParams(relu)
+    def batch_norm(self, input, relu: bool = True, eps: float = 1e-5,
+                   momentum: float = 0.9, name=None):
+        p = BatchNormParams(relu, float(eps), float(momentum))
         return self._add(BatchNorm(p, [input], name=self._name("batch_norm", name)))
 
     # -- shape ops -------------------------------------------------------
@@ -476,6 +479,12 @@ class FFModel:
         return self._add(
             Reverse(ReverseParams(axis), [input], name=self._name("reverse", name))
         )
+
+    def pad(self, input, pads: Sequence[Sequence[int]], value: float = 0.0,
+            name=None):
+        """Constant-pad: pads is ((before, after), ...) per logical dim."""
+        p = PadParams(tuple((int(b), int(a)) for b, a in pads), float(value))
+        return self._add(Pad(p, [input], name=self._name("pad", name)))
 
     def reduce_sum(self, input, axes: Sequence[int], keepdims: bool = False, name=None):
         p = ReduceParams(tuple(axes), keepdims, "sum")
@@ -625,9 +634,11 @@ class FFModel:
 
         num_devices = len(devices) if devices is not None else cfg.resolve_num_devices()
 
+        searched_here = False
         if strategy is None and cfg.import_strategy_file:
             strategy = Strategy.load(cfg.import_strategy_file)
         if strategy is None:
+            searched_here = True
             if cfg.search_budget > 0 and not cfg.only_data_parallel:
                 # reference: Unity graph_optimize is the default search
                 # path (GRAPH_OPTIMIZE_TASK_ID, graph.cc:2046); MCMC is
@@ -641,9 +652,12 @@ class FFModel:
             else:
                 strategy = data_parallel_strategy(num_devices)
         self.strategy = strategy
-        if strategy.catalog is None and any(
+        if searched_here and strategy.catalog is None and any(
             str(n).startswith("taso_rule_") for n, _ in strategy.rewrites
         ):
+            # fresh searches only: stamping an imported legacy trace
+            # with the LOCAL catalog's hash would fabricate provenance
+            # and defeat the replay check
             # pin the catalog identity the trace was searched with so
             # replay on another host can't silently resolve different
             # rules (rewrite.rules_for_replay checks the hash)

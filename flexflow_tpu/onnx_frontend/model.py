@@ -59,6 +59,10 @@ class ONNXModel:
                     init
                 )
         self._weight_of_op: Dict[str, Dict[str, np.ndarray]] = {}
+        # non-trainable op state captured at import (BatchNorm running
+        # stats) — written into ff._state by copy_weights, the same
+        # transfer the torch frontend does (torch_frontend/model.py:744)
+        self._state_of_op: Dict[str, Dict[str, np.ndarray]] = {}
 
     # ------------------------------------------------------------------
     def apply(self, ff: FFModel,
@@ -84,27 +88,49 @@ class ONNXModel:
         return [env[o.name] for o in self.graph.output]
 
     def copy_weights(self, ff: FFModel):
+        import jax
+
         weights = ff.get_weights()
         for op_name, entry in self._weight_of_op.items():
             if op_name in weights:
                 for k, v in entry.items():
                     weights[op_name][k] = v
         ff.set_weights(weights)
+        for op_name, entry in self._state_of_op.items():
+            st = (ff._state or {}).get(op_name)
+            if st is None:
+                continue
+            for k, v in entry.items():
+                if k in st:
+                    old = st[k]
+                    st[k] = jax.device_put(
+                        np.asarray(v, old.dtype), old.sharding
+                    )
 
     # -- handlers (reference handle_* methods) ---------------------------
     def _handle_gemm(self, ff, node, env):
         x = env[node.input[0]]
         w = env[node.input[1]]  # [out, in] (transB=1 convention)
         at = _attrs(node)
+        if at.get("transA", 0):
+            raise ValueError(
+                f"Gemm {node.name}: transA=1 unsupported (no graph op "
+                "transposes the activation operand)"
+            )
         if not at.get("transB", 0):
             w = w.T
+        # alpha/beta fold into the (constant) weight and bias
+        alpha = float(at.get("alpha", 1.0))
+        beta = float(at.get("beta", 1.0))
+        w = w * alpha if alpha != 1.0 else w
         out_dim = w.shape[0]
         use_bias = len(node.input) > 2
         name = node.name or f"gemm_{node.output[0]}"
         out = ff.dense(x, out_dim, use_bias=use_bias, name=name)
         entry = {"kernel": np.ascontiguousarray(w.T)}
         if use_bias:
-            entry["bias"] = np.asarray(env[node.input[2]])
+            b = np.asarray(env[node.input[2]], np.float32)
+            entry["bias"] = b * beta if beta != 1.0 else b
         self._weight_of_op[name] = entry
         return out
 
@@ -212,6 +238,143 @@ class ONNXModel:
 
     def _handle_identity(self, ff, node, env):
         return env[node.input[0]]
+
+    def _handle_batchnormalization(self, ff, node, env):
+        """X, scale, B, mean, var -> batch_norm with trained affine +
+        running stats transferred (the reference drops all four:
+        python/flexflow/onnx/model.py:143-147)."""
+        x = env[node.input[0]]
+        at = _attrs(node)
+        name = node.name or f"bn_{node.output[0]}"
+        out = ff.batch_norm(
+            x, relu=False,
+            eps=float(at.get("epsilon", 1e-5)),
+            momentum=float(at.get("momentum", 0.9)),
+            name=name,
+        )
+        self._weight_of_op[name] = {
+            "gamma": np.asarray(env[node.input[1]], np.float32),
+            "beta": np.asarray(env[node.input[2]], np.float32),
+        }
+        self._state_of_op[name] = {
+            "running_mean": np.asarray(env[node.input[3]], np.float32),
+            "running_var": np.asarray(env[node.input[4]], np.float32),
+        }
+        return out
+
+    def _handle_globalaveragepool(self, ff, node, env):
+        x = env[node.input[0]]
+        h, w = x.shape.logical_shape[2:4]
+        return ff.pool2d(x, h, w, 1, 1, 0, 0, pool_type="avg",
+                         name=node.name or None)
+
+    def _handle_pad(self, ff, node, env):
+        at = _attrs(node)
+        mode = at.get("mode", b"constant")
+        mode = mode.decode() if isinstance(mode, bytes) else mode
+        if mode != "constant":
+            raise ValueError(f"Pad {node.name}: mode {mode!r} unsupported")
+        if "pads" in at:  # opset < 11
+            flat = [int(p) for p in at["pads"]]
+            value = float(at.get("value", 0.0))
+        else:  # opset >= 11: pads (and optional value) are inputs
+            flat = [int(p) for p in np.asarray(env[node.input[1]]).ravel()]
+            value = (float(np.asarray(env[node.input[2]]).ravel()[0])
+                     if len(node.input) > 2 and node.input[2] else 0.0)
+        x = env[node.input[0]]
+        rank = len(flat) // 2
+        pads = list(zip(flat[:rank], flat[rank:]))
+        if isinstance(x, np.ndarray):
+            return np.pad(x, pads, constant_values=value)
+        if not any(b or a for b, a in pads):
+            return x
+        return ff.pad(x, pads, value=value, name=node.name or None)
+
+    def _handle_cast(self, ff, node, env):
+        to = int(_attrs(node)["to"])
+        np_dtype = protowire._DTYPES.get(to)
+        if np_dtype is None:
+            raise ValueError(f"Cast {node.name}: unsupported dtype {to}")
+        x = env[node.input[0]]
+        if isinstance(x, np.ndarray):
+            return x.astype(np_dtype)
+        return ff.cast(x, np.dtype(np_dtype).name, name=node.name or None)
+
+    def _axes_arg(self, node, env, at):
+        if "axes" in at:  # opset < 13
+            return [int(a) for a in at["axes"]]
+        return [int(a) for a in np.asarray(env[node.input[1]]).ravel()]
+
+    def _handle_unsqueeze(self, ff, node, env):
+        at = _attrs(node)
+        axes = self._axes_arg(node, env, at)
+        x = env[node.input[0]]
+        if isinstance(x, np.ndarray):
+            out_rank = x.ndim + len(axes)
+            for ax in sorted(a % out_rank for a in axes):
+                x = np.expand_dims(x, ax)
+            return x
+        shape = list(x.shape.logical_shape)
+        out_rank = len(shape) + len(axes)
+        for ax in sorted(a % out_rank for a in axes):
+            shape.insert(ax, 1)
+        return ff.reshape(x, shape, name=node.name or None)
+
+    def _handle_squeeze(self, ff, node, env):
+        at = _attrs(node)
+        x = env[node.input[0]]
+        if isinstance(x, np.ndarray):
+            axes = (self._axes_arg(node, env, at)
+                    if ("axes" in at or len(node.input) > 1) else None)
+            return np.squeeze(x, tuple(axes) if axes else None)
+        shape = list(x.shape.logical_shape)
+        if "axes" in at or len(node.input) > 1:
+            axes = {a % len(shape) for a in self._axes_arg(node, env, at)}
+        else:
+            axes = {i for i, s in enumerate(shape) if s == 1}
+        shape = [s for i, s in enumerate(shape) if i not in axes]
+        return ff.reshape(x, shape, name=node.name or None)
+
+    def _handle_constant(self, ff, node, env):
+        at = _attrs(node)
+        if "value" in at:
+            v = at["value"]
+            if not isinstance(v, np.ndarray):
+                # with the onnx package installed get_attribute_value
+                # returns a raw TensorProto
+                import onnx.numpy_helper
+
+                v = onnx.numpy_helper.to_array(v)
+            return np.asarray(v)
+        for k in ("value_float", "value_int"):
+            if k in at:
+                return np.asarray(at[k])
+        if "value_floats" in at:
+            return np.asarray(at["value_floats"], np.float32)
+        if "value_ints" in at:
+            return np.asarray(at["value_ints"], np.int64)
+        raise ValueError(f"Constant {node.name}: no value attribute")
+
+    def _handle_range(self, ff, node, env):
+        vals = [env[i] for i in node.input[:3]]
+        if not all(isinstance(v, np.ndarray) for v in vals):
+            raise ValueError(
+                f"Range {node.name}: only constant start/limit/delta "
+                "are supported (graph-tensor ranges are data-dependent "
+                "shapes, which XLA cannot compile)"
+            )
+        start, limit, delta = (v.ravel()[0] for v in vals)
+        return np.arange(start, limit, delta)
+
+    def _handle_shape(self, ff, node, env):
+        x = env[node.input[0]]
+        shape = (x.shape if isinstance(x, np.ndarray)
+                 else x.shape.logical_shape)
+        at = _attrs(node)  # opset-15 slice attributes
+        start = int(at.get("start", 0))
+        end = at.get("end")
+        return np.asarray(shape, np.int64)[
+            start:(int(end) if end is not None else None)]
 
 
 def onnx_to_flexflow(path_or_model, ff: FFModel,

@@ -221,6 +221,41 @@ class Reverse(Op):
 
 
 @dataclasses.dataclass(frozen=True)
+class PadParams:
+    pads: Tuple[Tuple[int, int], ...]  # (before, after) per logical dim
+    value: float = 0.0
+
+
+class Pad(Op):
+    """Constant-pad along logical dims (ONNX Pad / torch F.pad).  The
+    reference's onnx handler is a warned pass-through
+    (python/flexflow/onnx/model.py:229-233); here it is a real op:
+    jnp.pad lowers to one XLA pad HLO that fuses with its neighbors."""
+
+    op_type = OperatorType.PAD
+
+    def infer_output_shapes(self, input_shapes):
+        (ishape,) = input_shapes
+        pads = self.params.pads
+        if len(pads) != ishape.logical_rank:
+            raise ShapeError(
+                f"{self.name}: {len(pads)} pad pairs for rank "
+                f"{ishape.logical_rank}"
+            )
+        dims = []
+        for d, (b, a) in zip(_data_dims(ishape), pads):
+            if (b or a) and d.degree != 1:
+                raise ShapeError(f"{self.name}: padded axis is partitioned")
+            dims.append(ParallelDim(d.size + b + a, d.degree))
+        dims.append(ParallelDim(1, ishape.replica_degree, is_replica_dim=True))
+        return [ParallelTensorShape(tuple(dims), ishape.dtype)]
+
+    def forward(self, inputs, weights, *, training=False, rng=None):
+        return [jnp.pad(inputs[0], self.params.pads,
+                        constant_values=self.params.value)]
+
+
+@dataclasses.dataclass(frozen=True)
 class ConcatParams:
     axis: int
 

@@ -153,9 +153,18 @@ def is_taso_rule_file(path: str) -> bool:
     from .taso_pb import looks_like_pb
 
     if looks_like_pb(path):
-        # binary files reaching the rule loaders are catalogs or
-        # errors either way — let parse_rule_collection produce the
-        # clean diagnosis rather than fully parsing twice here
+        try:
+            with open(path, "rb") as f:
+                head = f.read(4096)
+        except OSError:
+            return False
+        if head.lstrip()[:1] == b"{":
+            # newline-led JSON sniffed as pb (0x0A is '\n'): decide by
+            # content so repo-format rewrite JSONs aren't misrouted
+            return b'"RuleCollection"' in head
+        # genuine binary: catalog or error either way — let
+        # parse_rule_collection produce the clean diagnosis rather
+        # than fully parsing twice here
         return True
     try:
         with open(path) as f:

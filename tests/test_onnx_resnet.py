@@ -1,0 +1,201 @@
+"""ONNX handler parity on a stock ResNet-18 graph (VERDICT r4 #2).
+
+The reference imports torch-exported CNNs through its onnx frontend
+(python/flexflow/onnx/model.py) — but its BatchNormalization handler
+drops the trained affine+stats and Pad/Cast/Unsqueeze are warned
+pass-throughs.  Here a torch ResNet-18 is serialized to real .onnx
+wire bytes (protowire's encoder — torch.onnx.export needs the `onnx`
+package this image doesn't bake in) with the exact node sequence torch
+exports (Conv/BatchNormalization/Relu/MaxPool/Add/GlobalAveragePool/
+Flatten/Gemm), then imported, forward-aligned against torch in eval
+mode, and trained one step on the 8-device CPU mesh.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer  # noqa: E402
+from flexflow_tpu.onnx_frontend import protowire  # noqa: E402
+from flexflow_tpu.onnx_frontend.model import ONNXModel  # noqa: E402
+
+
+# -- torch ResNet-18 (BasicBlock), torchvision-equivalent ----------------
+class BasicBlock(nn.Module):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout),
+            )
+
+    def forward(self, x):
+        idt = self.down(x) if self.down is not None else x
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return torch.relu(y + idt)
+
+
+class ResNet18(nn.Module):
+    def __init__(self, classes=10):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.pool = nn.MaxPool2d(3, 2, 1)
+        cfg = [(64, 64, 1), (64, 128, 2), (128, 256, 2), (256, 512, 2)]
+        blocks = []
+        for cin, cout, s in cfg:
+            blocks += [BasicBlock(cin, cout, s), BasicBlock(cout, cout, 1)]
+        self.blocks = nn.ModuleList(blocks)
+        self.fc = nn.Linear(512, classes)
+
+    def forward(self, x):
+        x = self.pool(torch.relu(self.bn1(self.conv1(x))))
+        for b in self.blocks:
+            x = b(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+# -- serialize to .onnx wire bytes (torch's export node sequence) --------
+class _Enc:
+    def __init__(self):
+        self.nodes, self.inits, self.n = [], {}, 0
+
+    def t(self, name, mod_tensor):
+        self.inits[name] = mod_tensor.detach().numpy()
+        return name
+
+    def emit(self, op, inputs, n_out=1, **attrs):
+        outs = [f"t{self.n + i}" for i in range(n_out)]
+        self.n += n_out
+        self.nodes.append(protowire.encode_node(op, inputs, outs, **attrs))
+        return outs[0] if n_out == 1 else outs
+
+    def conv(self, x, m, name):
+        kh, kw = m.kernel_size
+        args = [x, self.t(f"{name}.weight", m.weight)]
+        if m.bias is not None:
+            args.append(self.t(f"{name}.bias", m.bias))
+        return self.emit("Conv", args, kernel_shape=[kh, kw],
+                         strides=list(m.stride),
+                         pads=list(m.padding) * 2, group=1)
+
+    def bn(self, x, m, name):
+        return self.emit(
+            "BatchNormalization",
+            [x, self.t(f"{name}.weight", m.weight),
+             self.t(f"{name}.bias", m.bias),
+             self.t(f"{name}.mean", m.running_mean),
+             self.t(f"{name}.var", m.running_var)],
+            epsilon=float(m.eps), momentum=0.9)
+
+
+def resnet_to_onnx(model: ResNet18, in_shape) -> bytes:
+    e = _Enc()
+    x = e.conv("input", model.conv1, "conv1")
+    x = e.bn(x, model.bn1, "bn1")
+    x = e.emit("Relu", [x])
+    x = e.emit("MaxPool", [x], kernel_shape=[3, 3], strides=[2, 2],
+               pads=[1, 1, 1, 1])
+    for i, b in enumerate(model.blocks):
+        idt = x
+        if b.down is not None:
+            idt = e.conv(x, b.down[0], f"b{i}.down0")
+            idt = e.bn(idt, b.down[1], f"b{i}.down1")
+        y = e.conv(x, b.conv1, f"b{i}.conv1")
+        y = e.bn(y, b.bn1, f"b{i}.bn1")
+        y = e.emit("Relu", [y])
+        y = e.conv(y, b.conv2, f"b{i}.conv2")
+        y = e.bn(y, b.bn2, f"b{i}.bn2")
+        y = e.emit("Add", [y, idt])
+        x = e.emit("Relu", [y])
+    x = e.emit("GlobalAveragePool", [x])
+    x = e.emit("Flatten", [x], axis=1)
+    x = e.emit("Gemm", [x, e.t("fc.weight", model.fc.weight),
+                        e.t("fc.bias", model.fc.bias)],
+               alpha=1.0, beta=1.0, transB=1)
+    return protowire.encode_model(
+        e.nodes, [("input", list(in_shape))], [x], e.inits)
+
+
+B, HW, CLASSES = 8, 64, 10
+
+
+@pytest.fixture(scope="module")
+def imported(devices8):
+    torch.manual_seed(0)
+    tm = ResNet18(CLASSES).eval()
+    # non-trivial running stats so eval-mode alignment proves transfer
+    with torch.no_grad():
+        tm.train()
+        for _ in range(2):
+            tm(torch.randn(4, 3, HW, HW))
+        tm.eval()
+    wire = resnet_to_onnx(tm, (B, 3, HW, HW))
+
+    ff = FFModel(FFConfig(batch_size=B, num_devices=8,
+                          only_data_parallel=True))
+    x = ff.create_tensor([B, 3, HW, HW], name="input")
+    m = ONNXModel(wire)
+    (out,) = m.apply(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devices8)
+    m.copy_weights(ff)
+    return tm, ff
+
+
+def test_resnet18_onnx_forward_aligns(imported):
+    tm, ff = imported
+    x = np.random.RandomState(0).randn(B, 3, HW, HW).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+    got = np.asarray(ff.forward({"input": x}))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-4)
+
+
+def test_resnet18_onnx_trains(imported):
+    _, ff = imported
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, 3, HW, HW).astype(np.float32)
+    y = rng.randint(0, CLASSES, (B,)).astype(np.int32)
+    m1 = ff.train_step({"input": x}, y)
+    m2 = ff.train_step({"input": x}, y)
+    assert np.isfinite(m1["loss"]) and m2["loss"] < m1["loss"]
+
+
+def test_handler_coverage_ops(devices8):
+    """The r4-missing handlers (Pad/Cast/Unsqueeze/Squeeze/Constant/
+    Range/Shape) import as real graph ops / constant folds — not the
+    reference's warned pass-throughs."""
+    nodes = [
+        protowire.encode_node("Constant", [], ["pads"],
+                              value=np.array([0, 0, 1, 1, 0, 0, 1, 1],
+                                             np.int64)),
+        protowire.encode_node("Pad", ["input", "pads"], ["p"],
+                              mode="constant"),
+        protowire.encode_node("Cast", ["p"], ["c"], to=1),
+        protowire.encode_node("Unsqueeze", ["c"], ["u"], axes=[4]),
+        protowire.encode_node("Squeeze", ["u"], ["s"], axes=[4]),
+        protowire.encode_node("Shape", ["s"], ["shp"]),
+        protowire.encode_node("Range", ["zero", "four", "one"], ["r"]),
+    ]
+    inits = {"zero": np.array(0, np.int64), "four": np.array(4, np.int64),
+             "one": np.array(1, np.int64)}
+    wire = protowire.encode_model(nodes, [("input", [2, 3, 4, 4])],
+                                  ["s", "shp", "r"], inits)
+    ff = FFModel(FFConfig(batch_size=2, num_devices=1))
+    x = ff.create_tensor([2, 3, 4, 4], name="input")
+    m = ONNXModel(wire)
+    s, shp, r = m.apply(ff, [x])
+    assert tuple(s.shape.logical_shape) == (2, 3, 6, 6)
+    np.testing.assert_array_equal(shp, [2, 3, 6, 6])
+    np.testing.assert_array_equal(r, [0, 1, 2, 3])
