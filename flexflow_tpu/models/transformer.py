@@ -250,6 +250,11 @@ def gpt_beam_search(ff: FFModel, prompt_ids, max_new_tokens: int,
     """Beam-search decoding on the compiled fixed-shape GPT graph
     (beyond the reference: its legacy nmt/ decoder is greedy-only).
 
+    O(T^2) reference implementation: it re-runs the full forward per
+    emitted token and takes one prompt.  The serving path is
+    decoding.gpt_beam_search_cached — O(T) on the KV-cache decode twin,
+    batched over prompts, equality-tested against this function.
+
     Beams ride the model's batch dimension: all `beam_size` hypotheses
     of one prompt decode in a single forward per step, so the compiled
     batch size must be >= beam_size (extra rows are padding).  Scores

@@ -10,6 +10,8 @@ role; `serve_http` exposes a stdlib JSON endpoint.
 """
 from .engine import InferenceEngine
 from .batcher import DynamicBatcher
+from .generation import GenerationBatcher, GenerationEngine
 from .server import serve_http
 
-__all__ = ["InferenceEngine", "DynamicBatcher", "serve_http"]
+__all__ = ["InferenceEngine", "DynamicBatcher", "GenerationEngine",
+           "GenerationBatcher", "serve_http"]

@@ -23,6 +23,32 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+def latency_percentiles(latencies, lock) -> Dict[str, float]:
+    """p50/p95/p99/mean (ms) over a ring buffer (shared by the forward
+    and generation batchers)."""
+    with lock:  # appends race from the worker threads
+        lats = sorted(latencies)
+    if not lats:
+        return {"n": 0}
+
+    def pct(p):
+        # nearest-rank: ceil(p*n)-th order statistic (int(p*n) is
+        # upward-biased — p95 of a 20-sample window would always be
+        # the max)
+        import math
+
+        i = min(len(lats) - 1, max(0, math.ceil(p * len(lats)) - 1))
+        return lats[i] * 1e3
+
+    return {
+        "n": len(lats),
+        "p50_ms": round(pct(0.50), 3),
+        "p95_ms": round(pct(0.95), 3),
+        "p99_ms": round(pct(0.99), 3),
+        "mean_ms": round(sum(lats) / len(lats) * 1e3, 3),
+    }
+
+
 class _Pending:
     __slots__ = ("inputs", "event", "result", "error", "t_submit")
 
@@ -95,27 +121,7 @@ class DynamicBatcher:
 
     def latency_stats(self) -> Dict[str, float]:
         """p50/p95/p99/mean request latency (ms) over the ring window."""
-        with self._lat_lock:  # appends race from the worker threads
-            lats = sorted(self._latencies)
-        if not lats:
-            return {"n": 0}
-
-        def pct(p):
-            # nearest-rank: ceil(p*n)-th order statistic (int(p*n) is
-            # upward-biased — p95 of a 20-sample window would always be
-            # the max)
-            import math
-
-            i = min(len(lats) - 1, max(0, math.ceil(p * len(lats)) - 1))
-            return lats[i] * 1e3
-
-        return {
-            "n": len(lats),
-            "p50_ms": round(pct(0.50), 3),
-            "p95_ms": round(pct(0.95), 3),
-            "p99_ms": round(pct(0.99), 3),
-            "mean_ms": round(sum(lats) / len(lats) * 1e3, 3),
-        }
+        return latency_percentiles(self._latencies, self._lat_lock)
 
     def close(self):
         self._stop.set()

@@ -2,7 +2,12 @@
 backend's HTTP surface analogue).
 
 POST /v2/infer     {"inputs": {name: nested-list, ...}} -> {"outputs": [...]}
+POST /v2/generate  {"prompt": [ids...]} or {"prompts": [[ids...], ...]},
+                   optional "max_new_tokens" (int), "temperature" (float)
+                   -> {"tokens": [[ids...], ...]}   (requires a
+                   GenerationBatcher via serve_http(generator=...))
 GET  /v2/health    -> {"status": "ok", "requests": N}
+GET  /v2/stats     -> batch/request counters + latency percentiles
 """
 from __future__ import annotations
 
@@ -14,11 +19,14 @@ from typing import Optional
 import numpy as np
 
 
-def serve_http(batcher, host: str = "127.0.0.1", port: int = 8000,
-               block: bool = True):
-    """Serve a DynamicBatcher (or bare InferenceEngine) over HTTP.
-    Returns the server object; when block=False it runs on a daemon
-    thread (server.shutdown() stops it)."""
+def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
+               block: bool = True, generator=None):
+    """Serve a DynamicBatcher (or bare InferenceEngine) and/or a
+    GenerationBatcher over HTTP.  Returns the server object; when
+    block=False it runs on a daemon thread (server.shutdown() stops
+    it)."""
+    if batcher is None and generator is None:
+        raise ValueError("serve_http needs a batcher and/or a generator")
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -33,38 +41,57 @@ def serve_http(batcher, host: str = "127.0.0.1", port: int = 8000,
             self.wfile.write(body)
 
         def do_GET(self):
+            src = batcher if batcher is not None else generator
             if self.path == "/v2/health":
-                served = getattr(batcher, "batches_run",
-                                 getattr(batcher, "requests_served", 0))
+                served = getattr(src, "batches_run",
+                                 getattr(src, "requests_served", 0))
                 self._send(200, {"status": "ok", "requests": served})
             elif self.path == "/v2/stats":
                 stats = {
-                    "batches_run": getattr(batcher, "batches_run", 0),
-                    "requests_done": getattr(batcher, "requests_done", 0),
+                    "batches_run": getattr(src, "batches_run", 0),
+                    "requests_done": getattr(src, "requests_done", 0),
                 }
-                if hasattr(batcher, "latency_stats"):
-                    stats["latency"] = batcher.latency_stats()
+                if hasattr(src, "latency_stats"):
+                    stats["latency"] = src.latency_stats()
+                if generator is not None and src is not generator:
+                    stats["generate"] = {
+                        "batches_run": generator.batches_run,
+                        "requests_done": generator.requests_done,
+                        "latency": generator.latency_stats(),
+                    }
                 self._send(200, stats)
             else:
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path != "/v2/infer":
-                self._send(404, {"error": "not found"})
-                return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(length) or b"{}")
-                specs = _input_specs(batcher)
-                inputs = {}
-                for k, v in req["inputs"].items():
-                    if k in specs:
-                        dt = specs[k]  # model-declared dtype wins
-                    else:
-                        dt = np.int32 if _is_int(v) else np.float32
-                    inputs[k] = np.asarray(v, dtype=dt)
-                out = batcher.infer(inputs)
-                self._send(200, {"outputs": np.asarray(out).tolist()})
+                if self.path == "/v2/infer" and batcher is not None:
+                    specs = _input_specs(batcher)
+                    inputs = {}
+                    for k, v in req["inputs"].items():
+                        if k in specs:
+                            dt = specs[k]  # model-declared dtype wins
+                        else:
+                            dt = np.int32 if _is_int(v) else np.float32
+                        inputs[k] = np.asarray(v, dtype=dt)
+                    out = batcher.infer(inputs)
+                    self._send(200, {"outputs": np.asarray(out).tolist()})
+                elif self.path == "/v2/generate" and generator is not None:
+                    prompts = req.get("prompts")
+                    if prompts is None:
+                        prompts = [req["prompt"]]
+                    mnt = int(req.get("max_new_tokens", 16))
+                    temp = float(req.get("temperature", 0.0))
+                    handles = [
+                        generator.generate_async(p, mnt, temp)
+                        for p in prompts
+                    ]  # rows of one POST coalesce into one scan
+                    toks = [h.wait(120.0) for h in handles]
+                    self._send(200, {"tokens": toks})
+                else:
+                    self._send(404, {"error": "not found"})
             except Exception as e:  # surface as a JSON error
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
 

@@ -10,11 +10,16 @@ import pytest
 
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.decoding import (
+    gpt_beam_search_cached,
     gpt_generate_cached,
     gpt_generate_scan,
     make_gpt_decoder,
 )
-from flexflow_tpu.models.transformer import build_gpt, gpt_generate
+from flexflow_tpu.models.transformer import (
+    build_gpt,
+    gpt_beam_search,
+    gpt_generate,
+)
 
 V, S, B = 32, 12, 4
 
@@ -161,6 +166,53 @@ def test_scan_generate_one_program_per_total(devices8):
     a = gpt_generate_scan(ffd, ids[:, :6], max_new_tokens=3)
     b = gpt_generate_cached(ffd, ids[:, :6], max_new_tokens=3)
     np.testing.assert_array_equal(a, b)
+
+
+def test_cached_beam_search_matches_full_forward(devices8):
+    """The O(T) KV-cached beam search reproduces the O(T^2) reference
+    path exactly: same tokens, same score (single prompt)."""
+    ff, ids = _trained_gpt(devices8)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])  # batch B=4 beams
+    prompt = ids[:1, :5]
+    want_toks, want_score = gpt_beam_search(ff, prompt, max_new_tokens=6,
+                                            beam_size=4)
+    got_toks, got_scores = gpt_beam_search_cached(
+        ffd, prompt, max_new_tokens=6, beam_size=4)
+    np.testing.assert_array_equal(got_toks[0], want_toks)
+    assert abs(got_scores[0] - want_score) < 1e-4
+
+
+def test_cached_beam_search_eos_and_length_penalty(devices8):
+    """eos freezing and GNMT length normalization agree with the
+    reference path (frozen beams compete at their final score)."""
+    ff, ids = _trained_gpt(devices8)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])
+    prompt = ids[:1, :4]
+    eos = int(ids[0, 6])  # an id the greedy continuation will hit
+    want_toks, want_score = gpt_beam_search(
+        ff, prompt, max_new_tokens=7, beam_size=4,
+        length_penalty=0.6, eos_id=eos)
+    got_toks, got_scores = gpt_beam_search_cached(
+        ffd, prompt, max_new_tokens=7, beam_size=4,
+        length_penalty=0.6, eos_id=eos)
+    np.testing.assert_array_equal(got_toks[0], want_toks)
+    assert abs(got_scores[0] - want_score) < 1e-4
+
+
+def test_cached_beam_search_batched_prompts(devices8):
+    """A batch of prompts decodes in one pass and matches per-prompt
+    full-forward beam search (cache-row reordering keeps each row's
+    cache consistent with its hypothesis)."""
+    ff, ids = _trained_gpt(devices8)
+    ffd = make_gpt_decoder(ff, devices=devices8[:1])  # batch 4 = 2x2
+    prompts = np.stack([ids[0, :5], ids[2, 1:6]])
+    got_toks, got_scores = gpt_beam_search_cached(
+        ffd, prompts, max_new_tokens=5, beam_size=2)
+    for p in range(2):
+        want_toks, want_score = gpt_beam_search(
+            ff, prompts[p], max_new_tokens=5, beam_size=2)
+        np.testing.assert_array_equal(got_toks[p], want_toks)
+        assert abs(got_scores[p] - want_score) < 1e-4
 
 
 def test_decode_overflow_guard(devices8):
