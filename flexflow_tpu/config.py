@@ -54,6 +54,12 @@ class FFConfig:
     # 3258): a rewrite may spread to structurally identical ops — big
     # convergence win on deep nets with repeated layers
     search_propagate: bool = True
+    # rewrite enumeration breadth in the Unity search: how many rewrite
+    # steps deep and how many graph variants per subproblem.  The
+    # defaults keep default-config searches cheap; raise them when
+    # hunting catalog wins (scripts/inception_taso_ab.py uses 3/16)
+    rewrite_depth: int = 2
+    rewrite_max_variants: int = 8
     only_data_parallel: bool = False
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
@@ -166,6 +172,8 @@ class FFConfig:
         p.add_argument("--parameter-sync", dest="parameter_sync", type=str,
                        default="all_reduce", choices=("none", "ps", "all_reduce"))
         p.add_argument("--substitution-json", type=str, default=None)
+        p.add_argument("--rewrite-depth", type=int, default=2)
+        p.add_argument("--rewrite-max-variants", type=int, default=8)
         p.add_argument("--search-calibrate", dest="search_calibrate",
                        action="store_true", default=None)
         p.add_argument("--no-search-calibrate", dest="search_calibrate",
@@ -207,6 +215,8 @@ class FFConfig:
             search_overlap_backward_update=args.overlap_backward_update,
             parameter_sync=ParameterSyncType(args.parameter_sync),
             substitution_json=args.substitution_json,
+            rewrite_depth=args.rewrite_depth,
+            rewrite_max_variants=args.rewrite_max_variants,
             search_calibrate=args.search_calibrate,
             op_cost_cache_file=args.op_cost_cache,
             memory_search=args.memory_search,

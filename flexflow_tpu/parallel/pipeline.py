@@ -70,6 +70,108 @@ def gpipe(
     return lax.psum(outs, axis_name)  # broadcast to the group
 
 
+def one_f_one_b(
+    stage_fn: Callable,
+    stage_params,
+    x_mb: jax.Array,
+    last_fn: Callable,
+    last_params,
+    targets_mb: jax.Array,
+    *,
+    axis_name: str = "pp",
+    num_stages: int,
+    num_microbatches: int,
+):
+    """1F1B schedule over one pipeline group: forward and backward
+    interleave, capping in-flight saved activations at O(S) per stage
+    instead of GPipe's O(M).  Call INSIDE shard_map.
+
+    Unlike `gpipe` (plain forward; jax.grad derives the reverse
+    schedule), 1F1B cannot be expressed through outer autodiff — the
+    whole point is running microbatch j's backward before microbatch
+    j+k's forward — so this function computes the gradients ITSELF with
+    per-tick jax.vjp and returns them.  The loss head must live on the
+    last stage (that is what lets cotangents exist mid-schedule):
+
+      stage_fn(stage_params, act) -> act        homogeneous block chunk
+      last_fn(last_params, act, target) -> loss  one microbatch's head+loss
+
+    Timing (lockstep SPMD, everything masked): stage s runs microbatch
+    f's forward at tick s+f and microbatch j's backward at tick
+    2(S-1)-s+j; the last stage's backward of mb j lands the same tick
+    as its forward, the classic 1F1B cadence.  Saved boundary
+    activations live in a [2S-1]-slot ring (residency 2(S-1-s) ticks).
+    Total ticks M+2S-2 vs GPipe's 2(M+S-1) fwd+bwd — same steady-state
+    compute (each tick does one fwd + one vjp), 2(S-1) extra warmup/
+    drain tick-halves, O(S/M) of the schedule.
+
+    Returns (mean loss, stage_params grads, last_params grads) — loss
+    and last-grads are psum-broadcast to the group; stage grads are the
+    LOCAL stage's (pp-sharded like stage_params).
+    """
+    S, M = num_stages, num_microbatches
+    R = 2 * S - 1  # ring slots: max residency + 1
+    stage = lax.axis_index(axis_name)
+    zero_act = jnp.zeros_like(x_mb[0])
+    zero_tgt = jnp.zeros_like(targets_mb[0])
+
+    def masked_add(acc, upd, valid):
+        return jax.tree.map(
+            lambda a, u: a + jnp.where(valid, u, jnp.zeros_like(u)), acc, upd
+        )
+
+    def tick(carry, t):
+        fwd_buf, bwd_buf, ring, g_stage, g_last, loss_acc = carry
+        # ---- forward half: stage s runs microbatch f = t - s --------
+        f = t - stage
+        valid_f = (f >= 0) & (f < M)
+        x_t = jnp.take(x_mb, jnp.clip(f, 0, M - 1), axis=0)
+        a_in = jnp.where(stage == 0, x_t, fwd_buf)
+        a_in = jnp.where(valid_f, a_in, zero_act)
+        y = stage_fn(stage_params, a_in)
+        ring = ring.at[t % R].set(jnp.where(valid_f, a_in, ring[t % R]))
+        # last stage: this microbatch's head + loss, cotangent NOW
+        tgt = jnp.take(targets_mb, jnp.clip(f, 0, M - 1), axis=0)
+        tgt = jnp.where(valid_f, tgt, zero_tgt)
+        loss_f, head_vjp = jax.vjp(
+            lambda lp, a: last_fn(lp, a, tgt), last_params, y
+        )
+        d_last, dy_here = head_vjp(jnp.ones_like(loss_f) / M)
+        is_last = stage == S - 1
+        loss_acc = loss_acc + jnp.where(is_last & valid_f, loss_f / M, 0.0)
+        g_last = masked_add(g_last, d_last, is_last & valid_f)
+        # ---- backward half: stage s runs microbatch j ---------------
+        j = t - (2 * (S - 1) - stage)
+        valid_b = (j >= 0) & (j < M)
+        a_saved = ring[(stage + j) % R]
+        dy = jnp.where(is_last, dy_here, bwd_buf)
+        dy = jnp.where(valid_b, dy, zero_act)
+        _, stage_vjp = jax.vjp(stage_fn, stage_params, a_saved)
+        d_stage, dx = stage_vjp(dy)
+        g_stage = masked_add(g_stage, d_stage, valid_b)
+        # ---- shift: activations right, cotangents left --------------
+        fwd_buf = lax.ppermute(
+            y, axis_name, [(i, (i + 1) % S) for i in range(S)]
+        )
+        bwd_buf = lax.ppermute(
+            dx, axis_name, [(i, (i - 1) % S) for i in range(S)]
+        )
+        return (fwd_buf, bwd_buf, ring, g_stage, g_last, loss_acc), None
+
+    ring0 = jnp.zeros((R,) + x_mb.shape[1:], x_mb.dtype)
+    g_stage0 = jax.tree.map(jnp.zeros_like, stage_params)
+    g_last0 = jax.tree.map(jnp.zeros_like, last_params)
+    carry = (zero_act, zero_act, ring0, g_stage0, g_last0, jnp.zeros(()))
+    carry, _ = lax.scan(tick, carry, jnp.arange(M + 2 * S - 2))
+    _, _, _, g_stage, g_last, loss = carry
+    # loss/head grads were accumulated on the last stage only
+    return (
+        lax.psum(loss, axis_name),
+        g_stage,
+        jax.tree.map(lambda g: lax.psum(g, axis_name), g_last),
+    )
+
+
 def _split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
     b = x.shape[0]
     if b % num_microbatches:
@@ -104,23 +206,15 @@ def pipelined_apply(
     in-flight microbatch — the activation-memory lever that lets deep
     pipelines raise num_microbatches (smaller bubble) without raising
     peak HBM.  Same schedule, same collectives; backward recomputes
-    block internals (the standard TPU pipeline recipe — an interleaved
-    1F1B would cap in-flight microbatches at S instead of M but costs
-    ~2x compute under lockstep SPMD masking, a bad trade here).
+    block internals.  Boundary storage still grows O(M); when that is
+    the binding constraint, `one_f_one_b` caps residency at O(S)
+    (measured: temp bytes flat in M vs linear here — docs/PERF.md).
     """
     pp = mesh.shape[pp_axis]
     layers = jax.tree.leaves(stacked_params)[0].shape[0]
     if layers % pp:
         raise ValueError(f"{layers} blocks not divisible by pp={pp}")
-    body_block = jax.checkpoint(block_fn) if remat else block_fn
-
-    def stage_fn(local_params, act):
-        # run this stage's L/pp blocks in order
-        def body(a, p):
-            return body_block(p, a), None
-
-        out, _ = lax.scan(body, act, local_params)
-        return out
+    stage_fn = _make_stage_fn(block_fn, remat)
 
     def spmd(params, xb):
         x_mb = _split_microbatches(xb, num_microbatches)
@@ -139,6 +233,21 @@ def pipelined_apply(
         out_specs=in_x,
         check_vma=False,
     )(stacked_params, x)
+
+
+def _make_stage_fn(block_fn: Callable, remat: bool) -> Callable:
+    """One stage = scan over this device's local block chunk (shared by
+    the GPipe and 1F1B schedules so their numerics cannot diverge)."""
+    body_block = jax.checkpoint(block_fn) if remat else block_fn
+
+    def stage_fn(local_params, act):
+        def body(a, p):
+            return body_block(p, a), None
+
+        out, _ = lax.scan(body, act, local_params)
+        return out
+
+    return stage_fn
 
 
 def stacked_param_sharding(mesh: Mesh, a, pp_axis: str = "pp"):
@@ -197,10 +306,23 @@ def make_pipelined_transformer_step(
     lr: float = 0.01,
     pp_axis: str = "pp",
     dp_axis: str = "data",
+    schedule: str = "gpipe",
+    remat: bool = False,
 ):
     """(init_fn, step_fn): a full SGD train step (fwd+loss+bwd+update)
     for a block-stacked encoder pipelined over `pp` and batch-sharded
-    over `data`."""
+    over `data`.
+
+    schedule: "gpipe" (forward scan, jax.grad derives the reverse
+    schedule; O(M) saved boundaries, remat=True shrinks each to the
+    block boundary) or "1f1b" (interleaved fwd/bwd via `one_f_one_b`;
+    O(S) in-flight activations — the deep-pipeline memory lever).
+    Both compute identical gradients (test_pipeline.py asserts it)."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    pp = mesh.shape[pp_axis]
+    if layers % pp:
+        raise ValueError(f"{layers} blocks not divisible by pp={pp}")
 
     def init_fn(seed: int):
         key = jax.random.key(seed)
@@ -223,15 +345,59 @@ def make_pipelined_transformer_step(
     def loss_fn(params, x, y):
         h = pipelined_apply(block, params["blocks"], x, mesh=mesh,
                             num_microbatches=num_microbatches,
-                            pp_axis=pp_axis, dp_axis=dp_axis)
+                            pp_axis=pp_axis, dp_axis=dp_axis, remat=remat)
         logits = h.mean(axis=1) @ params["head"]
         logp = jax.nn.log_softmax(logits)
         return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
 
     @jax.jit
-    def step_fn(params, x, y):
+    def gpipe_step(params, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
         params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
         return params, loss
 
-    return init_fn, step_fn
+    # ---- 1f1b: grads computed inside the schedule ---------------------
+    stage_fn = _make_stage_fn(block, remat)
+
+    def last_fn(head, act, tgt):
+        logits = act.mean(axis=1) @ head
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, tgt[:, None], axis=-1).mean()
+
+    def spmd_1f1b(params, x, y):
+        x_mb = _split_microbatches(x, num_microbatches)
+        y_mb = _split_microbatches(y, num_microbatches)
+        loss, g_blocks, g_head = one_f_one_b(
+            stage_fn, params["blocks"], x_mb, last_fn, params["head"],
+            y_mb, axis_name=pp_axis, num_stages=pp,
+            num_microbatches=num_microbatches,
+        )
+        # dp: average grads (and loss) over the data axis
+        dp = mesh.shape.get(dp_axis, 1)
+        if dp > 1:
+            g_blocks = jax.tree.map(
+                lambda g: lax.pmean(g, dp_axis), g_blocks)
+            g_head = jax.tree.map(lambda g: lax.pmean(g, dp_axis), g_head)
+            loss = lax.pmean(loss, dp_axis)
+        return loss, {"blocks": g_blocks, "head": g_head}
+
+    block_shapes = jax.eval_shape(
+        lambda: _init_block_params(jax.random.key(0), layers, hidden, ffn)
+    )
+    block_specs = jax.tree.map(lambda _: P(pp_axis, None, None),
+                               block_shapes)
+    param_specs = {"blocks": block_specs, "head": P(None, None)}
+    in_x, in_y = P(dp_axis, None, None), P(dp_axis)
+
+    @jax.jit
+    def ofob_step(params, x, y):
+        loss, grads = jax.shard_map(
+            spmd_1f1b, mesh=mesh,
+            in_specs=(param_specs, in_x, in_y),
+            out_specs=(P(), param_specs),
+            check_vma=False,
+        )(params, x, y)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    return init_fn, (gpipe_step if schedule == "gpipe" else ofob_step)

@@ -1211,6 +1211,8 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         max_assignments=cfg.simulator_segment_size,
         enable_sample_parallel=cfg.enable_sample_parallel,
         remat=cfg.remat,
+        rewrite_depth=cfg.rewrite_depth,
+        rewrite_max_variants=cfg.rewrite_max_variants,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()

@@ -94,3 +94,73 @@ def test_pipelined_transformer_trains(devices8):
         losses.append(float(loss))
     assert losses[-1] < losses[0]
     assert np.isfinite(losses).all()
+
+
+@pytest.mark.parametrize("dp,pp,mb", [(1, 4, 8), (2, 4, 4)])
+def test_1f1b_matches_gpipe(devices8, dp, pp, mb):
+    """The 1F1B schedule computes the SAME loss and parameter update as
+    GPipe — same math, different activation residency."""
+    mesh = _mesh(devices8, dp, pp)
+    kw = dict(layers=4, hidden=16, ffn=32, num_heads=4, num_classes=4,
+              num_microbatches=mb, lr=0.1)
+    init_g, step_g = make_pipelined_transformer_step(
+        mesh, schedule="gpipe", **kw)
+    init_o, step_o = make_pipelined_transformer_step(
+        mesh, schedule="1f1b", **kw)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 8, 16), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 4, 16), jnp.int32)
+    pg, lg = step_g(init_g(seed=0), x, y)
+    po, lo = step_o(init_o(seed=0), x, y)
+    assert abs(float(lg) - float(lo)) < 1e-6
+    for key in ("blocks", "head"):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+            pg[key], po[key],
+        )
+
+
+def test_1f1b_trains(devices8):
+    mesh = _mesh(devices8, 2, 4)
+    init_fn, step_fn = make_pipelined_transformer_step(
+        mesh, layers=4, hidden=16, ffn=32, num_heads=4, num_classes=4,
+        num_microbatches=4, lr=0.1, schedule="1f1b",
+    )
+    params = init_fn(seed=0)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(16, 8, 16), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 4, 16), jnp.int32)
+    losses = []
+    for _ in range(10):
+        params, loss = step_fn(params, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] and np.isfinite(losses).all()
+
+
+def test_1f1b_activation_memory_scales_with_stages_not_microbatches():
+    """The schedule's reason to exist: saved-activation residency is
+    O(S) per stage (the [2S-1] ring), independent of M.  At constant
+    microbatch SIZE (batch grows with M), GPipe's saved boundaries grow
+    linearly with M while the 1F1B ring stays flat.  Verified via
+    compiled buffer analysis (measured on the CPU backend: gpipe
+    522k->2415k temp bytes from M=4 to M=32, 1f1b 118448->118576)."""
+    devices = jax.devices()[:4]
+    mesh = Mesh(np.array(devices).reshape(1, 4), ("data", "pp"))
+    kw = dict(layers=4, hidden=32, ffn=64, num_heads=4, num_classes=4,
+              lr=0.1)
+
+    def temp_bytes(schedule, mb):
+        init_fn, step_fn = make_pipelined_transformer_step(
+            mesh, num_microbatches=mb, schedule=schedule, **kw)
+        params = init_fn(seed=0)
+        x = jnp.zeros((4 * mb, 8, 32), jnp.float32)  # 4-row microbatches
+        y = jnp.zeros((4 * mb,), jnp.int32)
+        mem = step_fn.lower(params, x, y).compile().memory_analysis()
+        return int(mem.temp_size_in_bytes)
+
+    g8, g32 = temp_bytes("gpipe", 8), temp_bytes("gpipe", 32)
+    o8, o32 = temp_bytes("1f1b", 8), temp_bytes("1f1b", 32)
+    assert g32 > g8 * 2.0      # GPipe: saved boundaries grow with M
+    assert o32 < o8 * 1.05     # 1F1B: ring is M-independent
+    assert o8 < g8 / 3         # and far below GPipe at the same config
