@@ -1,0 +1,54 @@
+"""Callback tier demo (reference examples/python/keras/callback.py):
+LearningRateScheduler + EarlyStopping + ProgbarLogger + VerifyMetrics
+on an MNIST MLP.
+
+Run: python callback_demo.py [-e EPOCHS] [-b BATCH]
+"""
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.keras import (
+    Dense,
+    EarlyStopping,
+    LearningRateScheduler,
+    ProgbarLogger,
+    Sequential,
+    VerifyMetrics,
+    datasets,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=6)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--num-samples", type=int, default=4096)
+    p.add_argument("--floor", type=float, default=0.5)
+    args, _ = p.parse_known_args()
+
+    (x_train, y_train), _ = datasets.mnist.load_data(args.num_samples)
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+
+    model = Sequential([
+        Dense(256, activation="relu"),
+        Dense(10, activation="softmax"),
+    ], input_shape=(784,))
+    model.compile(optimizer="sgd",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=args.batch_size)
+    model.fit(
+        x_train, y_train, epochs=args.epochs, verbose=False,
+        callbacks=[
+            ProgbarLogger(),
+            LearningRateScheduler(lambda epoch, lr: lr * 0.9),
+            EarlyStopping(monitor="accuracy", patience=3),
+            VerifyMetrics(monitor="accuracy", floor=args.floor,
+                          each_epoch=True),
+        ],
+    )
+
+
+if __name__ == "__main__":
+    main()

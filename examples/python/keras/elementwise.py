@@ -1,0 +1,51 @@
+"""Elementwise-merge layers end to end (the reference's
+examples/python/keras/elementwise_*.py + unary.py tier, folded into
+one runnable script): Add / Subtract / Multiply branches training on a
+synthetic regression target.
+
+Run: python elementwise.py [-e EPOCHS] [-b BATCH]
+"""
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.keras import (
+    Add,
+    Concatenate,
+    Dense,
+    Input,
+    Model,
+    Multiply,
+    Subtract,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=4)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--num-samples", type=int, default=4096)
+    args, _ = p.parse_known_args()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(args.num_samples, 32).astype(np.float32)
+    y = (np.sin(x[:, :1]) + x[:, 1:2] * x[:, 2:3]).astype(np.float32)
+
+    inp = Input(shape=(32,))
+    a = Dense(64, activation="relu")(inp)
+    b = Dense(64, activation="tanh")(inp)
+    merged = Concatenate(axis=1)([
+        Add()([a, b]), Subtract()([a, b]), Multiply()([a, b]),
+    ])
+    t = Dense(32, activation="relu")(merged)
+    out = Dense(1)(t)
+
+    model = Model(inp, out)
+    model.compile(optimizer="adam", loss="mean_squared_error",
+                  metrics=["mean_squared_error"],
+                  batch_size=args.batch_size)
+    model.fit(x, y, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

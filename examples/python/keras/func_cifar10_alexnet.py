@@ -1,0 +1,58 @@
+"""Functional Keras CIFAR-10 AlexNet (reference examples/python/keras/
+func_cifar10_alexnet.py shape, scaled to 32px inputs).
+
+Run: python func_cifar10_alexnet.py [-e EPOCHS] [-b BATCH]
+"""
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.keras import (
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    Input,
+    MaxPooling2D,
+    Model,
+    datasets,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=4)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--num-samples", type=int, default=2048)
+    args, _ = p.parse_known_args()
+
+    (x_train, y_train), _ = datasets.cifar10.load_data(args.num_samples)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.ravel().astype(np.int32)
+
+    inp = Input(shape=(3, 32, 32))
+    t = Conv2D(64, (5, 5), strides=(1, 1), padding="same",
+               activation="relu")(inp)
+    t = MaxPooling2D((3, 3), strides=(2, 2))(t)
+    t = Conv2D(192, (5, 5), strides=(1, 1), padding="same",
+               activation="relu")(t)
+    t = MaxPooling2D((3, 3), strides=(2, 2))(t)
+    t = Conv2D(384, (3, 3), padding="same", activation="relu")(t)
+    t = Conv2D(256, (3, 3), padding="same", activation="relu")(t)
+    t = Conv2D(256, (3, 3), padding="same", activation="relu")(t)
+    t = MaxPooling2D((3, 3), strides=(2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(1024, activation="relu")(t)
+    t = Dropout(0.5)(t)
+    t = Dense(1024, activation="relu")(t)
+    out = Dense(10, activation="softmax")(t)
+
+    model = Model(inp, out)
+    model.compile(optimizer="sgd",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

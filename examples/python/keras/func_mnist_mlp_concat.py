@@ -1,0 +1,47 @@
+"""Functional Keras MNIST MLP with branch concat (reference
+examples/python/keras/func_mnist_mlp_concat.py shape): two Dense
+branches off one input, concatenated into the head — the branchy graph
+the merge rewrites and the strategy search care about.
+
+Run: python func_mnist_mlp_concat.py [-e EPOCHS] [-b BATCH]
+"""
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.keras import (
+    Concatenate,
+    Dense,
+    Input,
+    Model,
+    datasets,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=3)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--num-samples", type=int, default=4096)
+    args, _ = p.parse_known_args()
+
+    (x_train, y_train), _ = datasets.mnist.load_data(args.num_samples)
+    x_train = x_train.reshape(len(x_train), 784).astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+
+    inp = Input(shape=(784,))
+    a = Dense(128, activation="relu")(inp)
+    b = Dense(128, activation="sigmoid")(inp)
+    t = Concatenate(axis=1)([a, b])
+    t = Dense(64, activation="relu")(t)
+    out = Dense(10, activation="softmax")(t)
+
+    model = Model(inp, out)
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

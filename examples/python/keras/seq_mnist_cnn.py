@@ -1,0 +1,51 @@
+"""Sequential Keras MNIST CNN (reference examples/python/keras/
+seq_mnist_cnn.py shape): Conv-Conv-Pool -> Dense head.
+
+Run: python seq_mnist_cnn.py [-e EPOCHS] [-b BATCH] [--num-samples N]
+"""
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.keras import (
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    MaxPooling2D,
+    Sequential,
+    datasets,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=3)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--num-samples", type=int, default=2048)
+    args, _ = p.parse_known_args()
+
+    (x_train, y_train), _ = datasets.mnist.load_data(args.num_samples)
+    x_train = x_train.reshape(len(x_train), 1, 28, 28)
+    x_train = x_train.astype(np.float32) / 255.0
+    y_train = y_train.astype(np.int32)
+
+    model = Sequential([
+        Conv2D(32, (3, 3), strides=(1, 1), padding="same",
+               activation="relu"),
+        Conv2D(64, (3, 3), strides=(1, 1), padding="same",
+               activation="relu"),
+        MaxPooling2D((2, 2), strides=(2, 2)),
+        Flatten(),
+        Dense(128, activation="relu"),
+        Dropout(0.25),
+        Dense(10, activation="softmax"),
+    ], input_shape=(1, 28, 28))
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

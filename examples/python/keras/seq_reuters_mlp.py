@@ -1,0 +1,52 @@
+"""Reuters topic MLP over bag-of-words features (reference
+examples/python/keras/seq_reuters_mlp.py shape) — exercises the
+dependency-free keras.preprocessing pipeline: Tokenizer-style
+sequences -> pad_sequences -> binary term matrix.
+
+Run: python seq_reuters_mlp.py [-e EPOCHS] [-b BATCH]
+"""
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.keras import Dense, Dropout, Sequential, datasets
+from flexflow_tpu.keras.preprocessing import pad_sequences
+
+NUM_WORDS = 2000
+
+
+def to_binary_matrix(seqs: np.ndarray, n: int) -> np.ndarray:
+    m = np.zeros((len(seqs), n), np.float32)
+    for i, row in enumerate(seqs):
+        m[i, row[row > 0]] = 1.0
+    return m
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-e", "--epochs", type=int, default=4)
+    p.add_argument("-b", "--batch-size", type=int, default=64)
+    p.add_argument("--num-samples", type=int, default=2048)
+    args, _ = p.parse_known_args()
+
+    (x_train, y_train), _ = datasets.reuters.load_data(
+        num_words=NUM_WORDS, maxlen=200, num_samples=args.num_samples)
+    # normalize ragged/over-length rows through the preprocessing API
+    x_train = pad_sequences(list(x_train), maxlen=200, padding="post",
+                            truncating="post")
+    x_train = to_binary_matrix(x_train, NUM_WORDS)
+    y_train = y_train.astype(np.int32)
+
+    model = Sequential([
+        Dense(512, activation="relu"),
+        Dropout(0.5),
+        Dense(datasets.reuters.num_classes, activation="softmax"),
+    ], input_shape=(NUM_WORDS,))
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=args.batch_size)
+    model.fit(x_train, y_train, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

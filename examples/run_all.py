@@ -61,6 +61,24 @@ SCRIPTS = {
     "python/keras/func_cifar10_cnn_concat.py": [
         "-e", "1", "-b", "32", "--num-samples", "256",
     ],
+    "python/keras/seq_mnist_cnn.py": [
+        "-e", "1", "-b", "32", "--num-samples", "256",
+    ],
+    "python/keras/func_mnist_mlp_concat.py": [
+        "-e", "1", "--num-samples", "512",
+    ],
+    "python/keras/func_cifar10_alexnet.py": [
+        "-e", "1", "-b", "32", "--num-samples", "256",
+    ],
+    "python/keras/seq_reuters_mlp.py": [
+        "-e", "1", "-b", "32", "--num-samples", "256",
+    ],
+    "python/keras/callback_demo.py": [
+        "-e", "2", "--num-samples", "512", "--floor", "0.05",
+    ],
+    "python/keras/elementwise.py": [
+        "-e", "1", "-b", "32", "--num-samples", "512",
+    ],
     "python/pytorch/resnet50_search.py": [
         "-e", "1", "-b", "4", "--budget", "4",
     ],

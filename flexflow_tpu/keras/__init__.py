@@ -10,7 +10,13 @@ SPMD execution path.
 Layout convention follows the reference's keras port: image tensors are
 channels_first (NCHW), matching FFModel.conv2d.
 """
-from .callbacks import Callback, EarlyStopping, LearningRateScheduler
+from .callbacks import (
+    Callback,
+    EarlyStopping,
+    LearningRateScheduler,
+    ProgbarLogger,
+    VerifyMetrics,
+)
 from .layers import (
     Activation,
     Add,
@@ -32,13 +38,13 @@ from .layers import (
     Subtract,
 )
 from .models import Model, Sequential
-from . import datasets
+from . import datasets, preprocessing
 
 __all__ = [
     "Activation", "Add", "AveragePooling2D", "BatchNormalization",
     "Callback", "Concatenate", "Conv2D", "Dense", "Dropout",
     "EarlyStopping", "Embedding", "Flatten", "Input",
     "LayerNormalization", "LearningRateScheduler", "LSTM", "MaxPooling2D",
-    "Model", "Multiply", "Permute", "Reshape", "Sequential", "Subtract",
-    "datasets",
+    "Model", "Multiply", "Permute", "ProgbarLogger", "Reshape",
+    "Sequential", "Subtract", "VerifyMetrics", "datasets", "preprocessing",
 ]

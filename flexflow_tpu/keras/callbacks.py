@@ -1,8 +1,10 @@
 """Training callbacks (reference python/flexflow/keras/callbacks.py:
-Callback base, LearningRateScheduler, VerifyMetrics; plus EarlyStopping
-as a quality-of-life addition)."""
+Callback base, LearningRateScheduler, VerifyMetrics/EpochVerifyMetrics;
+plus EarlyStopping and ProgbarLogger as quality-of-life additions)."""
 from __future__ import annotations
 
+import sys
+import time
 from typing import Callable, Optional
 
 
@@ -31,6 +33,59 @@ class LearningRateScheduler(Callback):
         new_lr = self.schedule(epoch + 1, cur)
         if new_lr != cur:
             ffmodel.set_learning_rate(new_lr)
+
+
+class ProgbarLogger(Callback):
+    """Per-epoch metrics line (the reference keras port relies on the
+    C++ runtime's epoch printout; here it is an explicit callback so
+    `verbose=False` fits stay quiet unless asked)."""
+
+    def __init__(self, stream=None):
+        self.stream = stream or sys.stderr
+        self._t0 = None
+
+    def on_train_begin(self, ffmodel):
+        self._t0 = time.perf_counter()
+
+    def on_epoch_end(self, ffmodel, epoch: int, metrics):
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        print(f"epoch {epoch}: {metrics.summary()} [{dt:.1f}s elapsed]",
+              file=self.stream)
+
+
+class VerifyMetrics(Callback):
+    """Assert a metric reaches a floor by the end of training
+    (reference callbacks.py VerifyMetrics — its CI example scripts end
+    with this check).  `each_epoch=True` is EpochVerifyMetrics: stop
+    early once reached, fail only if never reached."""
+
+    def __init__(self, monitor: str = "accuracy", floor: float = 0.9,
+                 each_epoch: bool = False):
+        self.monitor = monitor
+        self.floor = floor
+        self.each_epoch = each_epoch
+        self._last: Optional[float] = None
+        self._reached = False
+
+    def on_train_begin(self, ffmodel):
+        # a reused instance must re-verify: stale success from an
+        # earlier fit() would mask a failing run
+        self._last = None
+        self._reached = False
+
+    def on_epoch_end(self, ffmodel, epoch: int, metrics):
+        self._last = float(getattr(metrics, self.monitor))
+        if self.each_epoch and self._last >= self.floor:
+            self._reached = True
+            ffmodel._stop_training = True
+
+    def on_train_end(self, ffmodel):
+        if self._reached or (self._last is not None
+                             and self._last >= self.floor):
+            return
+        raise AssertionError(
+            f"{self.monitor} = {self._last} below required {self.floor}"
+        )
 
 
 class EarlyStopping(Callback):

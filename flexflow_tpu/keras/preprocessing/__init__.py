@@ -1,0 +1,15 @@
+"""Keras-style data preprocessing.
+
+The reference's python/flexflow/keras/preprocessing/ re-exports the
+`keras_preprocessing` package (sequence.py:8-13, text.py); this image
+doesn't bake that dependency in, so these are self-contained numpy
+implementations of the same API surface.
+"""
+from . import sequence, text
+from .sequence import make_sampling_table, pad_sequences, skipgrams
+from .text import Tokenizer, one_hot, text_to_word_sequence
+
+__all__ = [
+    "sequence", "text", "pad_sequences", "make_sampling_table",
+    "skipgrams", "Tokenizer", "one_hot", "text_to_word_sequence",
+]

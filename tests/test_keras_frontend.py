@@ -135,3 +135,89 @@ def test_cifar10_canonical_tar_parse(tmp_path, monkeypatch):
     # not zeros, and train/test differ
     assert xtr.any() and xte.any()
     assert not np.array_equal(xtr[:16], xte)
+
+
+# -- preprocessing (dependency-free keras_preprocessing parity) ----------
+
+def test_pad_sequences_modes():
+    from flexflow_tpu.keras.preprocessing import pad_sequences
+
+    seqs = [[1, 2, 3], [4], []]
+    np.testing.assert_array_equal(
+        pad_sequences(seqs, maxlen=2),
+        [[2, 3], [0, 4], [0, 0]])  # pre-pad, pre-truncate (defaults)
+    np.testing.assert_array_equal(
+        pad_sequences(seqs, maxlen=2, padding="post", truncating="post"),
+        [[1, 2], [4, 0], [0, 0]])
+    out = pad_sequences(seqs, value=9)
+    assert out.shape == (3, 3) and out[2].tolist() == [9, 9, 9]
+    with pytest.raises(ValueError):
+        pad_sequences(seqs, padding="sideways")
+
+
+def test_tokenizer_round_trip():
+    from flexflow_tpu.keras.preprocessing import Tokenizer
+
+    texts = ["the cat sat on the mat", "the dog sat", "cat and dog!"]
+    tok = Tokenizer(num_words=5)
+    tok.fit_on_texts(texts)
+    assert tok.word_index["the"] == 1  # most frequent first
+    seqs = tok.texts_to_sequences(texts)
+    assert all(all(0 < i < 5 for i in s) for s in seqs)
+    m = tok.texts_to_matrix(texts, mode="binary")
+    assert m.shape == (3, 5) and set(np.unique(m)) <= {0.0, 1.0}
+    counts = tok.texts_to_matrix(texts, mode="count")
+    assert counts[0, 1] == 2.0  # "the" twice in the first text
+
+
+def test_tokenizer_oov():
+    from flexflow_tpu.keras.preprocessing import Tokenizer
+
+    tok = Tokenizer(num_words=3, oov_token="<oov>")
+    tok.fit_on_texts(["aa bb cc"])
+    (seq,) = tok.texts_to_sequences(["aa zz"])
+    assert len(seq) == 2 and seq[1] == tok.word_index["<oov>"]
+
+
+def test_skipgrams_window():
+    from flexflow_tpu.keras.preprocessing import skipgrams
+
+    couples, labels = skipgrams([1, 2, 3], 10, window_size=1,
+                                negative_samples=1.0, shuffle=False)
+    pos = [tuple(c) for c, l in zip(couples, labels) if l == 1]
+    assert set(pos) == {(1, 2), (2, 1), (2, 3), (3, 2)}
+    assert sum(1 for l in labels if l == 0) == len(pos)
+
+
+def test_verify_metrics_callback(devices8):
+    from flexflow_tpu.keras import Dense, Sequential, VerifyMetrics
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 16).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    model = Sequential([Dense(8, activation="relu"),
+                        Dense(2, activation="softmax")], input_shape=(16,))
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], batch_size=32)
+    with pytest.raises(AssertionError, match="accuracy"):
+        model.fit(x, y, epochs=1, verbose=False,
+                  callbacks=[VerifyMetrics(floor=1.01)])
+    model.fit(x, y, epochs=3, verbose=False,
+              callbacks=[VerifyMetrics(floor=0.4, each_epoch=True)])
+
+
+def test_tokenizer_tfidf_batch_independent():
+    """idf comes from fit-time document frequencies, so the same text
+    featurizes identically whatever batch it rides in."""
+    from flexflow_tpu.keras.preprocessing import Tokenizer
+
+    corpus = ["a b c", "a b", "a", "d d d"]
+    tok = Tokenizer()
+    tok.fit_on_texts(corpus)
+    alone = tok.texts_to_matrix(["a b"], mode="tfidf")[0]
+    batched = tok.texts_to_matrix(["a b", "d"], mode="tfidf")[0]
+    np.testing.assert_allclose(alone, batched)
+    # rarer term ("b": 2 docs) outweighs the ubiquitous one ("a": 3)
+    ia, ib = tok.word_index["a"], tok.word_index["b"]
+    assert alone[ib] > alone[ia] > 0
