@@ -177,6 +177,10 @@ def _fn_names() -> Dict:
         operator.truediv: "div", operator.floordiv: "floordiv",
         operator.neg: "neg", operator.pow: "pow",
         operator.getitem: "getitem",
+        # fx records `x.shape` as call_function(builtins.getattr):
+        # shapes are static here, so it folds to a tuple of ints
+        # (HF transformers' `hidden_states.shape[...]` idiom)
+        getattr: "getattr_",
     }
     if HAS_TORCH:
         t.update({
@@ -341,6 +345,12 @@ def lower_function(ff: FFModel, fname: str, a: List, kw: Dict, name: str):
             fn = {"add": ff.add, "sub": ff.subtract, "mul": ff.multiply,
                   "div": ff.divide}[fname]
             return fn(a[0], a[1], name=name)
+        if not _is_tensor(a[0]) and not _is_tensor(a[1]):
+            # static-shape arithmetic in the trace (e.g. HF's
+            # `x.shape[:-1] + (heads, d)`) folds in Python
+            return {"add": operator.add, "sub": operator.sub,
+                    "mul": operator.mul,
+                    "div": operator.truediv}[fname](a[0], a[1])
         tensor, scalar = (a[0], a[1]) if _is_tensor(a[0]) else (a[1], a[0])
         if fname == "sub" and not _is_tensor(a[0]):
             # scalar - x = -(x - scalar)
@@ -354,11 +364,17 @@ def lower_function(ff: FFModel, fname: str, a: List, kw: Dict, name: str):
               "mul": ff.scalar_multiply, "div": ff.scalar_true_divide}[fname]
         return fn(tensor, float(scalar), name=name)
     if fname == "floordiv":
+        if not _is_tensor(a[0]):  # folded shape arithmetic (shape // 2)
+            return operator.floordiv(a[0], a[1])
         t = ff.scalar_true_divide(a[0], float(a[1]), name=f"{name}_d")
         return ff.floor(t, name=name)
     if fname == "neg":
+        if not _is_tensor(a[0]):
+            return -a[0]
         return ff.scalar_multiply(a[0], -1.0, name=name)
     if fname == "pow":
+        if not _is_tensor(a[0]):
+            return operator.pow(a[0], a[1])
         return ff.pow(a[0], float(a[1]), name=name)
     if fname in _UNARY_FNS:
         return getattr(ff, _UNARY_FNS[fname])(a[0], name=name)
@@ -428,6 +444,13 @@ def lower_function(ff: FFModel, fname: str, a: List, kw: Dict, name: str):
                           name=name)
     if fname == "getitem":
         return _getitem(ff, a[0], a[1], name)
+    if fname == "getattr_":
+        x, attr = a[0], a[1]
+        if _is_tensor(x) and attr == "shape":
+            return tuple(x.shape.logical_shape)
+        if not _is_tensor(x):
+            return getattr(x, attr)
+        raise ValueError(f"unsupported tensor attribute in trace: {attr}")
     if fname == "f_linear":
         w = np.asarray(a[1])
         b = np.asarray(a[2]) if len(a) > 2 and a[2] is not None else kw.get("bias")
