@@ -54,6 +54,7 @@ SCRIPTS = {
     "python/native/moe.py": ["-e", "1", "-b", "32", *_DP],
     "python/native/transformer.py": ["-e", "1", "-b", "8", *_DP],
     "python/native/gpt.py": ["-e", "1", "-b", "8", *_DP],
+    "python/native/serve_gpt.py": ["-e", "5", "-b", "4", *_DP],
     "python/keras/seq_mnist_mlp.py": ["-e", "1", "--num-samples", "512"],
     "python/keras/func_cifar10_cnn.py": [
         "-e", "1", "-b", "32", "--num-samples", "256",

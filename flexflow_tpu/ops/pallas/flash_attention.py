@@ -28,24 +28,40 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# preferred tile edge: on-chip sweep (v5e, d=64, fwd+bwd, best-of-3
-# rounds at seq 1024/2048/4096/8192) — 1024-wide tiles beat 512 by
-# 10-30% and 128 by ~3x (the MXU amortizes the d=64 contraction over a
-# bigger tile; beyond 1024 the f32 score tile crowds VMEM and Mosaic
-# refuses ~4k tiles); smaller sizes only when seq demands
-_PREFERRED_BLOCK = 1024
+# Per-kernel preferred (block_q, block_k): r5 on-chip ASYMMETRIC sweep
+# (v5e, bh=96, d=64, seq2048, scan-chained timing so tunnel dispatch
+# is amortized — scripts/flash_ceiling_probe.py, table in docs/PERF.md).
+# Each kernel wants the LOOPED axis wide (fewer grid revisits of the
+# resident operand) and the GRID axis narrow:
+#   fwd  (grid q, loop kv): (512, 2048) — 4.41ms vs 5.55 at 1024x1024;
+#   dq   (grid q, loop kv): (512, 1024) — 5.79ms vs 7.10;
+#   dkv  (grid kv, loop q): (2048, 512) — 7.35ms vs 7.57.
+# bq=2048 tiles fail to compile for fwd/dq (f32 score tile + q-block
+# accumulators crowd VMEM); the dkv kernel fits them because its
+# per-cell state is [bk, d].
+_PREFERRED = {"fwd": (512, 2048), "dq": (512, 1024), "dkv": (2048, 512)}
 
 _NEG_INF = -1e30
 
 
-def _pick_block(s: int) -> Optional[int]:
-    """Largest power-of-two tile <= _PREFERRED_BLOCK dividing seq."""
-    b = _PREFERRED_BLOCK
+def _largest_dividing(s: int, cap: int) -> Optional[int]:
+    b = cap
     while b >= 128:
         if s % b == 0 and s >= b:
             return b
         b //= 2
     return None
+
+
+def _pick_block(s: int) -> Optional[int]:
+    """Generic feasibility tile (supportedness checks); per-kernel
+    choices come from _pick_blocks."""
+    return _largest_dividing(s, 1024)
+
+
+def _pick_blocks(kernel: str, sq: int, sk: int) -> Tuple[int, int]:
+    cap_q, cap_k = _PREFERRED[kernel]
+    return _largest_dividing(sq, cap_q), _largest_dividing(sk, cap_k)
 
 
 def _ref_attention(q, k, v, scale: float, causal: bool):
@@ -180,7 +196,7 @@ def _flash_fwd(q, k, v, scale, causal):
     if backend == "tpu" and _supported(q, k):
         return _flash_fwd_pallas(
             q, k, v, scale, causal,
-            _pick_block(q.shape[1]), _pick_block(k.shape[1]),
+            *_pick_blocks("fwd", q.shape[1], k.shape[1]),
         )
     # reference path: also produce lse for the backward
     s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
@@ -301,9 +317,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal,
-                      block_q: int, block_k: int, interpret: bool = False):
+                      block_q: int, block_k: int, interpret: bool = False,
+                      dkv_blocks: Optional[Tuple[int, int]] = None):
+    """block_q/block_k tile the dq kernel; dkv_blocks (defaulting to
+    the same pair) tiles the dkv kernel — the two kernels' best tiles
+    are opposite-handed (see _PREFERRED)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    dkv_bq, dkv_bk = dkv_blocks or (block_q, block_k)
     # delta = rowsum(dO * O): one cheap fused jnp pass, shared by both
     # kernels (standard flash-backward preprocessing)
     delta = jnp.sum(
@@ -332,21 +353,21 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, scale, causal,
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, block_q=block_q, scale=scale, causal=causal,
+            _bwd_dkv_kernel, block_q=dkv_bq, scale=scale, causal=causal,
             seq_q=sq,
         ),
-        grid=(bh, sk // block_k),
+        grid=(bh, sk // dkv_bk),
         in_specs=[
             pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, dkv_bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, dkv_bk, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, 1, sq), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, dkv_bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, dkv_bk, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
@@ -365,9 +386,11 @@ def _flash_vjp_fwd(q, k, v, scale, causal):
 def _flash_vjp_bwd(scale, causal, res, dout):
     q, k, v, out, lse = res
     if jax.default_backend() == "tpu" and _supported(q, k):
+        sq, sk = q.shape[1], k.shape[1]
         return _flash_bwd_pallas(
             q, k, v, out, lse, dout, scale, causal,
-            _pick_block(q.shape[1]), _pick_block(k.shape[1]),
+            *_pick_blocks("dq", sq, sk),
+            dkv_blocks=_pick_blocks("dkv", sq, sk),
         )
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)

@@ -8,6 +8,9 @@ transfer/introspection, and cache-state reset between sequences.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # search/train-heavy: full tier only
+
+
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.decoding import (
     gpt_beam_search_cached,

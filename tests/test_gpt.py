@@ -9,6 +9,9 @@ decoder blocks.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # search/train-heavy: full tier only
+
+
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.models.transformer import (
     bert_sp_strategy,

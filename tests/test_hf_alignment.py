@@ -14,6 +14,9 @@ path with different constants.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # search/train-heavy: full tier only
+
+
 torch = pytest.importorskip("torch")
 tf_mod = pytest.importorskip("transformers.models.bert.modeling_bert")
 

@@ -4,6 +4,9 @@ import numpy as np
 
 from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
 from flexflow_tpu.models.nmt import build_nmt
+import pytest
+
+pytestmark = pytest.mark.slow  # search/train-heavy: full tier only
 
 
 def test_lstm_op_shapes_and_numerics(devices8):

@@ -13,6 +13,9 @@ mode, and trained one step on the 8-device CPU mesh.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # search/train-heavy: full tier only
+
+
 torch = pytest.importorskip("torch")
 import torch.nn as nn  # noqa: E402
 

@@ -16,6 +16,8 @@ from flexflow_tpu.models.transformer import build_gpt, gpt_generate
 from flexflow_tpu.serving import GenerationBatcher, GenerationEngine
 from flexflow_tpu.serving.server import serve_http
 
+pytestmark = pytest.mark.slow  # search/train-heavy: full tier only
+
 V, S, B = 32, 16, 4
 
 

@@ -3,6 +3,9 @@ has no isolated search/simulator tests — we do, hermetically)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # search/train-heavy: full tier only
+
+
 from flexflow_tpu import FFConfig, FFModel
 from flexflow_tpu.fftype import ActiMode
 from flexflow_tpu.ops.op import ShardConfig

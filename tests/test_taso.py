@@ -33,9 +33,13 @@ from flexflow_tpu.pcg.taso import (
 
 CATALOG = "/root/reference/substitutions/graph_subst_3_v2.json"
 
-pytestmark = pytest.mark.skipif(
-    not os.path.exists(CATALOG), reason="reference catalog not mounted"
-)
+pytestmark = [
+    pytest.mark.skipif(
+        not os.path.exists(CATALOG),
+        reason="reference catalog not mounted",
+    ),
+    pytest.mark.slow,  # search/train-heavy: full tier only
+]
 
 
 # -- loader ----------------------------------------------------------------

@@ -23,6 +23,8 @@ from flexflow_tpu.sim.machine_model import TpuPodModel
 from flexflow_tpu.sim.simulator import OpCostModel, Simulator
 from flexflow_tpu.strategy import Strategy, apply_strategy, assign_views
 
+pytestmark = pytest.mark.slow  # search/train-heavy: full tier only
+
 
 def build_mlp(hidden=2048, batch=64, layers=2):
     ff = FFModel(FFConfig())
