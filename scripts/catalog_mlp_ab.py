@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Catalog-rule A/B on chip: branchy linear model (the residual of
+VERDICT r4 #1 — how `taso_rule_*` behaves ON HARDWARE, not just in
+searched cost).
+
+The model is the catalog's home turf: two dense+relu branches off one
+input, concatenated.  Three variants:
+
+  no_rewrites       rewrite enumeration off;
+  joint             catalog + builtins, ANALYTIC costs only
+                    (--no-calibrate path): the roofline prefers the
+                    merge composite, which hardware mispriced at
+                    width 4096 (0.90x — the documented negative);
+  joint_calibrated  measured-cost calibration on (the real-TPU
+                    default): the search measures the merged region,
+                    drops the regressive merge, and keeps
+                    taso_rule_543 (concat(relu,relu)->relu(concat)) —
+                    a catalog rule in an on-chip calibrated winning
+                    trace, measured neutral.
+
+Interleaved best-of-N windows via scripts/_ab_common.py.
+
+Usage: python scripts/catalog_mlp_ab.py [--batch 256] [--width 4096]
+       [--iters 20] [--windows 6] [--skip-calibrated] [--cpu-smoke]
+"""
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, ".."))
+sys.path.insert(0, _HERE)
+
+from _ab_common import interleaved_best, make_train_window, summarize  # noqa: E402
+
+
+def build(extra, batch, seq, width, dev, dtype):
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+
+    cfg = FFConfig(batch_size=batch, num_devices=1, search_budget=20,
+                   compute_dtype=dtype, **extra)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([batch, seq, width], name="input")
+    a = ff.relu(ff.dense(x, width, name="fa"))
+    b = ff.relu(ff.dense(x, width, name="fb"))
+    t = ff.concat([a, b], axis=2)
+    t = ff.dense(t, 16, name="head")
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    return ff
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--seq", type=int, default=4)
+    ap.add_argument("--width", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--windows", type=int, default=6)
+    ap.add_argument("--skip-calibrated", action="store_true",
+                    help="skip the calibrated leg (calibration adds "
+                         "on-chip region timing to the search)")
+    ap.add_argument("--cpu-smoke", action="store_true")
+    args = ap.parse_args()
+    if args.cpu_smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        args.batch, args.width, args.iters, args.windows = 8, 64, 2, 1
+        args.skip_calibrated = True  # calibration is a TPU-path feature
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    dtype = "bfloat16" if dev.platform != "cpu" else "float32"
+
+    variants = [
+        ("no_rewrites", dict(substitution_json="none",
+                             rewrite_max_variants=1,
+                             search_calibrate=False)),
+        ("joint", dict(rewrite_depth=3, rewrite_max_variants=24,
+                       search_calibrate=False)),
+    ]
+    if not args.skip_calibrated:
+        variants.append(
+            ("joint_calibrated", dict(rewrite_depth=3,
+                                      rewrite_max_variants=24,
+                                      search_calibrate=True)))
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(args.batch, args.seq, args.width).astype(np.float32)
+    ys = rng.randint(0, 16, (args.batch, args.seq)).astype(np.int32)
+
+    legs, windows = {}, {}
+    for tag, extra in variants:
+        print(f"[{tag}] searching + compiling ...", file=sys.stderr)
+        ff = build(extra, args.batch, args.seq, args.width, dev, dtype)
+        legs[tag] = {"rewrites": [list(r) for r in ff.strategy.rewrites]}
+        windows[tag] = make_train_window(ff, {"input": xs}, ys, args.iters)
+    for tag, timing in summarize(
+            interleaved_best(windows, args.windows)).items():
+        legs[tag].update(timing)
+
+    base = legs["no_rewrites"]["step_ms"]
+    out = {
+        "workload": f"branchy-linear b{args.batch} seq{args.seq} "
+                    f"w{args.width} {dtype} single-chip",
+        **legs,
+    }
+    for tag in legs:
+        if tag != "no_rewrites":
+            out[f"speedup_{tag}"] = round(base / legs[tag]["step_ms"], 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,11 +26,12 @@ import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(_HERE, ".."))
+sys.path.insert(0, _HERE)
+
+from _ab_common import interleaved_best, make_train_window, summarize  # noqa: E402
 
 
 def build(cfg_kwargs, batch, px, classes, dev):
-    import numpy as np
-
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
     from flexflow_tpu.models import build_inception_v3
 
@@ -44,29 +45,6 @@ def build(cfg_kwargs, batch, px, classes, dev):
                devices=[dev])
     search_s = time.perf_counter() - t0
     return ff, search_s
-
-
-def make_window(ff, batch, px, classes, iters):
-    import jax
-    import numpy as np
-
-    rng = np.random.RandomState(0)
-    xs = jax.device_put(rng.randn(batch, 3, px, px).astype(np.float32),
-                        ff.executor.input_shardings()["input"])
-    ys = jax.device_put(rng.randint(0, classes, batch).astype(np.int32),
-                        ff.executor.label_sharding())
-    for _ in range(3):
-        m = ff.train_step({"input": xs}, ys)
-    _ = float(m["loss"])
-
-    def window():
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            m = ff.train_step({"input": xs}, ys)
-        _ = float(m["loss"])  # one hard sync drains the serial chain
-        return (time.perf_counter() - t0) / iters
-
-    return window
 
 
 def main():
@@ -95,6 +73,8 @@ def main():
                   search_budget=args.budget, search_calibrate=False,
                   compute_dtype=dtype)
 
+    import numpy as np
+
     # build both, then INTERLEAVE timing windows A/B/A/B...: the tunnel's
     # 2-6x throughput wobble is time-correlated, so alternating windows
     # puts both variants under the same conditions (best-of-N per side)
@@ -103,6 +83,9 @@ def main():
                              rewrite_max_variants=1)),
         ("joint", dict(rewrite_depth=3, rewrite_max_variants=16)),
     )
+    rng = np.random.RandomState(0)
+    xs = rng.randn(args.batch, 3, args.px, args.px).astype(np.float32)
+    ys = rng.randint(0, args.classes, args.batch).astype(np.int32)
     legs, windows = {}, {}
     for tag, extra in variants:
         print(f"[{tag}] searching + compiling ...", file=sys.stderr)
@@ -112,20 +95,12 @@ def main():
             "search_compile_s": round(search_s, 1),
             "rewrites": [list(r) for r in ff.strategy.rewrites],
         }
-        windows[tag] = make_window(ff, args.batch, args.px, args.classes,
-                                   args.iters)
-    samples = {tag: [] for tag, _ in variants}
-    for w in range(args.windows):
-        for tag, _ in variants:
-            samples[tag].append(windows[tag]())
-        print(f"window {w}: " + " ".join(
-            f"{tag}={samples[tag][-1]*1e3:.2f}ms" for tag, _ in variants),
-            file=sys.stderr)
-    for tag, _ in variants:
-        dt = min(samples[tag])
-        legs[tag]["step_ms"] = round(dt * 1e3, 3)
-        legs[tag]["samples_per_sec"] = round(args.batch / dt, 2)
-        legs[tag]["window_ms"] = [round(s * 1e3, 3) for s in samples[tag]]
+        windows[tag] = make_train_window(ff, {"input": xs}, ys, args.iters)
+    for tag, timing in summarize(
+            interleaved_best(windows, args.windows)).items():
+        legs[tag].update(timing)
+        legs[tag]["samples_per_sec"] = round(
+            args.batch / (legs[tag]["step_ms"] / 1e3), 2)
 
     a, b = legs["no_rewrites"], legs["joint"]
     out = {
