@@ -332,6 +332,7 @@ class MultiHeadAttention(Op):
                 batch_spec=batch_spec,
                 head_spec=head_spec,
                 scale=scale, causal=p.causal,
+                training=training,
             )
         kv_appended = kh.shape[1] - self.inputs[1].shape.logical_shape[1]
         use_dropout = training and p.dropout > 0.0 and rng is not None

@@ -25,10 +25,11 @@ _NEG_INF = -1e30
 
 
 def _block_attend(q, k, v, scale, mask):
-    """One (q_block, kv_block) partial attention in f32.
+    """One (q_block, kv_block) partial attention in f32 (dense path).
 
     q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask: [sq, sk] bool or None.
-    Returns (scores_max, exp_scores_rowsum, weighted_v) for online merge.
+    Returns (o_b [b, sq, h, d] normalized, lse_b [b, h, sq]); fully
+    masked rows carry lse = -inf and o = 0 so the merge ignores them.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if mask is not None:
@@ -36,20 +37,85 @@ def _block_attend(q, k, v, scale, mask):
     m = jnp.max(s, axis=-1)  # [b, h, sq]
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
+    l_safe = jnp.where(l > 0.0, l, 1.0)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return m, l, pv
+    o_b = pv / l_safe.transpose(0, 2, 1)[..., None]
+    lse_b = jnp.where(l > 0.0, m + jnp.log(l_safe), _NEG_INF)
+    return o_b, lse_b
+
+
+def _block_attend_flash(q, k, v, scale, interpret):
+    """Flash-kernel block attend (non-causal ring steps): the Pallas
+    fwd kernel already returns (normalized out, lse) — exactly the
+    merge state — so no [sq, sk] score tensor ever touches HBM.
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]."""
+    from ..ops.pallas import flash_attention as fa
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+
+    def flat(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+
+    out, lse = fa._flash_fwd_pallas(
+        flat(q), flat(k), flat(v), scale, False,
+        *fa._pick_blocks("fwd", sq, sk), interpret=interpret,
+    )
+    o_b = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return o_b.astype(jnp.float32), lse.reshape(b, h, sq)
+
+
+def _use_flash_blocks(qh, kh, block_impl: str) -> bool:
+    from ..ops.pallas import flash_attention as fa
+
+    if block_impl == "dense":
+        return False
+    b, sq, h, d = qh.shape
+    q2 = jax.ShapeDtypeStruct((b * h, sq, d), qh.dtype)
+    k2 = jax.ShapeDtypeStruct((b * h, kh.shape[1], d), kh.dtype)
+    ok = fa._HAVE_PALLAS and fa._supported(q2, k2)
+    if block_impl == "flash":
+        # forced: a silent dense fallback would make callers (and the
+        # equivalence test) believe they exercised the kernel
+        if not ok:
+            raise ValueError(
+                f"block_impl='flash' unsupported here (pallas="
+                f"{fa._HAVE_PALLAS}, shard shapes {tuple(qh.shape)}/"
+                f"{tuple(kh.shape)})"
+            )
+        return True
+    return ok and jax.default_backend() == "tpu"  # "auto"
 
 
 def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
-                            scale: float, causal: bool):
-    """Per-shard body (inside shard_map). qh/kh/vh: [b, s_local, h, d]."""
+                            scale: float, causal: bool,
+                            block_impl: str = "auto",
+                            training: bool = False):
+    """Per-shard body (inside shard_map). qh/kh/vh: [b, s_local, h, d].
+
+    Per-block state is (normalized out, lse) — the same pair the Pallas
+    flash kernel emits — merged with the log-sum-exp reweighting, so
+    non-causal ring steps run the flash kernel directly (O(tile) VMEM
+    score blocks instead of a dense [sq, sk] HBM tensor per step).
+    Causal rings keep the dense block path: each step's mask offset is
+    device-dependent (traced), which the Pallas kernel's static causal
+    masking cannot express.  Training rings also stay dense: the raw
+    Pallas forward has no autodiff rule, and a correct ring BACKWARD
+    needs lse cotangents through the merge (future work) — the dense
+    path differentiates via plain jax ops."""
+    if block_impl == "flash" and (causal or training):
+        raise ValueError(
+            "block_impl='flash' is forward-only and non-causal "
+            f"(causal={causal}, training={training})"
+        )
     idx = jax.lax.axis_index(axis_name)
     s_local = qh.shape[1]
     k_local = kh.shape[1]  # may differ from s_local (cross-attention)
     b, _, h, d = qh.shape
+    flash_blocks = (not causal and not training
+                    and _use_flash_blocks(qh, kh, block_impl))
 
-    m_acc = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
-    l_acc = jnp.zeros((b, h, s_local), jnp.float32)
+    lse_acc = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
     o_acc = jnp.zeros((b, s_local, h, d), jnp.float32)
 
     k_blk, v_blk = kh, vh
@@ -63,24 +129,29 @@ def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
             q_pos = idx * s_local + jnp.arange(s_local)[:, None]
             k_pos = src * k_local + jnp.arange(k_local)[None, :]
             mask = q_pos >= k_pos  # [sq, sk]
+            o_b, lse_b = _block_attend(qh, k_blk, v_blk, scale, mask)
+        elif flash_blocks:
+            o_b, lse_b = _block_attend_flash(
+                qh, k_blk, v_blk, scale,
+                interpret=jax.default_backend() != "tpu",
+            )
         else:
-            mask = None
-        m_b, l_b, pv_b = _block_attend(qh, k_blk, v_blk, scale, mask)
-        m_new = jnp.maximum(m_acc, m_b)
-        c_old = jnp.exp(m_acc - m_new)
-        c_new = jnp.exp(m_b - m_new)
-        l_acc = l_acc * c_old + l_b * c_new
+            o_b, lse_b = _block_attend(qh, k_blk, v_blk, scale, None)
+        # log-sum-exp merge of normalized partials; -inf-safe (a row
+        # with no live keys yet keeps lse -inf and zero output)
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        live = lse_new > _NEG_INF / 2
+        c_old = jnp.where(live, jnp.exp(lse_acc - lse_new), 0.0)
+        c_new = jnp.where(live, jnp.exp(lse_b - lse_new), 0.0)
         o_acc = (
             o_acc * c_old.transpose(0, 2, 1)[..., None]
-            + pv_b * c_new.transpose(0, 2, 1)[..., None]
+            + o_b * c_new.transpose(0, 2, 1)[..., None]
         )
-        m_acc = m_new
+        lse_acc = lse_new
         if step + 1 < sp:
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-    l_safe = jnp.where(l_acc > 0.0, l_acc, 1.0)
-    out = o_acc / l_safe.transpose(0, 2, 1)[..., None]
-    return out.astype(qh.dtype)
+    return o_acc.astype(qh.dtype)
 
 
 def ring_attention(
@@ -94,11 +165,17 @@ def ring_attention(
     head_spec=None,
     scale: float = 1.0,
     causal: bool = False,
+    block_impl: str = "auto",
+    training: bool = False,
 ):
     """Sequence-parallel attention on [b, s, h, d] arrays whose s dim is
     sharded over `seq_axis`.  batch_spec/head_spec name the mesh axes (or
     None) sharding the batch/head dims, so the shard_map specs match the
-    surrounding SPMD sharding."""
+    surrounding SPMD sharding.  block_impl: "auto" (flash per-block on
+    TPU for non-causal INFERENCE rings, dense otherwise), "dense", or
+    "flash" (forced — raises when unsupported; interpret-mode off-TPU
+    for tests).  training=True pins the dense block path, which
+    differentiates via plain jax ops."""
     sp = mesh.shape[seq_axis]
     spec = PartitionSpec(batch_spec, seq_axis, head_spec, None)
     fn = functools.partial(
@@ -107,6 +184,8 @@ def ring_attention(
         sp=sp,
         scale=scale,
         causal=causal,
+        block_impl=block_impl,
+        training=training,
     )
     return jax.shard_map(
         fn,
