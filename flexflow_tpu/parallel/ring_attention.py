@@ -65,55 +65,53 @@ def _block_attend_flash(q, k, v, scale, interpret):
     return o_b.astype(jnp.float32), lse.reshape(b, h, sq)
 
 
-def _use_flash_blocks(qh, kh, block_impl: str) -> bool:
+def _use_flash_blocks(qh, kh, sp: int, block_impl: str) -> bool:
+    """Decide on the PER-SHARD shapes (global seq / sp): the kernels
+    run inside shard_map, so a globally-divisible length whose shard
+    has no >=128 tile must still fall back to dense."""
     from ..ops.pallas import flash_attention as fa
 
     if block_impl == "dense":
         return False
     b, sq, h, d = qh.shape
-    q2 = jax.ShapeDtypeStruct((b * h, sq, d), qh.dtype)
-    k2 = jax.ShapeDtypeStruct((b * h, kh.shape[1], d), kh.dtype)
-    ok = fa._HAVE_PALLAS and fa._supported(q2, k2)
+    sk = kh.shape[1]
+    ok = (
+        fa._HAVE_PALLAS
+        and sq % sp == 0
+        and sk % sp == 0
+        and fa._supported(
+            jax.ShapeDtypeStruct((b * h, sq // sp, d), qh.dtype),
+            jax.ShapeDtypeStruct((b * h, sk // sp, d), kh.dtype),
+        )
+    )
     if block_impl == "flash":
         # forced: a silent dense fallback would make callers (and the
         # equivalence test) believe they exercised the kernel
         if not ok:
             raise ValueError(
                 f"block_impl='flash' unsupported here (pallas="
-                f"{fa._HAVE_PALLAS}, shard shapes {tuple(qh.shape)}/"
-                f"{tuple(kh.shape)})"
+                f"{fa._HAVE_PALLAS}, global shapes {tuple(qh.shape)}/"
+                f"{tuple(kh.shape)}, sp={sp} -> shard seqs "
+                f"{sq // sp if sq % sp == 0 else 'indivisible'}/"
+                f"{sk // sp if sk % sp == 0 else 'indivisible'})"
             )
         return True
     return ok and jax.default_backend() == "tpu"  # "auto"
 
 
 def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
-                            scale: float, causal: bool,
-                            block_impl: str = "auto",
-                            training: bool = False):
-    """Per-shard body (inside shard_map). qh/kh/vh: [b, s_local, h, d].
-
-    Per-block state is (normalized out, lse) — the same pair the Pallas
-    flash kernel emits — merged with the log-sum-exp reweighting, so
-    non-causal ring steps run the flash kernel directly (O(tile) VMEM
-    score blocks instead of a dense [sq, sk] HBM tensor per step).
-    Causal rings keep the dense block path: each step's mask offset is
-    device-dependent (traced), which the Pallas kernel's static causal
-    masking cannot express.  Training rings also stay dense: the raw
-    Pallas forward has no autodiff rule, and a correct ring BACKWARD
-    needs lse cotangents through the merge (future work) — the dense
-    path differentiates via plain jax ops."""
-    if block_impl == "flash" and (causal or training):
-        raise ValueError(
-            "block_impl='flash' is forward-only and non-causal "
-            f"(causal={causal}, training={training})"
-        )
+                            scale: float, causal: bool):
+    """DENSE per-shard body (inside shard_map); qh/kh/vh:
+    [b, s_local, h, d].  Per-block state is (normalized out, lse),
+    merged with an -inf-safe log-sum-exp reweighting.  This path
+    differentiates through plain jax ops and carries the causal case
+    (each ring step's mask offset is device-dependent — traced — which
+    the Pallas kernel's static causal masking cannot express); the
+    non-causal flash path lives in _ring_flash_trainable."""
     idx = jax.lax.axis_index(axis_name)
     s_local = qh.shape[1]
     k_local = kh.shape[1]  # may differ from s_local (cross-attention)
     b, _, h, d = qh.shape
-    flash_blocks = (not causal and not training
-                    and _use_flash_blocks(qh, kh, block_impl))
 
     lse_acc = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
     o_acc = jnp.zeros((b, s_local, h, d), jnp.float32)
@@ -129,14 +127,9 @@ def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
             q_pos = idx * s_local + jnp.arange(s_local)[:, None]
             k_pos = src * k_local + jnp.arange(k_local)[None, :]
             mask = q_pos >= k_pos  # [sq, sk]
-            o_b, lse_b = _block_attend(qh, k_blk, v_blk, scale, mask)
-        elif flash_blocks:
-            o_b, lse_b = _block_attend_flash(
-                qh, k_blk, v_blk, scale,
-                interpret=jax.default_backend() != "tpu",
-            )
         else:
-            o_b, lse_b = _block_attend(qh, k_blk, v_blk, scale, None)
+            mask = None
+        o_b, lse_b = _block_attend(qh, k_blk, v_blk, scale, mask)
         # log-sum-exp merge of normalized partials; -inf-safe (a row
         # with no live keys yet keeps lse -inf and zero output)
         lse_new = jnp.logaddexp(lse_acc, lse_b)
@@ -152,6 +145,128 @@ def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
     return o_acc.astype(qh.dtype)
+
+
+def _ring_flash_fwd_sharded(qh, kh, vh, *, axis_name: str, sp: int,
+                            scale: float, interpret: bool):
+    """Non-causal flash ring FORWARD returning (out, lse) — the
+    residuals the manual backward needs.  Same schedule as
+    _ring_attention_sharded's flash path."""
+    b, s_local, h, d = qh.shape
+    lse_acc = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    o_acc = jnp.zeros((b, s_local, h, d), jnp.float32)
+    k_blk, v_blk = kh, vh
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    for step in range(sp):
+        o_b, lse_b = _block_attend_flash(qh, k_blk, v_blk, scale,
+                                         interpret)
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        live = lse_new > _NEG_INF / 2
+        c_old = jnp.where(live, jnp.exp(lse_acc - lse_new), 0.0)
+        c_new = jnp.where(live, jnp.exp(lse_b - lse_new), 0.0)
+        o_acc = (
+            o_acc * c_old.transpose(0, 2, 1)[..., None]
+            + o_b * c_new.transpose(0, 2, 1)[..., None]
+        )
+        lse_acc = lse_new
+        if step + 1 < sp:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    return o_acc.astype(qh.dtype), lse_acc
+
+
+def _ring_flash_bwd_sharded(qh, kh, vh, out, lse, dout, *,
+                            axis_name: str, sp: int, scale: float,
+                            interpret: bool):
+    """Non-causal flash ring BACKWARD.
+
+    Each device owns its q rows' (out, lse, dout) and accumulates dq
+    locally with the Pallas dq kernel; dk/dv partial sums ROTATE WITH
+    their k/v blocks (the dkv kernel adds each device's contribution
+    as the block passes through), so after sp steps plus one homing
+    ppermute every gradient block is complete on its owner.  The
+    global softmax statistics ride in `lse` — each block's
+    probabilities recompute against the FULL-sequence normalizer, which
+    is what makes blockwise dk/dv sums exact."""
+    from ..ops.pallas import flash_attention as fa
+
+    b, s_local, h, d = qh.shape
+    k_local = kh.shape[1]
+
+    def flat(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+
+    q2, do2, o2 = flat(qh), flat(dout), flat(out)
+    lse2 = lse.reshape(b * h, s_local)
+    dq_bq, dq_bk = fa._pick_blocks("dq", s_local, k_local)
+    dkv_bq, dkv_bk = fa._pick_blocks("dkv", s_local, k_local)
+
+    def unflat(t2, s):
+        return t2.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    dq_acc = jnp.zeros((b, s_local, h, d), jnp.float32)
+    k_blk, v_blk = kh, vh
+    dk_blk = jnp.zeros_like(kh, dtype=jnp.float32)
+    dv_blk = jnp.zeros_like(vh, dtype=jnp.float32)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    for step in range(sp):
+        dq_b, dk_b, dv_b = fa._flash_bwd_pallas(
+            q2, flat(k_blk), flat(v_blk), o2, lse2, do2, scale, False,
+            dq_bq, dq_bk, interpret=interpret,
+            dkv_blocks=(dkv_bq, dkv_bk),
+        )
+        dq_acc = dq_acc + unflat(dq_b, s_local).astype(jnp.float32)
+        dk_blk = dk_blk + unflat(dk_b, k_local).astype(jnp.float32)
+        dv_blk = dv_blk + unflat(dv_b, k_local).astype(jnp.float32)
+        # rotate the k/v blocks with their accumulating gradients; the
+        # FINAL rotation homes each gradient block to its owner, so
+        # only the accumulators ride it (k/v are dead after the last
+        # kernel call)
+        if step + 1 < sp:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+    return (dq_acc.astype(qh.dtype), dk_blk.astype(kh.dtype),
+            dv_blk.astype(vh.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash_trainable(qh, kh, vh, mesh, seq_axis, spec, sp, scale,
+                          interpret):
+    return _ring_flash_trainable_fwd(qh, kh, vh, mesh, seq_axis, spec,
+                                     sp, scale, interpret)[0]
+
+
+def _ring_flash_trainable_fwd(qh, kh, vh, mesh, seq_axis, spec, sp,
+                              scale, interpret):
+    out, lse = jax.shard_map(
+        functools.partial(_ring_flash_fwd_sharded, axis_name=seq_axis,
+                          sp=sp, scale=scale, interpret=interpret),
+        mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, PartitionSpec(spec[0], spec[2], seq_axis)),
+        check_vma=False,
+    )(qh, kh, vh)
+    return out, (qh, kh, vh, out, lse)
+
+
+def _ring_flash_trainable_bwd(mesh, seq_axis, spec, sp, scale,
+                              interpret, res, dout):
+    qh, kh, vh, out, lse = res
+    lse_spec = PartitionSpec(spec[0], spec[2], seq_axis)
+    dq, dk, dv = jax.shard_map(
+        functools.partial(_ring_flash_bwd_sharded, axis_name=seq_axis,
+                          sp=sp, scale=scale, interpret=interpret),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, lse_spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=False,
+    )(qh, kh, vh, out, lse, dout)
+    return dq, dk, dv
+
+
+_ring_flash_trainable.defvjp(_ring_flash_trainable_fwd,
+                             _ring_flash_trainable_bwd)
 
 
 def ring_attention(
@@ -171,21 +286,32 @@ def ring_attention(
     """Sequence-parallel attention on [b, s, h, d] arrays whose s dim is
     sharded over `seq_axis`.  batch_spec/head_spec name the mesh axes (or
     None) sharding the batch/head dims, so the shard_map specs match the
-    surrounding SPMD sharding.  block_impl: "auto" (flash per-block on
-    TPU for non-causal INFERENCE rings, dense otherwise), "dense", or
-    "flash" (forced — raises when unsupported; interpret-mode off-TPU
-    for tests).  training=True pins the dense block path, which
-    differentiates via plain jax ops."""
+    surrounding SPMD sharding.
+
+    block_impl: "auto" routes non-causal rings whose shard shapes the
+    Pallas kernels can tile through the FLASH ring — fully
+    differentiable via the manual ring backward
+    (_ring_flash_trainable), O(tile) VMEM score blocks, no [sq, sk]
+    HBM tensor in either pass — and everything else through the dense
+    jax-op path.  "dense" forces the dense path; "flash" forces the
+    flash ring (raises when causal or unsupported; interpret-mode
+    off-TPU for tests).  `training` is accepted for call-site symmetry
+    but both paths differentiate."""
     sp = mesh.shape[seq_axis]
     spec = PartitionSpec(batch_spec, seq_axis, head_spec, None)
+    if block_impl == "flash" and causal:
+        raise ValueError("block_impl='flash' is non-causal only")
+    if not causal and _use_flash_blocks(qh, kh, sp, block_impl):
+        return _ring_flash_trainable(
+            qh, kh, vh, mesh, seq_axis, spec, sp, float(scale),
+            jax.default_backend() != "tpu",
+        )
     fn = functools.partial(
         _ring_attention_sharded,
         axis_name=seq_axis,
         sp=sp,
         scale=scale,
         causal=causal,
-        block_impl=block_impl,
-        training=training,
     )
     return jax.shard_map(
         fn,

@@ -161,12 +161,50 @@ def test_ring_flash_blocks_match_dense(devices8):
     ).reshape(b, h, s, d).transpose(0, 2, 1, 3)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
-    # forced flash is forward-only / non-causal, and refuses shapes the
-    # kernel cannot tile rather than silently running dense
-    with pytest.raises(ValueError, match="forward-only"):
+    # forced flash is non-causal only, and refuses shapes the kernel
+    # cannot tile rather than silently running dense
+    with pytest.raises(ValueError, match="non-causal"):
         ring_attention(qh, kh, vh, mesh, "seq", scale=scale,
-                       block_impl="flash", training=True)
+                       block_impl="flash", causal=True)
     tiny = jnp.asarray(rng.randn(2, 4 * sp, 2, 8).astype(np.float32))
     with pytest.raises(ValueError, match="unsupported"):
         ring_attention(tiny, tiny, tiny, mesh, "seq", scale=scale,
                        block_impl="flash")
+    # the support check must see SHARD shapes: global 128*sp-divisible
+    # but shard 96-long has no >=128 tile -> refuse, not crash
+    odd = jnp.asarray(rng.randn(2, 96 * sp, 2, 64).astype(np.float32))
+    with pytest.raises(ValueError, match="unsupported"):
+        ring_attention(odd, odd, odd, mesh, "seq", scale=scale,
+                       block_impl="flash")
+
+
+def test_ring_flash_gradients_match_dense(devices8):
+    """The flash ring is fully differentiable: the manual ring backward
+    (rotating dk/dv partial sums, Pallas bwd kernels per block against
+    the global lse) must reproduce the dense ring's autodiff gradients."""
+    from jax.sharding import Mesh
+
+    from flexflow_tpu.parallel.ring_attention import ring_attention
+
+    sp = 4
+    b, s, h, d = 2, 128 * sp, 2, 64
+    rng = np.random.RandomState(7)
+    qh = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    kh = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    vh = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    mesh = Mesh(np.array(devices8[:sp]), ("seq",))
+    scale = 1.0 / np.sqrt(d)
+
+    def loss(impl):
+        def f(q, k, v):
+            o = ring_attention(q, k, v, mesh, "seq", scale=scale,
+                               block_impl=impl)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        return jax.grad(f, argnums=(0, 1, 2))(qh, kh, vh)
+
+    g_dense = loss("dense")
+    g_flash = loss("flash")
+    for gd, gf in zip(g_dense, g_flash):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=2e-4, atol=2e-4)
