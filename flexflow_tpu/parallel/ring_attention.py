@@ -44,11 +44,13 @@ def _block_attend(q, k, v, scale, mask):
     return o_b, lse_b
 
 
-def _block_attend_flash(q, k, v, scale, interpret):
-    """Flash-kernel block attend (non-causal ring steps): the Pallas
-    fwd kernel already returns (normalized out, lse) — exactly the
-    merge state — so no [sq, sk] score tensor ever touches HBM.
-    q: [b, sq, h, d]; k/v: [b, sk, h, d]."""
+def _block_attend_flash(q, k, v, scale, causal, interpret):
+    """Flash-kernel block attend: the Pallas fwd kernel already returns
+    (normalized out, lse) — exactly the merge state — so no [sq, sk]
+    score tensor ever touches HBM.  `causal` uses the kernel's static
+    intra-block masking (the ring's DIAGONAL blocks, where local and
+    global positions coincide).  q: [b, sq, h, d]; k/v: [b, sk, h, d].
+    """
     from ..ops.pallas import flash_attention as fa
 
     b, sq, h, d = q.shape
@@ -58,7 +60,7 @@ def _block_attend_flash(q, k, v, scale, interpret):
         return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
 
     out, lse = fa._flash_fwd_pallas(
-        flat(q), flat(k), flat(v), scale, False,
+        flat(q), flat(k), flat(v), scale, causal,
         *fa._pick_blocks("fwd", sq, sk), interpret=interpret,
     )
     o_b = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
@@ -104,10 +106,10 @@ def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
     """DENSE per-shard body (inside shard_map); qh/kh/vh:
     [b, s_local, h, d].  Per-block state is (normalized out, lse),
     merged with an -inf-safe log-sum-exp reweighting.  This path
-    differentiates through plain jax ops and carries the causal case
-    (each ring step's mask offset is device-dependent — traced — which
-    the Pallas kernel's static causal masking cannot express); the
-    non-causal flash path lives in _ring_flash_trainable."""
+    differentiates through plain jax ops; it is the fallback for
+    shapes the Pallas kernels cannot tile (and for non-square causal
+    cross-attention) — supported rings, causal included, route through
+    _ring_flash_trainable instead."""
     idx = jax.lax.axis_index(axis_name)
     s_local = qh.shape[1]
     k_local = kh.shape[1]  # may differ from s_local (cross-attention)
@@ -148,18 +150,27 @@ def _ring_attention_sharded(qh, kh, vh, *, axis_name: str, sp: int,
 
 
 def _ring_flash_fwd_sharded(qh, kh, vh, *, axis_name: str, sp: int,
-                            scale: float, interpret: bool):
-    """Non-causal flash ring FORWARD returning (out, lse) — the
-    residuals the manual backward needs.  Same schedule as
-    _ring_attention_sharded's flash path."""
+                            scale: float, causal: bool, interpret: bool):
+    """Flash ring FORWARD returning (out, lse) — the residuals the
+    manual backward needs.
+
+    Causality without kernel offsets: ring step 0 is every device's
+    DIAGONAL block (src == idx), which is exactly the kernel's static
+    causal masking; later steps hold strictly earlier (fully visible)
+    or strictly later (fully masked) blocks, decided by the traced
+    `step <= idx` — masked blocks simply don't merge (their compute is
+    the inherent idle work of an unbalanced causal ring)."""
+    idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = qh.shape
     lse_acc = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
     o_acc = jnp.zeros((b, s_local, h, d), jnp.float32)
     k_blk, v_blk = kh, vh
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     for step in range(sp):
-        o_b, lse_b = _block_attend_flash(qh, k_blk, v_blk, scale,
-                                         interpret)
+        o_b, lse_b = _block_attend_flash(
+            qh, k_blk, v_blk, scale, causal and step == 0, interpret)
+        if causal and step > 0:
+            lse_b = jnp.where(step <= idx, lse_b, _NEG_INF)
         lse_new = jnp.logaddexp(lse_acc, lse_b)
         live = lse_new > _NEG_INF / 2
         c_old = jnp.where(live, jnp.exp(lse_acc - lse_new), 0.0)
@@ -177,8 +188,9 @@ def _ring_flash_fwd_sharded(qh, kh, vh, *, axis_name: str, sp: int,
 
 def _ring_flash_bwd_sharded(qh, kh, vh, out, lse, dout, *,
                             axis_name: str, sp: int, scale: float,
-                            interpret: bool):
-    """Non-causal flash ring BACKWARD.
+                            causal: bool, interpret: bool):
+    """Flash ring BACKWARD (causal via the same diagonal-step /
+    gated-visibility scheme as the forward).
 
     Each device owns its q rows' (out, lse, dout) and accumulates dq
     locally with the Pallas dq kernel; dk/dv partial sums ROTATE WITH
@@ -204,20 +216,34 @@ def _ring_flash_bwd_sharded(qh, kh, vh, out, lse, dout, *,
     def unflat(t2, s):
         return t2.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
+    idx = jax.lax.axis_index(axis_name)
     dq_acc = jnp.zeros((b, s_local, h, d), jnp.float32)
     k_blk, v_blk = kh, vh
     dk_blk = jnp.zeros_like(kh, dtype=jnp.float32)
     dv_blk = jnp.zeros_like(vh, dtype=jnp.float32)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     for step in range(sp):
+        # causal off-diagonal steps: this device's queries see the held
+        # block only when it is strictly earlier (step <= idx).  Masked
+        # blocks must not reach the kernel with the true lse: their raw
+        # scores can EXCEED the global normalizer (they never entered
+        # the softmax), and exp(s - lse) would overflow before the gate
+        # zeroes it — feeding a huge lse drives p to exactly 0 instead.
+        if causal and step > 0:
+            live = step <= idx
+            lse_in = jnp.where(live, lse2, jnp.float32(1e30))
+            g = live.astype(jnp.float32)
+        else:
+            lse_in, g = lse2, jnp.float32(1.0)
         dq_b, dk_b, dv_b = fa._flash_bwd_pallas(
-            q2, flat(k_blk), flat(v_blk), o2, lse2, do2, scale, False,
+            q2, flat(k_blk), flat(v_blk), o2, lse_in, do2, scale,
+            causal and step == 0,
             dq_bq, dq_bk, interpret=interpret,
             dkv_blocks=(dkv_bq, dkv_bk),
         )
-        dq_acc = dq_acc + unflat(dq_b, s_local).astype(jnp.float32)
-        dk_blk = dk_blk + unflat(dk_b, k_local).astype(jnp.float32)
-        dv_blk = dv_blk + unflat(dv_b, k_local).astype(jnp.float32)
+        dq_acc = dq_acc + g * unflat(dq_b, s_local).astype(jnp.float32)
+        dk_blk = dk_blk + g * unflat(dk_b, k_local).astype(jnp.float32)
+        dv_blk = dv_blk + g * unflat(dv_b, k_local).astype(jnp.float32)
         # rotate the k/v blocks with their accumulating gradients; the
         # FINAL rotation homes each gradient block to its owner, so
         # only the accumulators ride it (k/v are dead after the last
@@ -231,18 +257,19 @@ def _ring_flash_bwd_sharded(qh, kh, vh, out, lse, dout, *,
             dv_blk.astype(vh.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_flash_trainable(qh, kh, vh, mesh, seq_axis, spec, sp, scale,
-                          interpret):
+                          causal, interpret):
     return _ring_flash_trainable_fwd(qh, kh, vh, mesh, seq_axis, spec,
-                                     sp, scale, interpret)[0]
+                                     sp, scale, causal, interpret)[0]
 
 
 def _ring_flash_trainable_fwd(qh, kh, vh, mesh, seq_axis, spec, sp,
-                              scale, interpret):
+                              scale, causal, interpret):
     out, lse = jax.shard_map(
         functools.partial(_ring_flash_fwd_sharded, axis_name=seq_axis,
-                          sp=sp, scale=scale, interpret=interpret),
+                          sp=sp, scale=scale, causal=causal,
+                          interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=(spec, PartitionSpec(spec[0], spec[2], seq_axis)),
         check_vma=False,
@@ -250,13 +277,14 @@ def _ring_flash_trainable_fwd(qh, kh, vh, mesh, seq_axis, spec, sp,
     return out, (qh, kh, vh, out, lse)
 
 
-def _ring_flash_trainable_bwd(mesh, seq_axis, spec, sp, scale,
+def _ring_flash_trainable_bwd(mesh, seq_axis, spec, sp, scale, causal,
                               interpret, res, dout):
     qh, kh, vh, out, lse = res
     lse_spec = PartitionSpec(spec[0], spec[2], seq_axis)
     dq, dk, dv = jax.shard_map(
         functools.partial(_ring_flash_bwd_sharded, axis_name=seq_axis,
-                          sp=sp, scale=scale, interpret=interpret),
+                          sp=sp, scale=scale, causal=causal,
+                          interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, lse_spec, spec),
         out_specs=(spec, spec, spec),
@@ -288,23 +316,32 @@ def ring_attention(
     None) sharding the batch/head dims, so the shard_map specs match the
     surrounding SPMD sharding.
 
-    block_impl: "auto" routes non-causal rings whose shard shapes the
-    Pallas kernels can tile through the FLASH ring — fully
-    differentiable via the manual ring backward
-    (_ring_flash_trainable), O(tile) VMEM score blocks, no [sq, sk]
-    HBM tensor in either pass — and everything else through the dense
-    jax-op path.  "dense" forces the dense path; "flash" forces the
-    flash ring (raises when causal or unsupported; interpret-mode
-    off-TPU for tests).  `training` is accepted for call-site symmetry
-    but both paths differentiate."""
+    block_impl: "auto" routes rings whose shard shapes the Pallas
+    kernels can tile through the FLASH ring — fully differentiable via
+    the manual ring backward (_ring_flash_trainable), O(tile) VMEM
+    score blocks, no [sq, sk] HBM tensor in either pass; causal rings
+    qualify too when shards are square (self-attention: the diagonal
+    step uses the kernel's static causal mask, off-diagonal steps gate
+    a traced visibility bit).  Everything else takes the dense jax-op
+    path.  "dense" forces the dense path; "flash" forces the flash
+    ring (raises when unsupported; interpret-mode off-TPU for tests).
+    `training` is accepted for call-site symmetry but both paths
+    differentiate."""
     sp = mesh.shape[seq_axis]
     spec = PartitionSpec(batch_spec, seq_axis, head_spec, None)
-    if block_impl == "flash" and causal:
-        raise ValueError("block_impl='flash' is non-causal only")
-    if not causal and _use_flash_blocks(qh, kh, sp, block_impl):
+    if causal and qh.shape[1] != kh.shape[1]:
+        # causal flash needs square diagonal blocks (self-attention)
+        if block_impl == "flash":
+            raise ValueError(
+                "block_impl='flash' causal rings need equal q/k seq "
+                f"lengths, got {qh.shape[1]}/{kh.shape[1]}")
+        flash = False
+    else:
+        flash = _use_flash_blocks(qh, kh, sp, block_impl)
+    if flash:
         return _ring_flash_trainable(
             qh, kh, vh, mesh, seq_axis, spec, sp, float(scale),
-            jax.default_backend() != "tpu",
+            bool(causal), jax.default_backend() != "tpu",
         )
     fn = functools.partial(
         _ring_attention_sharded,

@@ -161,11 +161,8 @@ def test_ring_flash_blocks_match_dense(devices8):
     ).reshape(b, h, s, d).transpose(0, 2, 1, 3)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
-    # forced flash is non-causal only, and refuses shapes the kernel
-    # cannot tile rather than silently running dense
-    with pytest.raises(ValueError, match="non-causal"):
-        ring_attention(qh, kh, vh, mesh, "seq", scale=scale,
-                       block_impl="flash", causal=True)
+    # forced flash refuses shapes the kernel cannot tile rather than
+    # silently running dense
     tiny = jnp.asarray(rng.randn(2, 4 * sp, 2, 8).astype(np.float32))
     with pytest.raises(ValueError, match="unsupported"):
         ring_attention(tiny, tiny, tiny, mesh, "seq", scale=scale,
@@ -208,3 +205,40 @@ def test_ring_flash_gradients_match_dense(devices8):
     for gd, gf in zip(g_dense, g_flash):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_causal_matches_dense(devices8, causal):
+    """Causal flash rings: the diagonal step uses the kernel's static
+    causal mask, off-diagonal steps gate a traced visibility bit — both
+    forward and the manual backward must match the dense causal ring."""
+    from jax.sharding import Mesh
+
+    from flexflow_tpu.parallel.ring_attention import ring_attention
+
+    sp = 4
+    b, s, h, d = 2, 128 * sp, 2, 64
+    rng = np.random.RandomState(11)
+    qh = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    kh = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    vh = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    mesh = Mesh(np.array(devices8[:sp]), ("seq",))
+    scale = 1.0 / np.sqrt(d)
+
+    def run(impl):
+        def f(q, k, v):
+            o = ring_attention(q, k, v, mesh, "seq", scale=scale,
+                               causal=causal, block_impl=impl)
+            return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+        (loss, o), grads = jax.value_and_grad(
+            f, argnums=(0, 1, 2), has_aux=True)(qh, kh, vh)
+        return o, grads
+
+    o_dense, g_dense = run("dense")
+    o_flash, g_flash = run("flash")
+    np.testing.assert_allclose(np.asarray(o_flash), np.asarray(o_dense),
+                               rtol=2e-4, atol=2e-4)
+    for gd, gf in zip(g_dense, g_flash):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=3e-4, atol=3e-4)
