@@ -54,6 +54,12 @@ class FFConfig:
     # 3258): a rewrite may spread to structurally identical ops — big
     # convergence win on deep nets with repeated layers
     search_propagate: bool = True
+    # incremental strategy evaluation (pcg/evaluator.py): memoize
+    # revisited candidates and delta-simulate single-op moves instead of
+    # re-simulating the whole graph.  Off = the always-full-eval path
+    # (delta_eval == full_eval is a tested invariant, so this is a
+    # debugging escape hatch, not a correctness knob).
+    search_eval_cache: bool = True
     # rewrite enumeration breadth in the Unity search: how many rewrite
     # steps deep and how many graph variants per subproblem.  The
     # defaults keep default-config searches cheap; raise them when
@@ -161,6 +167,8 @@ class FFConfig:
         p.add_argument("--alpha", "--search-alpha", dest="alpha", type=float, default=0.05)
         p.add_argument("--no-propagate", dest="search_propagate",
                        action="store_false", default=True)
+        p.add_argument("--no-search-eval-cache", dest="search_eval_cache",
+                       action="store_false", default=True)
         p.add_argument("--search-algo", dest="search_algo", type=str, default="unity",
                        choices=("unity", "mcmc"))
         p.add_argument("--only-data-parallel", action="store_true")
@@ -207,6 +215,7 @@ class FFConfig:
             search_budget=args.budget,
             search_alpha=args.alpha,
             search_propagate=args.search_propagate,
+            search_eval_cache=args.search_eval_cache,
             search_algo=args.search_algo,
             only_data_parallel=args.only_data_parallel,
             enable_parameter_parallel=args.enable_parameter_parallel,

@@ -45,6 +45,17 @@ class RecursiveLogger:
             if label:
                 self.debug("}")
 
+    def counters(self, label: str, mapping) -> None:
+        """Log a flat counters mapping as one `k=v` line — the shared
+        surface for search observability (evals/sec, memo hits,
+        delta-vs-full evals, dirty-frontier sizes)."""
+        if not self._log.isEnabledFor(logging.INFO):
+            return
+        parts = []
+        for k, v in mapping.items():
+            parts.append(f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}")
+        self.info("%s: %s", label, " ".join(parts))
+
     def set_level(self, level):
         self._log.setLevel(level)
 

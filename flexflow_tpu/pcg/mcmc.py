@@ -22,8 +22,10 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from ..fftype import OperatorType
+from ..logger import search_logger as slog
 from ..ops.op import ShardConfig
-from ..strategy import Strategy, apply_strategy, assign_views, data_parallel_strategy
+from ..strategy import Strategy
+from .evaluator import IncrementalEvaluator
 from .graph import Graph
 
 
@@ -92,10 +94,20 @@ class MCMCSearch:
         propagate: bool = True,
         propagation_chance: float = 0.25,
         continue_chance: float = 0.7,
+        use_eval_cache: bool = True,
     ):
         self.graph = graph
         self.n = num_devices
         self.simulator_factory = simulator_factory
+        # ONE simulator per search, not one per candidate: the factory
+        # still runs once so fitted-constant loading is unchanged, and
+        # its (node_key)->cost / OpTerms caches persist across
+        # evaluations (reference keeps one simulator for the whole
+        # search, simulator.cc:550-560)
+        self.simulator = simulator_factory()
+        self.evaluator = IncrementalEvaluator(
+            graph, self.simulator, training=True, use_cache=use_eval_cache
+        )
         self.budget = budget
         self.alpha = alpha
         self.memory_budget = memory_budget
@@ -173,18 +185,21 @@ class MCMCSearch:
 
     # -- cost ------------------------------------------------------------
     def evaluate(self, strategy: Strategy) -> float:
-        try:
-            g = apply_strategy(self.graph, strategy)
-            assign_views(g, strategy.mesh_axes)
-        except ValueError:  # ShapeError / unfactorable view -> illegal
+        res = self.evaluator.evaluate(strategy)
+        if res is None:  # ShapeError / unfactorable view -> illegal
             return math.inf
-        sim = self.simulator_factory()
-        res = sim.simulate(g, strategy.mesh_axes, training=True)
         cost = res.total_time
+        # per_device_memory is lazy — the liveness scan only runs when a
+        # budget makes the search actually consume it
         if self.memory_budget is not None and res.per_device_memory > self.memory_budget:
             over = res.per_device_memory / self.memory_budget - 1.0
             cost *= 1.0 + self.memory_lambda * over
         return cost
+
+    @property
+    def stats(self):
+        """EvalStats for the whole search (memo/delta/full counters)."""
+        return self.evaluator.stats
 
     # -- main loop (reference model.cc:3285-3356) ------------------------
     def optimize(self) -> Strategy:
@@ -240,6 +255,14 @@ class MCMCSearch:
                 if cost < best_cost:
                     best, best_cost = cand, cost
                     self.best_iteration = it
+        # search observability: counters ride on the returned strategy
+        # so benchmarks and callers can track cache effectiveness
+        best.search_stats = self.evaluator.stats.as_dict()
+        # underlying cache layers (term decomposition + op-cost cache)
+        best.search_stats["term_hits"] = self.simulator.term_hits
+        best.search_stats["term_misses"] = self.simulator.term_misses
+        best.search_stats["op_cost_hits"] = self.simulator.cost_model.cost_hits
+        slog.counters("mcmc eval stats", best.search_stats)
         return best
 
 
@@ -287,6 +310,7 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
         memory_lambda=cfg.memory_lambda,
         seed=cfg.seed,
         propagate=cfg.search_propagate,
+        use_eval_cache=cfg.search_eval_cache,
     )
     best = search.optimize()
     cost_model.save_persistent()

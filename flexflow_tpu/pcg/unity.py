@@ -45,6 +45,7 @@ from ..ops.op import Op, ShapeError, ShardConfig
 from ..parallel.machine import assign_axes
 from ..strategy import _PARAM_CLASSES, Strategy, apply_strategy, assign_views
 from ..tensor import ParallelTensor, ParallelTensorShape
+from .evaluator import IncrementalEvaluator
 from .graph import Graph
 from .mcmc import _factorizations
 from .substitution import (
@@ -101,6 +102,7 @@ class UnitySearch:
         enable_sample_parallel: bool = False,
         remat: bool = False,
         compute_scale: float = 1.0,
+        eval_cache: bool = True,
     ):
         self.event_rerank = event_rerank
         self.event_topk = event_topk
@@ -145,6 +147,13 @@ class UnitySearch:
                               parameter_sync=parameter_sync,
                               remat=remat,
                               compute_scale=compute_scale)
+        # memoized whole-strategy evaluator per (possibly rewritten)
+        # graph variant: the sp/sample candidate families and the
+        # memory-aware lambda binary search revisit identical strategies
+        # across optimize() passes — those re-evaluations become memo
+        # lookups (pcg/evaluator.py)
+        self.eval_cache = eval_cache
+        self._evaluators: Dict[Graph, "IncrementalEvaluator"] = {}
 
     # ------------------------------------------------------------------
     # graph splitting (reference find_split_node substitution.cc:2094)
@@ -701,6 +710,40 @@ class UnitySearch:
         self.graph = graph
         self._segments_memo = None
 
+    def _evaluator(self) -> IncrementalEvaluator:
+        """Memoized evaluator for the CURRENT self.graph (keyed by the
+        Graph object itself — identity hash — which also pins the graph
+        alive for the evaluator's cached records)."""
+        ev = self._evaluators.get(self.graph)
+        if ev is None:
+            ev = IncrementalEvaluator(self.graph, self._sim, training=True,
+                                      use_cache=self.eval_cache)
+            self._evaluators[self.graph] = ev
+        return ev
+
+    def eval_stats(self) -> Dict[str, float]:
+        """Aggregate evaluator counters across graph variants, plus the
+        segment-DP cache counters — the search-observability payload
+        attached to returned strategies."""
+        agg: Dict[str, float] = {}
+        for ev in self._evaluators.values():
+            for k, v in ev.stats.as_dict().items():
+                agg[k] = agg.get(k, 0) + v
+        n_evals = agg.get("evals", 0)
+        agg["evals_per_sec"] = (
+            n_evals / agg["eval_seconds"] if agg.get("eval_seconds") else 0.0
+        )
+        agg["mean_dirty_frontier"] = (
+            agg.get("dirty_ops", 0) / agg["delta_evals"]
+            if agg.get("delta_evals") else 0.0
+        )
+        agg["segment_evals"] = self.evals
+        agg["segment_cache_hits"] = self.cache_hits
+        agg["term_hits"] = self._sim.term_hits
+        agg["term_misses"] = self._sim.term_misses
+        agg["op_cost_hits"] = getattr(self.cost_model, "cost_hits", 0)
+        return agg
+
     def _optimize_graph(self, lam: float, collector: List[Tuple]):
         """Append every valid (obj, strategy, graph) for the CURRENT
         self.graph to collector (mesh factorizations, sp, pp)."""
@@ -719,11 +762,10 @@ class UnitySearch:
                 strategy = self._build_strategy(
                     mesh_axes, dp, shard_configs, edges
                 )
-                # validate + final rank with the strategy actually applied
-                try:
-                    g = apply_strategy(self.graph, strategy)
-                    assign_views(g, strategy.mesh_axes)
-                except (ShapeError, ValueError):
+                # validate with the strategy actually applied — through
+                # the memoized evaluator, so the lambda binary search's
+                # repeat passes validate revisited candidates by lookup
+                if self._evaluator().evaluate(strategy) is None:
                     continue
                 obj = self._objective(time, mem, lam)
                 slog.debug(
@@ -839,7 +881,7 @@ class UnitySearch:
             for obj, strategy, _g in collector:
                 strategy.search_cost = obj
             if not self.event_rerank:
-                return collector[0][1]
+                return self._finish(collector[0][1])
             # re-rank the analytic top-K with the event simulator's
             # contention-aware makespan (reference: candidates are
             # ultimately judged by simulate_runtime, not the analytic
@@ -869,7 +911,16 @@ class UnitySearch:
                 )
                 if final < best_obj:
                     best, best_obj = strategy, final
-            return best if best is not None else collector[0][1]
+            return self._finish(best if best is not None else collector[0][1])
+
+    def _finish(self, strategy: Strategy) -> Strategy:
+        """Attach the observability counters to the winning strategy and
+        log them (tentpole part 3)."""
+        from ..logger import search_logger as slog
+
+        strategy.search_stats = self.eval_stats()
+        slog.counters("unity eval stats", strategy.search_stats)
+        return strategy
 
     def _objective(self, time: float, mem: int, lam: float) -> float:
         """Single ranking formula for ALL candidate families (dp/tp/ep
@@ -922,17 +973,14 @@ class UnitySearch:
                 chain.append(("repartition", {"dim": 0, "degree": dp}))
             chain.append(("repartition", {"dim": 1, "degree": sp}))
             s.edge_ops["__inputs__"] = chain
-            try:
-                g = apply_strategy(self.graph, s)
-                assign_views(g, s.mesh_axes)
-            except (ShapeError, ValueError):
+            res = self._evaluator().evaluate(s)
+            if res is None:
                 continue
-            res = self._sim.simulate(g, mesh_axes, training=training)
             # ring attention KV rotation: ~an allgather of the group's
             # K+V per attention forward; backward re-rotates KV and
             # rotates dK/dV (~2x more); comm overlaps blockwise compute
             ring = 0.0
-            for op in g.topo_order():
+            for op in res.ops:
                 if op.op_type != OperatorType.MULTIHEAD_ATTENTION:
                     continue
                 kv_bytes = (
@@ -981,12 +1029,9 @@ class UnitySearch:
                 chain.append(("repartition", {"dim": 0, "degree": dp}))
             chain.append(("repartition", {"dim": 1, "degree": sp}))
             s.edge_ops["__inputs__"] = chain
-            try:
-                g = apply_strategy(self.graph, s)
-                assign_views(g, s.mesh_axes)
-            except (ShapeError, ValueError):
+            res = self._evaluator().evaluate(s)
+            if res is None:
                 continue
-            res = self._sim.simulate(g, mesh_axes, training=True)
             obj = self._objective(res.total_time, res.per_device_memory, lam)
             yield s, obj, f"dp={dp} sample={sp} (sample parallel)"
 
@@ -1115,7 +1160,9 @@ class UnitySearch:
                 chosen, hi = cand, mid
             else:
                 lo = mid
-        return chosen
+        # the winner's stats snapshot dates from the pass that found it;
+        # re-attach the whole-search cumulative counters
+        return self._finish(chosen)
 
     def _lambda_hi(self) -> float:
         # scale so the memory term can dominate: time-per-byte at HBM speed
@@ -1213,6 +1260,7 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         remat=cfg.remat,
         rewrite_depth=cfg.rewrite_depth,
         rewrite_max_variants=cfg.rewrite_max_variants,
+        eval_cache=cfg.search_eval_cache,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()

@@ -49,14 +49,60 @@ class CostMetrics:
     weights_memory: int = 0
 
 
-@dataclasses.dataclass
 class SimResult:
-    total_time: float
-    compute_time: float
-    comm_time: float
-    sync_time: float
-    per_device_memory: int
-    breakdown: Dict[str, float] = dataclasses.field(default_factory=dict)
+    """Simulation outcome.
+
+    `per_device_memory` is LAZY: searches only consume it when a memory
+    budget is set, but the liveness/remat scan behind it used to be paid
+    on every evaluation.  Constructing with `memory_fn` defers the scan
+    to first access (the computed value is then cached); constructing
+    with an int keeps the eager behavior.
+    """
+
+    def __init__(
+        self,
+        total_time: float,
+        compute_time: float,
+        comm_time: float,
+        sync_time: float,
+        per_device_memory: Optional[int] = None,
+        breakdown: Optional[Dict[str, float]] = None,
+        memory_fn: Optional[Callable[[], int]] = None,
+    ):
+        self.total_time = total_time
+        self.compute_time = compute_time
+        self.comm_time = comm_time
+        self.sync_time = sync_time
+        self.breakdown = breakdown if breakdown is not None else {}
+        self._memory = per_device_memory
+        self._memory_fn = memory_fn
+
+    @property
+    def per_device_memory(self) -> int:
+        if self._memory is None:
+            self._memory = int(self._memory_fn()) if self._memory_fn else 0
+            self._memory_fn = None  # release the captured op sequence
+        return self._memory
+
+
+@dataclasses.dataclass(frozen=True)
+class OpTerms:
+    """One op's additive contribution to a simulation — the delta-sim
+    decomposition (reference delta simulation in simulate_runtime: after
+    an MCMC substitution only affected tasks re-simulate).  Every field
+    depends ONLY on the cache key — node_key (op type, params,
+    ShardConfig, input parallel shapes), mesh signature, training — so
+    terms are cached across candidate strategies and whole-graph totals
+    are re-aggregated from cache."""
+
+    compute: float = 0.0      # analytic fwd(+bwd) time, pre compute_scale
+    xfer: float = 0.0         # parallel-op resharding collective
+    partial: float = 0.0      # fwd partial-sum all-reduce (undoubled)
+    grad_sync: float = 0.0    # gradient sync over weight replica axes
+    opt_numel: float = 0.0    # master-precision elements the update touches
+    mem_weights: int = 0      # per-device weight shard bytes
+    mem_residual: int = 0     # backward-residual activation bytes
+    mem_transient: int = 0    # fused transient workspace bytes (max-reduced)
 
 
 _KERNEL_OVERHEAD = 2e-6  # per-op dispatch/fusion overhead (XLA fuses, small)
@@ -127,6 +173,7 @@ class OpCostModel:
         # replayed on another
         self.device_key = device_key
         self.measured_hits = 0  # cost() calls answered by a measurement
+        self.cost_hits = 0      # cost() calls answered by the node_key cache
         self._persistent: Dict[str, float] = {}
         self._dirty = False
         if cache_path:
@@ -165,6 +212,7 @@ class OpCostModel:
         key = op.node_key()
         hit = self.cache.get(key)
         if hit is not None:
+            self.cost_hits += 1
             return hit
         cm = self._analytic(op)
         measured = self._measured(op, key)
@@ -302,6 +350,15 @@ class Simulator:
         # flat 2*size/BW, reference default_estimate_sync_cost
         # simulator.cc:786-813 + ParameterSyncType::PS optimizer.h:47)
         self.parameter_sync = parameter_sync
+        # (node_key, mesh signature, training) -> OpTerms: per-op
+        # contribution terms for the delta/memoized evaluator (the
+        # machine and sync mode are fixed per Simulator)
+        self._term_cache: Dict[Tuple, OpTerms] = {}
+        self.term_hits = 0
+        self.term_misses = 0
+        # (params, input shape) -> reconstructed member sub-ops for
+        # FUSED_PARALLEL costing (rebuilt on every call before)
+        self._fused_members: Dict[Tuple, List[Op]] = {}
 
     # -- comm costs ------------------------------------------------------
     def _collective_time(self, kind: str, size: int, group_len: int,
@@ -354,15 +411,22 @@ class Simulator:
             # one boundary, but each fused member still moves its bytes
             # (reference estimate_xfer_cost on FusedParallelOp walks the
             # member ops); shape propagates member to member
-            from ..parallel.parallel_op import PARALLEL_OP_KINDS
-            from ..tensor import ParallelTensor
+            key = (op.params, inp)
+            members = self._fused_members.get(key)
+            if members is None:
+                from ..parallel.parallel_op import PARALLEL_OP_KINDS
+                from ..tensor import ParallelTensor
 
+                members = []
+                shape = inp
+                for kind, params in op.params.ops:
+                    sub = PARALLEL_OP_KINDS[kind](params, [ParallelTensor(shape)])
+                    members.append(sub)
+                    shape = sub.outputs[0].shape
+                self._fused_members[key] = members
             total = 0.0
-            shape = inp
-            for kind, params in op.params.ops:
-                sub = PARALLEL_OP_KINDS[kind](params, [ParallelTensor(shape)])
+            for sub in members:
                 total += self.xfer_cost(sub, mesh_axes)
-                shape = sub.outputs[0].shape
             return max(total, _KERNEL_OVERHEAD)
         return _KERNEL_OVERHEAD
 
@@ -403,6 +467,77 @@ class Simulator:
                 if rep > 1 and w.create_gradients:
                     total += self.sync_time(w.shape.shard_bytes(), rep)
         return total
+
+    # -- per-op contribution terms (delta-sim decomposition) -------------
+    def op_terms(self, op: Op, mesh_axes: Dict[str, int],
+                 training: bool = True, skip_compute: bool = False) -> OpTerms:
+        """All of `op`'s additive contributions to simulate(), cached by
+        (node_key, mesh signature, training).  node_key already encodes
+        params + ShardConfig + input parallel shapes, so a strategy move
+        that leaves an op's config and input shapes unchanged reuses its
+        terms across candidates.  skip_compute: the op's compute is
+        covered by a measured segment — don't run (or cache-measure) the
+        per-op cost model for a term the aggregation will discard."""
+        key = (op.node_key(), tuple(sorted(mesh_axes.items())), training,
+               skip_compute)
+        hit = self._term_cache.get(key)
+        if hit is not None:
+            self.term_hits += 1
+            return hit
+        self.term_misses += 1
+        compute = xfer = partial = grad_sync = opt_numel = 0.0
+        mem_weights = mem_residual = mem_transient = 0
+        if op.op_type != OperatorType.INPUT:
+            if op.is_parallel_op():
+                xfer = self.xfer_cost(op, mesh_axes)
+            else:
+                partial = self.partial_sum_cost(op, mesh_axes)
+                if not skip_compute:
+                    cm = self.cost_model.cost(op)
+                    compute = cm.forward_time + (
+                        cm.backward_time if training else 0.0
+                    )
+        for w in op.weights:
+            sb = w.shape.shard_bytes()
+            mem_weights += sb
+            if w.create_gradients:
+                opt_numel += sb / max(
+                    1, np.dtype(w.shape.dtype.np_dtype).itemsize
+                )
+                rep = w.shape.replica_degree
+                if rep > 1:
+                    grad_sync += self.sync_time(sb, rep)
+        for t in op.outputs:
+            b = t.shape.shard_bytes()
+            if op.op_type in self._FUSED_ACT_TYPES:
+                mem_transient = max(mem_transient, b)
+            else:
+                mem_residual += b
+        terms = OpTerms(
+            compute=compute, xfer=xfer, partial=partial,
+            grad_sync=grad_sync, opt_numel=opt_numel,
+            mem_weights=mem_weights, mem_residual=mem_residual,
+            mem_transient=mem_transient,
+        )
+        self._term_cache[key] = terms
+        return terms
+
+    def memory_from_terms(self, ops: Sequence[Op], mesh_axes: Dict[str, int],
+                          training: bool = True) -> int:
+        """per_device_memory re-aggregated from cached OpTerms — exact
+        for the training non-remat accounting (weights + residual sum +
+        transient max; all integer bytes, so order-independent).  The
+        remat and inference liveness models need whole-graph structure
+        and keep using per_device_memory()."""
+        weights = residuals = transient = 0
+        for op in ops:
+            terms = self.op_terms(op, mesh_axes, training)
+            weights += terms.mem_weights
+            residuals += terms.mem_residual
+            transient = max(transient, terms.mem_transient)
+        if training:
+            weights *= 2 + self.optimizer_slots
+        return int(weights + residuals + transient)
 
     # -- memory ----------------------------------------------------------
 
@@ -542,33 +677,68 @@ class Simulator:
                 seg_cost_total += c
                 for g in guids:
                     measured_ops[g] = c
+        topo = graph.topo_order()
+        if training and not self.remat:
+            memory_fn = lambda: self.memory_from_terms(  # noqa: E731
+                topo, mesh_axes, training
+            )
+        else:
+            memory_fn = lambda: self.per_device_memory(  # noqa: E731
+                graph, training
+            )
+        return self.simulate_ops(
+            topo, mesh_axes, training=training, measured_ops=measured_ops,
+            seg_cost_total=seg_cost_total, memory_fn=memory_fn,
+        )
+
+    def simulate_ops(
+        self,
+        ops: Sequence[Op],
+        mesh_axes: Dict[str, int],
+        training: bool = True,
+        measured_ops: Optional[Dict[int, float]] = None,
+        seg_cost_total: float = 0.0,
+        memory_fn: Optional[Callable[[], int]] = None,
+    ) -> SimResult:
+        """Aggregate cached per-op terms over `ops` (a topo-ordered op
+        sequence).  The ONE aggregation path shared by full and delta
+        evaluations: the invariant delta_eval(state) == full_eval(state)
+        holds bit-for-bit because both sum identical cached OpTerms in
+        identical order."""
+        measured_ops = measured_ops or {}
         compute = seg_cost_total if training else seg_cost_total / 3.0
         analytic_compute = 0.0  # compute_scale applies ONLY here —
         # measured segment costs are already real backend seconds
         comm = 0.0
+        sync = 0.0
+        opt_numel = 0.0
         breakdown: Dict[str, float] = {}
-        for op in graph.topo_order():
+        for op in ops:
             if op.op_type == OperatorType.INPUT:
                 continue
+            terms = self.op_terms(op, mesh_axes, training,
+                                  skip_compute=op.guid in measured_ops)
+            if training:
+                sync += terms.grad_sync
+                opt_numel += terms.opt_numel
             if op.is_parallel_op():
-                c = self.xfer_cost(op, mesh_axes)
-                comm += c
-                breakdown[op.name] = c
+                comm += terms.xfer
+                breakdown[op.name] = terms.xfer
                 continue
-            ps = self.partial_sum_cost(op, mesh_axes)
+            ps = terms.partial
             if training and ps:
                 ps *= 2.0  # fwd psum + bwd mirrored all-gather/psum
             comm += ps
             if op.guid in measured_ops:
                 breakdown[op.name] = ps
                 continue
-            cm = self.cost_model.cost(op)
-            t = cm.forward_time + (cm.backward_time if training else 0.0)
-            analytic_compute += t
-            breakdown[op.name] = t + ps
+            analytic_compute += terms.compute
+            breakdown[op.name] = terms.compute + ps
         if training:
-            analytic_compute += self.optimizer_update_cost(graph)
-        sync = self.grad_sync_cost(graph, mesh_axes) if training else 0.0
+            # weight-update pass (optimizer_update_cost, from cached
+            # per-op numel terms)
+            bytes_moved = opt_numel * 4.0 * (3 + self.optimizer_slots)
+            analytic_compute += bytes_moved / self.machine.device().hbm_bandwidth
         # XLA overlaps collectives with independent compute; gradient
         # sync gets its own credit when backward/update overlap is
         # modeled (--search-overlap-backward-update)
@@ -583,6 +753,6 @@ class Simulator:
             compute_time=compute,
             comm_time=comm,
             sync_time=sync,
-            per_device_memory=self.per_device_memory(graph, training),
             breakdown=breakdown,
+            memory_fn=memory_fn,
         )

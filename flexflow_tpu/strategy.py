@@ -142,6 +142,38 @@ def data_parallel_strategy(num_devices: int) -> Strategy:
     return s
 
 
+def reapply_op(op: Op, new_inputs: Sequence, strategy: Strategy) -> Op:
+    """Re-instantiate one frontend op under a strategy's ShardConfig —
+    the unit apply step shared by apply_strategy and the incremental
+    evaluator (pcg/evaluator.py), so search-time costing and execution
+    can never instantiate ops differently."""
+    if op.op_type == OperatorType.INPUT:
+        return type(op)(op.params, [], name=op.name)
+    shard = strategy.shard_configs.get(op.name, ShardConfig())
+    return type(op)(op.params, list(new_inputs), name=op.name, shard=shard,
+                    **op.ctor_kwargs())
+
+
+def edge_chain_for(op: Op, out, strategy: Strategy,
+                   input_chain: List) -> List:
+    """The parallel-op chain a strategy inserts after one output tensor
+    (INPUT ops fall back to the __inputs__ chain)."""
+    if op.op_type == OperatorType.INPUT:
+        return strategy.edge_ops.get(out.name, input_chain)
+    return strategy.edge_ops.get(out.name, [])
+
+
+def build_edge_chain(pt, chain, add_op):
+    """Instantiate a parallel-op chain on `pt`, handing each new op to
+    `add_op`; returns the chain's final output tensor."""
+    for kind, pdict in chain:
+        params = _PARAM_CLASSES[kind](**dict(pdict))
+        pop = PARALLEL_OP_KINDS[kind](params, [pt], name=f"{kind}_{pt.name}")
+        add_op(pop)
+        pt = pop.outputs[0]
+    return pt
+
+
 def apply_strategy(graph: Graph, strategy: Strategy) -> Graph:
     """Rebuild the frontend PCG under a strategy.
 
@@ -153,33 +185,18 @@ def apply_strategy(graph: Graph, strategy: Strategy) -> Graph:
     """
     new_graph = Graph()
     tensor_map: Dict[int, object] = {}  # old tensor guid -> new ParallelTensor
-
-    def apply_edge_chain(pt, chain):
-        for kind, pdict in chain:
-            cls = PARALLEL_OP_KINDS[kind]
-            params = _PARAM_CLASSES[kind](**pdict)
-            pop = cls(params, [pt], name=f"{kind}_{pt.name}")
-            new_graph.add_op(pop)
-            pt = pop.outputs[0]
-        return pt
-
     input_chain = strategy.edge_ops.get("__inputs__", [])
     for op in graph.topo_order():
         if op.op_type == OperatorType.INPUT:
-            new_op = type(op)(op.params, [], name=op.name)
+            new_op = reapply_op(op, [], strategy)
             new_graph.add_op(new_op)
-            pt = new_op.outputs[0]
-            chain = strategy.edge_ops.get(op.outputs[0].name, input_chain)
-            pt = apply_edge_chain(pt, chain)
-            tensor_map[op.outputs[0].guid] = pt
+            chain = edge_chain_for(op, op.outputs[0], strategy, input_chain)
+            tensor_map[op.outputs[0].guid] = build_edge_chain(
+                new_op.outputs[0], chain, new_graph.add_op
+            )
             continue
-        new_inputs = []
-        for t in op.inputs:
-            pt = tensor_map[t.guid]
-            new_inputs.append(pt)
-        shard = strategy.shard_configs.get(op.name, ShardConfig())
-        new_op = type(op)(op.params, new_inputs, name=op.name, shard=shard,
-                          **op.ctor_kwargs())
+        new_inputs = [tensor_map[t.guid] for t in op.inputs]
+        new_op = reapply_op(op, new_inputs, strategy)
         # carry user-supplied initializers and grad flags from the frontend op
         old_by_name = {s.name: s for s in op.weight_specs}
         new_op.weight_specs = [
@@ -192,21 +209,28 @@ def apply_strategy(graph: Graph, strategy: Strategy) -> Graph:
             new_out.create_gradients = old_out.create_gradients
         new_graph.add_op(new_op)
         for old_out, new_out in zip(op.outputs, new_op.outputs):
-            chain = strategy.edge_ops.get(old_out.name, [])
-            tensor_map[old_out.guid] = apply_edge_chain(new_out, chain)
-            if not chain:
-                tensor_map[old_out.guid] = new_out
+            chain = edge_chain_for(op, old_out, strategy, input_chain)
+            tensor_map[old_out.guid] = build_edge_chain(
+                new_out, chain, new_graph.add_op
+            )
     return new_graph
+
+
+def assign_op_views(op: Op, mesh_axes: Dict[str, int]):
+    """Assign MachineViews to one op's outputs and weights — the unit
+    step of assign_views, also used by the incremental evaluator to
+    re-view only a delta's rebuilt frontier (pcg/evaluator.py)."""
+    for pt in list(op.outputs) + list(op.weights):
+        try:
+            view = assign_axes(pt.shape, mesh_axes)
+            validate_view(view, pt.shape, mesh_axes)
+        except ValueError as e:
+            raise ValueError(f"{pt.name} {pt.shape}: {e}") from e
+        pt.machine_view = view
 
 
 def assign_views(graph: Graph, mesh_axes: Dict[str, int]):
     """Assign a MachineView to every tensor by factoring its degrees onto
     the mesh axes (the view normalizer; SURVEY §7 hard part 4)."""
     for op in graph.topo_order():
-        for pt in list(op.outputs) + list(op.weights):
-            try:
-                view = assign_axes(pt.shape, mesh_axes)
-                validate_view(view, pt.shape, mesh_axes)
-            except ValueError as e:
-                raise ValueError(f"{pt.name} {pt.shape}: {e}") from e
-            pt.machine_view = view
+        assign_op_views(op, mesh_axes)

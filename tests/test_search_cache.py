@@ -1,0 +1,162 @@
+"""Incremental-evaluator property tests (ISSUE 1 tentpole invariant):
+delta evaluation must match full evaluation bit-for-bit across random
+move sequences, the strategy memo must answer revisited states, and the
+memo+delta path must do measurably fewer full-graph simulations than
+evaluations on a fixed-seed BERT-base search."""
+import random
+
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.models.moe import build_moe_encoder
+from flexflow_tpu.models.transformer import build_bert, build_transformer
+from flexflow_tpu.pcg.evaluator import IncrementalEvaluator, strategy_signature
+from flexflow_tpu.pcg.mcmc import MCMCSearch
+from flexflow_tpu.sim.machine_model import TpuPodModel
+from flexflow_tpu.sim.simulator import Simulator
+from flexflow_tpu.strategy import Strategy, data_parallel_strategy
+
+
+def _transformer():
+    ff = FFModel(FFConfig())
+    build_transformer(ff, batch_size=4, seq_length=16, hidden_size=32,
+                      num_layers=2, num_heads=4)
+    return ff
+
+
+def _moe():
+    ff = FFModel(FFConfig())
+    build_moe_encoder(ff, batch_size=4, seq_length=8, hidden_size=32,
+                      num_layers=2, num_heads=4, num_exp=4, num_select=2)
+    return ff
+
+
+def _machine():
+    return TpuPodModel(topology=(8,))
+
+
+def _random_strategies(graph, n_moves=60, seed=7):
+    """A seeded MCMC-like move sequence: mostly single-op ShardConfig
+    flips (delta-eligible), occasional mesh refactorizations (full
+    re-evals), with revisits (memo hits) by construction."""
+    search = MCMCSearch(graph, 8, lambda: Simulator(_machine()), budget=0)
+    rng = random.Random(seed)
+    dp, tp, ep = 8, 1, 1
+    flags = {}
+    out = [search._build(dp, tp, ep, flags)]
+    for _ in range(n_moves):
+        if rng.random() < 0.2 or not search.candidates:
+            dp, tp, ep = rng.choice(search.factorizations)
+        else:
+            c = rng.choice(search.candidates)
+            flags[c.name] = not flags.get(c.name, False)
+        out.append(search._build(dp, tp, ep, dict(flags)))
+    return out
+
+
+@pytest.mark.parametrize("build", [_transformer, _moe],
+                         ids=["transformer", "moe"])
+def test_delta_eval_matches_full_eval_bit_for_bit(build):
+    """delta_eval(state) == full_eval(state), exactly, for every state
+    of a random move sequence — including the lazy memory term."""
+    graph = build().layers
+    ev_delta = IncrementalEvaluator(graph, Simulator(_machine()),
+                                    use_cache=True)
+    ev_full = IncrementalEvaluator(graph, Simulator(_machine()),
+                                   use_cache=False)
+    legal = 0
+    for s in _random_strategies(graph):
+        rd = ev_delta.evaluate(s)
+        rf = ev_full.evaluate(s)
+        assert (rd is None) == (rf is None)
+        if rd is None:
+            continue
+        legal += 1
+        assert rd.total_time == rf.total_time
+        assert rd.compute_time == rf.compute_time
+        assert rd.comm_time == rf.comm_time
+        assert rd.sync_time == rf.sync_time
+        assert rd.per_device_memory == rf.per_device_memory
+    assert legal > 10
+    assert ev_delta.stats.delta_evals > 0
+    assert ev_delta.stats.memo_hits > 0
+    assert ev_full.stats.full_evals == ev_full.stats.evals - \
+        ev_full.stats.illegal_evals
+    st = ev_delta.stats
+    assert st.memo_hits + st.full_evals + st.delta_evals + \
+        st.illegal_evals == st.evals
+
+
+def test_memo_hit_on_revisited_strategy():
+    graph = _transformer().layers
+    ev = IncrementalEvaluator(graph, Simulator(_machine()), use_cache=True)
+    s = data_parallel_strategy(4)
+    r1 = ev.evaluate(s)
+    r2 = ev.evaluate(Strategy.from_json(s.to_json()))  # equal, distinct obj
+    assert r1 is r2  # answered by the memo, not re-simulated
+    assert ev.stats.memo_hits == 1 and ev.stats.full_evals == 1
+    assert strategy_signature(s) == strategy_signature(
+        Strategy.from_json(s.to_json())
+    )
+
+
+def test_signature_normalizes_trivial_configs():
+    from flexflow_tpu.ops.op import ShardConfig
+
+    a = data_parallel_strategy(8)
+    b = data_parallel_strategy(8)
+    b.shard_configs["fc_anything"] = ShardConfig()  # trivial == absent
+    assert strategy_signature(a) == strategy_signature(b)
+    c = data_parallel_strategy(8)
+    c.shard_configs["fc_anything"] = ShardConfig(channel=2)
+    assert strategy_signature(a) != strategy_signature(c)
+
+
+def test_mcmc_cached_matches_uncached_search():
+    """Same seed, same budget: the memoized+delta search must return the
+    same best strategy at the same cost as the always-full evaluator,
+    while doing fewer full simulations."""
+    machine = _machine()
+    ff1, ff2 = _transformer(), _transformer()
+    s1 = MCMCSearch(ff1.layers, 8, lambda: Simulator(machine), budget=40,
+                    seed=3)
+    s2 = MCMCSearch(ff2.layers, 8, lambda: Simulator(machine), budget=40,
+                    seed=3, use_eval_cache=False)
+    b1, b2 = s1.optimize(), s2.optimize()
+    assert b1.search_stats["evals"] == s1.stats.evals  # riding the result
+    assert b1.mesh_axes == b2.mesh_axes
+    assert b1.shard_configs == b2.shard_configs
+    assert s1.evaluate(b1) == s2.evaluate(b2)
+    assert s1.stats.full_evals < s1.stats.evals
+    assert s1.stats.memo_hits > 0
+
+
+@pytest.mark.slow
+def test_mcmc_bert_base_throughput_guard():
+    """Search-throughput smoke test (ISSUE 1 CI satellite): a fixed-seed
+    200-eval MCMC search on BERT-base must answer most evaluations from
+    the memo or the delta path — full-graph simulations strictly fewer
+    than evaluations — and still return the exact result of the
+    always-full reference evaluator."""
+    machine = _machine()
+
+    def bert():
+        ff = FFModel(FFConfig())
+        build_bert(ff)  # BERT-base dims (hidden 768, 12 layers)
+        return ff
+
+    fast = MCMCSearch(bert().layers, 8, lambda: Simulator(machine),
+                      budget=200, seed=0)
+    best = fast.optimize()
+    st = fast.stats
+    assert st.memo_hits + st.full_evals + st.delta_evals + \
+        st.illegal_evals == st.evals
+    assert st.full_evals < st.evals, st.summary()  # the cache regression guard
+    assert st.memo_hits > 0 and st.delta_evals > 0, st.summary()
+
+    ref = MCMCSearch(bert().layers, 8, lambda: Simulator(machine),
+                     budget=200, seed=0, use_eval_cache=False)
+    best_ref = ref.optimize()
+    assert best.mesh_axes == best_ref.mesh_axes
+    assert best.shard_configs == best_ref.shard_configs
+    assert fast.evaluate(best) == ref.evaluate(best_ref)
