@@ -39,6 +39,12 @@ from .metrics import Metrics, PerfMetrics
 from .model import FFModel
 from .optimizer import AdamOptimizer, SGDOptimizer
 from .recompile import RecompileState
+from .resilience import (
+    FaultKind,
+    FaultPlan,
+    RetryPolicy,
+    TrainingSupervisor,
+)
 from .strategy import Strategy, data_parallel_strategy
 from .tensor import ParallelDim, ParallelTensor, ParallelTensorShape, Tensor
 

@@ -12,11 +12,16 @@ restore handles the re-layout).
 from __future__ import annotations
 
 import json
+import logging
 import os
+import re
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+_log = logging.getLogger("flexflow_tpu.checkpoint")
 
 
 def _meta(ff, step: int) -> Dict[str, Any]:
@@ -80,13 +85,39 @@ class CheckpointManager:
     def restore(self, ff, step: Optional[int] = None) -> int:
         """Load a step (default: latest) into a compiled FFModel,
         resharding every leaf to the current executor's shardings.
-        Returns the restored step."""
-        ocp = self._ocp
-        if step is None:
-            step = self._mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        Returns the restored step.
 
+        With step=None a corrupt/partial latest checkpoint is skipped
+        and the previous one restored instead (the crash that truncated
+        the write is usually the crash being recovered from); an
+        explicitly requested step stays strict."""
+        if step is not None:
+            return self._restore_step(ff, step)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        last_err: Optional[Exception] = None
+        for s in steps:
+            try:
+                restored = self._restore_step(ff, s)
+            except Exception as e:  # noqa: BLE001 — orbax raises various
+                _log.warning(
+                    "checkpoint step %d in %s unrestorable (%s); "
+                    "falling back to the previous step", s, self.directory, e,
+                )
+                last_err = e
+                continue
+            if last_err is not None:
+                _log.warning(
+                    "restored OLDER step %d from %s — newer step(s) were "
+                    "corrupt/partial, their progress is lost",
+                    restored, self.directory,
+                )
+            return restored
+        raise last_err
+
+    def _restore_step(self, ff, step: int) -> int:
+        ocp = self._ocp
         target = {
             "weights": ff._weights,
             "opt_state": ff._opt_state,
@@ -131,6 +162,159 @@ class CheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+
+# -- orbax-free full-state checkpoints ----------------------------------
+
+_STEP_DIR_RE = re.compile(r"step_(\d{8})")
+
+
+class LocalCheckpointManager:
+    """Self-contained full-train-state checkpoints without orbax: one
+    flat .npz + meta.json per step.
+
+    Robustness contract (the supervisor's default backend):
+      * atomic writes — each step is staged in a `.tmp-*` dir and
+        `os.replace`d into place, so a crash mid-save never leaves a
+        half-written step dir that parses as a checkpoint;
+      * keep-last-k retention with pruning of older step dirs;
+      * restore detects a corrupt/partial latest step (unreadable npz,
+        missing meta, missing leaves) and falls back to the previous
+        one, oldest-surviving last.
+
+    Restore device_puts every leaf onto the model's CURRENT shardings,
+    so a checkpoint taken on one mesh resumes on another (the same
+    reshard-on-restore contract as the orbax manager) — this is what
+    carries trained state onto the surviving mesh after a device loss.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        if max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+        # tmp dirs from a writer that died mid-save are dead weight
+        for name in os.listdir(self.directory):
+            if name.startswith(".tmp-"):
+                shutil.rmtree(
+                    os.path.join(self.directory, name), ignore_errors=True
+                )
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:08d}")
+
+    def all_steps(self):
+        out = []
+        for name in os.listdir(self.directory):
+            m = _STEP_DIR_RE.fullmatch(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    @staticmethod
+    def _state_tree(ff):
+        return {
+            "weights": ff._weights,
+            "opt_state": ff._opt_state,
+            "op_state": ff._state,
+            "rng": jax.random.key_data(ff._rng),
+        }
+
+    # -- save -----------------------------------------------------------
+    def save(self, ff, step: int, wait: bool = True):
+        from jax.tree_util import keystr, tree_flatten_with_path
+
+        tree = jax.tree.map(np.asarray, self._state_tree(ff))
+        leaves, _ = tree_flatten_with_path(tree)
+        flat = {keystr(path): leaf for path, leaf in leaves}
+        tmp = os.path.join(self.directory, f".tmp-{step}-{os.getpid()}")
+        os.makedirs(tmp)
+        try:
+            np.savez(os.path.join(tmp, "state.npz"), **flat)
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(_meta(ff, step), f)
+            final = self._path(step)
+            if os.path.exists(final):
+                # a restored run replaying past an old cadence point
+                # re-saves the same step; the fresh write wins
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._prune()
+
+    def _prune(self):
+        steps = self.all_steps()
+        for s in steps[: -self.max_to_keep]:
+            shutil.rmtree(self._path(s), ignore_errors=True)
+
+    # -- restore --------------------------------------------------------
+    def restore(self, ff, step: Optional[int] = None) -> int:
+        """Load a step (default: latest, falling back past corrupt ones)
+        into a compiled FFModel, resharding every leaf onto the current
+        executor's shardings.  Returns the restored step."""
+        from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = list(reversed(self.all_steps()))
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        last_err: Optional[Exception] = None
+        for s in candidates:
+            try:
+                with open(os.path.join(self._path(s), "meta.json")) as f:
+                    json.load(f)  # meta must parse for the step to count
+                with np.load(os.path.join(self._path(s), "state.npz")) as data:
+                    target = self._state_tree(ff)
+                    leaves, treedef = tree_flatten_with_path(target)
+                    new_leaves = []
+                    for path, cur in leaves:
+                        arr = data[keystr(path)]  # KeyError -> partial ckpt
+                        sh = getattr(cur, "sharding", None)
+                        new_leaves.append(
+                            jax.device_put(arr, sh) if sh is not None else arr
+                        )
+            except Exception as e:  # unreadable/partial -> previous step
+                _log.warning(
+                    "checkpoint step %d in %s unrestorable (%s); "
+                    "falling back to the previous step", s, self.directory, e,
+                )
+                last_err = e
+                continue
+            if last_err is not None:
+                _log.warning(
+                    "restored OLDER step %d from %s — newer step(s) were "
+                    "corrupt/partial, their progress is lost",
+                    s, self.directory,
+                )
+            restored = tree_unflatten(treedef, new_leaves)
+            ff._weights = restored["weights"]
+            ff._opt_state = restored["opt_state"]
+            ff._state = restored["op_state"]
+            ff._rng = jax.random.wrap_key_data(restored["rng"])
+            if hasattr(ff, "sync_decode_pos"):
+                ff.sync_decode_pos()
+            return int(s)
+        raise last_err
+
+    def restore_meta(self, step: Optional[int] = None) -> Dict[str, Any]:
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        with open(os.path.join(self._path(step), "meta.json")) as f:
+            return dict(json.load(f))
+
+    def close(self):
+        pass
 
 
 # -- plain numpy weight files (reference-parity path) -------------------

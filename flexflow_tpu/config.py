@@ -30,6 +30,10 @@ from .fftype import ParameterSyncType
 # kernel at every length on the same chip.
 DEFAULT_FLASH_MIN_SEQ = 2048
 
+# valid FFConfig.nan_policy values (consumed by the resilience
+# supervisor's step-health handling, resilience/supervisor.py)
+NAN_POLICIES = ("raise", "skip_step", "restore")
+
 
 @dataclasses.dataclass
 class FFConfig:
@@ -127,6 +131,40 @@ class FFConfig:
     export_compgraph_file: Optional[str] = None
     include_costs_dot_graph: bool = False
 
+    # -- resilience (resilience/supervisor.py): checkpoint cadence,
+    #    restart budget, retry backoff, and non-finite-loss policy.
+    #    The reference has no analogue — it leans on Legion for fault
+    #    handling; these knobs drive the TPU-native supervisor.
+    checkpoint_every: int = 0  # steps between periodic checkpoints; 0 = off
+    checkpoint_dir: Optional[str] = None
+    checkpoint_keep: int = 3   # keep-last-k retention
+    max_restarts: int = 3      # restore-and-retry budget per run
+    retry_backoff: float = 0.1  # base backoff seconds (exponential, jittered)
+    nan_policy: str = "raise"  # raise | skip_step | restore
+
+    def __post_init__(self):
+        if self.nan_policy not in NAN_POLICIES:
+            raise ValueError(
+                f"nan_policy must be one of {NAN_POLICIES}, "
+                f"got {self.nan_policy!r}"
+            )
+        if self.checkpoint_every < 0:
+            raise ValueError(
+                f"checkpoint_every must be >= 0, got {self.checkpoint_every}"
+            )
+        if self.checkpoint_keep < 1:
+            raise ValueError(
+                f"checkpoint_keep must be >= 1, got {self.checkpoint_keep}"
+            )
+        if self.max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {self.max_restarts}"
+            )
+        if self.retry_backoff < 0:
+            raise ValueError(
+                f"retry_backoff must be >= 0, got {self.retry_backoff}"
+            )
+
     def should_calibrate(self) -> bool:
         """Resolve search_calibrate's auto mode: measured costs when a
         real accelerator backend is live, analytic roofline otherwise."""
@@ -202,6 +240,18 @@ class FFConfig:
         p.add_argument("--taskgraph", type=str, default=None)
         p.add_argument("--compgraph", type=str, default=None)
         p.add_argument("--include-costs-dot-graph", action="store_true")
+        p.add_argument("--checkpoint-every", dest="checkpoint_every",
+                       type=int, default=0)
+        p.add_argument("--checkpoint-dir", dest="checkpoint_dir", type=str,
+                       default=None)
+        p.add_argument("--checkpoint-keep", dest="checkpoint_keep", type=int,
+                       default=3)
+        p.add_argument("--max-restarts", dest="max_restarts", type=int,
+                       default=3)
+        p.add_argument("--retry-backoff", dest="retry_backoff", type=float,
+                       default=0.1)
+        p.add_argument("--nan-policy", dest="nan_policy", type=str,
+                       default="raise", choices=NAN_POLICIES)
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -241,6 +291,12 @@ class FFConfig:
             export_taskgraph_file=args.taskgraph,
             export_compgraph_file=args.compgraph,
             include_costs_dot_graph=args.include_costs_dot_graph,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_keep=args.checkpoint_keep,
+            max_restarts=args.max_restarts,
+            retry_backoff=args.retry_backoff,
+            nan_policy=args.nan_policy,
         )
 
 

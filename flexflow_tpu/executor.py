@@ -34,6 +34,32 @@ from .parallel.machine import view_to_spec
 from .pcg.graph import Graph
 
 
+class NonFiniteLossError(RuntimeError):
+    """A train/eval step produced a non-finite (NaN/inf) loss.
+
+    Raised by `check_step_health`; the resilience supervisor maps it to
+    FFConfig.nan_policy (raise | skip_step | restore)."""
+
+    def __init__(self, loss: float, step: Optional[int] = None):
+        self.loss = loss
+        self.step = step
+        where = f" at step {step}" if step is not None else ""
+        super().__init__(f"non-finite loss {loss!r}{where}")
+
+
+def check_step_health(metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+    """Step health hook: raise NonFiniteLossError when the step's loss
+    is NaN/inf.  Reads the metrics dict a step function returned (this
+    blocks on the device value — callers that poll every step, like the
+    supervisor, already pay that sync to record the loss)."""
+    loss = metrics.get("loss") if isinstance(metrics, dict) else None
+    if loss is None:
+        return
+    val = float(np.asarray(loss))
+    if not np.isfinite(val):
+        raise NonFiniteLossError(val, step=step)
+
+
 class GraphExecutor:
     """Compiles a PCG + strategy into init/step callables on a mesh."""
 

@@ -61,3 +61,9 @@ class RecursiveLogger:
 
 
 search_logger = RecursiveLogger("flexflow_tpu.search")
+
+# supervisor observability (resilience/supervisor.py): restarts,
+# retries, lost/skipped steps, checkpoint latency — emitted through
+# `counters` so bench runs can scrape recovery overhead the same way
+# they scrape search throughput
+resilience_logger = RecursiveLogger("flexflow_tpu.resilience")

@@ -117,6 +117,20 @@ from .strategy import (
 from .tensor import ParallelTensor, ParallelTensorShape
 
 
+def device_put_like(saved, current):
+    """device_put each saved leaf onto the matching current leaf's
+    sharding — the carry idiom shared by recompile and the resilience
+    supervisor's rollback."""
+    return jax.tree.map(
+        lambda v, cur: (
+            jax.device_put(v, cur.sharding)
+            if getattr(cur, "sharding", None) is not None
+            else v
+        ),
+        saved, current,
+    )
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         self.config = config or FFConfig()
@@ -946,6 +960,42 @@ class FFModel:
             cb.on_train_end(self)
         return history
 
+    def fit_resilient(
+        self,
+        x: Union[np.ndarray, Sequence[np.ndarray], Dict[str, np.ndarray]],
+        y: np.ndarray,
+        num_steps: Optional[int] = None,
+        epochs: Optional[int] = None,
+        batch_size: Optional[int] = None,
+        directory: Optional[str] = None,
+        fault_plan=None,
+        retry=None,
+    ):
+        """`fit` under the resilience supervisor: periodic checkpoints,
+        restore-and-retry on transient failures, and elastic re-search +
+        recompile on device loss (resilience/supervisor.py; knobs from
+        FFConfig: checkpoint_every/checkpoint_keep/max_restarts/
+        retry_backoff/nan_policy).  Step-indexed and unshuffled so an
+        interrupted run replays bit-identically on the same mesh.
+        Returns a SupervisorReport."""
+        from .resilience import TrainingSupervisor
+
+        assert self._step_fn is not None, "call compile() first"
+        batch_size = batch_size or self.config.batch_size
+        directory = directory or self.config.checkpoint_dir
+        if directory is None:
+            raise ValueError(
+                "fit_resilient needs a checkpoint directory: pass "
+                "directory= or set FFConfig.checkpoint_dir/--checkpoint-dir"
+            )
+        if num_steps is None:
+            num_batches = len(y) // batch_size
+            num_steps = num_batches * (epochs or self.config.epochs)
+        supervisor = TrainingSupervisor(
+            self, directory, fault_plan=fault_plan, retry=retry
+        )
+        return supervisor.run(x, y, num_steps=num_steps, batch_size=batch_size)
+
     # reference-parity step pieces (model.h:767-811) — all folded into the
     # single jitted step; kept as explicit methods for API compatibility.
     def init_operators(self):
@@ -1084,17 +1134,8 @@ class FFModel:
             devices=devices if devices is not None else args["devices"],
         )
         self.set_weights(saved_w)
-
-        def reput(saved, current):
-            return jax.tree.map(
-                lambda v, cur: jax.device_put(
-                    v, getattr(cur, "sharding", None)
-                ) if getattr(cur, "sharding", None) is not None else v,
-                saved, current,
-            )
-
-        self._opt_state = reput(saved_opt, self._opt_state)
-        self._state = reput(saved_state, self._state)
+        self._opt_state = device_put_like(saved_opt, self._opt_state)
+        self._state = device_put_like(saved_state, self._state)
         self._rng = saved_rng
 
     def recompile_on_condition(self, r) -> bool:

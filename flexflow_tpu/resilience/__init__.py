@@ -1,0 +1,36 @@
+"""Resilience subsystem: deterministic fault injection (faults.py),
+retry/backoff supervision (retry.py), and a training supervisor that
+composes checkpoints, recompile, and the strategy search into elastic
+recovery on a degraded mesh (supervisor.py).  See docs/RESILIENCE.md.
+"""
+from .faults import (
+    CheckpointWriteFault,
+    DeviceLossFault,
+    Fault,
+    FaultKind,
+    FaultPlan,
+    InjectedFault,
+    PreemptionFault,
+    StepFault,
+)
+from .retry import RetryPolicy
+from .supervisor import (
+    RestartBudgetExhausted,
+    SupervisorReport,
+    TrainingSupervisor,
+)
+
+__all__ = [
+    "CheckpointWriteFault",
+    "DeviceLossFault",
+    "Fault",
+    "FaultKind",
+    "FaultPlan",
+    "InjectedFault",
+    "PreemptionFault",
+    "StepFault",
+    "RetryPolicy",
+    "RestartBudgetExhausted",
+    "SupervisorReport",
+    "TrainingSupervisor",
+]

@@ -1,0 +1,313 @@
+"""TrainingSupervisor: "a failure happened, recover and keep training".
+
+Composes three pieces the repo already had in isolation — checkpoints
+that reshard on restore (checkpoint.py), `FFModel.recompile` strategy
+swaps (recompile.py), and the strategy searches (pcg/search.py) — into
+a supervised training loop:
+
+  * periodic checkpoints at a configurable step cadence (plus an anchor
+    at step 0, so the very first failure has a restore target);
+  * on a transient failure (injected step exception / host preemption,
+    or a non-finite loss under nan_policy="restore"), restore the
+    latest checkpoint and retry under a jittered-backoff RetryPolicy
+    with a hard restart budget;
+  * on device loss, re-run the strategy search (unity or MCMC per
+    FFConfig, data-parallel fallback) on the SURVIVING mesh in the
+    spirit of P²'s re-placement, `recompile()` onto the shrunken
+    device set, and carry weights/optimizer state over via the
+    checkpoint's reshard-on-restore — training continues at full
+    remaining-hardware speed under a freshly searched strategy.
+
+The loop is step-indexed and deterministic: batch `i` of a run is
+always rows [i*bs, (i+1)*bs) modulo the dataset (no shuffle), and the
+training RNG is checkpointed, so a crashed-and-restored run replays to
+weights BIT-IDENTICAL to an uninterrupted run at the same step count on
+the same mesh (tests/test_resilience.py enforces this).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..executor import NonFiniteLossError, check_step_health
+from ..logger import resilience_logger
+from .faults import (
+    CheckpointWriteFault,
+    DeviceLossFault,
+    FaultPlan,
+    PreemptionFault,
+    StepFault,
+)
+from .retry import RetryPolicy
+
+# failures the supervisor treats as restore-and-retry transients
+TRANSIENT_FAULTS = (StepFault, PreemptionFault)
+
+
+class RestartBudgetExhausted(RuntimeError):
+    """Raised when failures outrun RetryPolicy.max_restarts."""
+
+
+@dataclasses.dataclass
+class SupervisorReport:
+    """What a supervised run did: the step it reached, the per-step
+    losses actually recorded, and the counters dict (also logged via
+    RecursiveLogger.counters for bench runs to scrape)."""
+
+    final_step: int
+    losses: List[float]
+    counters: Dict[str, float]
+
+
+class TrainingSupervisor:
+    """Wraps a compiled FFModel's training loop with checkpointing,
+    retry/backoff recovery, and elastic re-search on device loss.
+
+    Knobs default from the model's FFConfig (checkpoint_every,
+    checkpoint_keep, max_restarts, retry_backoff, nan_policy); the
+    keyword arguments override per-supervisor.  `sleep` is injectable
+    so tests don't actually wait out backoffs; `search_fn(ff, n)`
+    overrides the strategy re-search on device loss.
+    """
+
+    def __init__(
+        self,
+        ff,
+        directory: str,
+        *,
+        checkpoint_every: Optional[int] = None,
+        keep: Optional[int] = None,
+        retry: Optional[RetryPolicy] = None,
+        fault_plan: Optional[FaultPlan] = None,
+        nan_policy: Optional[str] = None,
+        search_fn: Optional[Callable] = None,
+        backend: str = "local",
+        sleep: Callable[[float], None] = time.sleep,
+        logger=resilience_logger,
+    ):
+        from ..config import NAN_POLICIES
+
+        cfg = ff.config
+        self.ff = ff
+        self.checkpoint_every = (
+            cfg.checkpoint_every if checkpoint_every is None else checkpoint_every
+        )
+        self.retry = retry or RetryPolicy(
+            max_restarts=cfg.max_restarts,
+            base_backoff=cfg.retry_backoff,
+            seed=cfg.seed,
+        )
+        self.fault_plan = fault_plan or FaultPlan()
+        self.nan_policy = cfg.nan_policy if nan_policy is None else nan_policy
+        if self.nan_policy not in NAN_POLICIES:
+            raise ValueError(
+                f"nan_policy must be one of {NAN_POLICIES}, got {self.nan_policy!r}"
+            )
+        self.search_fn = search_fn
+        self.sleep = sleep
+        self.log = logger
+        keep = cfg.checkpoint_keep if keep is None else keep
+        if backend == "orbax":
+            from ..checkpoint import CheckpointManager
+
+            self.manager = CheckpointManager(directory, max_to_keep=keep)
+        elif backend == "local":
+            from ..checkpoint import LocalCheckpointManager
+
+            self.manager = LocalCheckpointManager(directory, max_to_keep=keep)
+        else:
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
+        self.counters: Dict[str, float] = {
+            "steps_run": 0,        # train_step invocations, replays included
+            "restarts": 0,         # restore events (transient + device loss)
+            "retries": 0,          # transient-failure retry attempts
+            "lost_steps": 0,       # steps of progress replayed after restores
+            "skipped_steps": 0,    # batches dropped under nan_policy=skip_step
+            "checkpoints": 0,
+            "checkpoint_failures": 0,
+            "checkpoint_time_s": 0.0,
+            "checkpoint_time_last_s": 0.0,
+            "device_losses": 0,
+            "re_searches": 0,
+        }
+
+    # -- deterministic batching -----------------------------------------
+    def _x_map(self, x) -> Dict[str, np.ndarray]:
+        input_ops = self.ff.layers.source_ops()
+        if isinstance(x, dict):
+            return dict(x)
+        if isinstance(x, (list, tuple)):
+            return {op.name: arr for op, arr in zip(input_ops, x)}
+        return {input_ops[0].name: x}
+
+    @staticmethod
+    def _batch(x_map, y, step: int, batch_size: int, num_batches: int):
+        i = step % num_batches
+        sl = slice(i * batch_size, (i + 1) * batch_size)
+        return {k: v[sl] for k, v in x_map.items()}, y[sl]
+
+    # -- checkpoint / restore -------------------------------------------
+    def _save_checkpoint(self, step: int) -> None:
+        self.fault_plan.check_checkpoint(step)
+        t0 = time.perf_counter()
+        self.manager.save(self.ff, step)
+        dt = time.perf_counter() - t0
+        self.counters["checkpoints"] += 1
+        self.counters["checkpoint_time_s"] += dt
+        self.counters["checkpoint_time_last_s"] = dt
+
+    def _save_checkpoint_survivable(self, step: int) -> None:
+        """A failed periodic save — injected or real (disk full, NFS
+        blip) — costs that save, never the run: count it and keep
+        training; the next cadence point writes a fresh one."""
+        try:
+            self._save_checkpoint(step)
+        except (CheckpointWriteFault, OSError) as e:
+            self.counters["checkpoint_failures"] += 1
+            self.log.info("checkpoint save failed at step %d: %s", step, e)
+
+    def _restore_latest(self, step: int) -> int:
+        restored = int(self.manager.restore(self.ff))
+        self.counters["restarts"] += 1
+        self.counters["lost_steps"] += max(0, step - restored)
+        self.log.info(
+            "restored step %d after failure at step %d", restored, step
+        )
+        return restored
+
+    # -- recovery paths --------------------------------------------------
+    def _retry_transient(self, err, step: int, restarts: int) -> int:
+        self.counters["retries"] += 1
+        if not self.retry.admits(restarts):
+            raise RestartBudgetExhausted(
+                f"restart budget ({self.retry.max_restarts}) exhausted at "
+                f"step {step}: {err}"
+            ) from err
+        self.sleep(self.retry.backoff(restarts))
+        return self._restore_latest(step)
+
+    def _search_strategy(self, num_devices: int):
+        if self.search_fn is not None:
+            return self.search_fn(self.ff, num_devices)
+        cfg = self.ff.config
+        if cfg.search_budget > 0 and not cfg.only_data_parallel:
+            from ..pcg.search import mcmc_search, unity_search
+
+            if cfg.search_algo == "mcmc":
+                return mcmc_search(self.ff, num_devices)
+            return unity_search(self.ff, num_devices)
+        from ..strategy import data_parallel_strategy
+
+        return data_parallel_strategy(num_devices)
+
+    def _recover_device_loss(self, fault: DeviceLossFault, step: int) -> int:
+        """Elastic recovery: re-search placement for the surviving
+        topology, recompile onto it, and reshard-restore the latest
+        checkpoint so trained state carries over to the new mesh."""
+        survivors = list(self.ff.mesh.devices.flat)[: fault.survivors]
+        if not survivors:
+            raise RuntimeError(f"device loss left no survivors: {fault}")
+        self.counters["device_losses"] += 1
+        self.log.info(
+            "device loss at step %d: %d devices survive, re-searching",
+            step, len(survivors),
+        )
+        strategy = self._search_strategy(len(survivors))
+        self.counters["re_searches"] += 1
+        # recompile rebuilds the executor on the shrunken mesh (fresh
+        # shardings); the checkpoint restore then overwrites the carried
+        # state with the last durable state, resharded onto that mesh
+        self.ff.recompile(
+            strategy=strategy, devices=survivors[: strategy.total_devices]
+        )
+        return self._restore_latest(step)
+
+    # -- nan handling -----------------------------------------------------
+    def _snapshot(self):
+        """Host copies of the full train state.  The step function
+        donates its weight/opt/state buffers (build_step
+        donate_argnums), so pre-step device arrays are dead after the
+        step — only a host copy can roll one back."""
+        ff = self.ff
+        return (
+            jax.tree.map(np.asarray, ff._weights),
+            jax.tree.map(np.asarray, ff._opt_state),
+            jax.tree.map(np.asarray, ff._state),
+            ff._rng,
+        )
+
+    def _rollback(self, snap) -> None:
+        from ..model import device_put_like
+
+        w, opt, st, rng = snap
+        ff = self.ff
+        ff.set_weights(w)
+        ff._opt_state = device_put_like(opt, ff._opt_state)
+        ff._state = device_put_like(st, ff._state)
+        ff._rng = rng
+
+    # -- the supervised loop ----------------------------------------------
+    def run(self, x, y, num_steps: int, batch_size: Optional[int] = None
+            ) -> SupervisorReport:
+        """Train for `num_steps` supervised steps over (x, y)."""
+        ff = self.ff
+        assert ff._step_fn is not None, "call compile() first"
+        batch_size = batch_size or ff.config.batch_size
+        x_map = self._x_map(x)
+        num_batches = len(y) // batch_size
+        if num_batches < 1:
+            raise ValueError(
+                f"need at least one batch: {len(y)} samples < "
+                f"batch_size {batch_size}"
+            )
+        # keyed by step so restores truncate exactly (a skipped step
+        # records nothing, so a plain list would drift out of phase)
+        loss_by_step: Dict[int, float] = {}
+        step = 0
+        restarts = 0
+        self._save_checkpoint_survivable(0)  # anchor: first failure has a target
+        while step < num_steps:
+            try:
+                self.fault_plan.check_step(step)
+                inputs, labels = self._batch(
+                    x_map, y, step, batch_size, num_batches
+                )
+                inputs = self.fault_plan.corrupt_batch(step, inputs)
+                snap = self._snapshot() if self.nan_policy == "skip_step" else None
+                m = ff.train_step(inputs, labels)
+                self.counters["steps_run"] += 1
+                try:
+                    check_step_health(m, step=step)
+                except NonFiniteLossError:
+                    if self.nan_policy != "skip_step":
+                        raise  # "raise" propagates; "restore" caught below
+                    # full step rollback (weights/opt/state/rng), then
+                    # move past the poisoned batch
+                    self._rollback(snap)
+                    self.counters["skipped_steps"] += 1
+                    m = None
+                if m is not None:
+                    loss_by_step[step] = float(np.asarray(m["loss"]))
+                step += 1
+                if self.checkpoint_every > 0 and step % self.checkpoint_every == 0:
+                    self._save_checkpoint_survivable(step)
+            except DeviceLossFault as f:
+                step = self._recover_device_loss(f, step)
+                loss_by_step = {s: v for s, v in loss_by_step.items() if s < step}
+            except TRANSIENT_FAULTS + (NonFiniteLossError,) as e:
+                if isinstance(e, NonFiniteLossError) and self.nan_policy == "raise":
+                    raise
+                restarts += 1
+                step = self._retry_transient(e, step, restarts)
+                # replayed steps re-record their losses
+                loss_by_step = {s: v for s, v in loss_by_step.items() if s < step}
+        self.log.counters("supervisor", self.counters)
+        return SupervisorReport(
+            final_step=step,
+            losses=[loss_by_step[s] for s in sorted(loss_by_step)],
+            counters=dict(self.counters),
+        )

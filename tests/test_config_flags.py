@@ -206,6 +206,36 @@ def test_cli_flags_parse():
     assert cfg.simulator_segment_size == 128
 
 
+def test_resilience_cli_flags_parse():
+    cfg = FFConfig.from_args([
+        "--checkpoint-every", "5", "--checkpoint-dir", "/tmp/ckpt",
+        "--checkpoint-keep", "2", "--max-restarts", "7",
+        "--retry-backoff", "0.5", "--nan-policy", "skip_step",
+    ])
+    assert cfg.checkpoint_every == 5
+    assert cfg.checkpoint_dir == "/tmp/ckpt"
+    assert cfg.checkpoint_keep == 2
+    assert cfg.max_restarts == 7
+    assert cfg.retry_backoff == pytest.approx(0.5)
+    assert cfg.nan_policy == "skip_step"
+    # defaults: resilience off until opted into
+    base = FFConfig.from_args([])
+    assert base.checkpoint_every == 0 and base.nan_policy == "raise"
+
+
+def test_resilience_config_validated():
+    with pytest.raises(ValueError):
+        FFConfig(nan_policy="bogus")
+    with pytest.raises(ValueError):
+        FFConfig(checkpoint_every=-1)
+    with pytest.raises(ValueError):
+        FFConfig(checkpoint_keep=0)
+    with pytest.raises(ValueError):
+        FFConfig(max_restarts=-2)
+    with pytest.raises(ValueError):
+        FFConfig(retry_backoff=-0.1)
+
+
 def test_remat_matches_nonremat_numerics_and_inserts_checkpoint(devices8):
     """--remat wraps pure segments in jax.checkpoint: identical math,
     recomputed backward (TPU-native HBM/FLOPs trade)."""
