@@ -408,6 +408,115 @@ def bench_moe_dispatch(dev, on_tpu):
     }
 
 
+def bench_weight_update(on_tpu):
+    """ZeRO-1 weight-update microbench (manifest v7): the Adam update
+    pass over the BERT-base parameter set, sharded along a dp mesh of
+    all visible devices vs replicated.  Uses the executor's own spec
+    machinery (parallel/zero.py) so a regression in the update path —
+    compute or layout — moves these numbers.  update-ms is a serial
+    chain of donated updates with one hard sync."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from flexflow_tpu.optimizer import AdamOptimizer
+    from flexflow_tpu.parallel.zero import shard_update_sharding
+
+    leg = MANIFEST["legs"]["weight_update"]
+    if on_tpu:
+        hidden, layers = leg["hidden"], leg["layers"]
+        inter, vocab, iters = leg["intermediate"], leg["vocab"], leg["iters"]
+    else:
+        hidden, layers, inter, vocab, iters = 64, 2, 128, 1000, 3
+
+    devs = jax.devices()
+    dp = len(devs)
+    mesh = Mesh(np.asarray(devs), ("data",))
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    shapes = {"embed.weight": (vocab, hidden)}
+    for i in range(layers):
+        shapes.update({
+            f"l{i}.qkv": (hidden, 3 * hidden),
+            f"l{i}.proj": (hidden, hidden),
+            f"l{i}.up": (hidden, inter),
+            f"l{i}.down": (inter, hidden),
+            f"l{i}.ln_scale": (hidden,),
+            f"l{i}.ln_bias": (hidden,),
+        })
+    rng = np.random.RandomState(0)
+    host_w = {k: rng.randn(*s).astype(np.float32) * 0.02
+              for k, s in shapes.items()}
+    host_g = {k: rng.randn(*s).astype(np.float32) * 1e-3
+              for k, s in shapes.items()}
+    opt = AdamOptimizer(alpha=1e-3)
+    out = {
+        "workload": f"Adam update, BERT-base param set "
+                    f"({layers}L h{hidden}), dp={dp} "
+                    f"(ZeRO-1 sharded vs replicated)",
+        "dp": dp,
+    }
+    for mode in ("replicated", "sharded"):
+        slot_sh = {
+            k: (shard_update_sharding(rep, v.shape, mesh, "data")
+                if mode == "sharded" else rep)
+            for k, v in host_w.items()
+        }
+        weights = {k: jax.device_put(v, rep) for k, v in host_w.items()}
+        grads = {k: jax.device_put(v, rep) for k, v in host_g.items()}
+        state = opt.init_state(weights)
+        state = {
+            k: (jax.tree.map(lambda v, s: jax.device_put(v, s), sub, slot_sh)
+                if isinstance(sub, dict) else jax.device_put(sub, rep))
+            for k, sub in state.items()
+        }
+
+        def step(w, s, g, _sh=slot_sh, _mode=mode):
+            if _mode == "sharded":
+                g = jax.tree.map(jax.lax.with_sharding_constraint, g, _sh)
+                w = jax.tree.map(jax.lax.with_sharding_constraint, w, _sh)
+            nw, ns = opt.update(w, g, s)
+            if _mode == "sharded":
+                nw = jax.tree.map(
+                    lambda v: jax.lax.with_sharding_constraint(v, rep), nw
+                )
+                ns = {
+                    k: (jax.tree.map(
+                        jax.lax.with_sharding_constraint, sub, _sh)
+                        if isinstance(sub, dict) else sub)
+                    for k, sub in ns.items()
+                }
+            return nw, ns
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        weights, state = jstep(weights, state, grads)  # compile + warm
+        jax.block_until_ready(jax.tree.leaves(weights)[0])
+
+        def window():
+            nonlocal weights, state
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                weights, state = jstep(weights, state, grads)
+            jax.block_until_ready(jax.tree.leaves(weights)[0])
+            return (time.perf_counter() - t0) / iters
+
+        dt = min(window() for _ in range(MANIFEST["timing"]["windows"]))
+        slot_bytes = sum(
+            int(np.prod(sub[k2].sharding.shard_shape(sub[k2].shape))
+                * sub[k2].dtype.itemsize)
+            for key, sub in state.items() if isinstance(sub, dict)
+            for k2 in sub
+        )
+        out[f"update_ms_{mode}"] = round(dt * 1e3, 3)
+        out[f"opt_state_mb_per_device_{mode}"] = round(
+            slot_bytes / 2**20, 2
+        )
+    if out["update_ms_sharded"] > 0:
+        out["sharded_vs_replicated_speedup"] = round(
+            out["update_ms_replicated"] / out["update_ms_sharded"], 2
+        )
+    return out
+
+
 def _outage_line(reason: str):
     # tunnel/backend outage: emit a diagnostic JSON line instead of a
     # stacktrace/hang so the capture records WHY there are no numbers
@@ -462,6 +571,8 @@ def main():
     dlrm = bench_dlrm(dev, on_tpu)
     gc.collect()
     moe = bench_moe_dispatch(dev, on_tpu)
+    gc.collect()
+    wu = bench_weight_update(on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
@@ -479,7 +590,7 @@ def main():
         "manifest_version": MANIFEST["version"],
         "legs": {"bert_base": bert, "resnet50": resnet,
                  "bert_long_context": bert_long, "dlrm": dlrm,
-                 "moe_dispatch": moe},
+                 "moe_dispatch": moe, "weight_update": wu},
     }
     print(json.dumps(result))
 

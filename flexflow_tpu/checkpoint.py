@@ -31,6 +31,14 @@ def _meta(ff, step: int) -> Dict[str, Any]:
         "strategy": ff.strategy.to_json() if ff.strategy is not None else None,
         "batch_size": ff.config.batch_size,
         "num_devices": ff.config.num_devices,
+        # ZeRO-1 layout marker: restore reshards slot leaves onto the
+        # CURRENT executor's shardings either way (sharded<->replicated
+        # and elastic meshes both round-trip); recorded so tooling can
+        # see which layout produced the artifact
+        "weight_update_sharding": bool(
+            getattr(ff.config, "weight_update_sharding", False)
+        ),
+        "wus_axis": getattr(ff.config, "wus_axis", None),
     }
 
 

@@ -31,8 +31,12 @@ from .fftype import ParameterSyncType
 DEFAULT_FLASH_MIN_SEQ = 2048
 
 # valid FFConfig.nan_policy values (consumed by the resilience
-# supervisor's step-health handling, resilience/supervisor.py)
-NAN_POLICIES = ("raise", "skip_step", "restore")
+# supervisor's step-health handling, resilience/supervisor.py).
+# "off" disables the per-step health check: check_step_health returns
+# without touching the device value, so callers that don't otherwise
+# consume the loss pay no sync for it (the supervisor itself still
+# syncs once per step to record the loss in its report).
+NAN_POLICIES = ("raise", "skip_step", "restore", "off")
 
 
 @dataclasses.dataclass
@@ -109,6 +113,17 @@ class FFConfig:
     simulator_segment_size: int = 16777216
 
     # -- execution
+    # cross-replica weight-update sharding (ZeRO-1, Xu et al.
+    # arXiv:2004.13336): reduce-scatter gradients along `wus_axis`,
+    # keep optimizer slots and run the update on the 1/N shard, then
+    # all-gather the updated weights back to their strategy sharding.
+    # Numerically equivalent to the replicated update; saves the
+    # redundant per-replica update compute and (slots-1)/N of the
+    # optimizer-state HBM.  The simulator models the sharded update
+    # (sim/simulator.py weight_update_sharding) so searches score
+    # candidates with the real update cost.
+    weight_update_sharding: bool = False
+    wus_axis: str = "data"  # mesh axis the update shards over
     # reference --fusion (apply_fusion model.cc:2495): fold trailing
     # activations into producers at compile; XLA fuses kernels anyway,
     # this shrinks the PCG/search space
@@ -140,7 +155,7 @@ class FFConfig:
     checkpoint_keep: int = 3   # keep-last-k retention
     max_restarts: int = 3      # restore-and-retry budget per run
     retry_backoff: float = 0.1  # base backoff seconds (exponential, jittered)
-    nan_policy: str = "raise"  # raise | skip_step | restore
+    nan_policy: str = "raise"  # raise | skip_step | restore | off
 
     def __post_init__(self):
         if self.nan_policy not in NAN_POLICIES:
@@ -164,6 +179,8 @@ class FFConfig:
             raise ValueError(
                 f"retry_backoff must be >= 0, got {self.retry_backoff}"
             )
+        if not self.wus_axis:
+            raise ValueError("wus_axis must be a non-empty mesh axis name")
 
     def should_calibrate(self) -> bool:
         """Resolve search_calibrate's auto mode: measured costs when a
@@ -230,6 +247,9 @@ class FFConfig:
         p.add_argument("--machine-model-version", type=int, default=0)
         p.add_argument("--machine-model-file", type=str, default=None)
         p.add_argument("--simulator-segment-size", type=int, default=16777216)
+        p.add_argument("--weight-update-sharding", dest="weight_update_sharding",
+                       action="store_true")
+        p.add_argument("--wus-axis", dest="wus_axis", type=str, default="data")
         p.add_argument("--fusion", action="store_true")
         p.add_argument("--remat", action="store_true")
         p.add_argument("--profiling", action="store_true")
@@ -282,6 +302,8 @@ class FFConfig:
             machine_model_version=args.machine_model_version,
             machine_model_file=args.machine_model_file,
             simulator_segment_size=args.simulator_segment_size,
+            weight_update_sharding=args.weight_update_sharding,
+            wus_axis=args.wus_axis,
             perform_fusion=args.fusion,
             remat=args.remat,
             profiling=args.profiling,

@@ -47,11 +47,16 @@ class NonFiniteLossError(RuntimeError):
         super().__init__(f"non-finite loss {loss!r}{where}")
 
 
-def check_step_health(metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+def check_step_health(metrics: Dict[str, Any], step: Optional[int] = None,
+                      nan_policy: str = "raise") -> None:
     """Step health hook: raise NonFiniteLossError when the step's loss
-    is NaN/inf.  Reads the metrics dict a step function returned (this
-    blocks on the device value — callers that poll every step, like the
-    supervisor, already pay that sync to record the loss)."""
+    is NaN/inf.  Reads the metrics dict a step function returned, which
+    blocks on the device value — so the sync is gated on the configured
+    policy: with nan_policy "off" (or None) no caller consumes the
+    health signal and the function returns without ever touching the
+    device array."""
+    if nan_policy in (None, "off"):
+        return
     loss = metrics.get("loss") if isinstance(metrics, dict) else None
     if loss is None:
         return
@@ -75,6 +80,7 @@ class GraphExecutor:
         remat: bool = False,
         compute_dtype=None,
         pipeline_plan=None,
+        wus_axis: Optional[str] = None,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -92,6 +98,12 @@ class GraphExecutor:
         self.order = graph.topo_order()
         self.sink = graph.sink_op()
         self._use_constraints = mesh.devices.size > 1
+        # cross-replica weight-update sharding (ZeRO-1, parallel/zero.py):
+        # active only when the axis exists on the mesh with size > 1
+        mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self.wus_axis = (
+            wus_axis if wus_axis and mesh_sizes.get(wus_axis, 1) > 1 else None
+        )
         for op in self.order:
             op._mesh = mesh  # ops with shard_map lowerings (ring attention)
         self._step_fn = None
@@ -174,7 +186,14 @@ class GraphExecutor:
             spec = PartitionSpec(*(entries[i] for i in TO_NHWC_PERM))
         return NamedSharding(self.mesh, spec)
 
-    def weight_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
+    def _weight_sharding_tree(
+        self, make
+    ) -> Dict[str, Dict[str, NamedSharding]]:
+        """The ONE walk over trainable-weight leaves (per-op entries
+        plus the pp-stacked __pipeline__ entries).  `make(spec, shape)`
+        maps each leaf's strategy PartitionSpec + global shape to its
+        NamedSharding, so weight_shardings and wus_shardings stay
+        structurally identical by construction."""
         out: Dict[str, Dict[str, NamedSharding]] = {}
         for op in self.order:
             if op.guid in self._block_guids:
@@ -182,7 +201,9 @@ class GraphExecutor:
             nt = _num_trainable(op)
             entry = {}
             for w in op.weights[:nt]:
-                entry[w.name.split(".")[-1]] = self.tensor_sharding(w)
+                entry[w.name.split(".")[-1]] = make(
+                    view_to_spec(w), w.shape.logical_shape
+                )
             if entry:
                 out[op.name] = entry
         if self.pipeline_plan is not None:
@@ -190,14 +211,63 @@ class GraphExecutor:
             plan = self.pipeline_plan
             for j, op in enumerate(plan.blocks[0]):
                 for spec, pt in zip(op.weight_specs, op.weights):
-                    ndim = len(pt.shape.logical_shape) + 1  # stacked dim
-                    entry[f"{j}.{spec.name}"] = NamedSharding(
-                        self.mesh,
-                        PartitionSpec(plan.pp_axis, *([None] * (ndim - 1))),
+                    shape = (len(plan.blocks),) + tuple(
+                        pt.shape.logical_shape
+                    )  # stacked dim leads
+                    entry[f"{j}.{spec.name}"] = make(
+                        PartitionSpec(
+                            plan.pp_axis, *([None] * (len(shape) - 1))
+                        ),
+                        shape,
                     )
             if entry:
                 out["__pipeline__"] = entry
         return out
+
+    def weight_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
+        return self._weight_sharding_tree(
+            lambda spec, shape: NamedSharding(self.mesh, spec)
+        )
+
+    def wus_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
+        """ZeRO-1 update layout (parallel/zero.py): each trainable
+        weight's strategy sharding with the wus axis folded into its
+        first free, evenly-divisible logical dim.  Leaves with no such
+        dim keep their strategy sharding — they fall back to the
+        replicated update individually.  Mirrors weight_shardings()'s
+        pytree structure exactly (same underlying walk)."""
+        from .parallel.zero import shard_update_spec
+
+        axis = self.wus_axis
+        size = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[axis]
+
+        def make(spec, shape):
+            z = shard_update_spec(spec, shape, axis, size)
+            return NamedSharding(self.mesh, z if z is not None else spec)
+
+        return self._weight_sharding_tree(make)
+
+    def shard_opt_state(self, opt_state):
+        """device_put the optimizer's weight-mirroring slot trees (SGD
+        v, Adam m/v) onto the ZeRO-1 update layout — 1/N per-device HBM
+        along the wus axis — and scalar entries (Adam's t) onto a
+        mesh-replicated sharding (an eagerly created scalar carries a
+        single-device sharding that checkpoint restore would otherwise
+        commit to, wedging multi-device steps).  No-op when
+        weight-update sharding is off: slots then inherit each weight's
+        strategy sharding from init_state."""
+        if self.wus_axis is None:
+            return opt_state
+        sh = self.wus_shardings()
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        return {
+            k: (
+                jax.tree.map(lambda v, s: jax.device_put(v, s), sub, sh)
+                if isinstance(sub, dict)
+                else jax.device_put(sub, rep)
+            )
+            for k, sub in opt_state.items()
+        }
 
     def state_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
         out: Dict[str, Dict[str, NamedSharding]] = {}
@@ -471,10 +541,44 @@ class GraphExecutor:
         )
 
     # -- train step ------------------------------------------------------
+    def _make_update_fn(self, opt: Optimizer):
+        """opt.update, wrapped for cross-replica weight-update sharding
+        when a wus axis is active (ZeRO-1, arXiv:2004.13336):
+        constraining the grads to the update layout turns the backward
+        psum into a reduce-scatter, the update then runs on the 1/N
+        shard (where the slots permanently live), and constraining the
+        result back to the strategy sharding emits the weight
+        all-gather.  Numerically the replicated update — all-reduce ==
+        reduce-scatter + all-gather — with 1/N of the update compute
+        and slot HBM per device."""
+        if self.wus_axis is None:
+            return opt.update
+        wus = self.wus_shardings()
+        strat = self.weight_shardings()
+
+        def constrain(tree, sh):
+            return jax.tree.map(
+                jax.lax.with_sharding_constraint, tree, sh
+            )
+
+        def update(weights, grads, state):
+            grads = constrain(grads, wus)
+            shard_w = constrain(weights, wus)
+            new_w, new_state = opt.update(shard_w, grads, state)
+            new_w = constrain(new_w, strat)
+            new_state = {
+                k: constrain(sub, wus) if isinstance(sub, dict) else sub
+                for k, sub in new_state.items()
+            }
+            return new_w, new_state
+
+        return update
+
     def build_step(self):
         metrics = self.metrics
         loss_obj = self.loss
         opt = self.optimizer
+        update_fn = self._make_update_fn(opt)
         lrep = self.label_replication
 
         # replay-mode (_load_cached) ops are excluded: the reference's
@@ -519,7 +623,7 @@ class GraphExecutor:
             (loss_val, (logits, new_state, taps)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(weights)
-            new_w, new_opt_state = opt.update(weights, grads, opt_state)
+            new_w, new_opt_state = update_fn(weights, grads, opt_state)
             m = metrics.compute(logits, labels)
             m["loss"] = loss_val
             if taps:

@@ -29,11 +29,39 @@ class PerfMetrics:
     rmse_loss: float = 0.0
     mae_loss: float = 0.0
 
+    _FIELDS = ("train_all", "train_correct", "cce_loss", "sparse_cce_loss",
+               "mse_loss", "rmse_loss", "mae_loss")
+
+    def __post_init__(self):
+        # running DEVICE-side sums (see accumulate); plain attribute so
+        # dataclass eq/asdict semantics are untouched
+        self._device_acc: Dict[str, jax.Array] = {}
+
     def update(self, other: Dict[str, float]):
         self.train_all += int(other.get("train_all", 0))
         self.train_correct += int(other.get("train_correct", 0))
         for f in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
             setattr(self, f, getattr(self, f) + float(other.get(f, 0.0)))
+
+    def accumulate(self, step_metrics: Dict[str, jax.Array]):
+        """Fold one step's metric arrays into device-side running sums —
+        no host sync, so back-to-back donated steps stay chained on
+        device.  finalize() converts once (per epoch)."""
+        for k in self._FIELDS:
+            v = step_metrics.get(k)
+            if v is None:
+                continue
+            acc = self._device_acc.get(k)
+            self._device_acc[k] = v if acc is None else acc + v
+
+    def finalize(self) -> "PerfMetrics":
+        """One host transfer: fold the accumulated device sums into the
+        scalar fields.  Idempotent between accumulate() calls."""
+        if self._device_acc:
+            vals = jax.device_get(self._device_acc)
+            self._device_acc = {}
+            self.update({k: float(np.asarray(v)) for k, v in vals.items()})
+        return self
 
     @property
     def accuracy(self) -> float:

@@ -731,6 +731,9 @@ class FFModel:
             ),
             remat=cfg.remat,
             pipeline_plan=pipeline_plan,
+            wus_axis=(
+                cfg.wus_axis if cfg.weight_update_sharding else None
+            ),
         )
         # score hooks live on the FRONTEND ops (the user's handles);
         # strategy application clones the compiled PCG's op objects
@@ -757,7 +760,12 @@ class FFModel:
         self._weights, self._state = self.executor.init_weights(
             seed if seed is not None else cfg.seed
         )
-        self._opt_state = self.optimizer.init_state(self._weights)
+        # ZeRO-1 layout: slots move to their 1/N per-device shard here,
+        # so every downstream consumer (step fn, checkpoint save/restore,
+        # recompile's device_put_like) inherits the sharded placement
+        self._opt_state = self.executor.shard_opt_state(
+            self.optimizer.init_state(self._weights)
+        )
         self._step_fn = self.executor.build_step()
         self._eval_fn = self.executor.build_eval_step()
         self._fwd_fn = self.executor.build_forward()
@@ -935,7 +943,11 @@ class FFModel:
             t0 = time.perf_counter()
             for batch, labels in loader:
                 m = self.train_step(batch, labels)
-                pm.update({k: float(v) for k, v in m.items() if k != "loss"})
+                # device-side accumulation: float(v) here would force a
+                # per-step host<->device sync that breaks the donated
+                # step chain; PerfMetrics sums on device and converts
+                # once per epoch (finalize below)
+                pm.accumulate(m)
                 for op in self._cache_ops:
                     # legacy model-level score fns poll here; 4-arg
                     # reference-style scorers already ran in train_step
@@ -944,6 +956,7 @@ class FFModel:
                         op.update_score(float(fn(self)))
             jax.block_until_ready(jax.tree.leaves(self._weights)[0])
             dt = time.perf_counter() - t0
+            pm.finalize()  # the epoch's single metrics host transfer
             throughput = num_batches * batch_size / dt
             if verbose:
                 print(

@@ -10,8 +10,11 @@ emits the psum over the data axes as part of backward — so the optimizer
 is a pure functional update over the weight pytree.  Optimizer slots
 (momentum/adam m,v) inherit each weight's NamedSharding, which is the
 sharded-optimizer-state ("ZeRO-esque") layout for free when weights are
-sharded.  API kept close to the reference (SGDOptimizer/AdamOptimizer
-names, optimizer.h:36-110) while the math is optax-compatible.
+sharded; with --weight-update-sharding the executor additionally shards
+slots and the update itself along the data axis (true ZeRO-1,
+executor._make_update_fn) — the update body here stays layout-agnostic.
+API kept close to the reference (SGDOptimizer/AdamOptimizer names,
+optimizer.h:36-110) while the math is optax-compatible.
 """
 from __future__ import annotations
 
@@ -67,15 +70,22 @@ class SGDOptimizer(Optimizer):
             )
             return new_w, state
 
-        def upd(w, g, v):
-            g = g + wd * w
-            v = self.momentum * v + g
-            step = g + self.momentum * v if self.nesterov else v
-            return w - self.lr * step, v
-
-        flat = jax.tree.map(upd, weights, grads, state["v"])
-        new_w = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        new_v = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        # one tree traversal per output (the tuple-leaf tree + two
+        # is_leaf re-traversals this replaces did the same math in
+        # three passes)
+        mu = self.momentum
+        new_v = jax.tree.map(
+            lambda w, g, v: mu * v + g + wd * w, weights, grads, state["v"]
+        )
+        if self.nesterov:
+            new_w = jax.tree.map(
+                lambda w, g, v: w - self.lr * (g + wd * w + mu * v),
+                weights, grads, new_v,
+            )
+        else:
+            new_w = jax.tree.map(
+                lambda w, v: w - self.lr * v, weights, new_v
+            )
         return new_w, {"v": new_v}
 
 

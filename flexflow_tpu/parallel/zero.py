@@ -1,0 +1,73 @@
+"""Cross-replica weight-update sharding (ZeRO-1) spec helpers.
+
+Reference: "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (Xu et al., arXiv:2004.13336).  On data-parallel
+legs every replica redundantly runs the full optimizer update and keeps
+a full replicated copy of the slots (Adam m/v).  The sharded update
+instead:
+
+  * reduce-scatters the gradient over the replica (wus) axis,
+  * updates a 1/N shard of the weight + slots (slots live sharded
+    permanently — 1/N per-device HBM),
+  * all-gathers the updated weights back to their strategy sharding.
+
+Total ring bytes equal the all-reduce the replicated path pays
+(all-reduce == reduce-scatter + all-gather), but the update compute and
+the slot memory shrink by 1/N.  The executor expresses all of it with
+`with_sharding_constraint` re-specs around `opt.update` — XLA SPMD then
+emits the reduce-scatter/all-gather pair — so the update body itself
+stays the plain functional optimizer.
+
+This module owns the spec arithmetic: given a weight's strategy
+PartitionSpec, fold the wus axis into its first free, evenly-divisible
+logical dim.  Weights with no such dim (a 10-way bias on an 8-way axis)
+keep their strategy sharding and fall back to the replicated update —
+per leaf, not per model.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _used_axes(spec: PartitionSpec):
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            yield from entry
+        else:
+            yield entry
+
+
+def shard_update_spec(
+    spec: PartitionSpec,
+    shape: Sequence[int],
+    axis: str,
+    axis_size: int,
+) -> Optional[PartitionSpec]:
+    """The ZeRO-1 update-layout spec for one weight, or None when the
+    weight cannot shard over `axis` (axis already used, no free dim
+    whose size divides evenly, or a trivial axis)."""
+    if axis_size <= 1 or axis in set(_used_axes(spec)):
+        return None
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, dim in enumerate(shape):
+        if entries[i] is None and dim % axis_size == 0 and dim > 0:
+            entries[i] = axis
+            return PartitionSpec(*entries)
+    return None
+
+
+def shard_update_sharding(
+    sharding: NamedSharding,
+    shape: Sequence[int],
+    mesh: Mesh,
+    axis: str,
+) -> NamedSharding:
+    """NamedSharding for the update layout; the strategy sharding when
+    the leaf cannot shard."""
+    sizes: Dict[str, int] = dict(zip(mesh.axis_names, mesh.devices.shape))
+    z = shard_update_spec(sharding.spec, shape, axis, sizes.get(axis, 1))
+    return sharding if z is None else NamedSharding(mesh, z)

@@ -289,7 +289,7 @@ class IncrementalEvaluator:
             )
         else:
             memory_fn = lambda: self.sim.per_device_memory(  # noqa: E731
-                graph, self.training
+                graph, self.training, mesh_axes=mesh_axes
             )
         res = self.sim.simulate_ops(order, mesh_axes, training=self.training,
                                     memory_fn=memory_fn)

@@ -298,6 +298,8 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
             **kw,
             parameter_sync=_sync_mode(cfg.parameter_sync),
             remat=cfg.remat,
+            weight_update_sharding=cfg.weight_update_sharding,
+            wus_axis=cfg.wus_axis,
         )
 
     search = MCMCSearch(
@@ -313,5 +315,9 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
         use_eval_cache=cfg.search_eval_cache,
     )
     best = search.optimize()
+    # surface the update-sharding mode candidates were scored under
+    best.search_stats["weight_update_sharding"] = bool(
+        cfg.weight_update_sharding
+    )
     cost_model.save_persistent()
     return best

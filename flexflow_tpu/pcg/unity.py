@@ -103,6 +103,8 @@ class UnitySearch:
         remat: bool = False,
         compute_scale: float = 1.0,
         eval_cache: bool = True,
+        weight_update_sharding: bool = False,
+        wus_axis: str = "data",
     ):
         self.event_rerank = event_rerank
         self.event_topk = event_topk
@@ -140,13 +142,17 @@ class UnitySearch:
         from ..sim.simulator import Simulator
 
         self.remat = remat
+        self.weight_update_sharding = weight_update_sharding
+        self.wus_axis = wus_axis
         self._sim = Simulator(machine, cost_model,
                               overlap_fraction=overlap_fraction,
                               optimizer_slots=optimizer_slots,
                               sync_overlap_fraction=sync_overlap_fraction,
                               parameter_sync=parameter_sync,
                               remat=remat,
-                              compute_scale=compute_scale)
+                              compute_scale=compute_scale,
+                              weight_update_sharding=weight_update_sharding,
+                              wus_axis=wus_axis)
         # memoized whole-strategy evaluator per (possibly rewritten)
         # graph variant: the sp/sample candidate families and the
         # memory-aware lambda binary search revisit identical strategies
@@ -190,12 +196,6 @@ class UnitySearch:
             return m.allreduce_time(size, g)
         return m.allgather_time(size, g)
 
-    def _sync_time(self, size: int, rep: int) -> float:
-        """Gradient sync under the configured ParameterSyncType —
-        delegated to the shared Simulator formula so the per-op costing
-        and whole-graph grad_sync_cost can never diverge."""
-        return self._sim.sync_time(size, rep)
-
     def _op_cost(self, op: Op, training: bool = True) -> Tuple[float, int]:
         """(time, per-device bytes) for one instantiated op — the same
         terms Simulator.simulate charges per op."""
@@ -213,9 +213,30 @@ class UnitySearch:
         mem = 0
         for w in op.weights:
             rep = w.shape.replica_degree
+            sb = w.shape.shard_bytes()
+            # Simulator.wus_group carries every guard (knob, sync mode,
+            # per-leaf divisibility); no mesh context at this DP stage,
+            # so the group falls back to the replica degree — exact on
+            # pure-dp meshes, and the authoritative evaluator re-scores
+            # with mesh_axes
+            g = self._sim.wus_group(w) if w.create_gradients else 1
             if training and rep > 1 and w.create_gradients:
-                sync += self._sync_time(w.shape.shard_bytes(), rep)
-            mem += w.shape.shard_bytes() * ((2 + self.optimizer_slots) if training else 1)
+                if g > 1:
+                    # reduce-scatter + weight all-gather (the gather
+                    # takes the generic comm credit, like
+                    # Simulator.simulate_ops)
+                    s, x = self._sim.weight_update_comm(sb, g)
+                    sync += s
+                    comm += x
+                else:
+                    sync += self._sim.sync_time(sb, rep)
+            if not training:
+                mem += sb
+            elif g > 1:
+                # ZeRO-1 slots: 1/g per device; master + grad whole
+                mem += sb * 2 + self.optimizer_slots * (sb // g)
+            else:
+                mem += sb * (2 + self.optimizer_slots)
         for o in op.outputs:
             mem += o.shape.shard_bytes()
         time = (t + comm * (1.0 - self.overlap)
@@ -849,7 +870,8 @@ class UnitySearch:
                     return 1.0 / _s if op.guid in _g else 1.0
 
             mem = self._sim.per_device_memory(g, training=True,
-                                              op_scale=op_scale)
+                                              op_scale=op_scale,
+                                              mesh_axes=strategy.mesh_axes)
             return self._objective(time, mem, lam)
         except Exception as e:  # noqa: BLE001
             slog.debug(
@@ -1181,9 +1203,15 @@ class UnitySearch:
             base = apply_rewrites(base, strategy.rewrites, rules)
         g = apply_strategy(base, strategy)
         assign_views(g, strategy.mesh_axes)
+        # mirror the cost simulator's gating exactly (parameter_sync
+        # included) so the memory the lambda search constrains is the
+        # memory the time model believes in
         sim = Simulator(self.machine, self.cost_model,
                         optimizer_slots=self.optimizer_slots,
-                        remat=self.remat)
+                        remat=self.remat,
+                        parameter_sync=self.parameter_sync,
+                        weight_update_sharding=self.weight_update_sharding,
+                        wus_axis=self.wus_axis)
         op_scale = None
         if strategy.pipeline:
             # each device holds only its stage's 1/S of the block stack
@@ -1196,7 +1224,8 @@ class UnitySearch:
             def op_scale(op, _g=block_guids, _s=S):  # noqa: E731
                 return 1.0 / _s if op.guid in _g else 1.0
 
-        return sim.per_device_memory(g, training=True, op_scale=op_scale)
+        return sim.per_device_memory(g, training=True, op_scale=op_scale,
+                                     mesh_axes=strategy.mesh_axes)
 
 
 def _sync_mode(pst) -> str:
@@ -1261,6 +1290,8 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         rewrite_depth=cfg.rewrite_depth,
         rewrite_max_variants=cfg.rewrite_max_variants,
         eval_cache=cfg.search_eval_cache,
+        weight_update_sharding=cfg.weight_update_sharding,
+        wus_axis=cfg.wus_axis,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()
@@ -1268,4 +1299,8 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         from ..strategy import data_parallel_strategy
 
         return data_parallel_strategy(num_devices)
+    # surface the update-sharding mode candidates were scored under
+    best.search_stats["weight_update_sharding"] = bool(
+        cfg.weight_update_sharding
+    )
     return best

@@ -281,7 +281,8 @@ class TrainingSupervisor:
                 m = ff.train_step(inputs, labels)
                 self.counters["steps_run"] += 1
                 try:
-                    check_step_health(m, step=step)
+                    check_step_health(m, step=step,
+                                      nan_policy=self.nan_policy)
                 except NonFiniteLossError:
                     if self.nan_policy != "skip_step":
                         raise  # "raise" propagates; "restore" caught below

@@ -99,8 +99,14 @@ class OpTerms:
     xfer: float = 0.0         # parallel-op resharding collective
     partial: float = 0.0      # fwd partial-sum all-reduce (undoubled)
     grad_sync: float = 0.0    # gradient sync over weight replica axes
+    #                           (all-reduce; reduce-scatter under wus)
     opt_numel: float = 0.0    # master-precision elements the update touches
+    #                           (already /rep under the sharded update)
+    opt_xfer: float = 0.0     # post-update weight all-gather (wus only)
     mem_weights: int = 0      # per-device weight shard bytes
+    mem_opt: int = 0          # per-device bytes ONE optimizer slot costs
+    #                           (== mem_weights replicated; grad weights
+    #                           /rep under the sharded update)
     mem_residual: int = 0     # backward-residual activation bytes
     mem_transient: int = 0    # fused transient workspace bytes (max-reduced)
 
@@ -329,6 +335,8 @@ class Simulator:
         parameter_sync: str = "allreduce",
         remat: bool = False,
         compute_scale: float = 1.0,
+        weight_update_sharding: bool = False,
+        wus_axis: str = "data",
     ):
         self.machine = machine
         self.cost_model = cost_model or OpCostModel(machine)
@@ -350,6 +358,17 @@ class Simulator:
         # flat 2*size/BW, reference default_estimate_sync_cost
         # simulator.cc:786-813 + ParameterSyncType::PS optimizer.h:47)
         self.parameter_sync = parameter_sync
+        # cross-replica weight-update sharding (ZeRO-1, executor
+        # --weight-update-sharding): the grad sync becomes a
+        # reduce-scatter, the update touches numel/rep elements, a
+        # post-update weight all-gather is charged, and optimizer-slot
+        # memory shrinks by 1/rep per grad weight.  Fixed per Simulator
+        # (like parameter_sync), so OpTerms cache keys are unaffected.
+        self.weight_update_sharding = weight_update_sharding
+        # the ONE mesh axis the executor shards the update over
+        # (FFConfig.wus_axis); wus_group() resolves each weight's
+        # actual sharding group from it
+        self.wus_axis = wus_axis
         # (node_key, mesh signature, training) -> OpTerms: per-op
         # contribution terms for the delta/memoized evaluator (the
         # machine and sync mode are fixed per Simulator)
@@ -456,6 +475,67 @@ class Simulator:
             return 2.0 * lat + 2.0 * size / bw
         return self._collective_time("allreduce", size, rep)
 
+    def wus_group(self, w, mesh_axes: Optional[Dict[str, int]] = None) -> int:
+        """The group size this weight's update actually shards over —
+        the executor-fidelity mirror of parallel/zero.py.  1 means the
+        leaf keeps the replicated update (wus off, a mesh without the
+        wus axis, a weight not replicated over it, or no free logical
+        dim evenly divisible by it), so it must keep replicated
+        cost/memory here too.
+
+        The runtime shards over the SINGLE configured wus mesh axis,
+        not the weight's whole replica group, so on a mixed mesh
+        ({data: 4, model: 2}) an 8-way-replicated weight shards 4-ways.
+        Eligibility mirrors zero.py's rule exactly: the axis must be
+        unused by the weight's spec — i.e. by its non-replica dims
+        (replication is expressed by omission, so a replica-dim entry
+        doesn't block) — and a free logical dim must divide evenly.
+        Callers without mesh context (unity's per-op DP stage) fall
+        back to the replica degree — exact on pure-dp meshes, and the
+        authoritative evaluation always re-scores with mesh_axes."""
+        if not self.weight_update_sharding or self.parameter_sync == "none":
+            return 1
+        if mesh_axes is None:
+            n = w.shape.replica_degree
+            if n <= 1:
+                return 1
+        else:
+            n = mesh_axes.get(self.wus_axis, 1)
+            if n <= 1:
+                return 1
+            view = getattr(w, "machine_view", None)
+            if view is not None and any(
+                self.wus_axis in axes
+                for dim, axes in zip(w.shape.dims, view.axes)
+                if not dim.is_replica_dim
+            ):
+                return 1  # axis already shards a logical dim
+        if not any(
+            not d.is_replica_dim and d.degree == 1
+            and d.size > 0 and d.size % n == 0
+            for d in w.shape.dims
+        ):
+            return 1
+        return n
+
+    def weight_update_comm(self, size: int, rep: int) -> Tuple[float, float]:
+        """One weight's (grad-sync, post-update-all-gather) times.
+
+        Replicated update: ring all-reduce of the grad (sync_time), no
+        gather.  Sharded update (ZeRO-1): reduce-scatter the grad +
+        all-gather the updated weight — the same ring bytes as the
+        all-reduce, split around an update that now touches only
+        numel/rep elements.  parameter_sync "none" keeps replicas
+        unsynced, which the sharded update cannot express — it stays on
+        the replicated path."""
+        if not self.weight_update_sharding or self.parameter_sync == "none":
+            return self.sync_time(size, rep), 0.0
+        if self.parameter_sync == "ps":
+            sync = self.sync_time(size, rep)  # flat 2*size/BW grad leg
+        else:
+            sync = self._collective_time("reducescatter", size, rep)
+        return sync, self._collective_time("allgather", size, rep)
+
     def grad_sync_cost(self, graph: Graph, mesh_axes: Dict[str, int]) -> float:
         """Gradient sync over each weight's replica axes (SPMD's psum in
         backward == reference optimizer ncclAllReduce; PS path
@@ -478,15 +558,21 @@ class Simulator:
         terms across candidates.  skip_compute: the op's compute is
         covered by a measured segment — don't run (or cache-measure) the
         per-op cost model for a term the aggregation will discard."""
-        key = (op.node_key(), tuple(sorted(mesh_axes.items())), training,
+        # mesh signature preserves INSERTION order (not sorted): views —
+        # which wus_group reads — are assigned by assign_axes' axis-
+        # declaration-order heuristic, so two orderings of equal-size
+        # axes are distinct mesh configurations and must not alias one
+        # cache entry (strategy_signature keeps order for the same
+        # reason)
+        key = (op.node_key(), tuple(mesh_axes.items()), training,
                skip_compute)
         hit = self._term_cache.get(key)
         if hit is not None:
             self.term_hits += 1
             return hit
         self.term_misses += 1
-        compute = xfer = partial = grad_sync = opt_numel = 0.0
-        mem_weights = mem_residual = mem_transient = 0
+        compute = xfer = partial = grad_sync = opt_numel = opt_xfer = 0.0
+        mem_weights = mem_opt = mem_residual = mem_transient = 0
         if op.op_type != OperatorType.INPUT:
             if op.is_parallel_op():
                 xfer = self.xfer_cost(op, mesh_axes)
@@ -500,13 +586,32 @@ class Simulator:
         for w in op.weights:
             sb = w.shape.shard_bytes()
             mem_weights += sb
+            opt_sb = sb
             if w.create_gradients:
-                opt_numel += sb / max(
+                numel = sb / max(
                     1, np.dtype(w.shape.dtype.np_dtype).itemsize
                 )
                 rep = w.shape.replica_degree
-                if rep > 1:
+                g = self.wus_group(w, mesh_axes)
+                if g > 1:
+                    s, x = self.weight_update_comm(sb, g)
+                    grad_sync += s
+                    if (rep > g and rep % g == 0
+                            and self.parameter_sync == "allreduce"):
+                        # tracked replication beyond the wus axis still
+                        # all-reduces, on the scattered shard
+                        grad_sync += self.sync_time(sb // g, rep // g)
+                    opt_xfer += x
+                    # the update runs on the 1/g shard; slots live
+                    # there permanently
+                    numel /= g
+                    opt_sb = sb // g
+                elif rep > 1:
+                    # replicated update (wus off, or this leaf falls
+                    # back per parallel/zero.py)
                     grad_sync += self.sync_time(sb, rep)
+                opt_numel += numel
+            mem_opt += opt_sb
         for t in op.outputs:
             b = t.shape.shard_bytes()
             if op.op_type in self._FUSED_ACT_TYPES:
@@ -515,9 +620,9 @@ class Simulator:
                 mem_residual += b
         terms = OpTerms(
             compute=compute, xfer=xfer, partial=partial,
-            grad_sync=grad_sync, opt_numel=opt_numel,
-            mem_weights=mem_weights, mem_residual=mem_residual,
-            mem_transient=mem_transient,
+            grad_sync=grad_sync, opt_numel=opt_numel, opt_xfer=opt_xfer,
+            mem_weights=mem_weights, mem_opt=mem_opt,
+            mem_residual=mem_residual, mem_transient=mem_transient,
         )
         self._term_cache[key] = terms
         return terms
@@ -529,14 +634,18 @@ class Simulator:
         transient max; all integer bytes, so order-independent).  The
         remat and inference liveness models need whole-graph structure
         and keep using per_device_memory()."""
-        weights = residuals = transient = 0
+        weights = opt = residuals = transient = 0
         for op in ops:
             terms = self.op_terms(op, mesh_axes, training)
             weights += terms.mem_weights
+            opt += terms.mem_opt
             residuals += terms.mem_residual
             transient = max(transient, terms.mem_transient)
         if training:
-            weights *= 2 + self.optimizer_slots
+            # master + grads replicated either way; slot bytes follow
+            # mem_opt (== mem_weights replicated, /rep under wus, so the
+            # replicated total is bit-identical to weights*(2+slots))
+            weights = weights * 2 + self.optimizer_slots * opt
         return int(weights + residuals + transient)
 
     # -- memory ----------------------------------------------------------
@@ -550,7 +659,8 @@ class Simulator:
     })
 
     def per_device_memory(self, graph: Graph, training: bool = True,
-                          op_scale=None, remat: Optional[bool] = None) -> int:
+                          op_scale=None, remat: Optional[bool] = None,
+                          mesh_axes: Optional[Dict[str, int]] = None) -> int:
         """Peak per-device bytes: weights (+grads+optimizer slots when
         training) plus LIVE activations, not the sum of every tensor
         ever produced (the r02 model summed all of them, so
@@ -576,8 +686,21 @@ class Simulator:
             for op in graph.ops for w in op.weights
         )
         if training:
-            # master copy + grads + optimizer slots
-            weights *= (2 + self.optimizer_slots)
+            if self.weight_update_sharding and self.parameter_sync != "none":
+                # ZeRO-1: slots of grad-bearing replicated weights live
+                # on their 1/group shard; master + grads stay whole;
+                # unshardable leaves fall back to full slots
+                opt = sum(
+                    w.shape.shard_bytes()
+                    // (self.wus_group(w, mesh_axes)
+                        if w.create_gradients else 1)
+                    * scale(op)
+                    for op in graph.ops for w in op.weights
+                )
+                weights = weights * 2 + self.optimizer_slots * opt
+            else:
+                # master copy + grads + optimizer slots
+                weights *= (2 + self.optimizer_slots)
 
         if not training:
             acts = self._liveness_peak(graph, scale)
@@ -645,16 +768,21 @@ class Simulator:
                 acts += internal  # runs inline, residuals persist
         return acts + worst_internal
 
-    def optimizer_update_cost(self, graph: Graph) -> float:
+    def optimizer_update_cost(self, graph: Graph,
+                              mesh_axes: Optional[Dict[str, int]] = None
+                              ) -> float:
         """Weight-update pass: read master weight + grad, write weight,
         touch each optimizer slot — pure HBM traffic in f32 (master
-        precision), one fused kernel under jit."""
+        precision), one fused kernel under jit.  Under weight-update
+        sharding the pass touches only each replicated weight's 1/group
+        shard (arXiv:2004.13336)."""
         numel = 0.0
         for op in graph.ops:
             for w in op.weights:
                 if w.create_gradients:
                     sb = w.shape.shard_bytes()
-                    numel += sb / max(1, np.dtype(w.shape.dtype.np_dtype).itemsize)
+                    n = sb / max(1, np.dtype(w.shape.dtype.np_dtype).itemsize)
+                    numel += n / self.wus_group(w, mesh_axes)
         bytes_moved = numel * 4.0 * (3 + self.optimizer_slots)
         return bytes_moved / self.machine.device().hbm_bandwidth
 
@@ -684,7 +812,7 @@ class Simulator:
             )
         else:
             memory_fn = lambda: self.per_device_memory(  # noqa: E731
-                graph, training
+                graph, training, mesh_axes=mesh_axes
             )
         return self.simulate_ops(
             topo, mesh_axes, training=training, measured_ops=measured_ops,
@@ -712,6 +840,7 @@ class Simulator:
         comm = 0.0
         sync = 0.0
         opt_numel = 0.0
+        opt_xfer = 0.0
         breakdown: Dict[str, float] = {}
         for op in ops:
             if op.op_type == OperatorType.INPUT:
@@ -721,6 +850,7 @@ class Simulator:
             if training:
                 sync += terms.grad_sync
                 opt_numel += terms.opt_numel
+                opt_xfer += terms.opt_xfer
             if op.is_parallel_op():
                 comm += terms.xfer
                 breakdown[op.name] = terms.xfer
@@ -741,10 +871,14 @@ class Simulator:
             analytic_compute += bytes_moved / self.machine.device().hbm_bandwidth
         # XLA overlaps collectives with independent compute; gradient
         # sync gets its own credit when backward/update overlap is
-        # modeled (--search-overlap-backward-update)
+        # modeled (--search-overlap-backward-update).  The sharded
+        # update's weight all-gather (opt_xfer) overlaps the NEXT
+        # step's forward the way other collectives overlap compute, so
+        # it takes the standard credit, not the backward-sync one.
         effective_comm = (
             comm * (1.0 - self.overlap_fraction)
             + sync * (1.0 - self.sync_overlap_fraction)
+            + opt_xfer * (1.0 - self.overlap_fraction)
         )
         compute = compute + analytic_compute * self.compute_scale
         total = compute + effective_comm

@@ -54,16 +54,22 @@ def _random_strategies(graph, n_moves=60, seed=7):
     return out
 
 
+@pytest.mark.parametrize("wus", [False, True],
+                         ids=["replicated-update", "sharded-update"])
 @pytest.mark.parametrize("build", [_transformer, _moe],
                          ids=["transformer", "moe"])
-def test_delta_eval_matches_full_eval_bit_for_bit(build):
+def test_delta_eval_matches_full_eval_bit_for_bit(build, wus):
     """delta_eval(state) == full_eval(state), exactly, for every state
-    of a random move sequence — including the lazy memory term."""
+    of a random move sequence — including the lazy memory term.  Runs
+    under both optimizer-cost models (replicated and ZeRO-1 sharded
+    update, ISSUE 3) since they produce different OpTerms."""
     graph = build().layers
-    ev_delta = IncrementalEvaluator(graph, Simulator(_machine()),
-                                    use_cache=True)
-    ev_full = IncrementalEvaluator(graph, Simulator(_machine()),
-                                   use_cache=False)
+    ev_delta = IncrementalEvaluator(
+        graph, Simulator(_machine(), weight_update_sharding=wus),
+        use_cache=True)
+    ev_full = IncrementalEvaluator(
+        graph, Simulator(_machine(), weight_update_sharding=wus),
+        use_cache=False)
     legal = 0
     for s in _random_strategies(graph):
         rd = ev_delta.evaluate(s)

@@ -1,0 +1,374 @@
+"""Cross-replica weight-update sharding (ZeRO-1, arXiv:2004.13336).
+
+Pins the tentpole contract on the hermetic 8-device CPU mesh:
+
+  * --weight-update-sharding trains to the SAME weights as the
+    replicated update (SGD momentum and Adam, k steps, tight tolerance);
+  * optimizer slots are NamedSharding-sharded along the wus axis —
+    1/dp per-device bytes, asserted via the sharding specs;
+  * checkpoint save -> restore round-trips, including an 8 -> 4 elastic
+    reshard onto a fresh mesh;
+  * the simulator scores the sharded update (numel/N update cost +
+    reduce-scatter/all-gather terms) so predicted step time and memory
+    change consistently when the knob flips, and the choice rides
+    strategy.search_stats.
+"""
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.optimizer import AdamOptimizer, SGDOptimizer
+
+
+def _model(devices, wus, opt, seed=0, num_devices=None):
+    cfg = FFConfig(
+        batch_size=16,
+        num_devices=num_devices or len(devices),
+        weight_update_sharding=wus,
+        seed=seed,
+    )
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 32], name="x")
+    t = ff.dense(x, 64, activation=ActiMode.RELU)
+    t = ff.dense(t, 64, activation=ActiMode.RELU)
+    t = ff.dense(t, 8)
+    ff.softmax(t)
+    ff.compile(
+        optimizer=opt,
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+        devices=devices,
+        seed=seed,
+    )
+    return ff
+
+
+def _data(n=96, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        rng.randn(n, 32).astype(np.float32),
+        rng.randint(0, 8, n).astype(np.int32),
+    )
+
+
+def _assert_trees_close(a, b, rtol=2e-5, atol=2e-6):
+    import jax
+
+    fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        )
+
+
+def _slot_shard_bytes(opt_state):
+    """(per-device, total) bytes over the weight-mirroring slot trees."""
+    import jax
+
+    shard = total = 0
+    for key, sub in opt_state.items():
+        if not isinstance(sub, dict):
+            continue
+        for leaf in jax.tree.leaves(sub):
+            sh = leaf.sharding
+            shard += int(
+                np.prod(sh.shard_shape(leaf.shape)) * leaf.dtype.itemsize
+            )
+            total += int(np.prod(leaf.shape) * leaf.dtype.itemsize)
+    return shard, total
+
+
+@pytest.mark.parametrize(
+    "make_opt",
+    [
+        lambda: SGDOptimizer(lr=0.05, momentum=0.9),
+        lambda: SGDOptimizer(lr=0.05, momentum=0.9, nesterov=True),
+        lambda: AdamOptimizer(alpha=0.01),
+    ],
+    ids=["sgd_momentum", "sgd_nesterov", "adam"],
+)
+def test_sharded_update_matches_replicated(devices8, make_opt):
+    """Same data, same seeds: k steps under --weight-update-sharding
+    must land on the replicated path's weights and slots."""
+    xs, ys = _data()
+    ff_rep = _model(devices8, wus=False, opt=make_opt())
+    ff_wus = _model(devices8, wus=True, opt=make_opt())
+    ff_rep.fit(xs, ys, epochs=2, verbose=False)
+    ff_wus.fit(xs, ys, epochs=2, verbose=False)
+    _assert_trees_close(ff_rep.get_weights(), ff_wus.get_weights())
+    import jax
+
+    _assert_trees_close(
+        jax.tree.map(np.asarray, ff_rep._opt_state),
+        jax.tree.map(np.asarray, ff_wus._opt_state),
+    )
+
+
+def test_opt_state_sharded_one_over_dp(devices8):
+    """Adam m/v land on NamedShardings carrying the wus axis: every
+    evenly-divisible slot holds 1/8 of its bytes per device, and the
+    aggregate per-device footprint shrinks by ~1/dp."""
+    from jax.sharding import NamedSharding
+
+    ff = _model(devices8, wus=True, opt=AdamOptimizer(alpha=0.01))
+    dp = 8
+    for op_name, entry in ff._opt_state["m"].items():
+        for wname, leaf in entry.items():
+            sh = leaf.sharding
+            assert isinstance(sh, NamedSharding)
+            if any(d % dp == 0 for d in leaf.shape):
+                # every slot with an evenly-divisible dim is sharded
+                assert "data" in [
+                    a
+                    for e in sh.spec
+                    if e is not None
+                    for a in (e if isinstance(e, tuple) else (e,))
+                ], (op_name, wname, sh.spec)
+    shard, total = _slot_shard_bytes(ff._opt_state)
+    # the three kernels dominate; biases may stay replicated
+    assert shard <= total // dp + total // 20, (shard, total)
+
+    ff_rep = _model(devices8, wus=False, opt=AdamOptimizer(alpha=0.01))
+    shard_rep, total_rep = _slot_shard_bytes(ff_rep._opt_state)
+    assert total_rep == total
+    assert shard_rep == total_rep  # replicated: full copy per device
+    assert shard * 4 < shard_rep  # >= 4x shrink on the 8-way mesh
+
+
+def test_unshardable_leaves_fall_back_per_leaf():
+    """A dim that doesn't divide by the wus axis keeps its strategy
+    sharding (replicated update for that leaf only)."""
+    from jax.sharding import PartitionSpec
+
+    from flexflow_tpu.parallel.zero import shard_update_spec
+
+    assert shard_update_spec(PartitionSpec(), (64, 32), "data", 8) == \
+        PartitionSpec("data", None)
+    assert shard_update_spec(PartitionSpec(), (10,), "data", 8) is None
+    # axis already used by the strategy -> no double-sharding
+    assert shard_update_spec(PartitionSpec("data"), (64,), "data", 8) is None
+    # first free divisible dim wins; sharded dims are skipped
+    assert shard_update_spec(
+        PartitionSpec("model", None), (64, 24), "data", 8
+    ) == PartitionSpec("model", "data")
+
+
+def test_checkpoint_roundtrip_and_elastic_reshard(devices8, tmp_path):
+    """Sharded slots save and restore; an 8 -> 4 elastic restore
+    reshards them onto the survivor mesh's ZeRO-1 layout."""
+    import jax
+
+    from flexflow_tpu.checkpoint import LocalCheckpointManager
+
+    xs, ys = _data()
+    ff = _model(devices8, wus=True, opt=AdamOptimizer(alpha=0.01))
+    ff.fit(xs, ys, epochs=1, verbose=False)
+    saved_w = ff.get_weights()
+    saved_opt = jax.tree.map(np.asarray, ff._opt_state)
+
+    mgr = LocalCheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(ff, step=1)
+    meta = mgr.restore_meta()
+    assert meta["weight_update_sharding"] is True
+    assert meta["wus_axis"] == "data"
+
+    ff.fit(xs, ys, epochs=1, verbose=False)  # diverge
+    assert mgr.restore(ff) == 1
+    _assert_trees_close(ff.get_weights(), saved_w, rtol=0, atol=0)
+    _assert_trees_close(
+        jax.tree.map(np.asarray, ff._opt_state), saved_opt, rtol=0, atol=0
+    )
+
+    # elastic: restore into a fresh 4-device model (wus still on)
+    ff4 = _model(devices8[:4], wus=True, opt=AdamOptimizer(alpha=0.01),
+                 seed=7)
+    assert mgr.restore(ff4) == 1
+    _assert_trees_close(ff4.get_weights(), saved_w, rtol=0, atol=0)
+    _assert_trees_close(
+        jax.tree.map(np.asarray, ff4._opt_state), saved_opt, rtol=0, atol=0
+    )
+    shard4, total4 = _slot_shard_bytes(ff4._opt_state)
+    assert shard4 < total4  # still sharded, now 1/4 per device
+    # the restored 4-device model keeps training
+    ff4.fit(xs, ys, epochs=1, verbose=False)
+
+
+def test_wus_noop_without_data_axis(devices8):
+    """A mesh without the wus axis (tp-only strategy) disables the
+    sharded update instead of failing."""
+    from flexflow_tpu.strategy import Strategy
+
+    cfg = FFConfig(batch_size=16, num_devices=2,
+                   weight_update_sharding=True)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 32], name="x")
+    t = ff.dense(x, 64, activation=ActiMode.RELU)
+    t = ff.dense(t, 8)
+    ff.softmax(t)
+    from flexflow_tpu.ops.op import ShardConfig
+
+    s = Strategy(mesh_axes={"model": 2})
+    s.shard_configs["dense_0"] = ShardConfig(channel=2)
+    s.shard_configs["dense_1"] = ShardConfig(reduction=2)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy=s, devices=devices8[:2])
+    assert ff.executor.wus_axis is None
+    xs, ys = _data(32)
+    m = ff.train_step({"x": xs[:16]}, ys[:16])
+    assert np.isfinite(float(m["loss"]))
+
+
+# -- simulator parity ----------------------------------------------------
+
+def _transformer_graph():
+    from flexflow_tpu.models.transformer import build_transformer
+
+    ff = FFModel(FFConfig())
+    build_transformer(ff, batch_size=8, seq_length=16, hidden_size=32,
+                      num_layers=2, num_heads=4)
+    return ff.layers
+
+
+def test_simulator_scores_sharded_update(devices8):
+    """Flipping the knob changes the predicted step time the right way:
+    the update term shrinks by ~1/dp while the grad ring bytes stay
+    (all-reduce == reduce-scatter + all-gather), and modeled per-device
+    memory drops by the slot shard savings."""
+    from flexflow_tpu.pcg.evaluator import IncrementalEvaluator
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+    from flexflow_tpu.sim.simulator import Simulator
+    from flexflow_tpu.strategy import data_parallel_strategy
+
+    graph = _transformer_graph()
+    machine = TpuPodModel(topology=(8,))
+    s = data_parallel_strategy(8)
+    ev_off = IncrementalEvaluator(graph, Simulator(machine))
+    ev_on = IncrementalEvaluator(
+        graph, Simulator(machine, weight_update_sharding=True)
+    )
+    off, on = ev_off.evaluate(s), ev_on.evaluate(s)
+    assert off is not None and on is not None
+    # numel/N update cost: strictly cheaper with replicated weights
+    assert on.total_time < off.total_time
+    # RS+AG == AR in the ring model: comm/sync totals stay consistent
+    assert on.compute_time < off.compute_time
+    # slots shrink ~1/dp; weights+grads+activations unchanged
+    assert on.per_device_memory < off.per_device_memory
+
+    # the delta vs the whole-graph optimizer_update_cost agree on scale
+    sim_off = Simulator(machine)
+    sim_on = Simulator(machine, weight_update_sharding=True)
+    from flexflow_tpu.strategy import apply_strategy, assign_views
+
+    g = apply_strategy(graph, s)
+    assign_views(g, s.mesh_axes)
+    c_off = sim_off.optimizer_update_cost(g)
+    c_on = sim_on.optimizer_update_cost(g)
+    assert c_on < c_off
+    assert c_off / c_on == pytest.approx(8.0, rel=0.2)
+
+
+def test_simulator_mirrors_per_leaf_fallback():
+    """A weight with no free dim divisible by the wus group keeps
+    replicated cost/memory in the simulator — the executor falls back
+    to the replicated update for exactly those leaves — and the group
+    is the SINGLE configured wus axis, not the whole replica product
+    (mixed meshes), vanishing entirely on meshes without that axis."""
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+    from flexflow_tpu.sim.simulator import Simulator
+    from flexflow_tpu.strategy import (
+        Strategy,
+        apply_strategy,
+        assign_views,
+        data_parallel_strategy,
+    )
+
+    cfg = FFConfig()
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 32], name="x")
+    t = ff.dense(x, 7)  # kernel (32,7), bias (7): bias can't shard by 8
+    ff.softmax(t)
+    s = data_parallel_strategy(8)
+    g = apply_strategy(ff.layers, s)
+    assign_views(g, s.mesh_axes)
+    dense = next(op for op in g.ops if op.name == "dense_0")
+    kernel, bias = dense.weights[0], dense.weights[1]
+    machine = TpuPodModel(topology=(8,))
+    sim_on = Simulator(machine, weight_update_sharding=True)
+    sim_off = Simulator(machine)
+    assert sim_on.wus_group(kernel, s.mesh_axes) == 8  # 32 % 8 == 0
+    assert sim_on.wus_group(bias, s.mesh_axes) == 1   # 7: no divisible dim
+    assert sim_off.wus_group(kernel, s.mesh_axes) == 1  # knob off
+
+    # bias numel stays whole in the sharded-update accounting
+    kb = kernel.shape.shard_bytes() / 4
+    bb = bias.shape.shard_bytes() / 4
+    expected = (kb / 8 + bb) / (kb + bb)
+    assert (sim_on.optimizer_update_cost(g, s.mesh_axes)
+            / sim_off.optimizer_update_cost(g, s.mesh_axes)
+            ) == pytest.approx(expected, rel=1e-6)
+
+    # mixed mesh: the executor shards over the 'data' axis only, so an
+    # 8-way-replicated weight shards 4-ways, not 8
+    from flexflow_tpu.ops.op import ShardConfig
+
+    ff2 = FFModel(FFConfig())
+    x2 = ff2.create_tensor([16, 32], name="x")
+    t2 = ff2.dense(x2, 64)
+    ff2.softmax(t2)
+    s2 = Strategy(mesh_axes={"data": 4, "model": 2})
+    s2.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": 4})]
+    g2 = apply_strategy(ff2.layers, s2)
+    assign_views(g2, s2.mesh_axes)
+    dense2 = next(op for op in g2.ops if op.name == "dense_0")
+    k2 = dense2.weights[0]
+    if k2.shape.replica_degree == 8:  # replicated over both axes
+        assert sim_on.wus_group(k2, s2.mesh_axes) == 4
+
+    # tp-only mesh: executor disables wus (no 'data' axis) — so must we
+    ff3 = FFModel(FFConfig())
+    x3 = ff3.create_tensor([16, 32], name="x")
+    t3 = ff3.dense(x3, 64)
+    t3 = ff3.dense(t3, 8)
+    ff3.softmax(t3)
+    s3 = Strategy(mesh_axes={"model": 8})
+    s3.shard_configs["dense_0"] = ShardConfig(channel=8)
+    s3.shard_configs["dense_1"] = ShardConfig(reduction=8)
+    g3 = apply_strategy(ff3.layers, s3)
+    assign_views(g3, s3.mesh_axes)
+    assert any(w.shape.replica_degree > 1
+               for op in g3.ops for w in op.weights)
+    for op in g3.ops:
+        for w in op.weights:
+            assert sim_on.wus_group(w, s3.mesh_axes) == 1
+
+
+def test_search_stats_surface_the_choice(devices8):
+    """The winning strategy's search_stats record the update-sharding
+    mode candidates were scored under (both searches)."""
+    for algo in ("mcmc", "unity"):
+        cfg = FFConfig(batch_size=32, num_devices=8, search_budget=8,
+                       search_algo=algo, search_calibrate=False,
+                       weight_update_sharding=True)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([32, 16], name="x")
+        t = ff.dense(x, 32, activation=ActiMode.RELU)
+        t = ff.dense(t, 8)
+        ff.softmax(t)
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   devices=devices8)
+        assert ff.strategy.search_stats["weight_update_sharding"] is True
+
+
+def test_config_cli_flags():
+    cfg = FFConfig.from_args(["--weight-update-sharding"])
+    assert cfg.weight_update_sharding is True and cfg.wus_axis == "data"
+    cfg = FFConfig.from_args(["--weight-update-sharding", "--wus-axis", "dp"])
+    assert cfg.wus_axis == "dp"
+    assert FFConfig.from_args([]).weight_update_sharding is False
+    with pytest.raises(ValueError):
+        FFConfig(wus_axis="")
