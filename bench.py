@@ -157,12 +157,29 @@ def _fidelity(ff, dev, dt, tag, leg=None):
             segment_costs=seg_costs,
         )
         actual_ms = dt * 1e3
-        return {
+        out = {
             "predicted_step_ms": round(res.total_time * 1e3, 2),
             "actual_step_ms": round(actual_ms, 2),
             "predicted_vs_actual": round(res.total_time * 1e3 / actual_ms, 3),
             "calibration": f"{len(seg_costs)} regions / {covered} ops measured",
         }
+        # unified fidelity record (obs/fidelity.py, manifest v8): the
+        # same schema fit-time telemetry emits, so bench captures and
+        # run_telemetry.jsonl records are directly comparable.  Built
+        # from the SAME SimResult as the predicted_* fields above (no
+        # second simulation, no disagreeing numbers).
+        try:
+            from flexflow_tpu.obs.fidelity import fidelity_record
+
+            out["fidelity_record"] = fidelity_record(
+                ff, dt, steps_measured=(leg or {}).get("iters", 0),
+                source=f"bench/{tag}", segment_costs=seg_costs,
+                sim_result=res,
+            )
+        except Exception as e:
+            print(f"bench[{tag}]: fidelity record failed: {e}",
+                  file=sys.stderr)
+        return out
     except Exception as e:  # pragma: no cover - diagnostics only
         print(f"bench[{tag}]: prediction check failed: {e}", file=sys.stderr)
         return {}

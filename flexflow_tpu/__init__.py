@@ -37,6 +37,7 @@ from .initializer import (
 from .loss import Loss
 from .metrics import Metrics, PerfMetrics
 from .model import FFModel
+from .obs import MetricsRegistry, RunTelemetry
 from .optimizer import AdamOptimizer, SGDOptimizer
 from .recompile import RecompileState
 from .resilience import (

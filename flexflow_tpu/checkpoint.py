@@ -66,6 +66,8 @@ class CheckpointManager:
     # -- save -----------------------------------------------------------
     def save(self, ff, step: int, wait: bool = True):
         """Persist weights + optimizer state + op state + rng + strategy."""
+        from .obs.trace import tracer_of
+
         ocp = self._ocp
         state = {
             "weights": ff._weights,
@@ -73,15 +75,17 @@ class CheckpointManager:
             "op_state": ff._state,
             "rng": jax.random.key_data(ff._rng),
         }
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(_meta(ff, step)),
-            ),
-        )
-        if wait:
-            self._mgr.wait_until_finished()
+        with tracer_of(ff).span("checkpoint_write", cat="checkpoint",
+                                step=step, backend="orbax"):
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    meta=ocp.args.JsonSave(_meta(ff, step)),
+                ),
+            )
+            if wait:
+                self._mgr.wait_until_finished()
 
     # -- restore --------------------------------------------------------
     def latest_step(self) -> Optional[int]:
@@ -237,24 +241,28 @@ class LocalCheckpointManager:
     def save(self, ff, step: int, wait: bool = True):
         from jax.tree_util import keystr, tree_flatten_with_path
 
-        tree = jax.tree.map(np.asarray, self._state_tree(ff))
-        leaves, _ = tree_flatten_with_path(tree)
-        flat = {keystr(path): leaf for path, leaf in leaves}
-        tmp = os.path.join(self.directory, f".tmp-{step}-{os.getpid()}")
-        os.makedirs(tmp)
-        try:
-            np.savez(os.path.join(tmp, "state.npz"), **flat)
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump(_meta(ff, step), f)
-            final = self._path(step)
-            if os.path.exists(final):
-                # a restored run replaying past an old cadence point
-                # re-saves the same step; the fresh write wins
-                shutil.rmtree(final)
-            os.replace(tmp, final)
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
-            raise
+        from .obs.trace import tracer_of
+
+        with tracer_of(ff).span("checkpoint_write", cat="checkpoint",
+                                step=step, backend="local"):
+            tree = jax.tree.map(np.asarray, self._state_tree(ff))
+            leaves, _ = tree_flatten_with_path(tree)
+            flat = {keystr(path): leaf for path, leaf in leaves}
+            tmp = os.path.join(self.directory, f".tmp-{step}-{os.getpid()}")
+            os.makedirs(tmp)
+            try:
+                np.savez(os.path.join(tmp, "state.npz"), **flat)
+                with open(os.path.join(tmp, "meta.json"), "w") as f:
+                    json.dump(_meta(ff, step), f)
+                final = self._path(step)
+                if os.path.exists(final):
+                    # a restored run replaying past an old cadence point
+                    # re-saves the same step; the fresh write wins
+                    shutil.rmtree(final)
+                os.replace(tmp, final)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
         self._prune()
 
     def _prune(self):

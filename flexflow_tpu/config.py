@@ -157,6 +157,18 @@ class FFConfig:
     retry_backoff: float = 0.1  # base backoff seconds (exponential, jittered)
     nan_policy: str = "raise"  # raise | skip_step | restore | off
 
+    # -- observability (obs/, docs/OBSERVABILITY.md).  trace_dir turns
+    #    on the full telemetry pipeline and names where the artifacts
+    #    land (trace.json Chrome trace + run_telemetry.jsonl metrics);
+    #    telemetry=True records in memory without writing files (drain
+    #    via FFModel.telemetry).  Disabled (the default) is zero-cost on
+    #    the step hot path: no span objects are ever allocated.
+    trace_dir: Optional[str] = None
+    telemetry: bool = False
+    # jax.profiler.trace device capture around a step window,
+    # "start:count" (e.g. "3:2" profiles steps 3 and 4); needs trace_dir
+    profile_steps: Optional[str] = None
+
     def __post_init__(self):
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(
@@ -181,6 +193,15 @@ class FFConfig:
             )
         if not self.wus_axis:
             raise ValueError("wus_axis must be a non-empty mesh axis name")
+        if self.profile_steps is not None:
+            from .obs import parse_profile_steps
+
+            parse_profile_steps(self.profile_steps)  # raises on bad spec
+            if not self.trace_dir:
+                raise ValueError(
+                    "profile_steps needs trace_dir set (the jax profiler "
+                    "capture is written under it)"
+                )
 
     def should_calibrate(self) -> bool:
         """Resolve search_calibrate's auto mode: measured costs when a
@@ -272,6 +293,10 @@ class FFConfig:
                        default=0.1)
         p.add_argument("--nan-policy", dest="nan_policy", type=str,
                        default="raise", choices=NAN_POLICIES)
+        p.add_argument("--trace-dir", dest="trace_dir", type=str, default=None)
+        p.add_argument("--telemetry", dest="telemetry", action="store_true")
+        p.add_argument("--profile-steps", dest="profile_steps", type=str,
+                       default=None)
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -319,6 +344,9 @@ class FFConfig:
             max_restarts=args.max_restarts,
             retry_backoff=args.retry_backoff,
             nan_policy=args.nan_policy,
+            trace_dir=args.trace_dir,
+            telemetry=args.telemetry,
+            profile_steps=args.profile_steps,
         )
 
 

@@ -420,7 +420,15 @@ class GraphExecutor:
 
     def _exec_op(self, op: Op, env: Dict[int, jax.Array], ctx: Dict):
         """Execute one PCG op into env — the shared body of the flat
-        interpreter and the remat segment functions."""
+        interpreter and the remat segment functions.  The op's jax ops
+        are emitted under `jax.named_scope(op.name)` so device-side
+        profiles (jax.profiler / XLA op_name metadata) attribute to PCG
+        operator names; named_scope runs at trace time only, so the
+        compiled step pays nothing per iteration."""
+        with jax.named_scope(op.name):
+            self._exec_op_traced(op, env, ctx)
+
+    def _exec_op_traced(self, op: Op, env: Dict[int, jax.Array], ctx: Dict):
         training = ctx["training"]
         to_compute = ctx["to_compute"]
         if (

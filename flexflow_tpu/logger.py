@@ -67,3 +67,10 @@ search_logger = RecursiveLogger("flexflow_tpu.search")
 # `counters` so bench runs can scrape recovery overhead the same way
 # they scrape search throughput
 resilience_logger = RecursiveLogger("flexflow_tpu.resilience")
+
+# on-chip calibration observability (profiler.measure_segment_costs):
+# region-measurement failures emit here instead of ad-hoc stdout
+# prints, so they land in run telemetry (the obs TelemetryLogHandler
+# listens on the flexflow_tpu logger tree) and in any app-configured
+# logging sink
+calib_logger = RecursiveLogger("flexflow_tpu.calib")

@@ -134,6 +134,11 @@ def device_put_like(saved, current):
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None):
         self.config = config or FFConfig()
+        # run telemetry (obs/): NULL-tracer + in-memory registry unless
+        # FFConfig.trace_dir/telemetry turns recording on
+        from .obs import RunTelemetry
+
+        self.telemetry = RunTelemetry.from_config(self.config)
         self.layers = Graph()  # frontend (degree-1) graph
         self.operators: Optional[Graph] = None  # compiled strategy graph
         self.strategy: Optional[Strategy] = None
@@ -629,7 +634,31 @@ class FFModel:
         devices: Optional[Sequence] = None,
         seed: Optional[int] = None,
     ):
+        tel = self.telemetry
+        t0 = time.perf_counter()
+        with tel.tracer.span("compile", cat="compile"):
+            result = self._compile_inner(
+                optimizer=optimizer, loss_type=loss_type, metrics=metrics,
+                comp_mode=comp_mode, strategy=strategy, devices=devices,
+                seed=seed,
+            )
+        tel.metrics.gauge("compile/total_ms").set(
+            (time.perf_counter() - t0) * 1e3
+        )
+        return result
+
+    def _compile_inner(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type: Union[LossType, str] = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics: Sequence[Union[MetricsType, str]] = (MetricsType.ACCURACY,),
+        comp_mode: CompMode = CompMode.TRAINING,
+        strategy: Optional[Strategy] = None,
+        devices: Optional[Sequence] = None,
+        seed: Optional[int] = None,
+    ):
         cfg = self.config
+        tel = self.telemetry
         self._compile_args = {
             "loss_type": loss_type,
             "metrics": tuple(metrics),
@@ -659,10 +688,17 @@ class FFModel:
                 # the legacy SysML'19 path (model.cc:3285)
                 from .pcg.search import mcmc_search, unity_search
 
-                if cfg.search_algo == "mcmc":
-                    strategy = mcmc_search(self, num_devices)
-                else:
-                    strategy = unity_search(self, num_devices)
+                t_search = time.perf_counter()
+                with tel.tracer.span("search", cat="search",
+                                     algo=cfg.search_algo,
+                                     devices=num_devices):
+                    if cfg.search_algo == "mcmc":
+                        strategy = mcmc_search(self, num_devices)
+                    else:
+                        strategy = unity_search(self, num_devices)
+                tel.metrics.gauge("compile/search_ms").set(
+                    (time.perf_counter() - t_search) * 1e3
+                )
             else:
                 strategy = data_parallel_strategy(num_devices)
         self.strategy = strategy
@@ -757,18 +793,23 @@ class FFModel:
             # compile/recompile (ops are rebuilt, the config persists)
             op._iter_seq_length = self.iter_config.seq_length
         self._step_cache = {}
-        self._weights, self._state = self.executor.init_weights(
-            seed if seed is not None else cfg.seed
-        )
+        # init_weights jit-executes eagerly, so this span IS a real XLA
+        # compile; build_step/eval/forward only stage traces (their XLA
+        # compile lands in the first fit step — see docs/OBSERVABILITY.md)
+        with tel.tracer.span("init_weights", cat="compile"):
+            self._weights, self._state = self.executor.init_weights(
+                seed if seed is not None else cfg.seed
+            )
         # ZeRO-1 layout: slots move to their 1/N per-device shard here,
         # so every downstream consumer (step fn, checkpoint save/restore,
         # recompile's device_put_like) inherits the sharded placement
         self._opt_state = self.executor.shard_opt_state(
             self.optimizer.init_state(self._weights)
         )
-        self._step_fn = self.executor.build_step()
-        self._eval_fn = self.executor.build_eval_step()
-        self._fwd_fn = self.executor.build_forward()
+        with tel.tracer.span("build_step_fns", cat="compile"):
+            self._step_fn = self.executor.build_step()
+            self._eval_fn = self.executor.build_eval_step()
+            self._fwd_fn = self.executor.build_forward()
         self._step_cache[self.iter_config.seq_length] = (
             self._step_fn, self._eval_fn, self._fwd_fn,
         )
@@ -877,9 +918,11 @@ class FFModel:
             op._iter_seq_length = seq_length
         cached = self._step_cache.get(seq_length)
         if cached is None:
-            self._step_fn = self.executor.build_step()
-            self._eval_fn = self.executor.build_eval_step()
-            self._fwd_fn = self.executor.build_forward()
+            with self.telemetry.tracer.span("build_step_fns", cat="compile",
+                                            seq_length=seq_length):
+                self._step_fn = self.executor.build_step()
+                self._eval_fn = self.executor.build_eval_step()
+                self._fwd_fn = self.executor.build_forward()
             self._step_cache[seq_length] = (
                 self._step_fn, self._eval_fn, self._fwd_fn,
             )
@@ -891,7 +934,12 @@ class FFModel:
         """One jitted iteration: forward + loss + backward + metrics + update."""
         self._check_not_decode_graph("train_step()")
         self.set_iteration_config(seq_length)
-        put_inputs, put_labels = self._device_put_batch(inputs, labels)
+        tel = self.telemetry
+        if tel.enabled:
+            with tel.tracer.span("host_transfer", cat="data"):
+                put_inputs, put_labels = self._device_put_batch(inputs, labels)
+        else:  # hot path: no span objects when telemetry is off
+            put_inputs, put_labels = self._device_put_batch(inputs, labels)
         self._rng, step_rng = jax.random.split(self._rng)
         self._weights, self._opt_state, self._state, m = self._step_fn(
             self._weights, self._opt_state, self._state, put_inputs, put_labels,
@@ -936,13 +984,48 @@ class FFModel:
             from .profiler import print_profile, profile_operators
 
             print_profile(profile_operators(self))
+        # telemetry: all per-step work lives behind ONE boolean so the
+        # disabled path allocates no span objects on the hot loop
+        tel = self.telemetry
+        tracing = tel.enabled
+        tracer = tel.tracer
+        step_hist = tel.metrics.histogram("fit/step_ms") if tracing else None
         for cb in callbacks:
             cb.on_train_begin(self)
+        try:
+            return self._fit_loop(
+                loader, epochs, callbacks, verbose, batch_size, num_batches,
+                history, tel, tracing, tracer, step_hist,
+            )
+        finally:
+            # flush in ALL exits: a crashed traced run (the case
+            # observability exists for) still writes its artifacts, and
+            # an interrupted --profile-steps window stops the profiler
+            if tracing:
+                tel.flush()
+
+    def _fit_loop(self, loader, epochs, callbacks, verbose, batch_size,
+                  num_batches, history, tel, tracing, tracer, step_hist):
+        global_step = 0
+        epoch_step_s: List[float] = []  # per-epoch seconds/step
         for epoch in range(epochs):
             pm = PerfMetrics()
             t0 = time.perf_counter()
             for batch, labels in loader:
-                m = self.train_step(batch, labels)
+                if tracing:
+                    tel.on_step(global_step)  # jax.profiler window
+                    ts = time.perf_counter()
+                    # NOTE: steps dispatch asynchronously, so this span
+                    # is host dispatch time (the first one also carries
+                    # the XLA compile); device time shows up in the
+                    # epoch's device_drain span and the fidelity record
+                    with tracer.span("step", cat="train", step=global_step,
+                                     epoch=epoch):
+                        m = self.train_step(batch, labels)
+                    step_hist.observe((time.perf_counter() - ts) * 1e3)
+                    global_step += 1
+                else:
+                    m = self.train_step(batch, labels)
                 # device-side accumulation: float(v) here would force a
                 # per-step host<->device sync that breaks the donated
                 # step chain; PerfMetrics sums on device and converts
@@ -954,10 +1037,19 @@ class FFModel:
                     fn = getattr(op, "score_fn", None)
                     if fn is not None and op._is_legacy_score():
                         op.update_score(float(fn(self)))
-            jax.block_until_ready(jax.tree.leaves(self._weights)[0])
+            with tracer.span("device_drain", cat="train", epoch=epoch):
+                jax.block_until_ready(jax.tree.leaves(self._weights)[0])
             dt = time.perf_counter() - t0
             pm.finalize()  # the epoch's single metrics host transfer
             throughput = num_batches * batch_size / dt
+            if tracing:
+                epoch_step_s.append(dt / max(1, num_batches))
+                tel.metrics.histogram("fit/epoch_s").observe(dt)
+                tel.metrics.gauge("fit/throughput_sps").set(throughput)
+                tel.metrics.fold_counters("fit/metrics", {
+                    f: getattr(pm, f) for f in PerfMetrics._FIELDS
+                })
+                tel.metrics.gauge("fit/metrics/accuracy").set(pm.accuracy)
             if verbose:
                 print(
                     f"epoch {epoch}: {pm.summary()} "
@@ -971,7 +1063,18 @@ class FFModel:
                 break
         for cb in callbacks:
             cb.on_train_end(self)
-        return history
+        if tracing and epoch_step_s:
+            # fidelity record: predicted vs measured step time.  The
+            # best epoch is the steady-state measurement (epoch 0 pays
+            # the step fn's XLA compile; with a single epoch that cost
+            # is in the measurement — noted in the record's source docs)
+            from .obs.fidelity import report_fidelity
+
+            report_fidelity(
+                self, min(epoch_step_s),
+                steps_measured=global_step, source="fit",
+            )
+        return history  # fit's finally clause flushes the artifacts
 
     def fit_resilient(
         self,

@@ -1,0 +1,122 @@
+"""Simulator-fidelity reporting: predicted vs measured step time.
+
+After a traced `FFModel.fit` (or a bench leg), compare the simulator's
+`predicted_step_ms` for the compiled strategy against the measured step
+timeline and emit a per-run fidelity record — so sim drift becomes a
+tracked artifact in `run_telemetry.jsonl` instead of a bench footnote,
+and the (predicted, measured) pairs accumulate into exactly the dataset
+a learned TPU cost model trains on (arXiv:2008.01040).
+
+The predictor is configured the way the strategy search's simulator was
+(same fitted overlap constants, parameter-sync mode, remat and
+weight-update-sharding flags), so the record measures the fidelity of
+the costs the search actually ranked candidates with.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+FIDELITY_SCHEMA = 1
+
+
+def predicted_step(ff, segment_costs: Optional[
+        Sequence[Tuple[Sequence[int], float]]] = None):
+    """SimResult for the compiled model's strategy on its mesh, using
+    the search's own simulator configuration
+    (pcg.mcmc.make_search_simulator — shared, not duplicated, so a new
+    simulator knob cannot silently diverge the two)."""
+    from ..pcg.mcmc import make_search_simulator
+    from ..sim.machine_model import make_machine_model
+    from ..sim.simulator import make_cost_model
+
+    cfg = ff.config
+    num_devices = int(ff.mesh.devices.size)
+    machine = make_machine_model(cfg, num_devices)
+    cost_model = make_cost_model(cfg, machine)
+    sim = make_search_simulator(cfg, machine, cost_model)
+    return sim.simulate(
+        ff.operators, ff.strategy.mesh_axes, training=True,
+        segment_costs=segment_costs,
+    )
+
+
+def fidelity_record(
+    ff,
+    measured_step_s: float,
+    steps_measured: int = 0,
+    source: str = "fit",
+    segment_costs: Optional[Sequence[Tuple[Sequence[int], float]]] = None,
+    sim_result=None,
+) -> Dict:
+    """The per-run fidelity record (stable schema, FIDELITY_SCHEMA).
+
+    measured_step_s: steady-state seconds per training step (callers
+    exclude the compile step).  segment_costs, when provided (bench legs
+    run profiler.measure_segment_costs), calibrates the prediction at
+    fused-region granularity and is summarized under "regions".
+    sim_result: a caller's already-computed SimResult (bench passes its
+    own so the record agrees with its predicted_* fields instead of
+    paying — and possibly disagreeing with — a second simulation)."""
+    res = (
+        sim_result if sim_result is not None
+        else predicted_step(ff, segment_costs=segment_costs)
+    )
+    predicted_ms = res.total_time * 1e3
+    measured_ms = measured_step_s * 1e3
+    record: Dict = {
+        "fidelity_schema": FIDELITY_SCHEMA,
+        "source": source,
+        "predicted_step_ms": round(predicted_ms, 4),
+        "measured_step_ms": round(measured_ms, 4),
+        "predicted_vs_measured": (
+            round(predicted_ms / measured_ms, 4) if measured_ms > 0 else None
+        ),
+        "predicted_compute_ms": round(res.compute_time * 1e3, 4),
+        "predicted_comm_ms": round(res.comm_time * 1e3, 4),
+        "predicted_sync_ms": round(res.sync_time * 1e3, 4),
+        "mesh_axes": dict(ff.strategy.mesh_axes),
+        "num_devices": int(ff.mesh.devices.size),
+        "steps_measured": int(steps_measured),
+        "calibrated": bool(segment_costs),
+        "backend": str(ff.mesh.devices.flat[0].platform),
+    }
+    if segment_costs:
+        regions: List[Dict] = [
+            {"ops": len(guids), "measured_ms": round(cost * 1e3, 4)}
+            for guids, cost in segment_costs
+        ]
+        record["regions"] = regions
+        record["region_ops_covered"] = sum(r["ops"] for r in regions)
+    return record
+
+
+def report_fidelity(ff, measured_step_s: float, steps_measured: int = 0,
+                    source: str = "fit", segment_costs=None) -> Optional[Dict]:
+    """Build the record and attach it to the model's telemetry registry
+    (when telemetry is enabled).  Returns the record, or None when the
+    prediction cannot be computed (never fails a training run over a
+    diagnostic)."""
+    try:
+        record = fidelity_record(
+            ff, measured_step_s, steps_measured=steps_measured,
+            source=source, segment_costs=segment_costs,
+        )
+    except Exception as e:
+        from ..logger import calib_logger
+
+        calib_logger.info("fidelity prediction failed: %r", e)
+        return None
+    tel = getattr(ff, "telemetry", None)
+    if tel is not None and tel.enabled:
+        tel.metrics.fidelity(record)
+        tel.metrics.gauge("fidelity/predicted_step_ms").set(
+            record["predicted_step_ms"]
+        )
+        tel.metrics.gauge("fidelity/measured_step_ms").set(
+            record["measured_step_ms"]
+        )
+        if record["predicted_vs_measured"] is not None:
+            tel.metrics.gauge("fidelity/predicted_vs_measured").set(
+                record["predicted_vs_measured"]
+            )
+    return record

@@ -1,0 +1,220 @@
+"""Metrics registry: typed counters/gauges/histograms + a JSONL drain.
+
+Unifies the repo's three previously disjoint observability surfaces —
+the searches' `EvalStats`/`strategy.search_stats` dicts, the resilience
+supervisor's `resilience_logger` counters, and `PerfMetrics` epoch
+summaries — into one registry that drains to a per-run
+`run_telemetry.jsonl` with a stable schema (SCHEMA_VERSION below; see
+docs/OBSERVABILITY.md).
+
+Record schema, one JSON object per line:
+
+    {"schema": 1, "ts": <unix seconds>, "kind": "counter" | "gauge" |
+     "histogram" | "event" | "fidelity", "name": <str>, ...payload}
+
+    counter   -> {"value": int}
+    gauge     -> {"value": float}
+    histogram -> {"count", "sum", "min", "max", "mean"}
+    event     -> {"fields": {...}}   (log records, one-shot markers)
+    fidelity  -> the obs/fidelity.py record verbatim
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+
+class Counter:
+    """Monotonic cumulative count."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        self.value += n
+
+    def record(self) -> Dict:
+        return {"kind": "counter", "name": self.name, "value": self.value}
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def record(self) -> Dict:
+        return {"kind": "gauge", "name": self.name, "value": self.value}
+
+
+class Histogram:
+    """Streaming count/sum/min/max summary of observations."""
+
+    __slots__ = ("name", "count", "sum", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def record(self) -> Dict:
+        return {
+            "kind": "histogram",
+            "name": self.name,
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else 0.0,
+            "max": self.max if self.count else 0.0,
+            "mean": self.mean,
+        }
+
+
+class MetricsRegistry:
+    """Create-or-get typed metrics; same-name different-type is a bug
+    and raises."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._events: List[Dict] = []
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, not {cls.__name__}"
+            )
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    # -- bulk folds ------------------------------------------------------
+    def fold_counters(self, group: str, mapping: Dict) -> None:
+        """Snapshot a flat counters dict (search_stats, supervisor
+        counters, PerfMetrics fields) as gauges named `group/key` —
+        these surfaces report cumulative totals, so last-write-wins is
+        the correct fold."""
+        for k, v in mapping.items():
+            if isinstance(v, bool):
+                v = int(v)
+            if isinstance(v, (int, float)):
+                self.gauge(f"{group}/{k}").set(v)
+
+    def event(self, name: str, **fields) -> None:
+        """One-shot structured record (log lines, run markers)."""
+        self._events.append({
+            "kind": "event",
+            "name": name,
+            "ts": time.time(),
+            "fields": fields,
+        })
+
+    def fidelity(self, record: Dict) -> None:
+        """Attach a simulator-fidelity record (obs/fidelity.py)."""
+        rec = dict(record)
+        rec["kind"] = "fidelity"
+        rec.setdefault("name", "fidelity")
+        rec.setdefault("ts", time.time())
+        self._events.append(rec)
+
+    # -- drain -----------------------------------------------------------
+    def drain(self) -> List[Dict]:
+        """Buffered events (cleared) + a snapshot of every metric's
+        current value.  Each record carries the schema version and a
+        timestamp; re-draining re-snapshots metrics (cumulative values,
+        later ts wins for readers)."""
+        now = time.time()
+        records: List[Dict] = []
+        events, self._events = self._events, []
+        for ev in events:
+            ev.setdefault("ts", now)
+            ev["schema"] = SCHEMA_VERSION
+            records.append(ev)
+        for name in sorted(self._metrics):
+            rec = self._metrics[name].record()
+            rec["ts"] = now
+            rec["schema"] = SCHEMA_VERSION
+            records.append(rec)
+        return records
+
+    def write_jsonl(self, path: str) -> int:
+        """Append drained records to a JSONL file; returns the count."""
+        records = self.drain()
+        if not records:
+            return 0
+        with open(path, "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+        return len(records)
+
+
+def emit_counters(logger, label: str, mapping: Dict,
+                  registry: Optional[MetricsRegistry] = None,
+                  group: Optional[str] = None) -> None:
+    """The migration shim for the legacy `RecursiveLogger.counters`
+    call sites (mcmc/unity/supervisor): emits the EXACT same log line
+    the old call did, then folds the mapping into the registry (when
+    one is wired) so the counters also land in run_telemetry.jsonl."""
+    logger.counters(label, mapping)
+    if registry is not None:
+        registry.fold_counters(group or label.replace(" ", "_"), mapping)
+
+
+class TelemetryLogHandler(logging.Handler):
+    """Captures `flexflow_tpu.*` log records (calibration failures,
+    supervisor restore notices) into the registry's event stream so
+    they land in run_telemetry.jsonl instead of dying on stdout/stderr."""
+
+    def __init__(self, registry: MetricsRegistry, level=logging.INFO):
+        super().__init__(level=level)
+        self.registry = registry
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.registry.event(
+                "log",
+                logger=record.name,
+                level=record.levelname,
+                message=record.getMessage(),
+            )
+        except Exception:  # pragma: no cover - never break the app on telemetry
+            self.handleError(record)

@@ -1,0 +1,159 @@
+"""Timeline tracing: Chrome trace-event spans for a training run.
+
+The reference leans on Legion's profiler for "where did this strategy's
+time go"; here a run records host-side spans (step begin/end, jit
+compile, host transfer, checkpoint writes, restarts, search phases)
+into a Chrome trace-event JSON that Perfetto / chrome://tracing opens
+directly, while `jax.named_scope` on every PCG op (executor._exec_op)
+attributes the device-side XLA profile to operator names.
+
+Zero-cost-when-disabled contract: the module-level NULL_TRACER is what
+every call site holds when telemetry is off — its `span()` returns one
+preallocated no-op context manager, so the step hot path allocates no
+span objects (tests/test_telemetry.py guards this via
+`span_allocations()`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+# global allocation counter for the disabled-path guard test: every real
+# Span construction bumps it; the NULL path never constructs one
+_SPAN_ALLOCS = 0
+
+
+def span_allocations() -> int:
+    """How many Span objects have been constructed process-wide."""
+    return _SPAN_ALLOCS
+
+
+class Span:
+    """One B/E event pair; used as a context manager."""
+
+    __slots__ = ("_tracer", "name", "cat", "args")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: Dict):
+        global _SPAN_ALLOCS
+        _SPAN_ALLOCS += 1
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._tracer._emit("B", self.name, self.cat, self.args)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._tracer._emit("E", self.name, self.cat, None)
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span: one instance serves every disabled call site."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Disabled tracer: every method is a constant-time no-op that
+    allocates nothing."""
+
+    enabled = False
+
+    def span(self, name: str, cat: str = "run", **args) -> _NullSpan:
+        return _NULL_SPAN
+
+    def instant(self, name: str, cat: str = "run", **args) -> None:
+        return None
+
+    def write(self, path: str) -> None:
+        return None
+
+
+NULL_TRACER = NullTracer()
+
+
+class Tracer:
+    """Records B/E span pairs + instant events with microsecond
+    timestamps (the Chrome trace-event clock unit)."""
+
+    enabled = True
+
+    def __init__(self, run_id: Optional[str] = None):
+        self.run_id = run_id
+        self.events: List[Dict] = []
+        self._pid = os.getpid()
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _emit(self, ph: str, name: str, cat: str, args: Optional[Dict]):
+        ev = {
+            "ph": ph,
+            "name": name,
+            "cat": cat,
+            "ts": self._now_us(),
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+
+    def span(self, name: str, cat: str = "run", **args) -> Span:
+        return Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "run", **args) -> None:
+        ev = {
+            "ph": "i",
+            "name": name,
+            "cat": cat,
+            "ts": self._now_us(),
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "s": "t",  # thread-scoped instant
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+
+    def write(self, path: str) -> None:
+        """Serialize as Chrome trace-event JSON (Perfetto-loadable),
+        events sorted by timestamp."""
+        with self._lock:
+            events = sorted(self.events, key=lambda e: e["ts"])
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+        }
+        if self.run_id:
+            doc["otherData"] = {"run_id": self.run_id}
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+
+def tracer_of(ff) -> "Tracer | NullTracer":
+    """The model's active tracer, or NULL_TRACER for anything without
+    telemetry (plain executors, tests poking internals)."""
+    tel = getattr(ff, "telemetry", None)
+    return tel.tracer if tel is not None else NULL_TRACER

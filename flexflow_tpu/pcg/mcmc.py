@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..fftype import OperatorType
 from ..logger import search_logger as slog
+from ..obs.metrics import emit_counters
 from ..ops.op import ShardConfig
 from ..strategy import Strategy
 from .evaluator import IncrementalEvaluator
@@ -95,7 +96,11 @@ class MCMCSearch:
         propagation_chance: float = 0.25,
         continue_chance: float = 0.7,
         use_eval_cache: bool = True,
+        registry=None,
     ):
+        # obs.metrics.MetricsRegistry (or None): final counters also
+        # land in run telemetry, not just the log line
+        self.registry = registry
         self.graph = graph
         self.n = num_devices
         self.simulator_factory = simulator_factory
@@ -262,14 +267,47 @@ class MCMCSearch:
         best.search_stats["term_hits"] = self.simulator.term_hits
         best.search_stats["term_misses"] = self.simulator.term_misses
         best.search_stats["op_cost_hits"] = self.simulator.cost_model.cost_hits
-        slog.counters("mcmc eval stats", best.search_stats)
+        # identical log line to the pre-registry call (obs migration);
+        # search_stats stays the same plain dict on the strategy
+        emit_counters(slog, "mcmc eval stats", best.search_stats,
+                      registry=self.registry, group="search/mcmc")
         return best
+
+
+def make_search_simulator(cfg, machine, cost_model):
+    """The ONE place an FFConfig becomes the Simulator configuration
+    candidates are costed with: fitted overlap constants (when a
+    calibration is persisted), parameter-sync mode, remat, and the
+    ZeRO-1 update flags.  obs/fidelity.py reuses it so fidelity records
+    measure the same simulator the search ranked candidates with."""
+    from ..sim.calibrate import load_overlap_constants
+    from ..sim.simulator import Simulator
+    from .unity import _sync_mode
+
+    fitted = load_overlap_constants()
+    kw = {}
+    if fitted is not None:
+        kw["overlap_fraction"] = fitted["overlap_fraction"]
+        kw["compute_scale"] = fitted.get("compute_scale", 1.0)
+    return Simulator(
+        machine,
+        cost_model,
+        sync_overlap_fraction=(
+            fitted["sync_overlap_fraction"] if fitted is not None
+            else (0.7 if cfg.search_overlap_backward_update else None)
+        ),
+        **kw,
+        parameter_sync=_sync_mode(cfg.parameter_sync),
+        remat=cfg.remat,
+        weight_update_sharding=cfg.weight_update_sharding,
+        wus_axis=cfg.wus_axis,
+    )
 
 
 def mcmc_optimize(model, num_devices: int) -> Strategy:
     """Entry used by FFModel.compile (config-driven)."""
     from ..sim.machine_model import make_machine_model
-    from ..sim.simulator import Simulator, make_cost_model
+    from ..sim.simulator import make_cost_model
 
     cfg = model.config
     machine = make_machine_model(cfg, num_devices)
@@ -278,29 +316,8 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
     # across candidate evaluations (reference simulator.cc:550-560)
     cost_model = make_cost_model(cfg, machine)
 
-    from ..sim.calibrate import load_overlap_constants
-    from .unity import _sync_mode
-
-    fitted = load_overlap_constants()
-
     def sim_factory():
-        kw = {}
-        if fitted is not None:
-            kw["overlap_fraction"] = fitted["overlap_fraction"]
-            kw["compute_scale"] = fitted.get("compute_scale", 1.0)
-        return Simulator(
-            machine,
-            cost_model,
-            sync_overlap_fraction=(
-                fitted["sync_overlap_fraction"] if fitted is not None
-                else (0.7 if cfg.search_overlap_backward_update else None)
-            ),
-            **kw,
-            parameter_sync=_sync_mode(cfg.parameter_sync),
-            remat=cfg.remat,
-            weight_update_sharding=cfg.weight_update_sharding,
-            wus_axis=cfg.wus_axis,
-        )
+        return make_search_simulator(cfg, machine, cost_model)
 
     search = MCMCSearch(
         model.layers,
@@ -313,6 +330,9 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
         seed=cfg.seed,
         propagate=cfg.search_propagate,
         use_eval_cache=cfg.search_eval_cache,
+        registry=getattr(
+            getattr(model, "telemetry", None), "metrics", None
+        ),
     )
     best = search.optimize()
     # surface the update-sharding mode candidates were scored under

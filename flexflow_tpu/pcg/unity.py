@@ -105,7 +105,11 @@ class UnitySearch:
         eval_cache: bool = True,
         weight_update_sharding: bool = False,
         wus_axis: str = "data",
+        registry=None,
     ):
+        # obs.metrics.MetricsRegistry (or None): final counters also
+        # land in run telemetry, not just the log line
+        self.registry = registry
         self.event_rerank = event_rerank
         self.event_topk = event_topk
         self.sync_overlap = (
@@ -937,11 +941,14 @@ class UnitySearch:
 
     def _finish(self, strategy: Strategy) -> Strategy:
         """Attach the observability counters to the winning strategy and
-        log them (tentpole part 3)."""
+        log them (identical line format to the pre-registry call); with
+        a registry wired they also land in run telemetry."""
         from ..logger import search_logger as slog
+        from ..obs.metrics import emit_counters
 
         strategy.search_stats = self.eval_stats()
-        slog.counters("unity eval stats", strategy.search_stats)
+        emit_counters(slog, "unity eval stats", strategy.search_stats,
+                      registry=self.registry, group="search/unity")
         return strategy
 
     def _objective(self, time: float, mem: int, lam: float) -> float:
@@ -1292,6 +1299,9 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         eval_cache=cfg.search_eval_cache,
         weight_update_sharding=cfg.weight_update_sharding,
         wus_axis=cfg.wus_axis,
+        registry=getattr(
+            getattr(model, "telemetry", None), "metrics", None
+        ),
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()

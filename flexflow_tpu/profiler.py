@@ -193,8 +193,17 @@ def print_profile(rows: List[Dict[str, object]]):
         ms = "n/a" if r["fwd_ms"] is None else f"{r['fwd_ms']:.3f}"
         gf = r["flops"] / 1e9
         print(f"{r['name']:<{name_w}}{r['type']:<20}{ms:>10}{gf:>12.3f}")
-    total = sum(r["fwd_ms"] or 0.0 for r in rows)
-    print(f"{'TOTAL':<{name_w}}{'':<20}{total:>10.3f}")
+    # unmeasurable ops (fwd_ms None) are EXCLUDED from the total, and
+    # the row says so — a sum that silently counted them as 0 ms read
+    # as a complete step time when it wasn't
+    measured = [r for r in rows if r["fwd_ms"] is not None]
+    total = sum(r["fwd_ms"] for r in measured)
+    qualifier = f"({len(measured)} measured / {len(rows)} total ops"
+    excluded = len(rows) - len(measured)
+    if excluded:
+        qualifier += f", {excluded} excluded"
+    qualifier += ")"
+    print(f"{'TOTAL':<{name_w}}{'':<20}{total:>10.3f}  {qualifier}")
 
 
 # ---------------------------------------------------------------------------
@@ -387,14 +396,19 @@ def measure_segment_costs(
                 return None, []
             return (best - base) / chain_n, sorted(member)
         except Exception as e:
-            import os
+            # calibration failures flow through the shared logging
+            # surface (flexflow_tpu.calib) — the obs TelemetryLogHandler
+            # puts them in run_telemetry.jsonl; the full traceback is a
+            # DEBUG-level detail
+            import traceback
 
-            if os.environ.get("FF_CALIB_DEBUG"):  # pragma: no cover
-                import traceback
+            from .logger import calib_logger
 
-                print(f"calib: region {[op.name for op in body_ops][:4]}... "
-                      f"failed: {e!r}")
-                traceback.print_exc()
+            calib_logger.info(
+                "region %s... failed: %r",
+                [op.name for op in body_ops][:4], e,
+            )
+            calib_logger.debug("%s", traceback.format_exc())
             return None, []
 
     measured_regions = []

@@ -35,6 +35,8 @@ import numpy as np
 
 from ..executor import NonFiniteLossError, check_step_health
 from ..logger import resilience_logger
+from ..obs.metrics import emit_counters
+from ..obs.trace import tracer_of
 from .faults import (
     CheckpointWriteFault,
     DeviceLossFault,
@@ -171,7 +173,9 @@ class TrainingSupervisor:
             self.log.info("checkpoint save failed at step %d: %s", step, e)
 
     def _restore_latest(self, step: int) -> int:
-        restored = int(self.manager.restore(self.ff))
+        with tracer_of(self.ff).span("restart", cat="resilience",
+                                     failed_step=step):
+            restored = int(self.manager.restore(self.ff))
         self.counters["restarts"] += 1
         self.counters["lost_steps"] += max(0, step - restored)
         self.log.info(
@@ -216,7 +220,9 @@ class TrainingSupervisor:
             "device loss at step %d: %d devices survive, re-searching",
             step, len(survivors),
         )
-        strategy = self._search_strategy(len(survivors))
+        with tracer_of(self.ff).span("re_search", cat="resilience",
+                                     survivors=len(survivors)):
+            strategy = self._search_strategy(len(survivors))
         self.counters["re_searches"] += 1
         # recompile rebuilds the executor on the shrunken mesh (fresh
         # shardings); the checkpoint restore then overwrites the carried
@@ -306,7 +312,16 @@ class TrainingSupervisor:
                 step = self._retry_transient(e, step, restarts)
                 # replayed steps re-record their losses
                 loss_by_step = {s: v for s, v in loss_by_step.items() if s < step}
-        self.log.counters("supervisor", self.counters)
+        # same "supervisor: k=v ..." log line as before, now also folded
+        # into the run's metrics registry (-> run_telemetry.jsonl)
+        tel = getattr(self.ff, "telemetry", None)
+        emit_counters(
+            self.log, "supervisor", self.counters,
+            registry=tel.metrics if tel is not None else None,
+            group="resilience",
+        )
+        if tel is not None and tel.enabled:
+            tel.flush()
         return SupervisorReport(
             final_step=step,
             losses=[loss_by_step[s] for s in sorted(loss_by_step)],

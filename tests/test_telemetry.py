@@ -1,0 +1,316 @@
+"""Unified run telemetry (flexflow_tpu/obs/): trace-event schema,
+metrics-registry semantics, named_scope HLO attribution, fidelity
+records, and the zero-cost disabled path."""
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.obs import (
+    MetricsRegistry,
+    RunTelemetry,
+    parse_profile_steps,
+    span_allocations,
+)
+from flexflow_tpu.obs.metrics import emit_counters
+
+
+def _build_mlp(cfg, in_dim=32, classes=10):
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, in_dim], name="input")
+    h = ff.dense(x, 64)
+    h = ff.relu(h)
+    ff.dense(h, classes)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    return ff
+
+
+def _data(n=64, in_dim=32, classes=10):
+    rng = np.random.RandomState(0)
+    return (rng.randn(n, in_dim).astype(np.float32),
+            rng.randint(0, classes, n).astype(np.int32))
+
+
+def _match_be_pairs(events):
+    """Walk B/E events per (pid, tid) with stack discipline; returns
+    the matched (name, dur) list and asserts nothing dangles."""
+    stacks, pairs = {}, []
+    for ev in events:
+        key = (ev["pid"], ev["tid"])
+        if ev["ph"] == "B":
+            stacks.setdefault(key, []).append(ev)
+        elif ev["ph"] == "E":
+            stack = stacks.get(key)
+            assert stack, f"E event with empty stack: {ev}"
+            b = stack.pop()
+            assert ev["ts"] >= b["ts"]
+            pairs.append((b["name"], ev["ts"] - b["ts"]))
+    for key, stack in stacks.items():
+        assert not stack, f"unclosed B events on {key}: {stack}"
+    return pairs
+
+
+# ---------------------------------------------------------------------------
+# tentpole: trace-event timeline + JSONL + fidelity from an 8-device fit
+# ---------------------------------------------------------------------------
+
+def test_fit_trace_and_telemetry_8dev(tmp_path, devices8):
+    """Acceptance: an 8-device CPU-mesh fit with --trace-dir produces a
+    loadable Chrome trace (>= one span per step, plus compile spans) and
+    a run_telemetry.jsonl with unified metrics + a fidelity record."""
+    td = str(tmp_path / "telem")
+    cfg = FFConfig(batch_size=16, num_devices=8, trace_dir=td)
+    ff = _build_mlp(cfg)
+    X, y = _data(64)
+    ff.fit(X, y, batch_size=16, epochs=2, verbose=False)
+
+    with open(os.path.join(td, "trace.json")) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert events
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)  # serialized sorted by timestamp
+    pairs = _match_be_pairs(events)
+    names = [n for n, _ in pairs]
+    # 4 batches/epoch x 2 epochs; one step + one host_transfer span each
+    assert names.count("step") == 8
+    assert names.count("host_transfer") == 8
+    assert "compile" in names
+    assert "init_weights" in names  # the eager XLA compile inside compile()
+    assert all(d >= 0 for _, d in pairs)
+
+    recs = [json.loads(line)
+            for line in open(os.path.join(td, "run_telemetry.jsonl"))]
+    assert all(r["schema"] == 1 for r in recs)
+    by_kind = {}
+    for r in recs:
+        by_kind.setdefault(r["kind"], []).append(r)
+    hists = {r["name"]: r for r in by_kind["histogram"]}
+    assert hists["fit/step_ms"]["count"] == 8
+    gauges = {r["name"]: r for r in by_kind["gauge"]}
+    assert gauges["compile/total_ms"]["value"] > 0
+    assert "fit/metrics/train_all" in gauges  # PerfMetrics unified
+    (fid,) = by_kind["fidelity"]
+    assert fid["predicted_step_ms"] > 0
+    assert fid["measured_step_ms"] > 0
+    assert fid["predicted_vs_measured"] == pytest.approx(
+        fid["predicted_step_ms"] / fid["measured_step_ms"], abs=1e-4
+    )  # record values are rounded to 4 decimals
+    assert fid["mesh_axes"] == {"data": 8}
+    assert fid["num_devices"] == 8
+    assert fid["source"] == "fit"
+
+
+def test_supervisor_emits_checkpoint_and_restart_spans(tmp_path, devices8):
+    from flexflow_tpu.resilience import FaultKind, FaultPlan, TrainingSupervisor
+
+    td = str(tmp_path / "telem")
+    cfg = FFConfig(batch_size=8, num_devices=8, trace_dir=td,
+                   checkpoint_every=2, max_restarts=3, retry_backoff=0.0)
+    ff = _build_mlp(cfg)
+    X, y = _data(32)
+    sup = TrainingSupervisor(
+        ff, str(tmp_path / "ckpt"),
+        fault_plan=FaultPlan.single(3, FaultKind.STEP_EXCEPTION),
+        sleep=lambda s: None,
+    )
+    report = sup.run(X, y, num_steps=4)
+    assert report.counters["restarts"] == 1
+
+    with open(os.path.join(td, "trace.json")) as f:
+        events = json.load(f)["traceEvents"]
+    names = [n for n, _ in _match_be_pairs(events)]
+    assert "checkpoint_write" in names
+    assert "restart" in names
+    recs = [json.loads(line)
+            for line in open(os.path.join(td, "run_telemetry.jsonl"))]
+    gauges = {r["name"]: r["value"] for r in recs if r["kind"] == "gauge"}
+    # supervisor counters unified into the registry
+    assert gauges["resilience/restarts"] == 1
+    assert gauges["resilience/checkpoints"] >= 1
+    # the supervisor's restore log line captured as an event record
+    logs = [r for r in recs
+            if r["kind"] == "event" and r["name"] == "log"]
+    assert any("restored step" in r["fields"]["message"] for r in logs)
+
+
+def test_crashed_fit_still_writes_artifacts(tmp_path, devices8):
+    """A traced run that dies mid-training is exactly the run whose
+    telemetry matters: fit's finally clause must flush the artifacts."""
+
+    class Boom(Exception):
+        pass
+
+    class Crasher:
+        def on_train_begin(self, ff):
+            pass
+
+        def on_epoch_end(self, ff, epoch, pm):
+            raise Boom()
+
+    td = str(tmp_path / "telem")
+    cfg = FFConfig(batch_size=16, num_devices=8, trace_dir=td)
+    ff = _build_mlp(cfg)
+    X, y = _data(64)
+    with pytest.raises(Boom):
+        ff.fit(X, y, batch_size=16, epochs=2, verbose=False,
+               callbacks=[Crasher()])
+    with open(os.path.join(td, "trace.json")) as f:
+        events = json.load(f)["traceEvents"]
+    names = [n for n, _ in _match_be_pairs(events)]
+    assert names.count("step") == 4  # epoch 0's steps made it to disk
+    assert os.path.exists(os.path.join(td, "run_telemetry.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# metrics registry semantics
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(4)
+    assert reg.counter("c") is c and c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("g")
+    g.set(2.5)
+    g.set(1.0)
+    assert g.value == 1.0
+    h = reg.histogram("h")
+    for v in (1.0, 3.0, 2.0):
+        h.observe(v)
+    assert (h.count, h.sum, h.min, h.max) == (3, 6.0, 1.0, 3.0)
+    assert h.mean == pytest.approx(2.0)
+    with pytest.raises(TypeError):
+        reg.gauge("c")  # same name, different type
+
+    recs = {(r["kind"], r["name"]): r for r in reg.drain()}
+    assert recs[("counter", "c")]["value"] == 5
+    assert recs[("histogram", "h")]["mean"] == pytest.approx(2.0)
+    assert all(r["schema"] == 1 and "ts" in r for r in recs.values())
+
+
+def test_emit_counters_keeps_log_line_format(caplog):
+    """The migrated call sites must emit the EXACT RecursiveLogger
+    `label: k=v ...` line (float -> %.4g) while also folding into the
+    registry."""
+    from flexflow_tpu.logger import search_logger
+
+    reg = MetricsRegistry()
+    stats = {"evals": 12, "evals_per_sec": 123.4567, "flag": True}
+    with caplog.at_level(logging.INFO, logger="flexflow_tpu.search"):
+        emit_counters(search_logger, "mcmc eval stats", stats,
+                      registry=reg, group="search/mcmc")
+    assert caplog.messages == ["mcmc eval stats: evals=12 evals_per_sec=123.5 flag=True"]
+    gauges = {r["name"]: r["value"] for r in reg.drain()
+              if r["kind"] == "gauge"}
+    assert gauges["search/mcmc/evals"] == 12
+    assert gauges["search/mcmc/evals_per_sec"] == pytest.approx(123.4567)
+    assert gauges["search/mcmc/flag"] == 1
+
+
+def test_search_stats_reach_registry(devices8):
+    cfg = FFConfig(batch_size=16, num_devices=2, telemetry=True,
+                   search_budget=2, search_algo="mcmc",
+                   search_calibrate=False)
+    ff = _build_mlp(cfg)
+    assert ff.strategy.search_stats  # dict API unchanged
+    names = [r["name"] for r in ff.telemetry.metrics.drain()
+             if r["kind"] == "gauge"]
+    assert any(n.startswith("search/mcmc/") for n in names)
+    assert "compile/search_ms" in names
+
+
+def test_calib_logger_lands_in_telemetry():
+    from flexflow_tpu.logger import calib_logger
+
+    tel = RunTelemetry(enabled=True)
+    try:
+        calib_logger.info("region %s failed: %r", ["dense_0"], "boom")
+        events = [r for r in tel.metrics.drain() if r["kind"] == "event"]
+        assert any(
+            r["fields"]["logger"] == "flexflow_tpu.calib"
+            and "dense_0" in r["fields"]["message"]
+            for r in events
+        )
+    finally:
+        tel.close()
+
+
+# ---------------------------------------------------------------------------
+# named_scope: op names in the compiled step HLO
+# ---------------------------------------------------------------------------
+
+def test_named_scope_op_names_in_step_hlo():
+    import jax
+
+    cfg = FFConfig(batch_size=8, num_devices=1)
+    ff = _build_mlp(cfg)
+    X, y = _data(8)
+    put_inputs, put_labels = ff._device_put_batch({"input": X}, y)
+    rng = jax.random.key(0)
+    lowered = ff._step_fn.lower(
+        ff._weights, ff._opt_state, ff._state, put_inputs, put_labels, rng
+    )
+    hlo = lowered.compile().as_text()
+    for op in ff.operators.topo_order():
+        if op.name.startswith("dense"):
+            assert op.name in hlo  # named_scope carried into op metadata
+
+
+# ---------------------------------------------------------------------------
+# disabled path: zero allocation on the step hot path
+# ---------------------------------------------------------------------------
+
+def test_disabled_fit_allocates_no_spans():
+    cfg = FFConfig(batch_size=16, num_devices=1)
+    ff = _build_mlp(cfg)
+    assert not ff.telemetry.enabled
+    X, y = _data(64)
+    before = span_allocations()
+    ff.fit(X, y, batch_size=16, epochs=2, verbose=False)
+    assert span_allocations() == before
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def test_cli_knobs(tmp_path):
+    td = str(tmp_path / "t")
+    cfg = FFConfig.from_args(
+        ["--trace-dir", td, "--profile-steps", "3:2", "--telemetry"]
+    )
+    assert cfg.trace_dir == td
+    assert cfg.telemetry is True
+    assert cfg.profile_steps == "3:2"
+    assert parse_profile_steps("3:2") == (3, 5)
+
+    assert FFConfig.from_args([]).trace_dir is None
+
+    with pytest.raises(ValueError):
+        FFConfig(profile_steps="3:2")  # needs trace_dir
+    with pytest.raises(ValueError):
+        FFConfig(trace_dir=td, profile_steps="nope")
+    with pytest.raises(ValueError):
+        FFConfig(trace_dir=td, profile_steps="3:0")
+
+
+def test_print_profile_total_excludes_unmeasured(capsys):
+    from flexflow_tpu.profiler import print_profile
+
+    rows = [
+        {"name": "a", "type": "LINEAR", "fwd_ms": 1.5, "flops": 1e9},
+        {"name": "b", "type": "CACHE", "fwd_ms": None, "flops": 0.0},
+        {"name": "c", "type": "LINEAR", "fwd_ms": 0.5, "flops": 1e9},
+    ]
+    print_profile(rows)
+    out = capsys.readouterr().out
+    assert "2.000" in out  # 1.5 + 0.5, Nones excluded
+    assert "(2 measured / 3 total ops, 1 excluded)" in out

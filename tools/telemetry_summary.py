@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Render a run_telemetry.jsonl into a step-time / compile-time /
+search / resilience / fidelity table.
+
+Usage:
+    python tools/telemetry_summary.py <run_telemetry.jsonl | trace-dir>
+
+Accepts either the JSONL itself or the --trace-dir directory containing
+it.  Metrics are cumulative snapshots, so for re-drained runs the
+latest record per name wins (ties broken by file order).  See
+docs/OBSERVABILITY.md for the record schema.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+
+def load_records(path: str) -> List[Dict]:
+    if os.path.isdir(path):
+        path = os.path.join(path, "run_telemetry.jsonl")
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def latest_by_name(records: List[Dict], kinds) -> Dict[str, Dict]:
+    """Last record per name among `kinds` (cumulative snapshots: the
+    newest drain supersedes older ones)."""
+    out: Dict[str, Dict] = {}
+    for rec in records:
+        if rec.get("kind") in kinds and "name" in rec:
+            out[rec["name"]] = rec
+    return out
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _section(title: str, rows: List[tuple]) -> str:
+    if not rows:
+        return ""
+    w = max(len(k) for k, _ in rows) + 2
+    lines = [title, "-" * len(title)]
+    lines += [f"{k:<{w}}{_fmt(v)}" for k, v in rows]
+    return "\n".join(lines) + "\n"
+
+
+def summarize(records: List[Dict]) -> str:
+    metrics = latest_by_name(records, {"counter", "gauge", "histogram"})
+    fidelity = [r for r in records if r.get("kind") == "fidelity"]
+    events = [r for r in records if r.get("kind") == "event"]
+    out: List[str] = []
+
+    step = metrics.get("fit/step_ms")
+    rows = []
+    if step:
+        rows += [
+            ("steps", step.get("count", 0)),
+            ("dispatch ms mean", step.get("mean", 0.0)),
+            ("dispatch ms min/max",
+             f"{_fmt(step.get('min', 0.0))} / {_fmt(step.get('max', 0.0))}"),
+        ]
+    epoch = metrics.get("fit/epoch_s")
+    if epoch:
+        rows.append(("epoch s mean", epoch.get("mean", 0.0)))
+    tput = metrics.get("fit/throughput_sps")
+    if tput:
+        rows.append(("throughput samples/s", tput.get("value", 0.0)))
+    out.append(_section("Steps", rows))
+
+    rows = [
+        (name.split("/", 1)[1] if "/" in name else name,
+         rec.get("value", 0.0))
+        for name, rec in sorted(metrics.items())
+        if name.startswith("compile/")
+    ]
+    out.append(_section("Compile (ms)", rows))
+
+    rows = [
+        (name.split("/", 2)[-1], rec.get("value", 0.0))
+        for name, rec in sorted(metrics.items())
+        if name.startswith("search/")
+    ]
+    out.append(_section("Search", rows))
+
+    rows = [
+        (name.split("/", 1)[1], rec.get("value", 0.0))
+        for name, rec in sorted(metrics.items())
+        if name.startswith("resilience/")
+    ]
+    out.append(_section("Resilience", rows))
+
+    rows = []
+    for rec in fidelity:
+        rows += [
+            ("source", rec.get("source", "?")),
+            ("predicted step ms", rec.get("predicted_step_ms")),
+            ("measured step ms", rec.get("measured_step_ms")),
+            ("predicted / measured", rec.get("predicted_vs_measured")),
+            ("mesh", json.dumps(rec.get("mesh_axes", {}))),
+            ("calibrated", rec.get("calibrated", False)),
+        ]
+    out.append(_section("Fidelity", rows))
+
+    logs = [r for r in events if r.get("name") == "log"]
+    if logs:
+        lines = ["Log events", "----------"]
+        for r in logs[-20:]:
+            f = r.get("fields", {})
+            lines.append(
+                f"[{f.get('level', '?')}] {f.get('logger', '?')}: "
+                f"{f.get('message', '')}"
+            )
+        out.append("\n".join(lines) + "\n")
+
+    body = "\n".join(s for s in out if s)
+    return body if body.strip() else "no telemetry records found\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="run_telemetry.jsonl or the trace dir")
+    args = p.parse_args(argv)
+    try:
+        records = load_records(args.path)
+    except FileNotFoundError as e:
+        print(f"error: no telemetry file at {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(summarize(records))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
