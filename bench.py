@@ -534,6 +534,90 @@ def bench_weight_update(on_tpu):
     return out
 
 
+def bench_checkpoint(dev, on_tpu):
+    """Checkpoint-stall microbench (manifest v9): the step-boundary
+    stall of a full-train-state save under the durability layer
+    (checkpoint.py).  Sync saves pay serialize + fsync + crc-verify +
+    publish inline; async saves (`wait=False`) stall only for the
+    device->host snapshot and hand the rest to the background writer —
+    this leg records both stalls plus the writer's flush throughput, so
+    a regression in either the snapshot path or the verified-write path
+    moves a number."""
+    import shutil
+    import tempfile
+
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.checkpoint import LocalCheckpointManager
+    from flexflow_tpu.fftype import ActiMode
+    from flexflow_tpu.optimizer import AdamOptimizer
+
+    leg = MANIFEST["legs"]["checkpoint"]
+    if on_tpu:
+        in_dim, hidden, layers = leg["input_dim"], leg["hidden"], leg["layers"]
+        classes, batch, iters = leg["classes"], leg["batch"], leg["iters"]
+    else:
+        in_dim, hidden, layers, classes, batch, iters = 256, 512, 3, 512, 16, 3
+
+    cfg = FFConfig(batch_size=batch, num_devices=1)
+    ff = FFModel(cfg)
+    t = ff.create_tensor([batch, in_dim], name="x")
+    for _ in range(layers):
+        t = ff.dense(t, hidden, activation=ActiMode.RELU)
+    t = ff.dense(t, classes)
+    ff.softmax(t)
+    # Adam: m/v slots triple the serialized state vs bare weights —
+    # the realistic full-train-state payload
+    ff.compile(optimizer=AdamOptimizer(alpha=1e-3),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch, in_dim).astype(np.float32)
+    ys = rng.randint(0, classes, size=batch).astype(np.int32)
+    m = ff.train_step({"x": xs}, ys)  # materialize weights + slots
+    _ = float(m["loss"])
+
+    tmpdir = tempfile.mkdtemp(prefix="ckpt_bench_")
+    try:
+        mgr = LocalCheckpointManager(tmpdir, max_to_keep=2)
+        sync_stalls, async_stalls, flushes = [], [], []
+        step = 0
+        for _ in range(iters):
+            step += 1
+            t0 = time.perf_counter()
+            mgr.save(ff, step, wait=True)
+            sync_stalls.append(time.perf_counter() - t0)
+        for _ in range(iters):
+            step += 1
+            t0 = time.perf_counter()
+            mgr.save(ff, step, wait=False)
+            t1 = time.perf_counter()
+            async_stalls.append(t1 - t0)  # snapshot + enqueue only
+            failures = mgr.drain()
+            flushes.append(time.perf_counter() - t1)
+            assert not failures, failures
+        with open(os.path.join(mgr._path(step), "manifest.json")) as f:
+            total_bytes = json.load(f)["total_bytes"]
+        mgr.close()
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    stall_sync = min(sync_stalls)
+    stall_async = min(async_stalls)
+    flush = min(flushes)
+    return {
+        "workload": f"full-train-state save ({layers}L h{hidden} Adam), "
+                    "sync write vs async snapshot-only stall, crc32-verified",
+        "state_mb": round(total_bytes / 2**20, 2),
+        "stall_ms_sync": round(stall_sync * 1e3, 3),
+        "stall_ms_async_snapshot": round(stall_async * 1e3, 3),
+        "async_stall_below_sync": bool(stall_async < stall_sync),
+        "sync_vs_async_stall_ratio": round(stall_sync / max(stall_async, 1e-9), 2),
+        "flush_ms": round(flush * 1e3, 3),
+        # serialize+fsync+verify+publish throughput of the background writer
+        "write_mb_per_s": round(total_bytes / 2**20 / max(flush, 1e-9), 1),
+    }
+
+
 def _outage_line(reason: str):
     # tunnel/backend outage: emit a diagnostic JSON line instead of a
     # stacktrace/hang so the capture records WHY there are no numbers
@@ -590,6 +674,8 @@ def main():
     moe = bench_moe_dispatch(dev, on_tpu)
     gc.collect()
     wu = bench_weight_update(on_tpu)
+    gc.collect()
+    ckpt = bench_checkpoint(dev, on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
@@ -607,7 +693,8 @@ def main():
         "manifest_version": MANIFEST["version"],
         "legs": {"bert_base": bert, "resnet50": resnet,
                  "bert_long_context": bert_long, "dlrm": dlrm,
-                 "moe_dispatch": moe, "weight_update": wu},
+                 "moe_dispatch": moe, "weight_update": wu,
+                 "checkpoint": ckpt},
     }
     print(json.dumps(result))
 

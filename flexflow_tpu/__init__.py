@@ -8,7 +8,10 @@ collectives replace NCCL; and the Unity/MCMC strategy search drives a
 TPU-pod machine model.  See SURVEY.md at the repo root.
 """
 from .checkpoint import (
+    CheckpointCompatibilityError,
     CheckpointManager,
+    CheckpointVerifyError,
+    LocalCheckpointManager,
     ModelCheckpoint,
     load_weights_npz,
     save_weights_npz,
@@ -43,7 +46,9 @@ from .recompile import RecompileState
 from .resilience import (
     FaultKind,
     FaultPlan,
+    HungStepFault,
     RetryPolicy,
+    StepWatchdog,
     TrainingSupervisor,
 )
 from .strategy import Strategy, data_parallel_strategy

@@ -153,6 +153,16 @@ class FFConfig:
     checkpoint_every: int = 0  # steps between periodic checkpoints; 0 = off
     checkpoint_dir: Optional[str] = None
     checkpoint_keep: int = 3   # keep-last-k retention
+    # async verified saves: the step boundary stalls only for the
+    # device->host snapshot; serialize/fsync/verify/publish run on a
+    # background writer (checkpoint.py + resilience/async_writer.py)
+    checkpoint_async: bool = False
+    # hung-step watchdog: per-step device sync deadline in seconds
+    # (resilience/watchdog.py); 0 disables the watchdog entirely
+    step_timeout: float = 0.0
+    # SIGTERM/SIGINT preemption grace: emergency checkpoint at the next
+    # step boundary instead of dying checkpoint-less
+    preempt_grace: bool = True
     max_restarts: int = 3      # restore-and-retry budget per run
     retry_backoff: float = 0.1  # base backoff seconds (exponential, jittered)
     nan_policy: str = "raise"  # raise | skip_step | restore | off
@@ -190,6 +200,11 @@ class FFConfig:
         if self.retry_backoff < 0:
             raise ValueError(
                 f"retry_backoff must be >= 0, got {self.retry_backoff}"
+            )
+        if self.step_timeout < 0:
+            raise ValueError(
+                f"step_timeout must be >= 0 (0 = watchdog off), "
+                f"got {self.step_timeout}"
             )
         if not self.wus_axis:
             raise ValueError("wus_axis must be a non-empty mesh axis name")
@@ -287,6 +302,12 @@ class FFConfig:
                        default=None)
         p.add_argument("--checkpoint-keep", dest="checkpoint_keep", type=int,
                        default=3)
+        p.add_argument("--checkpoint-async", dest="checkpoint_async",
+                       action="store_true")
+        p.add_argument("--step-timeout", dest="step_timeout", type=float,
+                       default=0.0)
+        p.add_argument("--no-preempt-grace", dest="preempt_grace",
+                       action="store_false", default=True)
         p.add_argument("--max-restarts", dest="max_restarts", type=int,
                        default=3)
         p.add_argument("--retry-backoff", dest="retry_backoff", type=float,
@@ -341,6 +362,9 @@ class FFConfig:
             checkpoint_every=args.checkpoint_every,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_keep=args.checkpoint_keep,
+            checkpoint_async=args.checkpoint_async,
+            step_timeout=args.step_timeout,
+            preempt_grace=args.preempt_grace,
             max_restarts=args.max_restarts,
             retry_backoff=args.retry_backoff,
             nan_policy=args.nan_policy,

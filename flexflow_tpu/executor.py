@@ -48,19 +48,28 @@ class NonFiniteLossError(RuntimeError):
 
 
 def check_step_health(metrics: Dict[str, Any], step: Optional[int] = None,
-                      nan_policy: str = "raise") -> None:
+                      nan_policy: str = "raise", watchdog=None) -> None:
     """Step health hook: raise NonFiniteLossError when the step's loss
     is NaN/inf.  Reads the metrics dict a step function returned, which
     blocks on the device value — so the sync is gated on the configured
     policy: with nan_policy "off" (or None) no caller consumes the
     health signal and the function returns without ever touching the
-    device array."""
+    device array.
+
+    `watchdog` (a resilience.watchdog.StepWatchdog) bounds that device
+    sync: a wedged collective raises HungStepTimeout here instead of
+    blocking the host forever, so callers using this as their per-step
+    sync point get hang detection for free."""
     if nan_policy in (None, "off"):
         return
     loss = metrics.get("loss") if isinstance(metrics, dict) else None
     if loss is None:
         return
-    val = float(np.asarray(loss))
+
+    def read():
+        return float(np.asarray(loss))
+
+    val = watchdog.sync(read, step=step) if watchdog is not None else read()
     if not np.isfinite(val):
         raise NonFiniteLossError(val, step=step)
 

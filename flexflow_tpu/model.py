@@ -1003,6 +1003,13 @@ class FFModel:
             # an interrupted --profile-steps window stops the profiler
             if tracing:
                 tel.flush()
+            # drain checkpoint-manager callbacks (ModelCheckpoint with
+            # async_save): queued background saves must land even when
+            # the fit loop died before on_train_end ran
+            for cb in callbacks:
+                drain = getattr(getattr(cb, "manager", None), "drain", None)
+                if callable(drain):
+                    drain()
 
     def _fit_loop(self, loader, epochs, callbacks, verbose, batch_size,
                   num_batches, history, tel, tracing, tracer, step_hist):
@@ -1086,14 +1093,21 @@ class FFModel:
         directory: Optional[str] = None,
         fault_plan=None,
         retry=None,
+        resume: bool = False,
     ):
-        """`fit` under the resilience supervisor: periodic checkpoints,
-        restore-and-retry on transient failures, and elastic re-search +
-        recompile on device loss (resilience/supervisor.py; knobs from
-        FFConfig: checkpoint_every/checkpoint_keep/max_restarts/
-        retry_backoff/nan_policy).  Step-indexed and unshuffled so an
-        interrupted run replays bit-identically on the same mesh.
-        Returns a SupervisorReport."""
+        """`fit` under the resilience supervisor: periodic checkpoints
+        (async verified saves with FFConfig.checkpoint_async),
+        restore-and-retry on transient failures, SIGTERM/SIGINT
+        preemption grace, a hung-step watchdog (step_timeout), and
+        elastic re-search + recompile on device loss
+        (resilience/supervisor.py; knobs from FFConfig:
+        checkpoint_every/checkpoint_keep/checkpoint_async/step_timeout/
+        preempt_grace/max_restarts/retry_backoff/nan_policy).
+        Step-indexed and unshuffled so an interrupted run replays
+        bit-identically on the same mesh.  resume=True continues from
+        the directory's newest verified checkpoint — the replacement
+        process of a preempted run picks up where the emergency
+        checkpoint left off.  Returns a SupervisorReport."""
         from .resilience import TrainingSupervisor
 
         assert self._step_fn is not None, "call compile() first"
@@ -1110,7 +1124,8 @@ class FFModel:
         supervisor = TrainingSupervisor(
             self, directory, fault_plan=fault_plan, retry=retry
         )
-        return supervisor.run(x, y, num_steps=num_steps, batch_size=batch_size)
+        return supervisor.run(x, y, num_steps=num_steps,
+                              batch_size=batch_size, resume=resume)
 
     # reference-parity step pieces (model.h:767-811) — all folded into the
     # single jitted step; kept as explicit methods for API compatibility.

@@ -28,6 +28,7 @@ from .metrics import (
     MetricsRegistry,
     TelemetryLogHandler,
     emit_counters,
+    registry_of,
 )
 from .trace import NULL_TRACER, Tracer, span_allocations, tracer_of
 
@@ -187,6 +188,7 @@ __all__ = [
     "emit_counters",
     "fidelity_record",
     "parse_profile_steps",
+    "registry_of",
     "report_fidelity",
     "span_allocations",
     "tracer_of",

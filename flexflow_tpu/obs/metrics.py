@@ -187,6 +187,14 @@ class MetricsRegistry:
         return len(records)
 
 
+def registry_of(ff) -> Optional[MetricsRegistry]:
+    """The model's metrics registry, or None for anything without a
+    telemetry bundle (plain executors, tests poking internals) — the
+    counterpart of `obs.trace.tracer_of` for metric call sites."""
+    tel = getattr(ff, "telemetry", None)
+    return tel.metrics if tel is not None else None
+
+
 def emit_counters(logger, label: str, mapping: Dict,
                   registry: Optional[MetricsRegistry] = None,
                   group: Optional[str] = None) -> None:

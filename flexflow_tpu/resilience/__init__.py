@@ -1,14 +1,18 @@
 """Resilience subsystem: deterministic fault injection (faults.py),
-retry/backoff supervision (retry.py), and a training supervisor that
-composes checkpoints, recompile, and the strategy search into elastic
-recovery on a degraded mesh (supervisor.py).  See docs/RESILIENCE.md.
+retry/backoff supervision (retry.py), off-critical-path checkpoint
+writes (async_writer.py), a hung-step watchdog (watchdog.py), and a
+training supervisor that composes checkpoints, recompile, preemption
+grace, and the strategy search into elastic recovery on a degraded
+mesh (supervisor.py).  See docs/RESILIENCE.md.
 """
+from .async_writer import AsyncCheckpointWriter
 from .faults import (
     CheckpointWriteFault,
     DeviceLossFault,
     Fault,
     FaultKind,
     FaultPlan,
+    HungStepFault,
     InjectedFault,
     PreemptionFault,
     StepFault,
@@ -19,16 +23,21 @@ from .supervisor import (
     SupervisorReport,
     TrainingSupervisor,
 )
+from .watchdog import HungStepTimeout, StepWatchdog
 
 __all__ = [
+    "AsyncCheckpointWriter",
     "CheckpointWriteFault",
     "DeviceLossFault",
     "Fault",
     "FaultKind",
     "FaultPlan",
+    "HungStepFault",
+    "HungStepTimeout",
     "InjectedFault",
     "PreemptionFault",
     "StepFault",
+    "StepWatchdog",
     "RetryPolicy",
     "RestartBudgetExhausted",
     "SupervisorReport",

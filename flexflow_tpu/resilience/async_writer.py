@@ -1,0 +1,123 @@
+"""Background checkpoint writer: serialize/fsync/publish off the step path.
+
+The checkpoint managers' `save(wait=False)` path snapshots device
+arrays to host (the only accelerator stall), then hands the serialized
+write — npz encode, fsync, checksum verification, atomic publish — to
+this single-threaded writer.  Training resumes immediately; durability
+work overlaps the next steps' device time.
+
+Contract:
+
+  * jobs run FIFO on one daemon thread, so step N's checkpoint always
+    publishes before step N+1's (the `latest` pointer never regresses);
+  * a failing job (disk full, verification mismatch) never kills the
+    writer or the training loop — the exception is logged, recorded,
+    and surfaced at the next `drain()` so the supervisor can fold it
+    into its `checkpoint_failures` counter;
+  * `drain()` blocks until every submitted job has finished — the
+    supervisor calls it before any restore (a pending newer checkpoint
+    must land first) and on every exit path, `fit` drains
+    checkpoint-manager callbacks in its `finally`, and the preemption
+    grace handler drains before the process exits.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, List, Optional, Tuple
+
+_log = logging.getLogger("flexflow_tpu.checkpoint")
+
+_SENTINEL = object()
+
+
+class AsyncCheckpointWriter:
+    """One daemon thread draining a FIFO queue of checkpoint write jobs."""
+
+    def __init__(self, name: str = "ckpt-writer"):
+        self.name = name
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._failures: List[Tuple[int, Exception]] = []
+        # observability hook: called with the queue depth on every
+        # submit/complete (the manager points it at the run's
+        # resilience/ckpt_queue_depth gauge)
+        self.depth_cb: Optional[Callable[[int], None]] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=self.name, daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is _SENTINEL:
+                    return
+                step, fn = item
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001 — job errors must not
+                    # kill the writer; they surface at drain()
+                    _log.warning(
+                        "async checkpoint write for step %d failed: %s",
+                        step, e,
+                    )
+                    with self._lock:
+                        self._failures.append((step, e))
+            finally:
+                self._q.task_done()
+                self._notify_depth()
+
+    def _notify_depth(self) -> None:
+        cb = self.depth_cb
+        if cb is not None:
+            try:
+                cb(self._q.unfinished_tasks)
+            except Exception:  # pragma: no cover — never break on telemetry
+                pass
+
+    # -- API -------------------------------------------------------------
+    def submit(self, step: int, fn: Callable[[], None]) -> None:
+        """Queue one write job (already-snapshotted state captured in
+        `fn`); returns immediately."""
+        self._ensure_thread()
+        self._q.put((step, fn))
+        self._notify_depth()
+
+    @property
+    def queue_depth(self) -> int:
+        return self._q.unfinished_tasks
+
+    def wait(self) -> None:
+        """Block until every submitted job has run, leaving accumulated
+        failures in place (backpressure callers must not consume what
+        the owner's drain() is meant to report)."""
+        if self._thread is not None:
+            self._q.join()
+
+    def drain(self) -> List[Tuple[int, Exception]]:
+        """Block until every submitted job has run; return (and clear)
+        the failures accumulated since the last drain."""
+        self.wait()
+        with self._lock:
+            failures, self._failures = self._failures, []
+        return failures
+
+    def close(self) -> List[Tuple[int, Exception]]:
+        """Drain, stop the thread, and return outstanding failures.
+        Safe to call twice; a closed writer restarts lazily on the next
+        submit()."""
+        failures = self.drain()
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(_SENTINEL)
+            self._thread.join(timeout=10.0)
+        self._thread = None
+        return failures

@@ -17,6 +17,11 @@ Fault matrix (see docs/RESILIENCE.md):
   checkpoint_write  CheckpointWriteFault  count, keep training
   device_loss       DeviceLossFault       re-search surviving mesh,
                                           recompile, reshard-restore
+  hung_step         HungStepFault         device-loss-style: re-search
+                                          + recompile the full mesh,
+                                          reshard-restore (the injected
+                                          twin of a real watchdog
+                                          HungStepTimeout)
   nan_loss          (batch poisoned)      per FFConfig.nan_policy
 """
 from __future__ import annotations
@@ -34,6 +39,11 @@ class FaultKind(str, enum.Enum):
     HOST_PREEMPTION = "host_preemption"
     CHECKPOINT_WRITE = "checkpoint_write"
     DEVICE_LOSS = "device_loss"
+    # a wedged collective: the step's device sync never returns.  The
+    # injected form raises at the step boundary so the supervisor's
+    # hung-step classification (resilience/watchdog.py) is exercisable
+    # without a real hang or a real timeout wait
+    HUNG_STEP = "hung_step"
     # transient data corruption: the step's float inputs become NaN for
     # exactly one step, driving the loss non-finite (exercises
     # FFConfig.nan_policy end to end without faking metrics)
@@ -72,10 +82,15 @@ class DeviceLossFault(InjectedFault):
         self.survivors = int(survivors)
 
 
+class HungStepFault(InjectedFault):
+    kind = FaultKind.HUNG_STEP
+
+
 _EXC_FOR_KIND = {
     FaultKind.STEP_EXCEPTION: StepFault,
     FaultKind.HOST_PREEMPTION: PreemptionFault,
     FaultKind.DEVICE_LOSS: DeviceLossFault,
+    FaultKind.HUNG_STEP: HungStepFault,
 }
 
 

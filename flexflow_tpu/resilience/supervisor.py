@@ -6,7 +6,9 @@ swaps (recompile.py), and the strategy searches (pcg/search.py) — into
 a supervised training loop:
 
   * periodic checkpoints at a configurable step cadence (plus an anchor
-    at step 0, so the very first failure has a restore target);
+    at step 0, so the very first failure has a restore target), written
+    synchronously or — with `checkpoint_async` — as async verified
+    saves that stall the accelerator only for the host snapshot;
   * on a transient failure (injected step exception / host preemption,
     or a non-finite loss under nan_policy="restore"), restore the
     latest checkpoint and retry under a jittered-backoff RetryPolicy
@@ -16,7 +18,17 @@ a supervised training loop:
     spirit of P²'s re-placement, `recompile()` onto the shrunken
     device set, and carry weights/optimizer state over via the
     checkpoint's reshard-on-restore — training continues at full
-    remaining-hardware speed under a freshly searched strategy.
+    remaining-hardware speed under a freshly searched strategy;
+  * on a hung step — a per-step device sync exceeding `step_timeout`
+    (watchdog.py), or an injected `HungStepFault` — classify it as a
+    device-loss-style fault on the FULL current mesh: re-search,
+    recompile (which resets the wedged collective state), and
+    reshard-restore;
+  * on SIGTERM/SIGINT (the standard TPU preemption notice), finish the
+    in-flight step, write an emergency checkpoint at the step boundary,
+    drain the async writer, and return a restorable report instead of
+    dying checkpoint-less (`run(..., resume=True)` picks the next
+    process up from it).
 
 The loop is step-indexed and deterministic: batch `i` of a run is
 always rows [i*bs, (i+1)*bs) modulo the dataset (no shuffle), and the
@@ -27,27 +39,38 @@ the same mesh (tests/test_resilience.py enforces this).
 from __future__ import annotations
 
 import dataclasses
+import signal
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from ..checkpoint import CheckpointVerifyError
 from ..executor import NonFiniteLossError, check_step_health
 from ..logger import resilience_logger
-from ..obs.metrics import emit_counters
+from ..obs.metrics import emit_counters, registry_of
 from ..obs.trace import tracer_of
 from .faults import (
     CheckpointWriteFault,
     DeviceLossFault,
     FaultPlan,
+    HungStepFault,
     PreemptionFault,
     StepFault,
 )
 from .retry import RetryPolicy
+from .watchdog import HungStepTimeout, StepWatchdog
 
 # failures the supervisor treats as restore-and-retry transients
 TRANSIENT_FAULTS = (StepFault, PreemptionFault)
+# failures classified as "the mesh wedged": recover by re-search +
+# recompile of the full current mesh + reshard-restore
+HUNG_FAULTS = (HungStepFault, HungStepTimeout)
+# signals treated as a preemption notice (the TPU runtime sends SIGTERM
+# ahead of reclaiming a preemptible slice; SIGINT covers operators)
+GRACE_SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
 
 class RestartBudgetExhausted(RuntimeError):
@@ -58,22 +81,28 @@ class RestartBudgetExhausted(RuntimeError):
 class SupervisorReport:
     """What a supervised run did: the step it reached, the per-step
     losses actually recorded, and the counters dict (also logged via
-    RecursiveLogger.counters for bench runs to scrape)."""
+    RecursiveLogger.counters for bench runs to scrape).  `preempted`
+    carries the signal name when the run stopped early on a
+    SIGTERM/SIGINT emergency checkpoint (resume with
+    `run(..., resume=True)`)."""
 
     final_step: int
     losses: List[float]
     counters: Dict[str, float]
+    preempted: Optional[str] = None
 
 
 class TrainingSupervisor:
     """Wraps a compiled FFModel's training loop with checkpointing,
-    retry/backoff recovery, and elastic re-search on device loss.
+    retry/backoff recovery, preemption grace, a hung-step watchdog,
+    and elastic re-search on device loss.
 
     Knobs default from the model's FFConfig (checkpoint_every,
-    checkpoint_keep, max_restarts, retry_backoff, nan_policy); the
-    keyword arguments override per-supervisor.  `sleep` is injectable
-    so tests don't actually wait out backoffs; `search_fn(ff, n)`
-    overrides the strategy re-search on device loss.
+    checkpoint_keep, checkpoint_async, step_timeout, preempt_grace,
+    max_restarts, retry_backoff, nan_policy); the keyword arguments
+    override per-supervisor.  `sleep` is injectable so tests don't
+    actually wait out backoffs; `search_fn(ff, n)` overrides the
+    strategy re-search on device loss.
     """
 
     def __init__(
@@ -88,6 +117,9 @@ class TrainingSupervisor:
         nan_policy: Optional[str] = None,
         search_fn: Optional[Callable] = None,
         backend: str = "local",
+        async_save: Optional[bool] = None,
+        step_timeout: Optional[float] = None,
+        preempt_grace: Optional[bool] = None,
         sleep: Callable[[float], None] = time.sleep,
         logger=resilience_logger,
     ):
@@ -112,6 +144,19 @@ class TrainingSupervisor:
         self.search_fn = search_fn
         self.sleep = sleep
         self.log = logger
+        self.async_save = (
+            getattr(cfg, "checkpoint_async", False)
+            if async_save is None else bool(async_save)
+        )
+        self.watchdog = StepWatchdog(
+            getattr(cfg, "step_timeout", 0.0)
+            if step_timeout is None else step_timeout
+        )
+        self.preempt_grace = (
+            getattr(cfg, "preempt_grace", True)
+            if preempt_grace is None else bool(preempt_grace)
+        )
+        self._preempt: Optional[str] = None
         keep = cfg.checkpoint_keep if keep is None else keep
         if backend == "orbax":
             from ..checkpoint import CheckpointManager
@@ -134,6 +179,8 @@ class TrainingSupervisor:
             "checkpoint_time_s": 0.0,
             "checkpoint_time_last_s": 0.0,
             "device_losses": 0,
+            "hung_steps": 0,       # watchdog timeouts + injected hangs
+            "emergency_saves": 0,  # preemption-grace checkpoints
             "re_searches": 0,
         }
 
@@ -153,26 +200,45 @@ class TrainingSupervisor:
         return {k: v[sl] for k, v in x_map.items()}, y[sl]
 
     # -- checkpoint / restore -------------------------------------------
-    def _save_checkpoint(self, step: int) -> None:
+    def _save_checkpoint(self, step: int, wait: Optional[bool] = None) -> None:
         self.fault_plan.check_checkpoint(step)
+        if wait is None:
+            wait = not self.async_save
         t0 = time.perf_counter()
-        self.manager.save(self.ff, step)
+        self.manager.save(self.ff, step, wait=wait)
+        # async mode: this is the step-boundary STALL (snapshot +
+        # enqueue), not the full write — the flush overlaps training
         dt = time.perf_counter() - t0
         self.counters["checkpoints"] += 1
         self.counters["checkpoint_time_s"] += dt
         self.counters["checkpoint_time_last_s"] = dt
 
-    def _save_checkpoint_survivable(self, step: int) -> None:
+    def _save_checkpoint_survivable(self, step: int,
+                                    wait: Optional[bool] = None) -> None:
         """A failed periodic save — injected or real (disk full, NFS
-        blip) — costs that save, never the run: count it and keep
-        training; the next cadence point writes a fresh one."""
+        blip, a write-time crc verification miss) — costs that save,
+        never the run: count it and keep training; the next cadence
+        point writes a fresh one."""
         try:
-            self._save_checkpoint(step)
-        except (CheckpointWriteFault, OSError) as e:
+            self._save_checkpoint(step, wait=wait)
+        except (CheckpointWriteFault, CheckpointVerifyError, OSError) as e:
             self.counters["checkpoint_failures"] += 1
             self.log.info("checkpoint save failed at step %d: %s", step, e)
 
+    def _drain_writer(self) -> None:
+        """Wait out pending async saves; fold their failures into the
+        checkpoint counters (an async write failure surfaces here, not
+        at the save() call that queued it)."""
+        for failed_step, err in self.manager.drain():
+            self.counters["checkpoint_failures"] += 1
+            self.log.info(
+                "async checkpoint save failed at step %d: %s", failed_step, err
+            )
+
     def _restore_latest(self, step: int) -> int:
+        # a pending async save may be the newest durable state — let it
+        # land (or fail) before picking the restore target
+        self._drain_writer()
         with tracer_of(self.ff).span("restart", cat="resilience",
                                      failed_step=step):
             restored = int(self.manager.restore(self.ff))
@@ -208,6 +274,22 @@ class TrainingSupervisor:
 
         return data_parallel_strategy(num_devices)
 
+    def _elastic_restart(self, survivors: List, step: int, reason: str) -> int:
+        """Re-search placement for `survivors`, recompile onto them,
+        and reshard-restore the latest checkpoint so trained state
+        carries over to the rebuilt executor."""
+        with tracer_of(self.ff).span("re_search", cat="resilience",
+                                     survivors=len(survivors), reason=reason):
+            strategy = self._search_strategy(len(survivors))
+        self.counters["re_searches"] += 1
+        # recompile rebuilds the executor (fresh shardings, fresh
+        # collective state); the checkpoint restore then overwrites the
+        # carried state with the last durable state, resharded onto it
+        self.ff.recompile(
+            strategy=strategy, devices=survivors[: strategy.total_devices]
+        )
+        return self._restore_latest(step)
+
     def _recover_device_loss(self, fault: DeviceLossFault, step: int) -> int:
         """Elastic recovery: re-search placement for the surviving
         topology, recompile onto it, and reshard-restore the latest
@@ -220,17 +302,178 @@ class TrainingSupervisor:
             "device loss at step %d: %d devices survive, re-searching",
             step, len(survivors),
         )
-        with tracer_of(self.ff).span("re_search", cat="resilience",
-                                     survivors=len(survivors)):
-            strategy = self._search_strategy(len(survivors))
-        self.counters["re_searches"] += 1
-        # recompile rebuilds the executor on the shrunken mesh (fresh
-        # shardings); the checkpoint restore then overwrites the carried
-        # state with the last durable state, resharded onto that mesh
-        self.ff.recompile(
-            strategy=strategy, devices=survivors[: strategy.total_devices]
+        return self._elastic_restart(survivors, step, reason="device_loss")
+
+    def _recover_hung_step(self, err, step: int, restarts: int) -> int:
+        """A hung step (watchdog timeout or injected HungStepFault) is
+        a device-loss-style fault with the FULL mesh surviving: the
+        devices are still there, the collective state is wedged, and
+        recompile + reshard-restore resets it.  Counts against the
+        restart budget — a mesh that hangs on every recovery attempt
+        must eventually fail loudly, not loop forever."""
+        self.counters["hung_steps"] += 1
+        if not self.retry.admits(restarts):
+            raise RestartBudgetExhausted(
+                f"restart budget ({self.retry.max_restarts}) exhausted at "
+                f"hung step {step}: {err}"
+            ) from err
+        self.log.info("hung step %d (%s): recompiling the full mesh", step, err)
+        survivors = list(self.ff.mesh.devices.flat)
+        return self._elastic_restart(survivors, step, reason="hung_step")
+
+    # -- preemption grace -------------------------------------------------
+    def _on_grace_signal(self, signum, frame) -> None:
+        self._preempt = signal.Signals(signum).name
+        # signal-handler context: only set the flag and note it — the
+        # heavy work happens at the next step boundary on the main path
+        self.log.info(
+            "%s received: emergency checkpoint at the next step boundary",
+            self._preempt,
         )
-        return self._restore_latest(step)
+
+    def _install_grace_handlers(self) -> Dict:
+        """SIGTERM/SIGINT -> request an emergency save at the next step
+        boundary.  Returns the displaced handlers (restored on exit);
+        empty when not on the main thread (signal.signal would raise)."""
+        if not self.preempt_grace:
+            return {}
+        if threading.current_thread() is not threading.main_thread():
+            return {}
+        installed = {}
+        for sig in GRACE_SIGNALS:
+            try:
+                installed[sig] = signal.signal(sig, self._on_grace_signal)
+            except (ValueError, OSError):  # exotic embeddings
+                break
+        return installed
+
+    def _emergency_stop(self, step: int) -> None:
+        """The preemption deadline is unknown — synchronously write one
+        final checkpoint at this step boundary, drain the async writer,
+        and leave the directory restorable."""
+        registry = registry_of(self.ff)
+        with tracer_of(self.ff).span("emergency_checkpoint", cat="resilience",
+                                     step=step, reason=self._preempt):
+            # drain FIRST: a queued async save may still be flushing on
+            # the writer thread, and the sync emergency write must not
+            # race it on the step dir / LATEST pointer
+            self._drain_writer()
+            self._save_checkpoint_survivable(step, wait=True)
+        self.counters["emergency_saves"] += 1
+        if registry is not None:
+            registry.counter("resilience/ckpt_emergency_saves").inc()
+        self.log.info(
+            "emergency checkpoint at step %d after %s; exiting restorable",
+            step, self._preempt,
+        )
+
+    # -- the supervised loop ----------------------------------------------
+    def run(self, x, y, num_steps: int, batch_size: Optional[int] = None,
+            resume: bool = False) -> SupervisorReport:
+        """Train for `num_steps` supervised steps over (x, y).
+
+        resume=True restores the newest verified checkpoint in the
+        directory (if any) and continues from its step — the companion
+        of the preemption-grace exit, for the replacement process."""
+        ff = self.ff
+        assert ff._step_fn is not None, "call compile() first"
+        batch_size = batch_size or ff.config.batch_size
+        x_map = self._x_map(x)
+        num_batches = len(y) // batch_size
+        if num_batches < 1:
+            raise ValueError(
+                f"need at least one batch: {len(y)} samples < "
+                f"batch_size {batch_size}"
+            )
+        # keyed by step so restores truncate exactly (a skipped step
+        # records nothing, so a plain list would drift out of phase)
+        loss_by_step: Dict[int, float] = {}
+        step = 0
+        restarts = 0
+        self._preempt = None
+        if resume and self.manager.latest_step() is not None:
+            step = int(self.manager.restore(ff))
+            self.log.info("resumed from checkpoint step %d", step)
+        else:
+            self._save_checkpoint_survivable(0)  # anchor: first failure has a target
+        displaced = self._install_grace_handlers()
+        try:
+            while step < num_steps:
+                if self._preempt is not None:
+                    break
+                try:
+                    self.fault_plan.check_step(step)
+                    inputs, labels = self._batch(
+                        x_map, y, step, batch_size, num_batches
+                    )
+                    inputs = self.fault_plan.corrupt_batch(step, inputs)
+                    snap = self._snapshot() if self.nan_policy == "skip_step" else None
+                    m = ff.train_step(inputs, labels)
+                    self.counters["steps_run"] += 1
+                    # the per-step device sync, under the hung-step
+                    # watchdog: a wedged collective raises
+                    # HungStepTimeout here instead of blocking forever
+                    loss_val = self.watchdog.sync(
+                        lambda: float(np.asarray(m["loss"])), step=step
+                    )
+                    try:
+                        check_step_health({"loss": loss_val}, step=step,
+                                          nan_policy=self.nan_policy)
+                    except NonFiniteLossError:
+                        if self.nan_policy != "skip_step":
+                            raise  # "raise" propagates; "restore" caught below
+                        # full step rollback (weights/opt/state/rng), then
+                        # move past the poisoned batch
+                        self._rollback(snap)
+                        self.counters["skipped_steps"] += 1
+                        loss_val = None
+                    if loss_val is not None:
+                        loss_by_step[step] = loss_val
+                    step += 1
+                    if self.checkpoint_every > 0 and step % self.checkpoint_every == 0:
+                        self._save_checkpoint_survivable(step)
+                except DeviceLossFault as f:
+                    step = self._recover_device_loss(f, step)
+                    loss_by_step = {s: v for s, v in loss_by_step.items() if s < step}
+                except HUNG_FAULTS as e:
+                    restarts += 1
+                    step = self._recover_hung_step(e, step, restarts)
+                    loss_by_step = {s: v for s, v in loss_by_step.items() if s < step}
+                except TRANSIENT_FAULTS + (NonFiniteLossError,) as e:
+                    if isinstance(e, NonFiniteLossError) and self.nan_policy == "raise":
+                        raise
+                    restarts += 1
+                    step = self._retry_transient(e, step, restarts)
+                    # replayed steps re-record their losses
+                    loss_by_step = {s: v for s, v in loss_by_step.items() if s < step}
+            if self._preempt is not None:
+                # AFTER the loop, not at its top: a signal during the
+                # final step must still get its boundary checkpoint —
+                # report.preempted promises a restorable directory
+                self._emergency_stop(step)
+        finally:
+            for sig, handler in displaced.items():
+                signal.signal(sig, handler)
+            # every exit path — clean, preempted, budget-exhausted —
+            # waits out the async writer: queued saves must land (or
+            # be counted failed) before the process can go away
+            self._drain_writer()
+        # same "supervisor: k=v ..." log line as before, now also folded
+        # into the run's metrics registry (-> run_telemetry.jsonl)
+        tel = getattr(self.ff, "telemetry", None)
+        emit_counters(
+            self.log, "supervisor", self.counters,
+            registry=tel.metrics if tel is not None else None,
+            group="resilience",
+        )
+        if tel is not None and tel.enabled:
+            tel.flush()
+        return SupervisorReport(
+            final_step=step,
+            losses=[loss_by_step[s] for s in sorted(loss_by_step)],
+            counters=dict(self.counters),
+            preempted=self._preempt,
+        )
 
     # -- nan handling -----------------------------------------------------
     def _snapshot(self):
@@ -255,75 +498,3 @@ class TrainingSupervisor:
         ff._opt_state = device_put_like(opt, ff._opt_state)
         ff._state = device_put_like(st, ff._state)
         ff._rng = rng
-
-    # -- the supervised loop ----------------------------------------------
-    def run(self, x, y, num_steps: int, batch_size: Optional[int] = None
-            ) -> SupervisorReport:
-        """Train for `num_steps` supervised steps over (x, y)."""
-        ff = self.ff
-        assert ff._step_fn is not None, "call compile() first"
-        batch_size = batch_size or ff.config.batch_size
-        x_map = self._x_map(x)
-        num_batches = len(y) // batch_size
-        if num_batches < 1:
-            raise ValueError(
-                f"need at least one batch: {len(y)} samples < "
-                f"batch_size {batch_size}"
-            )
-        # keyed by step so restores truncate exactly (a skipped step
-        # records nothing, so a plain list would drift out of phase)
-        loss_by_step: Dict[int, float] = {}
-        step = 0
-        restarts = 0
-        self._save_checkpoint_survivable(0)  # anchor: first failure has a target
-        while step < num_steps:
-            try:
-                self.fault_plan.check_step(step)
-                inputs, labels = self._batch(
-                    x_map, y, step, batch_size, num_batches
-                )
-                inputs = self.fault_plan.corrupt_batch(step, inputs)
-                snap = self._snapshot() if self.nan_policy == "skip_step" else None
-                m = ff.train_step(inputs, labels)
-                self.counters["steps_run"] += 1
-                try:
-                    check_step_health(m, step=step,
-                                      nan_policy=self.nan_policy)
-                except NonFiniteLossError:
-                    if self.nan_policy != "skip_step":
-                        raise  # "raise" propagates; "restore" caught below
-                    # full step rollback (weights/opt/state/rng), then
-                    # move past the poisoned batch
-                    self._rollback(snap)
-                    self.counters["skipped_steps"] += 1
-                    m = None
-                if m is not None:
-                    loss_by_step[step] = float(np.asarray(m["loss"]))
-                step += 1
-                if self.checkpoint_every > 0 and step % self.checkpoint_every == 0:
-                    self._save_checkpoint_survivable(step)
-            except DeviceLossFault as f:
-                step = self._recover_device_loss(f, step)
-                loss_by_step = {s: v for s, v in loss_by_step.items() if s < step}
-            except TRANSIENT_FAULTS + (NonFiniteLossError,) as e:
-                if isinstance(e, NonFiniteLossError) and self.nan_policy == "raise":
-                    raise
-                restarts += 1
-                step = self._retry_transient(e, step, restarts)
-                # replayed steps re-record their losses
-                loss_by_step = {s: v for s, v in loss_by_step.items() if s < step}
-        # same "supervisor: k=v ..." log line as before, now also folded
-        # into the run's metrics registry (-> run_telemetry.jsonl)
-        tel = getattr(self.ff, "telemetry", None)
-        emit_counters(
-            self.log, "supervisor", self.counters,
-            registry=tel.metrics if tel is not None else None,
-            group="resilience",
-        )
-        if tel is not None and tel.enabled:
-            tel.flush()
-        return SupervisorReport(
-            final_step=step,
-            losses=[loss_by_step[s] for s in sorted(loss_by_step)],
-            counters=dict(self.counters),
-        )

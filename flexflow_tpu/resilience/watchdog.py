@@ -1,0 +1,105 @@
+"""Hung-step watchdog: bound the per-step device sync with a timeout.
+
+A wedged collective (ICI link flap, a peer host dropping out of a
+multi-slice ring) does not raise — the host-side sync simply never
+returns, and an unsupervised run hangs forever where a crash would
+have triggered recovery.  `StepWatchdog.sync` runs the blocking device
+read on a persistent worker thread and gives up after `timeout_s`,
+raising `HungStepTimeout`; the supervisor classifies that like a
+device-loss fault and routes it into the existing restart / elastic
+re-search path (recompiling the executor is what resets the wedged
+collective state).
+
+One worker thread serves every step, so the hot path pays a queue
+put/event wait, not a thread spawn.  On timeout the wedged worker is
+abandoned (it is a daemon thread blocked on the dead sync — it costs
+one stack and exits if the sync ever unwedges) and the next sync gets a
+fresh worker.  A disabled watchdog (timeout_s == 0, the default) calls
+the function inline: no thread, no overhead.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+
+class HungStepTimeout(RuntimeError):
+    """The per-step device sync exceeded the watchdog timeout.
+
+    Raised by real watchdog expiry — the injected twin is
+    `resilience.faults.HungStepFault`; the supervisor treats both as
+    the same device-loss-style fault."""
+
+    def __init__(self, step: Optional[int], timeout_s: float):
+        self.step = step
+        self.timeout_s = timeout_s
+        where = f" at step {step}" if step is not None else ""
+        super().__init__(
+            f"device sync{where} exceeded the {timeout_s:g}s step "
+            "watchdog — treating the step as hung"
+        )
+
+
+_STOP = object()
+
+
+class StepWatchdog:
+    """Runs blocking device syncs with a hang deadline."""
+
+    def __init__(self, timeout_s: float = 0.0):
+        if timeout_s < 0:
+            raise ValueError(f"timeout_s must be >= 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self._worker: Optional[threading.Thread] = None
+        self._requests: Optional["queue.Queue"] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0
+
+    @staticmethod
+    def _serve(requests: "queue.Queue") -> None:
+        while True:
+            item = requests.get()
+            if item is _STOP:
+                return
+            fn, box, done = item
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised by sync()
+                box["error"] = e
+            finally:
+                done.set()
+
+    def _ensure_worker(self) -> "queue.Queue":
+        if self._worker is None or not self._worker.is_alive():
+            self._requests = queue.Queue()
+            self._worker = threading.Thread(
+                target=self._serve, args=(self._requests,),
+                daemon=True, name="step-watchdog",
+            )
+            self._worker.start()
+        return self._requests
+
+    def sync(self, fn: Callable[[], Any], step: Optional[int] = None) -> Any:
+        """Run `fn` (a blocking device read); raise HungStepTimeout if
+        it does not return within `timeout_s`.  Exceptions from `fn`
+        propagate unchanged; a disabled watchdog calls `fn` inline."""
+        if not self.enabled:
+            return fn()
+        requests = self._ensure_worker()
+        box: dict = {}
+        done = threading.Event()
+        requests.put((fn, box, done))
+        if not done.wait(self.timeout_s):
+            # abandon the wedged worker: queue a stop so it exits if the
+            # sync ever returns, and spawn fresh on the next call.  Each
+            # request carries its own box/event, so a late completion
+            # cannot cross-talk with a newer sync.
+            requests.put(_STOP)
+            self._worker = None
+            raise HungStepTimeout(step, self.timeout_s)
+        if "error" in box:
+            raise box["error"]
+        return box["result"]

@@ -223,6 +223,20 @@ def test_resilience_cli_flags_parse():
     assert base.checkpoint_every == 0 and base.nan_policy == "raise"
 
 
+def test_durability_cli_flags_parse():
+    cfg = FFConfig.from_args([
+        "--checkpoint-async", "--step-timeout", "45.5", "--no-preempt-grace",
+    ])
+    assert cfg.checkpoint_async is True
+    assert cfg.step_timeout == pytest.approx(45.5)
+    assert cfg.preempt_grace is False
+    # defaults: sync saves, watchdog off, grace on
+    base = FFConfig.from_args([])
+    assert base.checkpoint_async is False
+    assert base.step_timeout == 0.0
+    assert base.preempt_grace is True
+
+
 def test_resilience_config_validated():
     with pytest.raises(ValueError):
         FFConfig(nan_policy="bogus")
@@ -234,6 +248,8 @@ def test_resilience_config_validated():
         FFConfig(max_restarts=-2)
     with pytest.raises(ValueError):
         FFConfig(retry_backoff=-0.1)
+    with pytest.raises(ValueError):
+        FFConfig(step_timeout=-1.0)
 
 
 def test_remat_matches_nonremat_numerics_and_inserts_checkpoint(devices8):
