@@ -3,7 +3,13 @@ decode twin, serve /v2/generate over HTTP, and fire concurrent
 requests (docs/SERVING.md; the scope the reference's triton/ prototype
 never reached).
 
+--serving-mode continuous (the default) runs the iteration-level
+scheduler on the paged KV-cache pool (serving/scheduler.py);
+--serving-mode static falls back to the whole-scan GenerationBatcher.
+
 Run: python serve_gpt.py [-e STEPS] [-b BATCH]
+                         [--serving-mode continuous|static]
+                         [--kv-page-size N] [--serving-slots N]
 """
 import argparse
 import json
@@ -14,7 +20,8 @@ import numpy as np
 
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.models.transformer import build_gpt
-from flexflow_tpu.serving import GenerationBatcher, GenerationEngine
+from flexflow_tpu.serving import (ContinuousScheduler, GenerationBatcher,
+                                  GenerationEngine)
 from flexflow_tpu.serving.server import serve_http
 
 V, S = 64, 24
@@ -25,7 +32,8 @@ def main():
     p.add_argument("-e", "--steps", type=int, default=30)
     p.add_argument("-b", "--batch-size", type=int, default=8)
     args, _ = p.parse_known_args()
-    b = args.batch_size
+    serving_cfg = FFConfig.from_args()  # --serving-mode/--kv-page-size/
+    b = args.batch_size                 # --serving-slots/--kv-pool-blocks
 
     ff = FFModel(FFConfig(batch_size=b, num_devices=1))
     build_gpt(ff, batch_size=b, seq_length=S, hidden_size=32,
@@ -42,11 +50,20 @@ def main():
         m = ff.train_step({"input": ids, "positions": pos}, labels)
     print(f"trained {args.steps} steps, loss={float(m['loss']):.3f}")
 
-    engine = GenerationEngine(ff, batch_size=b)
-    batcher = GenerationBatcher(engine, flush_timeout_s=0.02)
+    if serving_cfg.serving_mode == "continuous":
+        page = serving_cfg.kv_page_size
+        if S % page:  # the demo model's position table is small
+            page = 4
+        batcher = ContinuousScheduler.from_trained(
+            ff, batch_slots=serving_cfg.serving_slots, page_size=page,
+            num_blocks=serving_cfg.kv_pool_blocks or None)
+    else:
+        engine = GenerationEngine(ff, batch_size=b)
+        batcher = GenerationBatcher(engine, flush_timeout_s=0.02)
     server = serve_http(generator=batcher, port=0, block=False)
     port = server.server_address[1]
-    print(f"serving /v2/generate on :{port}")
+    print(f"serving /v2/generate on :{port} "
+          f"({serving_cfg.serving_mode} mode)")
 
     def client(i, out):
         payload = {"prompt": ids[i % b, :4].tolist(), "max_new_tokens": 8}

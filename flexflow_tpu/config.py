@@ -38,6 +38,12 @@ DEFAULT_FLASH_MIN_SEQ = 2048
 # syncs once per step to record the loss in its report).
 NAN_POLICIES = ("raise", "skip_step", "restore", "off")
 
+# valid FFConfig.serving_mode values (serving/, docs/SERVING.md):
+# "continuous" = iteration-level batching on the paged KV pool
+# (serving/scheduler.py); "static" = the whole-scan GenerationBatcher
+# fallback (one program per coalesced batch, dense per-slot caches).
+SERVING_MODES = ("continuous", "static")
+
 
 @dataclasses.dataclass
 class FFConfig:
@@ -179,7 +185,34 @@ class FFConfig:
     # "start:count" (e.g. "3:2" profiles steps 3 and 4); needs trace_dir
     profile_steps: Optional[str] = None
 
+    # -- serving (serving/, docs/SERVING.md): generation tier mode and
+    #    paged KV-cache pool geometry.  Consumed by the serving entry
+    #    points (examples/serve_gpt.py, bench serving leg) — training
+    #    never reads these.
+    serving_mode: str = "continuous"  # continuous | static (fallback)
+    kv_page_size: int = 16     # tokens per KV block (must divide max_seq)
+    kv_pool_blocks: int = 0    # physical blocks incl. scratch; 0 = auto
+    serving_slots: int = 8     # continuous decode batch slots
+
     def __post_init__(self):
+        if self.serving_mode not in SERVING_MODES:
+            raise ValueError(
+                f"serving_mode must be one of {SERVING_MODES}, "
+                f"got {self.serving_mode!r}"
+            )
+        if self.kv_page_size < 1:
+            raise ValueError(
+                f"kv_page_size must be >= 1, got {self.kv_page_size}"
+            )
+        if self.kv_pool_blocks < 0:
+            raise ValueError(
+                f"kv_pool_blocks must be >= 0 (0 = auto), "
+                f"got {self.kv_pool_blocks}"
+            )
+        if self.serving_slots < 1:
+            raise ValueError(
+                f"serving_slots must be >= 1, got {self.serving_slots}"
+            )
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(
                 f"nan_policy must be one of {NAN_POLICIES}, "
@@ -318,6 +351,14 @@ class FFConfig:
         p.add_argument("--telemetry", dest="telemetry", action="store_true")
         p.add_argument("--profile-steps", dest="profile_steps", type=str,
                        default=None)
+        p.add_argument("--serving-mode", dest="serving_mode", type=str,
+                       default="continuous", choices=SERVING_MODES)
+        p.add_argument("--kv-page-size", dest="kv_page_size", type=int,
+                       default=16)
+        p.add_argument("--kv-pool-blocks", dest="kv_pool_blocks",
+                       type=int, default=0)
+        p.add_argument("--serving-slots", dest="serving_slots", type=int,
+                       default=8)
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -371,6 +412,10 @@ class FFConfig:
             trace_dir=args.trace_dir,
             telemetry=args.telemetry,
             profile_steps=args.profile_steps,
+            serving_mode=args.serving_mode,
+            kv_page_size=args.kv_page_size,
+            kv_pool_blocks=args.kv_pool_blocks,
+            serving_slots=args.serving_slots,
         )
 
 

@@ -63,10 +63,17 @@ def _gpt_dims(ff: FFModel) -> Dict[str, int]:
 
 
 def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
-                     devices=None) -> FFModel:
+                     devices=None, kv_page_size: int = 0,
+                     kv_num_blocks: int = 0) -> FFModel:
     """Build + compile the KV-cache decode twin of a trained GPT and
     transfer its weights.  The decode graph is seq-1 with
-    decode_max_seq = the trained model's position-table size."""
+    decode_max_seq = the trained model's position-table size.
+
+    kv_page_size > 0 builds the PAGED twin (serving/scheduler.py):
+    every attention layer's k/v cache is a [kv_num_blocks,
+    kv_page_size, h, d] block pool with a host-owned per-slot block
+    table + seq_lens instead of a dense per-slot [b, max_seq, h, d]
+    buffer — continuous batching's allocation substrate."""
     from .config import FFConfig
     from .models.transformer import build_gpt
 
@@ -85,6 +92,7 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
         intermediate_size=dims["intermediate_size"],
         vocab_size=dims["vocab_size"], dropout=0.0,
         max_positions=dims["max_seq"], decode_max_seq=dims["max_seq"],
+        kv_page_size=kv_page_size, kv_num_blocks=kv_num_blocks,
     )
     ffd.compile(
         optimizer=SGDOptimizer(lr=0.0),
@@ -372,3 +380,52 @@ def run_generate_scan(ffd: FFModel, prompt_pad: np.ndarray,
     out[:, 0] = prompt_pad[:, 0]
     out[:, 1:] = toks
     return out
+
+
+def build_paged_decode_step(ffd: FFModel):
+    """ONE compiled step function for continuous batching on a paged
+    decode twin (make_gpt_decoder with kv_page_size > 0):
+
+        step(weights, state, tokens[b], positions[b], block_table)
+            -> (logits [b, vocab], new_state)
+
+    Unlike the full-generation scan (whose program is keyed by total
+    length), the continuous scheduler steps every in-flight sequence by
+    one token per call with per-row positions — the shapes never change,
+    so this single program serves the engine's entire lifetime with
+    zero recompiles.  The scheduler owns the state pytree and threads
+    it through explicitly; nothing here touches ffd._state.
+
+    Hot-path discipline (this runs once per generated token):
+      * block_table/seq_lens are jit ARGUMENTS substituted into the
+        attention op states inside the trace — the per-step override
+        costs nothing at run time and the host never rebuilds the
+        state dict;
+      * the state pytree is DONATED, so each step's k/v pool scatter
+        updates the buffers in place instead of copying every layer's
+        pool per token (XLA honors this on TPU; on CPU it degrades to
+        a copy, harmlessly)."""
+    import jax
+    import jax.numpy as jnp
+
+    ex = ffd.executor
+
+    def step(weights, state, tokens, positions, block_table):
+        state = {
+            op: {
+                k: (block_table if k == "block_table"
+                    else positions if k == "seq_lens" else v)
+                for k, v in entries.items()
+            }
+            for op, entries in state.items()
+        }
+        logits, new_state, _, _ = ex.run_forward(
+            weights, state,
+            {"input": tokens[:, None],
+             "positions": positions[:, None].astype(jnp.int32)},
+            training=False, rng=None,
+        )
+        return logits[:, 0], new_state
+
+    with ex.mesh:
+        return jax.jit(step, donate_argnums=(1,))

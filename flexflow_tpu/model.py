@@ -311,6 +311,8 @@ class FFModel:
         causal: bool = False,
         name: Optional[str] = None,
         decode_max_seq: int = 0,
+        kv_page_size: int = 0,
+        kv_num_blocks: int = 0,
     ) -> ParallelTensor:
         p = MultiHeadAttentionParams(
             embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
@@ -319,7 +321,9 @@ class FFModel:
         return self._add(
             MultiHeadAttention(p, [query, key, value],
                                name=self._name("attention", name),
-                               decode_max_seq=decode_max_seq)
+                               decode_max_seq=decode_max_seq,
+                               kv_page_size=kv_page_size,
+                               kv_num_blocks=kv_num_blocks)
         )
 
     def batch_matmul(
@@ -1183,10 +1187,12 @@ class FFModel:
 
     def reset_decode_state(self):
         """Zero the decode caches (k_cache/v_cache/cache_pos state
-        entries) so the next decode_step starts a fresh sequence."""
+        entries, plus the paged-mode block_table/seq_lens) so the next
+        decode_step starts a fresh sequence."""
         import jax.numpy as jnp
 
-        names = ("k_cache", "v_cache", "cache_pos")
+        names = ("k_cache", "v_cache", "cache_pos", "block_table",
+                 "seq_lens")
         self._state = {
             op: {
                 k: (jnp.zeros_like(v) if k in names else v)

@@ -62,11 +62,14 @@ class MultiHeadAttention(Op):
     op_type = OperatorType.MULTIHEAD_ATTENTION
 
     def __init__(self, params, inputs, name="", shard=None,
-                 decode_max_seq: int = 0):
+                 decode_max_seq: int = 0, kv_page_size: int = 0,
+                 kv_num_blocks: int = 0):
         from .op import ShardConfig
 
         # must exist before Op.__init__ runs make_weight_specs
         self._decode_max_seq = int(decode_max_seq)
+        self._kv_page_size = int(kv_page_size)
+        self._kv_num_blocks = int(kv_num_blocks)
         super().__init__(params, inputs, name=name,
                          shard=shard or ShardConfig())
 
@@ -125,9 +128,29 @@ class MultiHeadAttention(Op):
     def _decode_n(self) -> int:
         return int(getattr(self, "_decode_max_seq", 0) or 0)
 
+    # Paged decode mode (serving/kv_pool.py, the vLLM PagedAttention
+    # design, SOSP'23): instead of one dense [b, N, h, d] cache per
+    # sequence slot, k/v live in a POOL of fixed-size blocks
+    # [num_blocks, page, h, d] shared by all slots; a per-slot block
+    # table [b, N/page] maps logical block -> physical block and a
+    # per-slot seq_lens [b] carries each row's own position (continuous
+    # batching runs rows at different positions in one step).  The
+    # block table and seq_lens are HOST-owned (the scheduler allocates
+    # on extend / frees on retire and rewrites them between steps);
+    # in-graph they are read-only and returned unchanged.
+    def _paged(self) -> bool:
+        return self._decode_n() > 0 and \
+            int(getattr(self, "_kv_page_size", 0) or 0) > 0
+
     def ctor_kwargs(self) -> dict:
         n = self._decode_n()
-        return {"decode_max_seq": n} if n else {}
+        if not n:
+            return {}
+        kw = {"decode_max_seq": n}
+        if self._paged():
+            kw["kv_page_size"] = self._kv_page_size
+            kw["kv_num_blocks"] = self._kv_num_blocks
+        return kw
 
     def num_trainable_weights(self) -> int:
         n = 4
@@ -190,6 +213,9 @@ class MultiHeadAttention(Op):
                     f"{self.name}: decode mode needs an unsharded seq dim"
                 )
 
+            if self._paged():
+                return specs + self._paged_state_specs(qd, dt)
+
             def cache(d_head):
                 dims = (
                     ParallelDim(qd[0].size, qd[0].degree),
@@ -211,6 +237,61 @@ class MultiHeadAttention(Op):
                 WeightSpec("cache_pos", pos_shape, zero),
             ]
         return specs
+
+    def _paged_state_specs(self, qd, dt):
+        """State specs for paged decode: block-pool k/v caches plus the
+        host-owned per-slot block table and sequence lengths."""
+        from ..initializer import ZeroInitializer
+
+        p: MultiHeadAttentionParams = self.params
+        n, page, nb = self._decode_n(), self._kv_page_size, \
+            self._kv_num_blocks
+        q = self.inputs[0].shape
+        if qd[1].size != 1:
+            raise ShapeError(
+                f"{self.name}: paged decode steps one token at a time "
+                f"(build the decode twin with seq_length=1, got "
+                f"{qd[1].size})"
+            )
+        if qd[0].degree != 1 or self.shard.channel != 1 \
+                or q.replica_degree != 1:
+            raise ShapeError(
+                f"{self.name}: paged decode mode needs an unsharded "
+                "decode graph (the block gather is not GSPMD-partitioned "
+                "yet)"
+            )
+        if page < 1 or n % page:
+            raise ShapeError(
+                f"{self.name}: kv_page_size {page} must divide "
+                f"decode_max_seq {n} (the gathered view must equal the "
+                "dense cache shape for bit-identical attention)"
+            )
+        if nb < 2:
+            raise ShapeError(
+                f"{self.name}: kv_num_blocks {nb} < 2 (block 0 is the "
+                "scratch block idle slots write into)"
+            )
+        zero = ZeroInitializer()
+
+        def pool(d_head):
+            dims = (
+                ParallelDim(nb), ParallelDim(page),
+                ParallelDim(p.num_heads), ParallelDim(d_head),
+                ParallelDim(1, 1, is_replica_dim=True),
+            )
+            return ParallelTensorShape(dims, dt)
+
+        def ints(*sizes):
+            dims = tuple(ParallelDim(s) for s in sizes) + (
+                ParallelDim(1, 1, is_replica_dim=True),)
+            return ParallelTensorShape(dims, DataType.INT32)
+
+        return [
+            WeightSpec("k_cache", pool(p.k_channels), zero),
+            WeightSpec("v_cache", pool(p.v_channels), zero),
+            WeightSpec("block_table", ints(qd[0].size, n // page), zero),
+            WeightSpec("seq_lens", ints(qd[0].size), zero),
+        ]
 
     def forward(self, inputs, weights, *, training=False, rng=None):
         q, k, v = inputs
@@ -240,6 +321,15 @@ class MultiHeadAttention(Op):
             kh = jnp.concatenate([kh, jnp.zeros((bsz, 1, h, dk), kh.dtype)], axis=1)
             vh = jnp.concatenate([vh, jnp.zeros((bsz, 1, h, dv), vh.dtype)], axis=1)
         scale = 1.0 / np.sqrt(p.k_channels)
+        if self._paged():
+            k_cache, v_cache, btab, slen = weights[-4:]
+            ctx, k_cache, v_cache = self._attend_decode_paged(
+                qh, kh, vh, k_cache, v_cache, btab, slen, scale
+            )
+            out = jnp.einsum("bqhd,hde->bqe", ctx, wo)
+            if bo is not None:
+                out = out + bo[None, None]
+            return [out.astype(q.dtype), k_cache, v_cache, btab, slen]
         if self._decode_n() > 0:
             k_cache, v_cache, pos = weights[-3], weights[-2], weights[-1]
             ctx, k_cache, v_cache, pos = self._attend_decode(
@@ -290,6 +380,54 @@ class MultiHeadAttention(Op):
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(qh.dtype))
         return ctx, k_cache, v_cache, (pos0 + s).reshape(1)
+
+    def _attend_decode_paged(self, qh, kh, vh, k_cache, v_cache, btab,
+                             slen, scale):
+        """Paged incremental attention: write this step's k/v into the
+        block pool at each row's OWN position (slot = block_table[i,
+        pos_i // page], offset = pos_i % page), then attend over the
+        row's gathered block view.  The gather materializes a dense
+        [b, N, h, d] view (N = table_len * page == decode_max_seq), so
+        the score/softmax/context math is shape-identical to the dense
+        `_attend_decode` path — greedy decoding is bit-identical by
+        construction, while the RESIDENT cache is the shared pool
+        (sum-of-live-lengths HBM instead of b * max_seq).  Gathered
+        slots past a row's length hold other sequences' bytes; the
+        per-row position mask zeroes them out of the softmax exactly
+        (exp underflow of the finfo.min fill), so cross-sequence leaks
+        are structurally impossible, not just unlikely.
+
+        Rows always step one token; idle scheduler slots point their
+        table at scratch block 0 with seq_len 0, so their (garbage)
+        writes land in scratch and their logits are ignored host-side."""
+        p: MultiHeadAttentionParams = self.params
+        b = qh.shape[0]
+        page = self._kv_page_size
+        pos = slen.reshape(b).astype(jnp.int32)  # [b] incoming position
+        blk = jnp.take_along_axis(
+            btab, (pos // page)[:, None], axis=1
+        )[:, 0]
+        off = pos % page
+        k_cache = k_cache.at[blk, off].set(kh[:, 0].astype(k_cache.dtype))
+        v_cache = v_cache.at[blk, off].set(vh[:, 0].astype(v_cache.dtype))
+        n = btab.shape[1] * page
+        kv_k = jnp.take(k_cache, btab, axis=0).reshape(
+            b, n, p.num_heads, -1)
+        kv_v = jnp.take(v_cache, btab, axis=0).reshape(
+            b, n, p.num_heads, -1)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", qh, kv_k.astype(qh.dtype)
+        ) * scale
+        key_pos = jnp.arange(n, dtype=jnp.int32)
+        # one-token steps: causal and visible-prefix masks coincide at
+        # key_pos <= pos_i (the row's just-written slot is attendable)
+        mask = key_pos[None, :] <= pos[:, None]  # [b, n]
+        scores = jnp.where(
+            mask[:, None, None, :], scores, jnp.finfo(scores.dtype).min
+        )
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, kv_v.astype(qh.dtype))
+        return ctx, k_cache, v_cache
 
     # -- attention core dispatch ----------------------------------------
     def _seq_degree(self) -> int:

@@ -7,11 +7,21 @@ Strategy into bucketed jitted callables (static shapes per batch
 bucket, so XLA compiles once per bucket); `DynamicBatcher` coalesces
 concurrent requests up to max_batch/timeout — the Triton scheduler's
 role; `serve_http` exposes a stdlib JSON endpoint.
+
+Generation has two tiers (docs/SERVING.md): `GenerationBatcher` runs
+STATIC batches (whole generations as one scan program, requests
+coalesced up front) and `ContinuousScheduler` runs CONTINUOUS
+(iteration-level) batches on a paged KV-cache pool — sequences are
+admitted and retired at every decode step, so heterogeneous lengths
+share device time and HBM instead of padding to the batch max.
 """
-from .engine import InferenceEngine
 from .batcher import DynamicBatcher
+from .engine import InferenceEngine
 from .generation import GenerationBatcher, GenerationEngine
+from .kv_pool import KVPool
+from .scheduler import ContinuousScheduler, PagedKVDecodeModel
 from .server import serve_http
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "GenerationEngine",
-           "GenerationBatcher", "serve_http"]
+           "GenerationBatcher", "ContinuousScheduler",
+           "PagedKVDecodeModel", "KVPool", "serve_http"]

@@ -23,11 +23,11 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
-def latency_percentiles(latencies, lock) -> Dict[str, float]:
-    """p50/p95/p99/mean (ms) over a ring buffer (shared by the forward
-    and generation batchers)."""
-    with lock:  # appends race from the worker threads
-        lats = sorted(latencies)
+def percentile_summary(values, ps=(0.50, 0.95, 0.99)) -> Dict[str, float]:
+    """n / p*_ms / mean_ms summary of latencies in SECONDS — the one
+    percentile implementation (batchers' ring windows, the continuous
+    scheduler's TTFT stats, and the loadgen report all use it)."""
+    lats = sorted(values)
     if not lats:
         return {"n": 0}
 
@@ -40,13 +40,19 @@ def latency_percentiles(latencies, lock) -> Dict[str, float]:
         i = min(len(lats) - 1, max(0, math.ceil(p * len(lats)) - 1))
         return lats[i] * 1e3
 
-    return {
-        "n": len(lats),
-        "p50_ms": round(pct(0.50), 3),
-        "p95_ms": round(pct(0.95), 3),
-        "p99_ms": round(pct(0.99), 3),
-        "mean_ms": round(sum(lats) / len(lats) * 1e3, 3),
-    }
+    out = {"n": len(lats)}
+    for p in ps:
+        out[f"p{int(round(p * 100))}_ms"] = round(pct(p), 3)
+    out["mean_ms"] = round(sum(lats) / len(lats) * 1e3, 3)
+    return out
+
+
+def latency_percentiles(latencies, lock) -> Dict[str, float]:
+    """p50/p95/p99/mean (ms) over a ring buffer (shared by the forward
+    and generation batchers)."""
+    with lock:  # appends race from the worker threads
+        vals = list(latencies)
+    return percentile_summary(vals)
 
 
 class _Pending:
@@ -74,9 +80,13 @@ class DynamicBatcher:
     def __init__(self, engine, max_batch: int = 32,
                  flush_timeout_s: float = 0.005,
                  max_inflight: int = 2,
-                 latency_window: int = 1024):
+                 latency_window: int = 1024, registry=None):
         self.engine = engine
         self.max_batch = max_batch
+        # obs.metrics registry: counters/latencies fold in as
+        # serving/infer_* so they drain to run_telemetry.jsonl
+        # (the /v2/stats JSON shape is unchanged)
+        self.registry = registry
         self.flush_timeout_s = flush_timeout_s
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         # bounded: backpressure keeps at most `max_inflight` batches on
@@ -118,6 +128,12 @@ class DynamicBatcher:
             p.error = RuntimeError("DynamicBatcher is closed")
             p.event.set()
         return p
+
+    @property
+    def worker_alive(self) -> bool:
+        """False once either pipeline thread has died — /v2/health
+        reports "degraded" then (requests would only time out)."""
+        return self._assembler.is_alive() and self._completer.is_alive()
 
     def latency_stats(self) -> Dict[str, float]:
         """p50/p95/p99/mean request latency (ms) over the ring window."""
@@ -243,3 +259,10 @@ class DynamicBatcher:
                 self._latencies.append(now - p.t_submit)
             self.requests_done += 1
             p.event.set()
+        if self.registry is not None:
+            reg = self.registry
+            reg.counter("serving/infer_batches_run").inc()
+            reg.counter("serving/infer_requests_done").inc(len(batch))
+            for p in batch:
+                reg.histogram("serving/infer_latency_ms").observe(
+                    (now - p.t_submit) * 1e3)

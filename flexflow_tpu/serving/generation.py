@@ -130,9 +130,13 @@ class GenerationBatcher:
 
     def __init__(self, engine: GenerationEngine,
                  flush_timeout_s: float = 0.01,
-                 latency_window: int = 1024):
+                 latency_window: int = 1024, registry=None):
         self.engine = engine
         self.flush_timeout_s = flush_timeout_s
+        # obs.metrics registry: counters/latencies fold in as
+        # serving/generate_* so they drain to run_telemetry.jsonl
+        # (the /v2/stats JSON shape is unchanged)
+        self.registry = registry
         self._queue: "queue.Queue[_PendingGen]" = queue.Queue()
         self._stop = threading.Event()
         self._latencies = deque(maxlen=latency_window)
@@ -170,6 +174,13 @@ class GenerationBatcher:
             p.error = RuntimeError("GenerationBatcher is closed")
             p.event.set()
         return p
+
+    @property
+    def worker_alive(self) -> bool:
+        """False once the worker thread has died (crash or close) —
+        /v2/health reports "degraded" then, because every request
+        submitted to a dead worker can only time out."""
+        return self._worker.is_alive()
 
     def latency_stats(self) -> Dict[str, float]:
         from .batcher import latency_percentiles
@@ -249,6 +260,15 @@ class GenerationBatcher:
                     self._latencies.append(now - p.t_submit)
                 self.requests_done += 1
                 p.event.set()
+            if self.registry is not None:
+                reg = self.registry
+                reg.counter("serving/generate_batches_run").inc()
+                reg.counter("serving/generate_requests_done").inc(
+                    len(batch))
+                for p in batch:
+                    reg.histogram(
+                        "serving/generate_latency_ms").observe(
+                        (now - p.t_submit) * 1e3)
         except Exception as e:
             for p in batch:
                 p.error = e

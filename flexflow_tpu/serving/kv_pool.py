@@ -1,0 +1,195 @@
+"""Paged KV-cache pool accounting (the vLLM PagedAttention design,
+SOSP'23, on the host side).
+
+The device holds per-layer block pools ([num_blocks, page, heads, d]
+state arrays built by `make_gpt_decoder(kv_page_size=...)`); this
+module is the single source of truth for WHICH physical block belongs
+to WHICH sequence.  All layers allocate in lockstep (every layer's
+cache has the same sequence structure), so one free list and one block
+table per scheduler slot cover the whole model.
+
+Accounting protocol (no mid-flight OOM by construction):
+
+* **Admission reserves, extension allocates.**  `try_admit` checks the
+  sequence's WORST-CASE block need (ceil((plen + max_new) / page))
+  against unreserved capacity and either books it or refuses — a full
+  pool queues requests, it never crashes mid-decode.  Physical blocks
+  are then popped lazily by `extend` as the sequence actually grows
+  (allocate-on-extend), so a short reply never pins its worst case and
+  `used_blocks` tracks real occupancy.
+* **Retire frees.**  `retire` returns every block (and the unused
+  reservation) to the pool the moment a sequence finishes — early eos
+  makes room for the next admission immediately.
+* **Block 0 is scratch.**  Idle scheduler slots point their table at
+  block 0; their per-step garbage writes land there and are never
+  attendable (masked by seq_len 0), so scratch never needs zeroing.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+SCRATCH_BLOCK = 0
+
+
+class PoolExhausted(Exception):
+    """Internal invariant breach: extend() needed a block the
+    admission reservation did not cover.  Seeing this means the
+    accounting is wrong — callers must never trigger it."""
+
+
+class KVPool:
+    """Host-side block accounting for the paged decode twin.
+
+    num_blocks counts the PHYSICAL pool including the scratch block;
+    usable capacity is num_blocks - 1.  max_blocks_per_seq is the
+    table width (decode_max_seq // page for the bit-identical gather).
+    """
+
+    def __init__(self, num_blocks: int, page_size: int,
+                 max_blocks_per_seq: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks {num_blocks} < 2 (scratch + at least one "
+                "usable block)")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_blocks_per_seq < 1:
+            raise ValueError(
+                f"max_blocks_per_seq must be >= 1, got "
+                f"{max_blocks_per_seq}")
+        self.num_blocks = int(num_blocks)
+        self.page_size = int(page_size)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        # LIFO free list: recently-freed blocks are re-used first (their
+        # pool rows are the likeliest to still be in cache)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._tables: Dict[int, List[int]] = {}   # seq id -> block ids
+        self._reserved: Dict[int, int] = {}       # seq id -> max blocks
+        self.peak_used = 0
+        # the scheduler worker mutates the pool while /v2/stats reads
+        # it from HTTP threads — iteration over _tables must not race
+        # a retire()'s pop
+        self._lock = threading.Lock()
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def used_blocks(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    @property
+    def reserved_blocks(self) -> int:
+        with self._lock:  # /v2/stats reads while the worker admits
+            return sum(self._reserved.values())
+
+    def blocks_for(self, tokens: int) -> int:
+        """ceil(tokens / page): blocks a sequence of that length needs."""
+        return max(1, -(-int(tokens) // self.page_size))
+
+    # -- lifecycle --------------------------------------------------------
+    def try_admit(self, seq_id: int, max_tokens: int) -> bool:
+        """Reserve worst-case capacity for a new sequence.  False means
+        the pool cannot guarantee the sequence will finish — the caller
+        keeps it queued and retries after the next retirement."""
+        if seq_id in self._reserved:
+            raise ValueError(f"sequence {seq_id} already admitted")
+        need = self.blocks_for(max_tokens)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"sequence {seq_id} needs {need} blocks > table width "
+                f"{self.max_blocks_per_seq} (prompt + max_new_tokens "
+                f"exceed decode_max_seq)")
+        with self._lock:  # raw sum: the lock is not reentrant
+            if sum(self._reserved.values()) + need > self.usable_blocks:
+                return False
+            self._reserved[seq_id] = need
+            self._tables[seq_id] = []
+        return True
+
+    def extend(self, seq_id: int, tokens: int) -> List[int]:
+        """Grow seq_id's table to cover `tokens` total tokens; returns
+        the block ids allocated by THIS call (allocate-on-extend)."""
+        with self._lock:
+            table = self._tables[seq_id]
+            need = self.blocks_for(tokens)
+            if need > self._reserved[seq_id]:
+                raise PoolExhausted(
+                    f"sequence {seq_id} grew to {need} blocks past its "
+                    f"reservation of {self._reserved[seq_id]}")
+            grown = []
+            while len(table) < need:
+                blk = self._free.pop()  # reservation guarantees non-empty
+                table.append(blk)
+                grown.append(blk)
+            if self.used_blocks > self.peak_used:
+                self.peak_used = self.used_blocks
+            return grown
+
+    def retire(self, seq_id: int) -> None:
+        """Free every block and drop the reservation (free-on-retire)."""
+        with self._lock:
+            self._free.extend(self._tables.pop(seq_id))
+            del self._reserved[seq_id]
+
+    def live_sequences(self) -> List[int]:
+        with self._lock:
+            return list(self._tables)
+
+    def table_of(self, seq_id: int) -> List[int]:
+        with self._lock:
+            return list(self._tables[seq_id])
+
+    def table_row(self, seq_id: Optional[int]) -> np.ndarray:
+        """[max_blocks_per_seq] int32 row for the device block table;
+        unallocated (and idle-slot) entries point at scratch."""
+        row = np.full(self.max_blocks_per_seq, SCRATCH_BLOCK, np.int32)
+        if seq_id is not None:
+            with self._lock:
+                table = list(self._tables[seq_id])
+            row[:len(table)] = table
+        return row
+
+    # -- telemetry --------------------------------------------------------
+    def occupancy(self) -> float:
+        """Fraction of usable blocks currently allocated."""
+        return self.used_blocks / self.usable_blocks
+
+    def fragmentation(self, seq_tokens: Dict[int, int]) -> float:
+        """Internal fragmentation: fraction of allocated slots not
+        holding a live token (waste in each sequence's last block).
+        seq_tokens maps live seq id -> its current token count."""
+        with self._lock:
+            alloc = self.used_blocks * self.page_size
+            if not alloc:
+                return 0.0
+            live = sum(min(seq_tokens.get(s, 0),
+                           len(self._tables[s]) * self.page_size)
+                       for s in self._tables)
+        return 1.0 - live / alloc
+
+    def check_invariants(self) -> None:
+        """Every block is exactly one of: scratch, free, or in exactly
+        one live table — and allocated == sum of live tables.  Raises
+        AssertionError on leaks or double-frees (tested property)."""
+        with self._lock:
+            owned: List[int] = []
+            for table in self._tables.values():
+                owned.extend(table)
+            assert len(owned) == len(set(owned)), "block in two tables"
+            assert SCRATCH_BLOCK not in owned, "scratch block allocated"
+            free = set(self._free)
+            assert len(free) == len(self._free), "double-freed block"
+            assert not (free & set(owned)), \
+                "block both free and allocated"
+            assert free | set(owned) | {SCRATCH_BLOCK} == \
+                set(range(self.num_blocks)), "block leaked"
+            assert self.used_blocks == len(owned)
+            for sid, table in self._tables.items():
+                assert len(table) <= self._reserved[sid], \
+                    "over-reservation"

@@ -1,0 +1,133 @@
+"""Poisson open-loop load generator for the generation serving tier.
+
+Open-loop means arrivals are scheduled by the CLOCK, not by
+completions: requests are submitted at exponential inter-arrival gaps
+(rate_rps) regardless of how far behind the server is, so queueing
+delay shows up in the measured latencies instead of being hidden by a
+closed loop's self-throttling — the methodology every serving paper
+(Orca, vLLM) benches with.  Drives anything with the batcher contract
+(`generate_async(prompt, max_new_tokens, temperature)` returning a
+handle with `.wait(timeout)`), i.e. both GenerationBatcher (static)
+and ContinuousScheduler (continuous), so bench.py compares the two on
+identical arrival sequences (same seed -> same prompts, same gaps).
+
+Reported SLOs:
+  * TTFT: submit -> first generated token.  Continuous handles stamp
+    `t_first_token` when the token is sampled; static handles deliver
+    everything at completion, so TTFT degrades to completion time —
+    which is exactly the static tier's real time-to-first-token.
+  * per-token latency: generation time per token after the first.
+  * sustained tokens/s: generated tokens / makespan.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from .batcher import percentile_summary
+
+
+def _summary(vals) -> Dict[str, float]:
+    return percentile_summary(vals, ps=(0.50, 0.99))
+
+
+def sample_workload(rng: np.random.RandomState, n_requests: int,
+                    vocab_size: int, prompt_len_range=(2, 12),
+                    max_new_range=(2, 24), long_frac: float = 0.0,
+                    long_max_new_range=(40, 56)):
+    """A mixed-length workload: (prompt, max_new_tokens) pairs with
+    uniform lengths — the heterogeneity that strands static batches.
+
+    long_frac > 0 makes the reply lengths HEAVY-TAILED (the canonical
+    serving distribution: most replies short, a tail of long ones):
+    that fraction of requests draws max_new from long_max_new_range
+    instead.  One long request in a static batch pads every short
+    neighbor to its bucket; the continuous tier retires the short ones
+    at their own length."""
+    reqs = []
+    for _ in range(n_requests):
+        plen = int(rng.randint(prompt_len_range[0],
+                               prompt_len_range[1] + 1))
+        lo, hi = (long_max_new_range
+                  if long_frac > 0.0 and rng.random_sample() < long_frac
+                  else max_new_range)
+        mnt = int(rng.randint(lo, hi + 1))
+        prompt = rng.randint(0, vocab_size, plen).tolist()
+        reqs.append((prompt, mnt))
+    return reqs
+
+
+def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
+                temperature: float = 0.0, timeout_s: float = 120.0,
+                on_submit: Optional[Callable] = None) -> Dict:
+    """Fire `requests` [(prompt, max_new_tokens), ...] at Poisson
+    arrivals of `rate_rps`, wait for completion, report SLOs.
+
+    Failed/timed-out requests are counted, excluded from latency
+    summaries, and never crash the run (the server keeps them going;
+    the loadgen just stops waiting)."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=len(requests))
+    t0 = time.monotonic()
+    next_at = t0
+    handles = []
+    for (prompt, mnt), gap in zip(requests, gaps):
+        next_at += gap
+        delay = next_at - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        h = batcher.generate_async(prompt, mnt, temperature)
+        handles.append((h, len(prompt), mnt))
+        if on_submit is not None:
+            on_submit(h)
+    results = []
+    failures = 0
+    # ONE deadline across all waits (the server.py /v2/generate
+    # convention): a wedged engine costs ~timeout_s total, not
+    # timeout_s per outstanding handle
+    wait_deadline = time.monotonic() + timeout_s
+    for h, plen, mnt in handles:
+        try:
+            toks = h.wait(max(0.0, wait_deadline - time.monotonic()))
+        except Exception:
+            failures += 1
+            continue
+        # every handle flavor stamps t_submit at generate_async time —
+        # the loadgen's submit clock.  t_done/t_first_token exist only
+        # on continuous handles; static handles deliver everything at
+        # completion, so both degrade to the wait-return time.
+        t_submit = h.t_submit
+        t_done = getattr(h, "t_done", None) or time.monotonic()
+        n_gen = getattr(h, "n_generated", 0) or max(
+            0, len(toks) - plen)
+        t_first = getattr(h, "t_first_token", None) or t_done
+        results.append({
+            "submit": t_submit,
+            "ttft_s": t_first - t_submit,
+            "done": t_done,
+            "n_generated": n_gen,
+            "gen_s": t_done - t_first,
+        })
+    report = {
+        "offered_rps": rate_rps,
+        "requests": len(requests),
+        "completed": len(results),
+        "failures": failures,
+    }
+    if results:
+        makespan = max(r["done"] for r in results) - t0
+        total_tokens = sum(r["n_generated"] for r in results)
+        per_token = [
+            r["gen_s"] / (r["n_generated"] - 1)
+            for r in results if r["n_generated"] > 1 and r["gen_s"] > 0
+        ]
+        report.update({
+            "makespan_s": round(makespan, 3),
+            "tokens_generated": total_tokens,
+            "tokens_per_s": round(total_tokens / max(makespan, 1e-9), 2),
+            "ttft": _summary([r["ttft_s"] for r in results]),
+            "per_token": _summary(per_token),
+        })
+    return report

@@ -1,0 +1,524 @@
+"""Continuous (iteration-level) batching: the Orca OSDI'22 scheduling
+discipline on top of the paged KV-cache pool (serving/kv_pool.py).
+
+`GenerationBatcher` (the static path) coalesces requests into ONE scan
+program: every row rides to the batch's max length, a 5-token reply
+pays for a 200-token neighbor, and a request arriving one step after
+dispatch waits out the whole scan.  The continuous scheduler instead
+keeps a persistent decode loop stepping every in-flight sequence by
+one token per iteration; at EVERY step boundary it retires finished
+sequences (eos / max_new_tokens) and admits queued prompts into the
+freed slots — prefill is interleaved with decode (an admitted prompt
+feeds one token per step at its own position), so the device never
+waits for stragglers and short replies exit the moment they finish.
+
+Allocation rides the paged pool: sequences reserve worst-case blocks
+at admission (a full pool QUEUES the request — never a crash), extend
+block-by-block as they grow, and free on retirement, so resident KV
+HBM is sum-of-live-lengths instead of slots x max_seq.
+
+Shape discipline (the TPU-native part): one compiled [slots, 1] step
+program serves the engine's whole lifetime — admissions, retirements
+and per-row positions are DATA (block tables + seq_lens), never
+shapes, so steady state has zero recompiles.  Sampling is host-side
+per row, which also lifts the static batcher's same-temperature
+coalescing restriction: a continuous batch freely mixes temperatures.
+
+SLO telemetry (obs.metrics): TTFT and per-token latency histograms,
+queue depth, KV-pool occupancy/fragmentation — drained to
+run_telemetry.jsonl and surfaced in /v2/stats (docs/SERVING.md).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .kv_pool import KVPool
+
+
+class PagedKVDecodeModel:
+    """Device half of the continuous engine: the paged decode twin of
+    a trained GPT plus its single compiled step function.
+
+    step(tokens[b], seq_lens[b], block_tables[b, max_blocks]) runs one
+    decode step for every slot at its OWN position and returns host
+    logits [b, vocab].  The block tables and seq_lens are host-owned
+    scheduler data written into the op-state pytree each step."""
+
+    def __init__(self, ff_train, batch_slots: int = 8,
+                 page_size: int = 16, num_blocks: Optional[int] = None,
+                 devices=None):
+        from ..decoding import (_gpt_dims, build_paged_decode_step,
+                                make_gpt_decoder)
+
+        dims = _gpt_dims(ff_train)
+        max_seq = dims["max_seq"]
+        if page_size < 1 or max_seq % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide the model's "
+                f"max positions {max_seq}")
+        max_blocks = max_seq // page_size
+        if num_blocks is None:
+            # default: half of the dense footprint (+ scratch) — the
+            # HBM the pool actually saves; callers needing guaranteed
+            # all-slots-at-max-length admission pass the full
+            # 1 + batch_slots * max_blocks
+            num_blocks = 1 + max(max_blocks,
+                                 (batch_slots * max_blocks + 1) // 2)
+        self.ffd = make_gpt_decoder(
+            ff_train, batch_size=batch_slots, devices=devices,
+            kv_page_size=page_size, kv_num_blocks=num_blocks,
+        )
+        self.batch_slots = batch_slots
+        self.page_size = page_size
+        self.num_blocks = num_blocks
+        self.max_blocks_per_seq = max_blocks
+        self.max_seq = max_seq
+        self.vocab = dims["vocab_size"]
+        self._step_fn = build_paged_decode_step(self.ffd)
+        # the step fn DONATES its state argument; keep the twin's own
+        # pristine pytree intact and thread a private copy (reset()
+        # rebuilds from the pristine shapes after a failed step)
+        import jax
+        import jax.numpy as jnp
+
+        self._state = jax.tree.map(jnp.copy, self.ffd._state)
+
+    def reset(self):
+        """Fresh zero decode state (fault recovery: a step that died
+        mid-execution may have invalidated the donated buffers)."""
+        import jax
+        import jax.numpy as jnp
+
+        self._state = jax.tree.map(
+            lambda x: jnp.zeros(x.shape, x.dtype), self.ffd._state)
+
+    def step(self, tokens: np.ndarray, seq_lens: np.ndarray,
+             block_tables: np.ndarray) -> np.ndarray:
+        # per-token hot path: the block table / seq_lens override
+        # happens INSIDE the jitted step and the state pytree is
+        # donated — no host-side dict rebuild, no per-layer pool copy
+        logits, self._state = self._step_fn(
+            self.ffd._weights, self._state, tokens, seq_lens,
+            block_tables,
+        )
+        return np.asarray(logits, np.float32)
+
+
+class _PendingSeq:
+    """Future-style handle for one continuous-mode request.  Besides
+    the final token list it records the SLO timestamps the loadgen and
+    telemetry consume: submit, first generated token (TTFT), done."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
+                 "event", "result", "error", "t_submit", "t_first_token",
+                 "t_done", "n_generated")
+
+    def __init__(self, prompt, max_new_tokens, temperature, seed):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.event = threading.Event()
+        self.result: Optional[List[int]] = None
+        self.error: Optional[Exception] = None
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.n_generated = 0
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.event.wait(timeout):
+            raise TimeoutError("generation request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _Live:
+    """Slot-resident decoding state for one admitted sequence."""
+
+    __slots__ = ("req", "seq_id", "pos", "next_token", "generated",
+                 "max_new", "rng")
+
+    def __init__(self, req: _PendingSeq, seq_id: int, max_new: int):
+        self.req = req
+        self.seq_id = seq_id
+        self.pos = 0                      # tokens already in the cache
+        self.next_token = req.prompt[0]   # token fed at position `pos`
+        self.generated: List[int] = []
+        self.max_new = max_new            # clamped to the position table
+        self.rng = (np.random.RandomState(req.seed)
+                    if req.temperature > 0.0 else None)
+
+
+class ContinuousScheduler:
+    """Persistent decode loop with iteration-level admission/retirement.
+
+    API-compatible with GenerationBatcher (generate / generate_async /
+    latency_stats / close / batches_run / requests_done), so serve_http
+    and the loadgen drive either engine unchanged.  `batches_run`
+    counts decode steps here — the unit of batching is the step."""
+
+    def __init__(self, model, pool: Optional[KVPool] = None,
+                 eos_id: int = -1, registry=None, seed: int = 0,
+                 latency_window: int = 1024,
+                 close_timeout_s: float = 60.0):
+        self.model = model
+        self.pool = pool or KVPool(
+            model.num_blocks, model.page_size, model.max_blocks_per_seq)
+        self.eos_id = int(eos_id)
+        self.registry = registry
+        self._queue: "queue.Queue[_PendingSeq]" = queue.Queue()
+        self._waiting: deque = deque()  # worker-local FIFO admit order
+        self._stop = threading.Event()
+        self._latencies = deque(maxlen=latency_window)
+        self._ttfts = deque(maxlen=latency_window)
+        self._lat_lock = threading.Lock()
+        self._slots: List[Optional[_Live]] = [None] * model.batch_slots
+        # persistent step buffers, updated INCREMENTALLY: block-table
+        # rows change only on admit/retire and when a row crosses a
+        # page boundary (every page-th token), not per step — the
+        # decode loop's python cost stays O(live rows), not
+        # O(rows x table width)
+        self._tokens = np.zeros(model.batch_slots, np.int32)
+        self._slens = np.zeros(model.batch_slots, np.int32)
+        self._btab = np.zeros(
+            (model.batch_slots, self.pool.max_blocks_per_seq), np.int32)
+        self._next_seq_id = 0
+        self._seed = itertools.count(int(seed) + 1)
+        self._close_timeout_s = float(close_timeout_s)
+        self.batches_run = 0       # decode steps executed
+        self.requests_done = 0
+        self.tokens_generated = 0
+        self.step_failures = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    @classmethod
+    def from_trained(cls, ff_train, batch_slots: int = 8,
+                     page_size: int = 16,
+                     num_blocks: Optional[int] = None, devices=None,
+                     eos_id: int = -1, registry=None,
+                     seed: int = 0) -> "ContinuousScheduler":
+        model = PagedKVDecodeModel(ff_train, batch_slots=batch_slots,
+                                   page_size=page_size,
+                                   num_blocks=num_blocks,
+                                   devices=devices)
+        return cls(model, eos_id=eos_id, registry=registry, seed=seed)
+
+    # -- client API -----------------------------------------------------
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 temperature: float = 0.0,
+                 timeout: Optional[float] = 60.0) -> List[int]:
+        return self.generate_async(
+            prompt, max_new_tokens, temperature).wait(timeout)
+
+    def generate_async(self, prompt, max_new_tokens: int = 16,
+                       temperature: float = 0.0) -> _PendingSeq:
+        if self._stop.is_set():
+            raise RuntimeError("ContinuousScheduler is closed")
+        # validate HERE so a bad request fails alone (the batcher
+        # convention); continuous mode has no same-temperature
+        # restriction — sampling is host-side per row
+        p = _PendingSeq(prompt, max_new_tokens, temperature,
+                        next(self._seed))
+        if not 1 <= len(p.prompt) < self.model.max_seq:
+            raise ValueError(
+                f"prompt length {len(p.prompt)} outside [1, "
+                f"{self.model.max_seq})")
+        if p.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self._queue.put(p)
+        if self._stop.is_set():  # close() raced the put
+            p.error = RuntimeError("ContinuousScheduler is closed")
+            p.event.set()
+        return p
+
+    @property
+    def worker_alive(self) -> bool:
+        return self._worker.is_alive()
+
+    def latency_stats(self) -> Dict[str, float]:
+        from .batcher import latency_percentiles
+
+        return latency_percentiles(self._latencies, self._lat_lock)
+
+    def ttft_stats(self) -> Dict[str, float]:
+        from .batcher import latency_percentiles
+
+        return latency_percentiles(self._ttfts, self._lat_lock)
+
+    def stats(self) -> Dict:
+        live = [s for s in self._slots if s is not None]
+        seq_tokens = {s.seq_id: s.pos for s in live}
+        return {
+            "mode": "continuous",
+            "steps": self.batches_run,
+            "requests_done": self.requests_done,
+            "tokens_generated": self.tokens_generated,
+            "step_failures": self.step_failures,
+            "queue_depth": self._queue.qsize() + len(self._waiting),
+            "live_sequences": len(live),
+            "kv_pool": {
+                "page_size": self.pool.page_size,
+                "usable_blocks": self.pool.usable_blocks,
+                "used_blocks": self.pool.used_blocks,
+                "reserved_blocks": self.pool.reserved_blocks,
+                "peak_used_blocks": self.pool.peak_used,
+                "occupancy": round(self.pool.occupancy(), 4),
+                "fragmentation": round(
+                    self.pool.fragmentation(seq_tokens), 4),
+            },
+            "ttft": self.ttft_stats(),
+            "latency": self.latency_stats(),
+        }
+
+    def close(self):
+        """Stop the loop and drain: in-flight sequences fail with a
+        closed error (their blocks are freed), queued requests fail
+        without hanging out their timeout.  The worker owns _slots and
+        _waiting, so the full drain runs EITHER on the worker's way out
+        of _loop OR here once the worker is confirmed dead — never
+        concurrently; the thread-safe arrival queue is always drained."""
+        self._stop.set()
+        deadline = time.monotonic() + self._close_timeout_s
+        while time.monotonic() < deadline and self._worker.is_alive():
+            self._worker.join(timeout=0.2)
+        err = RuntimeError("ContinuousScheduler closed")
+        # Drain even if the worker outlived the deadline (a device step
+        # wedged mid-dispatch): waiters must not sit out their full
+        # wait() timeouts against a hung engine.  _drain is defensive
+        # about double-retires, and a worker that later un-wedges finds
+        # _stop set, treats its emptied slots as idle, and exits
+        # through its own (now no-op) drain.
+        self._drain(err)
+        while True:  # late enqueues that raced the stop flag
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            p.error = err
+            p.event.set()
+
+    # -- worker ---------------------------------------------------------
+    def _free_slot_buffers(self, slot: int):
+        """Point a vacated slot's step buffers back at scratch."""
+        self._btab[slot] = 0
+        self._tokens[slot] = 0
+        self._slens[slot] = 0
+
+    def _drain(self, err: Exception):
+        """Fail every queued/waiting/live request (close or fault).
+        Runs on the worker's way out of _loop AND from close() — which
+        overlap only when close() gave up on a wedged worker, so
+        retires tolerate the other drain having won the race."""
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                try:
+                    self.pool.retire(s.seq_id)
+                except KeyError:
+                    pass  # the racing drain already freed it
+                s.req.error = err
+                s.req.event.set()
+                self._free_slot_buffers(i)
+        self._slots = [None] * self.model.batch_slots
+        while self._waiting:
+            p = self._waiting.popleft()
+            p.error = err
+            p.event.set()
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            p.error = err
+            p.event.set()
+
+    def _admit(self):
+        """Pull arrivals, then admit FIFO into free slots while the
+        pool can GUARANTEE completion.  Strict FIFO: a head-of-line
+        request that doesn't fit blocks later (smaller) ones — no
+        starvation, predictable SLO."""
+        while True:
+            try:
+                self._waiting.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        while free and self._waiting:
+            req = self._waiting[0]
+            max_new = min(req.max_new_tokens,
+                          self.model.max_seq - len(req.prompt))
+            sid = self._next_seq_id
+            try:
+                admitted = self.pool.try_admit(
+                    sid, len(req.prompt) + max_new)
+            except ValueError as e:
+                # can never fit any pool state (table width): fail it
+                # alone instead of wedging the FIFO head forever
+                self._waiting.popleft()
+                req.error = e
+                req.event.set()
+                continue
+            if not admitted:
+                if self.pool.reserved_blocks == 0:
+                    # empty pool and still no room: this pool can never
+                    # serve the request — fail instead of starving
+                    self._waiting.popleft()
+                    req.error = ValueError(
+                        f"request needs {self.pool.blocks_for(len(req.prompt) + max_new)} "
+                        f"KV blocks but the pool only has "
+                        f"{self.pool.usable_blocks}")
+                    req.event.set()
+                    continue
+                if self.registry is not None:
+                    self.registry.counter(
+                        "serving/admissions_deferred").inc()
+                break
+            self._waiting.popleft()
+            self._next_seq_id += 1
+            live = _Live(req, sid, max_new)
+            slot = free.pop(0)
+            self._slots[slot] = live
+            self.pool.extend(sid, 1)  # first block, allocate-on-admit
+            self._btab[slot] = self.pool.table_row(sid)
+            self._tokens[slot] = live.next_token
+            self._slens[slot] = 0
+
+    def _loop(self):
+        """Thread body: run the decode loop, then drain no matter how
+        it exited — a crash fails pending requests immediately instead
+        of parking them for their full wait timeout (and leaves
+        worker_alive False for the /v2/health degraded check)."""
+        err: Exception = RuntimeError("ContinuousScheduler closed")
+        try:
+            self._decode_loop()
+        except Exception as e:  # scheduler bug / pool invariant breach
+            err = e
+        self._drain(err)
+
+    def _decode_loop(self):
+        page = self.pool.page_size
+        while not self._stop.is_set():
+            self._admit()
+            if all(s is None for s in self._slots):
+                # idle: park on the arrival queue instead of spinning
+                try:
+                    self._waiting.append(self._queue.get(timeout=0.05))
+                except queue.Empty:
+                    pass
+                continue
+            for i, live in enumerate(self._slots):
+                if live is None:
+                    continue
+                # crossing a page boundary: allocate the next block
+                # (admission reserved it, so this cannot fail)
+                if live.pos and live.pos % page == 0:
+                    self.pool.extend(live.seq_id, live.pos + 1)
+                    self._btab[i] = self.pool.table_row(live.seq_id)
+            try:
+                logits = self.model.step(
+                    self._tokens, self._slens, self._btab)
+            except Exception as e:  # fail in-flight only; queued survive
+                self.step_failures += 1
+                if self.registry is not None:
+                    self.registry.counter("serving/step_failures").inc()
+                for i, live in enumerate(self._slots):
+                    if live is None:
+                        continue
+                    self.pool.retire(live.seq_id)
+                    live.req.error = e
+                    live.req.event.set()
+                    self._slots[i] = None
+                    self._free_slot_buffers(i)
+                # a step that died mid-execution may have consumed the
+                # donated state buffers — rebuild before the next admit
+                reset = getattr(self.model, "reset", None)
+                if reset is not None:
+                    reset()
+                continue
+            self.batches_run += 1
+            now = time.monotonic()
+            for i, live in enumerate(self._slots):
+                if live is None:
+                    continue
+                live.pos += 1
+                plen = len(live.req.prompt)
+                if live.pos < plen:
+                    # prefill: the next token is given, logits ignored
+                    live.next_token = live.req.prompt[live.pos]
+                    self._tokens[i] = live.next_token
+                    self._slens[i] = live.pos
+                    continue
+                tok = int(self._sample(logits[i], live))
+                if not live.generated:
+                    live.req.t_first_token = now
+                    with self._lat_lock:
+                        self._ttfts.append(now - live.req.t_submit)
+                    if self.registry is not None:
+                        self.registry.histogram(
+                            "serving/ttft_ms").observe(
+                            (now - live.req.t_submit) * 1e3)
+                live.generated.append(tok)
+                self.tokens_generated += 1
+                done = (len(live.generated) >= live.max_new
+                        or (self.eos_id >= 0 and tok == self.eos_id))
+                if done:
+                    self._finish(i, live)
+                else:
+                    live.next_token = tok
+                    self._tokens[i] = tok
+                    self._slens[i] = live.pos
+            self._observe_step()
+
+    def _sample(self, row_logits: np.ndarray, live: _Live) -> int:
+        if live.req.temperature <= 0.0:  # greedy hot path: one argmax
+            return int(row_logits.argmax())
+        from ..models.transformer import sample_next
+
+        return sample_next(row_logits[None], live.req.temperature,
+                           live.rng)[0]
+
+    def _finish(self, slot: int, live: _Live):
+        self.pool.retire(live.seq_id)
+        self._slots[slot] = None
+        self._free_slot_buffers(slot)
+        req = live.req
+        req.n_generated = len(live.generated)
+        req.result = req.prompt + live.generated
+        req.t_done = time.monotonic()
+        with self._lat_lock:
+            self._latencies.append(req.t_done - req.t_submit)
+        self.requests_done += 1
+        if self.registry is not None:
+            reg = self.registry
+            reg.counter("serving/requests_done").inc()
+            reg.histogram("serving/request_latency_ms").observe(
+                (req.t_done - req.t_submit) * 1e3)
+            if req.n_generated > 1 and req.t_first_token is not None:
+                reg.histogram("serving/per_token_ms").observe(
+                    (req.t_done - req.t_first_token) * 1e3
+                    / (req.n_generated - 1))
+        req.event.set()
+
+    def _observe_step(self):
+        if self.registry is None:
+            return
+        reg = self.registry
+        live = [s for s in self._slots if s is not None]
+        reg.counter("serving/steps").inc()
+        reg.gauge("serving/queue_depth").set(
+            self._queue.qsize() + len(self._waiting))
+        reg.gauge("serving/live_sequences").set(len(live))
+        reg.gauge("serving/kv_used_blocks").set(self.pool.used_blocks)
+        reg.histogram("serving/kv_occupancy").observe(
+            self.pool.occupancy())
+        reg.histogram("serving/kv_fragmentation").observe(
+            self.pool.fragmentation({s.seq_id: s.pos for s in live}))

@@ -3,16 +3,28 @@ backend's HTTP surface analogue).
 
 POST /v2/infer     {"inputs": {name: nested-list, ...}} -> {"outputs": [...]}
 POST /v2/generate  {"prompt": [ids...]} or {"prompts": [[ids...], ...]},
-                   optional "max_new_tokens" (int), "temperature" (float)
+                   optional "max_new_tokens" (int), "temperature"
+                   (float), "timeout_s" (float, default 120; an
+                   expired wait returns HTTP 503 — the request still
+                   completes server-side)
                    -> {"tokens": [[ids...], ...]}   (requires a
-                   GenerationBatcher via serve_http(generator=...))
-GET  /v2/health    -> {"status": "ok", "requests": N}
+                   GenerationBatcher or ContinuousScheduler via
+                   serve_http(generator=...))
+GET  /v2/health    -> {"status": "ok"|"degraded", "requests": N}
+                   ("degraded" when a batcher's worker thread has
+                   died: the endpoint would accept requests that can
+                   never complete.  Degraded rides HTTP 503 so
+                   status-code-only probes drop the backend too)
 GET  /v2/stats     -> batch/request counters + latency percentiles
+                   (+ a "continuous" block when the generator is a
+                   ContinuousScheduler: queue depth, KV pool
+                   occupancy/fragmentation, TTFT percentiles)
 """
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -45,7 +57,20 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
             if self.path == "/v2/health":
                 served = getattr(src, "batches_run",
                                  getattr(src, "requests_served", 0))
-                self._send(200, {"status": "ok", "requests": served})
+                # a dead worker thread leaves the endpoint accepting
+                # requests that only ever time out — report degraded
+                # so health checks catch it (ISSUE 6 satellite)
+                dead = [
+                    obj for obj in (batcher, generator)
+                    if obj is not None
+                    and getattr(obj, "worker_alive", True) is False
+                ]
+                status = "degraded" if dead else "ok"
+                # degraded rides a 503 so status-code-only probes
+                # (k8s, LBs) drop the backend too, not just readers
+                # of the JSON body
+                self._send(200 if not dead else 503,
+                           {"status": status, "requests": served})
             elif self.path == "/v2/stats":
                 stats = {
                     "batches_run": getattr(src, "batches_run", 0),
@@ -59,6 +84,8 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
                         "requests_done": generator.requests_done,
                         "latency": generator.latency_stats(),
                     }
+                if generator is not None and hasattr(generator, "stats"):
+                    stats["continuous"] = generator.stats()
                 self._send(200, stats)
             else:
                 self._send(404, {"error": "not found"})
@@ -84,16 +111,42 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
                         prompts = [req["prompt"]]
                     mnt = int(req.get("max_new_tokens", 16))
                     temp = float(req.get("temperature", 0.0))
+                    timeout = float(req.get("timeout_s", 120.0))
+                    if timeout <= 0:
+                        raise ValueError(
+                            f"timeout_s must be > 0, got {timeout}")
                     handles = [
                         generator.generate_async(p, mnt, temp)
                         for p in prompts
                     ]  # rows of one POST coalesce into one scan
-                    toks = [h.wait(120.0) for h in handles]
+                    # ONE deadline for the whole request: sequential
+                    # waits must not each restart the clock, or a
+                    # multi-prompt POST could block prompts x timeout
+                    deadline = time.monotonic() + timeout
+                    toks = [
+                        h.wait(max(0.0, deadline - time.monotonic()))
+                        for h in handles
+                    ]
                     self._send(200, {"tokens": toks})
                 else:
                     self._send(404, {"error": "not found"})
-            except Exception as e:  # surface as a JSON error
+            except TimeoutError as e:
+                # the wait expired but the request is still decoding
+                # server-side: 503 tells the client to back off/retry,
+                # not that the request was malformed
+                self._send(503, {"error": f"TimeoutError: {e}",
+                                 "retriable": True})
+            except (ValueError, KeyError, TypeError,
+                    json.JSONDecodeError) as e:
+                # malformed request (bad JSON, missing fields, lengths
+                # out of range): the client's fault, not retriable
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
+            except Exception as e:
+                # engine fault (failed decode step, closed batcher):
+                # the server's fault — 500 so clients/load balancers
+                # retry instead of dropping a well-formed request
+                self._send(500, {"error": f"{type(e).__name__}: {e}",
+                                 "retriable": True})
 
     server = ThreadingHTTPServer((host, port), Handler)
     if block:

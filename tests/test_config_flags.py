@@ -237,6 +237,34 @@ def test_durability_cli_flags_parse():
     assert base.preempt_grace is True
 
 
+def test_serving_cli_flags_parse():
+    cfg = FFConfig.from_args([
+        "--serving-mode", "static", "--kv-page-size", "8",
+        "--kv-pool-blocks", "65", "--serving-slots", "16",
+    ])
+    assert cfg.serving_mode == "static"
+    assert cfg.kv_page_size == 8
+    assert cfg.kv_pool_blocks == 65
+    assert cfg.serving_slots == 16
+    # defaults: continuous with auto-sized pool
+    base = FFConfig.from_args([])
+    assert base.serving_mode == "continuous"
+    assert base.kv_page_size == 16
+    assert base.kv_pool_blocks == 0
+    assert base.serving_slots == 8
+
+
+def test_serving_config_validated():
+    with pytest.raises(ValueError):
+        FFConfig(serving_mode="bogus")
+    with pytest.raises(ValueError):
+        FFConfig(kv_page_size=0)
+    with pytest.raises(ValueError):
+        FFConfig(kv_pool_blocks=-1)
+    with pytest.raises(ValueError):
+        FFConfig(serving_slots=0)
+
+
 def test_resilience_config_validated():
     with pytest.raises(ValueError):
         FFConfig(nan_policy="bogus")

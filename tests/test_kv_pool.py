@@ -1,0 +1,117 @@
+"""Paged KV-cache pool accounting (serving/kv_pool.py): block
+conservation under arbitrary admit/extend/retire interleavings, the
+reservation discipline (a full pool queues, never crashes), and the
+occupancy/fragmentation telemetry the scheduler reports."""
+import numpy as np
+import pytest
+
+from flexflow_tpu.serving.kv_pool import (KVPool, PoolExhausted,
+                                          SCRATCH_BLOCK)
+
+
+def test_basic_lifecycle():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    assert pool.usable_blocks == 8 and pool.used_blocks == 0
+    assert pool.try_admit(1, 10)  # 3 blocks reserved
+    assert pool.reserved_blocks == 3
+    assert pool.used_blocks == 0  # allocate-on-extend, not on admit
+    grown = pool.extend(1, 1)
+    assert len(grown) == 1 and pool.used_blocks == 1
+    assert pool.extend(1, 4) == []  # still inside block 1
+    assert len(pool.extend(1, 5)) == 1  # crosses into block 2
+    assert pool.table_of(1) == grown + pool.table_of(1)[1:]
+    pool.retire(1)
+    assert pool.used_blocks == 0 and pool.reserved_blocks == 0
+    pool.check_invariants()
+
+
+def test_full_pool_queues_not_crashes():
+    pool = KVPool(num_blocks=5, page_size=2, max_blocks_per_seq=4)
+    assert pool.try_admit(1, 8)  # 4 of 4 usable
+    assert not pool.try_admit(2, 2)  # full: refused, caller queues
+    pool.retire(1)
+    assert pool.try_admit(2, 2)  # freed capacity admits
+    pool.check_invariants()
+
+
+def test_oversize_request_rejected_loudly():
+    pool = KVPool(num_blocks=17, page_size=2, max_blocks_per_seq=4)
+    with pytest.raises(ValueError, match="table width"):
+        pool.try_admit(1, 10)  # 5 blocks > 4-wide table
+
+
+def test_extension_past_reservation_is_a_bug():
+    pool = KVPool(num_blocks=9, page_size=2, max_blocks_per_seq=4)
+    assert pool.try_admit(1, 4)  # 2 blocks
+    pool.extend(1, 4)
+    with pytest.raises(PoolExhausted):
+        pool.extend(1, 5)
+
+
+def test_double_admit_rejected():
+    pool = KVPool(num_blocks=9, page_size=2, max_blocks_per_seq=4)
+    assert pool.try_admit(1, 2)
+    with pytest.raises(ValueError, match="already admitted"):
+        pool.try_admit(1, 2)
+
+
+def test_table_row_pads_with_scratch():
+    pool = KVPool(num_blocks=9, page_size=2, max_blocks_per_seq=4)
+    assert pool.try_admit(7, 6)
+    pool.extend(7, 3)  # 2 blocks
+    row = pool.table_row(7)
+    assert row.dtype == np.int32 and len(row) == 4
+    assert list(row[:2]) == pool.table_of(7)
+    assert all(b == SCRATCH_BLOCK for b in row[2:])
+    assert all(b == SCRATCH_BLOCK for b in pool.table_row(None))
+
+
+def test_property_random_interleaving():
+    """The acceptance property: after ANY admit/extend/retire sequence,
+    allocated blocks equal the sum of live block tables — no leaks, no
+    double-frees — and exhaustion only ever refuses admission."""
+    rng = np.random.RandomState(0)
+    pool = KVPool(num_blocks=33, page_size=4, max_blocks_per_seq=8)
+    live = {}  # seq id -> (target_tokens, current_tokens)
+    next_id = 0
+    admitted = refused = 0
+    for _ in range(2000):
+        op = rng.randint(3)
+        if op == 0:  # admit
+            target = int(rng.randint(1, 33))
+            if pool.try_admit(next_id, target):
+                live[next_id] = [target, 0]
+                admitted += 1
+            else:
+                refused += 1
+            next_id += 1
+        elif op == 1 and live:  # extend one live sequence a token
+            sid = list(live)[rng.randint(len(live))]
+            target, cur = live[sid]
+            if cur < target:
+                live[sid][1] = cur + 1
+                pool.extend(sid, cur + 1)
+        elif op == 2 and live:  # retire one
+            sid = list(live)[rng.randint(len(live))]
+            del live[sid]
+            pool.retire(sid)
+        pool.check_invariants()
+        assert pool.used_blocks == sum(
+            len(pool.table_of(s)) for s in live)
+        assert 0.0 <= pool.occupancy() <= 1.0
+        frag = pool.fragmentation({s: live[s][1] for s in live})
+        assert 0.0 <= frag <= 1.0
+    assert admitted > 50 and refused > 10  # both paths exercised
+    for sid in list(live):
+        pool.retire(sid)
+    pool.check_invariants()
+    assert pool.used_blocks == 0 and pool.reserved_blocks == 0
+    assert pool.peak_used > 0
+
+
+def test_fragmentation_counts_last_block_waste():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    assert pool.try_admit(1, 5)
+    pool.extend(1, 5)  # 2 blocks = 8 slots for 5 tokens
+    assert pool.fragmentation({1: 5}) == pytest.approx(3 / 8)
+    assert pool.fragmentation({1: 8}) == 0.0  # full blocks: no waste

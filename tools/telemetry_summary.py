@@ -108,6 +108,24 @@ def summarize(records: List[Dict]) -> str:
     out.append(_section("Resilience", rows))
 
     rows = []
+    for name, rec in sorted(metrics.items()):
+        if not name.startswith("serving/"):
+            continue
+        short = name.split("/", 1)[1]
+        if rec.get("kind") == "histogram":
+            # SLO histograms (ttft_ms, per_token_ms, kv occupancy):
+            # render the streaming summary, not a bare value
+            rows.append((
+                short,
+                f"n={rec.get('count', 0)} mean={_fmt(rec.get('mean', 0.0))} "
+                f"min={_fmt(rec.get('min', 0.0))} "
+                f"max={_fmt(rec.get('max', 0.0))}",
+            ))
+        else:
+            rows.append((short, rec.get("value", 0.0)))
+    out.append(_section("Serving", rows))
+
+    rows = []
     for rec in fidelity:
         rows += [
             ("source", rec.get("source", "?")),
