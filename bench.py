@@ -618,6 +618,125 @@ def bench_checkpoint(dev, on_tpu):
     }
 
 
+def bench_cold_start(dev, on_tpu):
+    """Cold-start leg (manifest v11): what the strategy store buys at
+    process start.  Same model, same config, twice against one store
+    root: the first `FFModel.compile` pays the Unity search and
+    publishes; the second restores the strategy (search_stats records
+    store_hit) — the leg reports both wall times and the speedup.
+    When the host exposes >= 8 devices it also measures the resilience
+    supervisor's elastic 8->4 device-loss recovery cold (re-search on
+    the 4-survivor mesh) vs warm (the degraded-mesh key is already
+    published), the store's second job after replica spin-up."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.fftype import ActiMode
+    from flexflow_tpu.optimizer import SGDOptimizer
+
+    leg = MANIFEST["legs"]["cold_start"]
+    hidden, layers = leg["hidden"], leg["layers"]
+    classes, batch = leg["classes"], leg["batch"]
+    budget = leg["search_budget"]
+
+    devs = jax.devices()
+    n = min(len(devs), leg["devices_cap"])
+
+    def build(store_root, ndev, **cfg_kw):
+        cfg = FFConfig(batch_size=batch, num_devices=ndev,
+                       search_budget=budget, strategy_store=store_root,
+                       enable_parameter_parallel=True, **cfg_kw)
+        ff = FFModel(cfg)
+        t = ff.create_tensor([batch, leg["input_dim"]], name="x")
+        for _ in range(layers):
+            t = ff.dense(t, hidden, activation=ActiMode.RELU)
+        t = ff.dense(t, classes)
+        ff.softmax(t)
+        return ff
+
+    def timed_compile(store_root):
+        ff = build(store_root, n)
+        t0 = time.perf_counter()
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   devices=devs[:n])
+        return time.perf_counter() - t0, ff
+
+    tmpdir = tempfile.mkdtemp(prefix="cold_start_bench_")
+    try:
+        cold_s, ff_cold = timed_compile(tmpdir)
+        warm_s, ff_warm = timed_compile(tmpdir)
+        assert not ff_cold.strategy.search_stats.get("store_hit")
+        assert ff_warm.strategy.search_stats.get("store_hit")
+        result = {
+            "workload": f"compile-with-search vs compile-with-warm-store "
+                        f"({layers}L h{hidden} MLP, unity budget {budget}, "
+                        f"{n} devices)",
+            "compile_s_cold": round(cold_s, 3),
+            "compile_s_warm": round(warm_s, 3),
+            "warm_store_hit": True,
+            "cold_vs_warm_speedup": round(cold_s / max(warm_s, 1e-9), 2),
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    # -- elastic 8->4 recovery, warm vs cold store ----------------------
+    result["elastic"] = None
+    if len(devs) >= 8:
+        from flexflow_tpu.resilience import FaultPlan
+        from flexflow_tpu.resilience.faults import FaultKind
+
+        steps, fault_step = leg["elastic_steps"], leg["elastic_fault_step"]
+        rng = np.random.RandomState(0)
+        xs = rng.randn(batch * 4, leg["input_dim"]).astype(np.float32)
+        ys = rng.randint(0, classes, size=batch * 4).astype(np.int32)
+
+        def run_once(store_root, ckpt_dir):
+            ff = build(store_root, 8, checkpoint_every=1, max_restarts=3,
+                       retry_backoff=0.0)
+            ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                       loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                       devices=devs[:8])
+            plan = FaultPlan.single(fault_step, FaultKind.DEVICE_LOSS,
+                                    survivors=4)
+            t0 = time.perf_counter()
+            report = ff.fit_resilient(
+                {"x": xs}, ys, num_steps=steps, batch_size=batch,
+                directory=ckpt_dir, fault_plan=plan,
+            )
+            dt = time.perf_counter() - t0
+            assert report.final_step == steps
+            return dt, report.counters
+
+        store2 = tempfile.mkdtemp(prefix="cold_start_elastic_")
+        try:
+            ck1 = tempfile.mkdtemp(prefix="cold_start_ck1_")
+            ck2 = tempfile.mkdtemp(prefix="cold_start_ck2_")
+            try:
+                cold_run_s, cold_counters = run_once(store2, ck1)
+                warm_run_s, warm_counters = run_once(store2, ck2)
+                assert cold_counters["re_search_store_hits"] == 0
+            finally:
+                shutil.rmtree(ck1, ignore_errors=True)
+                shutil.rmtree(ck2, ignore_errors=True)
+            result["elastic"] = {
+                "recovery_run_s_cold": round(cold_run_s, 3),
+                "recovery_run_s_warm": round(warm_run_s, 3),
+                "warm_re_search_store_hits": int(
+                    warm_counters["re_search_store_hits"]
+                ),
+                "cold_vs_warm_speedup": round(
+                    cold_run_s / max(warm_run_s, 1e-9), 2
+                ),
+            }
+        finally:
+            shutil.rmtree(store2, ignore_errors=True)
+    return result
+
+
 def bench_serving(dev, on_tpu):
     """Generation-serving throughput leg (manifest v10): the same
     mixed-length workload and Poisson arrival sequence through the
@@ -794,6 +913,8 @@ def main():
     ckpt = bench_checkpoint(dev, on_tpu)
     gc.collect()
     serving = bench_serving(dev, on_tpu)
+    gc.collect()
+    cold_start = bench_cold_start(dev, on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
@@ -812,7 +933,8 @@ def main():
         "legs": {"bert_base": bert, "resnet50": resnet,
                  "bert_long_context": bert_long, "dlrm": dlrm,
                  "moe_dispatch": moe, "weight_update": wu,
-                 "checkpoint": ckpt, "serving": serving},
+                 "checkpoint": ckpt, "serving": serving,
+                 "cold_start": cold_start},
     }
     print(json.dumps(result))
 

@@ -35,7 +35,12 @@ def main():
     serving_cfg = FFConfig.from_args()  # --serving-mode/--kv-page-size/
     b = args.batch_size                 # --serving-slots/--kv-pool-blocks
 
-    ff = FFModel(FFConfig(batch_size=b, num_devices=1))
+    # --strategy-store/--compilation-cache flow into the replica's
+    # compiles (docs/STORE.md "Replica cold start"): a second process
+    # serving the same model restores instead of re-searching
+    ff = FFModel(FFConfig(batch_size=b, num_devices=1,
+                          strategy_store=serving_cfg.strategy_store,
+                          compilation_cache=serving_cfg.compilation_cache))
     build_gpt(ff, batch_size=b, seq_length=S, hidden_size=32,
               num_layers=2, num_heads=4, intermediate_size=64,
               vocab_size=V)

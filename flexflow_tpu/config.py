@@ -109,6 +109,18 @@ class FFConfig:
     memory_lambda: float = 1.0
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
+    # persistent strategy + compile artifact store (store/,
+    # docs/STORE.md): searched strategies keyed by (graph signature,
+    # mesh fingerprint, simulator version) survive the process, so a
+    # preempted worker, an elastic re-search on a degraded mesh, or a
+    # new serving replica restores instead of re-searching.  None =
+    # fall through to $FLEXFLOW_TPU_STORE_DIR (fleet deployments);
+    # ""/"none" = explicitly off (the substitution_json pattern).
+    strategy_store: Optional[str] = None
+    # JAX persistent compilation cache dir so the compiled step
+    # function itself survives process death: a path, or "auto" =
+    # <strategy store root>/xla_cache.  None = off.
+    compilation_cache: Optional[str] = None
 
     # -- simulator / machine model (reference: --machine-model-version/-file,
     #    --simulator-segment-size)
@@ -241,6 +253,13 @@ class FFConfig:
             )
         if not self.wus_axis:
             raise ValueError("wus_axis must be a non-empty mesh axis name")
+        if self.compilation_cache is not None and not str(
+            self.compilation_cache
+        ).strip():
+            raise ValueError(
+                "compilation_cache must be a directory path or 'auto' "
+                "(None disables it)"
+            )
         if self.profile_steps is not None:
             from .obs import parse_profile_steps
 
@@ -250,6 +269,13 @@ class FFConfig:
                     "profile_steps needs trace_dir set (the jax profiler "
                     "capture is written under it)"
                 )
+
+    def resolve_store_dir(self) -> Optional[str]:
+        """Effective strategy-store root (None = store off); resolution
+        rules live with the store (store.resolve_store_dir)."""
+        from .store import resolve_store_dir
+
+        return resolve_store_dir(self)
 
     def should_calibrate(self) -> bool:
         """Resolve search_calibrate's auto mode: measured costs when a
@@ -326,6 +352,12 @@ class FFConfig:
                        default=DEFAULT_FLASH_MIN_SEQ)
         p.add_argument("--export-strategy", dest="export_strategy", type=str, default=None)
         p.add_argument("--import-strategy", dest="import_strategy", type=str, default=None)
+        p.add_argument("--strategy-store", dest="strategy_store", type=str,
+                       default=None)
+        p.add_argument("--no-strategy-store", dest="strategy_store",
+                       action="store_const", const="none")
+        p.add_argument("--compilation-cache", dest="compilation_cache",
+                       type=str, nargs="?", const="auto", default=None)
         p.add_argument("--taskgraph", type=str, default=None)
         p.add_argument("--compgraph", type=str, default=None)
         p.add_argument("--include-costs-dot-graph", action="store_true")
@@ -397,6 +429,8 @@ class FFConfig:
             flash_min_seq=args.flash_min_seq,
             export_strategy_file=args.export_strategy,
             import_strategy_file=args.import_strategy,
+            strategy_store=args.strategy_store,
+            compilation_cache=args.compilation_cache,
             export_taskgraph_file=args.taskgraph,
             export_compgraph_file=args.compgraph,
             include_costs_dot_graph=args.include_costs_dot_graph,

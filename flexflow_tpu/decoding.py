@@ -83,6 +83,13 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
         batch_size=b, num_devices=1,
         compute_dtype=ff_train.config.compute_dtype,
         only_data_parallel=True,
+        # replica cold start (docs/STORE.md): the twin's compile keeps
+        # the train model's artifact-store wiring, so its decode step
+        # reloads from the XLA persistent cache on spin-up instead of
+        # recompiling (only_data_parallel means it never searches —
+        # the compilation cache is the piece that matters here)
+        strategy_store=ff_train.config.strategy_store,
+        compilation_cache=ff_train.config.compilation_cache,
     )
     ffd = FFModel(cfg)
     build_gpt(

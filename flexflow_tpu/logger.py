@@ -74,3 +74,9 @@ resilience_logger = RecursiveLogger("flexflow_tpu.resilience")
 # listens on the flexflow_tpu logger tree) and in any app-configured
 # logging sink
 calib_logger = RecursiveLogger("flexflow_tpu.calib")
+
+# strategy/compile artifact store observability (store/): hit/miss
+# decisions, quarantined corrupt entries, survivable publish failures —
+# all non-fatal by design, so the log line is the only trace beyond the
+# store/* counters
+store_logger = RecursiveLogger("flexflow_tpu.store")

@@ -651,6 +651,23 @@ class FFModel:
         )
         return result
 
+    def _stamp_catalog(self, strategy: Strategy) -> None:
+        """Pin the catalog identity a FRESHLY searched trace used, so
+        replay on another host can't silently resolve different rules
+        (rewrite.rules_for_replay checks the hash).  Only ever called
+        on this process's own search results — stamping an imported or
+        store-restored trace with the LOCAL catalog's hash would
+        fabricate provenance and defeat the replay check."""
+        if strategy.catalog is not None or not any(
+            str(n).startswith("taso_rule_") for n, _ in strategy.rewrites
+        ):
+            return
+        from .pcg.rewrite import catalog_fingerprint, catalog_for_config
+
+        path = catalog_for_config(self.config)
+        if path:
+            strategy.catalog = catalog_fingerprint(path)
+
     def _compile_inner(
         self,
         optimizer: Optional[Optimizer] = None,
@@ -681,45 +698,50 @@ class FFModel:
 
         num_devices = len(devices) if devices is not None else cfg.resolve_num_devices()
 
-        searched_here = False
+        # compiled-step persistence half of the artifact store: point
+        # XLA's cache under the store root BEFORE anything jit-executes
+        # so a restarted replica re-loads executables instead of
+        # recompiling (store/, docs/STORE.md)
+        if cfg.compilation_cache:
+            from .store import enable_compilation_cache
+
+            enable_compilation_cache(cfg)
+
         if strategy is None and cfg.import_strategy_file:
             strategy = Strategy.load(cfg.import_strategy_file)
         if strategy is None:
-            searched_here = True
             if cfg.search_budget > 0 and not cfg.only_data_parallel:
                 # reference: Unity graph_optimize is the default search
                 # path (GRAPH_OPTIMIZE_TASK_ID, graph.cc:2046); MCMC is
-                # the legacy SysML'19 path (model.cc:3285)
+                # the legacy SysML'19 path (model.cc:3285).  The
+                # strategy store wraps either: a warm entry for (graph,
+                # mesh, simulator version) skips the search entirely
+                # (search_stats records store_hit)
                 from .pcg.search import mcmc_search, unity_search
+                from .store import cached_search
+
+                def _run_search():
+                    if cfg.search_algo == "mcmc":
+                        s = mcmc_search(self, num_devices)
+                    else:
+                        s = unity_search(self, num_devices)
+                    # stamp the catalog identity BEFORE the store
+                    # publish so restored entries carry the provenance
+                    # their replay check needs (see _stamp_catalog)
+                    self._stamp_catalog(s)
+                    return s
 
                 t_search = time.perf_counter()
                 with tel.tracer.span("search", cat="search",
                                      algo=cfg.search_algo,
                                      devices=num_devices):
-                    if cfg.search_algo == "mcmc":
-                        strategy = mcmc_search(self, num_devices)
-                    else:
-                        strategy = unity_search(self, num_devices)
+                    strategy = cached_search(self, num_devices, _run_search)
                 tel.metrics.gauge("compile/search_ms").set(
                     (time.perf_counter() - t_search) * 1e3
                 )
             else:
                 strategy = data_parallel_strategy(num_devices)
         self.strategy = strategy
-        if searched_here and strategy.catalog is None and any(
-            str(n).startswith("taso_rule_") for n, _ in strategy.rewrites
-        ):
-            # fresh searches only: stamping an imported legacy trace
-            # with the LOCAL catalog's hash would fabricate provenance
-            # and defeat the replay check
-            # pin the catalog identity the trace was searched with so
-            # replay on another host can't silently resolve different
-            # rules (rewrite.rules_for_replay checks the hash)
-            from .pcg.rewrite import catalog_fingerprint, catalog_for_config
-
-            path = catalog_for_config(cfg)
-            if path:
-                strategy.catalog = catalog_fingerprint(path)
         if cfg.export_strategy_file:
             strategy.save(cfg.export_strategy_file)
 

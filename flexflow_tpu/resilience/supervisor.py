@@ -182,6 +182,8 @@ class TrainingSupervisor:
             "hung_steps": 0,       # watchdog timeouts + injected hangs
             "emergency_saves": 0,  # preemption-grace checkpoints
             "re_searches": 0,
+            "re_search_store_hits": 0,  # elastic re-searches answered
+                                        # by the strategy store
         }
 
     # -- deterministic batching -----------------------------------------
@@ -265,11 +267,32 @@ class TrainingSupervisor:
             return self.search_fn(self.ff, num_devices)
         cfg = self.ff.config
         if cfg.search_budget > 0 and not cfg.only_data_parallel:
+            # elastic fast path: the strategy store may already hold a
+            # searched plan for this degraded mesh (a previous loss at
+            # the same survivor count, or a pre-seeded fleet store) —
+            # cached_search consults it before paying a full re-search
+            # and publishes on a miss so the NEXT loss is instant
             from ..pcg.search import mcmc_search, unity_search
+            from ..store import cached_search
 
-            if cfg.search_algo == "mcmc":
-                return mcmc_search(self.ff, num_devices)
-            return unity_search(self.ff, num_devices)
+            def _run():
+                if cfg.search_algo == "mcmc":
+                    s = mcmc_search(self.ff, num_devices)
+                else:
+                    s = unity_search(self.ff, num_devices)
+                # same pre-publish provenance stamp as FFModel.compile's
+                # search path: a store entry restored on another host
+                # must carry the catalog identity its rewrite trace was
+                # searched with (rewrite.rules_for_replay pins the hash)
+                self.ff._stamp_catalog(s)
+                return s
+
+            strategy = cached_search(self.ff, num_devices, _run)
+            if (getattr(strategy, "search_stats", None) or {}).get(
+                "store_hit"
+            ):
+                self.counters["re_search_store_hits"] += 1
+            return strategy
         from ..strategy import data_parallel_strategy
 
         return data_parallel_strategy(num_devices)

@@ -113,6 +113,16 @@ class OpTerms:
 
 _KERNEL_OVERHEAD = 2e-6  # per-op dispatch/fusion overhead (XLA fuses, small)
 
+#: semantic version of the analytic cost model + simulator formulas.
+#: Part of the strategy store's simulator-version key component
+#: (store/key.py): bump it whenever cost semantics change — OpTerms
+#: decomposition, comm estimators, overlap crediting, memory accounting
+#: — so strategies searched under the old model stop hitting and
+#: re-search under the new one instead of replaying stale rankings.
+#: (The learned cost model, arXiv:2008.01040, will ride this same
+#: constant: model retrain => version bump => fleet-wide invalidation.)
+COST_MODEL_VERSION = 1
+
 # backward/forward cost ratio per op class (replaces the old flat 2x:
 # conv/matmul backward really is two same-size contractions, but an
 # embedding backward is one gradient scatter with no input grad, and
@@ -197,21 +207,54 @@ class OpCostModel:
                 self._persistent.update(
                     {k: float(v) for k, v in data.items()}
                 )
-            except (OSError, ValueError):
-                pass
+            except (OSError, ValueError, TypeError, AttributeError):
+                pass  # absent, torn, or valid-JSON-wrong-shape
 
     def save_persistent(self, path: Optional[str] = None):
+        """Crash-safe, concurrency-safe persistence of the measured-cost
+        cache.  Called unconditionally at the end of every Unity/MCMC
+        search (unity.py/mcmc.py), so a mid-write kill must never
+        corrupt the shared file: the write goes to a process-unique tmp
+        (mkstemp — a fixed `.tmp` name would let two searches clobber
+        each other's staging) and lands via one atomic os.replace.
+        Merge-on-save: entries measured by OTHER concurrent searches
+        since our load are re-read and kept — last writer no longer
+        erases them; our own measurements win ties."""
         import json
         import os
+        import tempfile
 
         path = path or self.cache_path
         if not path or not self._dirty:
             return
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._persistent, f)
-        os.replace(tmp, path)
+        path = os.path.abspath(path)
+        dirname = os.path.dirname(path)
+        os.makedirs(dirname, exist_ok=True)
+        merged: Dict[str, float] = {}
+        try:
+            with open(path) as f:
+                merged = {k: float(v) for k, v in json.load(f).items()}
+        except (OSError, ValueError, TypeError, AttributeError):
+            # absent, torn, or valid-JSON-wrong-shape (a list, null
+            # values) — our entries still publish whole either way
+            merged = {}
+        merged.update(self._persistent)
+        fd, tmp = tempfile.mkstemp(
+            dir=dirname, prefix=os.path.basename(path) + ".tmp-"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(merged, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._persistent = merged
         self._dirty = False
 
     def cost(self, op: Op) -> CostMetrics:

@@ -1,0 +1,174 @@
+"""Persistent strategy + compile artifact store (docs/STORE.md).
+
+The reference FlexFlow ships searched strategies as on-disk artifacts
+(--export-strategy/--import-strategy, graph.cc:2164-2400) because the
+search is the expensive, reusable part of the system.  This package
+makes that a first-class, content-addressed tier:
+
+  * StrategyStore — durable searched strategies keyed by
+    (graph signature, mesh fingerprint, simulator version), with
+    verify-then-publish writes and corrupt-entry tolerance (store.py);
+  * cached_search — the one consult-then-publish wrapper every search
+    site uses: FFModel.compile, the resilience supervisor's elastic
+    re-search, and (through compile) serving replica spin-up;
+  * enable_compilation_cache — JAX persistent compilation cache wired
+    under the store root, so the compiled step function itself
+    survives process death alongside the strategy that produced it.
+
+Config surface: FFConfig.strategy_store / --strategy-store DIR /
+--no-strategy-store (or the FLEXFLOW_TPU_STORE_DIR env var for fleet
+deployments), FFConfig.compilation_cache / --compilation-cache [DIR].
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from ..logger import store_logger
+from .key import (
+    StoreKey,
+    graph_signature,
+    mesh_fingerprint,
+    simulator_version,
+    store_key_for,
+)
+from .store import MANIFEST_VERSION, StoreVerifyError, StrategyStore
+
+#: env var naming a shared store root for every process in a fleet
+#: (per-run --strategy-store overrides it; --no-strategy-store opts out)
+STORE_DIR_ENV = "FLEXFLOW_TPU_STORE_DIR"
+
+
+def resolve_store_dir(cfg) -> Optional[str]:
+    """FFConfig.strategy_store -> effective store root, or None when
+    the store is off.  None falls through to $FLEXFLOW_TPU_STORE_DIR;
+    ''/'none' is an explicit opt-out (the substitution_json pattern)."""
+    v = cfg.strategy_store
+    if v is None:
+        v = os.environ.get(STORE_DIR_ENV) or None
+    if not v or str(v).strip().lower() == "none":
+        return None
+    return str(v)
+
+
+def store_from_config(cfg, registry=None) -> Optional[StrategyStore]:
+    """The run's StrategyStore, or None when disabled/unusable.  An
+    unwritable root degrades to store-off with a log line — persistence
+    is an accelerator, never a crash source."""
+    root = resolve_store_dir(cfg)
+    if root is None:
+        return None
+    try:
+        return StrategyStore(root, registry=registry)
+    except OSError as e:
+        store_logger.info(
+            "strategy store root %s unusable (%s); continuing without "
+            "the store", root, e,
+        )
+        return None
+
+
+def enable_compilation_cache(cfg) -> Optional[str]:
+    """Point JAX's persistent compilation cache at
+    FFConfig.compilation_cache ('auto' = <store root>/xla_cache), so a
+    restarted process re-loads its XLA executables from disk instead of
+    recompiling.  Returns the cache dir, or None when off.  GLOBAL jax
+    config: the most recent compile's setting wins for the whole
+    process, so point every model in one process at the same cache
+    (content-addressed internally — sharing is safe; split dirs only
+    cost duplicate executables)."""
+    spec = cfg.compilation_cache
+    if not spec:
+        return None
+    if str(spec).strip().lower() == "auto":
+        root = resolve_store_dir(cfg)
+        if root is None:
+            raise ValueError(
+                "compilation_cache='auto' ties the XLA cache to the "
+                "strategy store root, but no store is configured — set "
+                f"--strategy-store/${STORE_DIR_ENV} or pass an explicit "
+                "--compilation-cache DIR"
+            )
+        path = os.path.join(root, "xla_cache")  # StrategyStore layout
+    else:
+        path = str(spec)
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        if jax.default_backend() not in ("cpu",):
+            # cache EVERY executable on accelerators: cold start is the
+            # point, and the store root is operator-provisioned space
+            # (gc via docs/STORE.md).  On the CPU backend keep jax's
+            # conservative defaults — force-caching sub-second CPU
+            # executables makes their deserialization path segfault
+            # (observed on jax 0.4.37 CPU meshes), and a CPU recompile
+            # is cheaper than the risk
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except (AttributeError, ValueError) as e:  # older/newer jax knob drift
+        store_logger.info(
+            "jax persistent compilation cache tuning unavailable (%s); "
+            "cache dir still set where supported", e,
+        )
+    return path
+
+
+def cached_search(model, num_devices: int,
+                  run_search: Callable[[], "object"]):
+    """Consult-then-publish around one strategy search.
+
+    Store off -> run_search() unchanged.  Store on: a hit returns the
+    published strategy with search_stats carrying store_hit=True (the
+    search is skipped entirely); a miss runs the search and publishes
+    the winner under the same key so every later process — a preempted
+    worker's replacement, an elastic re-search on the degraded mesh, a
+    new serving replica — restores it instead of re-paying the search.
+    """
+    cfg = model.config
+    registry = getattr(getattr(model, "telemetry", None), "metrics", None)
+    store = store_from_config(cfg, registry=registry)
+    if store is None:
+        return run_search()
+    key = store_key_for(cfg, model.layers, num_devices)
+    hit = store.lookup(key)
+    if hit is not None:
+        store_logger.info(
+            "store hit %s: strategy restored for %d devices, search "
+            "skipped", key.digest[:16], num_devices,
+        )
+        return hit
+    strategy = run_search()
+    stats = getattr(strategy, "search_stats", None)
+    if stats is None:
+        stats = {}
+        strategy.search_stats = stats
+    stats["store_hit"] = False
+    stats["store_key"] = key.digest
+    store.publish(
+        key,
+        strategy,
+        searched_cost=getattr(strategy, "search_cost", None),
+        search_stats=stats,
+        created_at=time.time(),
+    )
+    return strategy
+
+
+__all__ = [
+    "MANIFEST_VERSION",
+    "STORE_DIR_ENV",
+    "StoreKey",
+    "StoreVerifyError",
+    "StrategyStore",
+    "cached_search",
+    "enable_compilation_cache",
+    "graph_signature",
+    "mesh_fingerprint",
+    "resolve_store_dir",
+    "simulator_version",
+    "store_from_config",
+    "store_key_for",
+]

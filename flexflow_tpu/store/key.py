@@ -1,0 +1,210 @@
+"""Store keys: (graph signature, mesh fingerprint, simulator version).
+
+A searched strategy is reusable exactly when three things match the
+search that produced it:
+
+  * the FRONTEND graph it was searched for — ops, params, shapes,
+    dtypes, edges, and the op/tensor NAMES a Strategy's shard_configs /
+    edge_ops reference (the reference keys its exported strategies the
+    same way: graph.cc:2164-2400 serializes per-op guids+params);
+  * the machine it was placed onto — device count, machine-model
+    identity, backend kind (an 8-chip plan is wrong on 4 survivors;
+    a v5p-torus plan is wrong on a flat CPU mesh);
+  * the simulator that ranked the candidates — cost-model version,
+    fitted calibration table, and every search-shaping config knob
+    (a ZeRO-1-costed winner is stale once the calibration improves —
+    the invalidation discipline arXiv:2008.01040's learned cost model
+    will also need).
+
+Each component is a canonical JSON blob; the composed sha256 is the
+content address under StrategyStore.  Digests are of EFFECTIVE inputs:
+the calibration component hashes the constants a search would actually
+load (sim/calibrate.load_overlap_constants), not raw file bytes, so an
+ignored/invalid table can't split keys.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+
+def _sha256_text(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def _sha256_json(obj) -> str:
+    return _sha256_text(json.dumps(obj, sort_keys=True, default=str))
+
+
+def _sha256_file(path: Optional[str]) -> Optional[str]:
+    if not path:
+        return None
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+# -- component fingerprints -------------------------------------------------
+
+def graph_signature(graph) -> str:
+    """Canonical hash of a frontend (degree-1) PCG.
+
+    One record per op: name, type, params, input tensor names, output
+    (name, shape) pairs.  Records sort by op name — layer names are the
+    stable identity strategies bind to (shard_configs / edge_ops are
+    name-keyed), so two construction orders of the same named graph
+    hash identically, while any op/shape/dtype/edge change does not.
+    """
+    records = []
+    for op in graph.topo_order():
+        records.append({
+            "name": op.name,
+            "type": op.op_type.value,
+            "params": repr(op.params),
+            "shard": repr(op.shard) if getattr(op, "shard", None) else None,
+            "inputs": [t.name for t in op.inputs],
+            "outputs": [(t.name, str(t.shape)) for t in op.outputs],
+        })
+    records.sort(key=lambda r: r["name"])
+    return _sha256_json(records)
+
+
+def mesh_fingerprint(cfg, num_devices: int) -> Dict:
+    """Identity of the hardware a strategy was placed onto: device
+    count, node split, machine-model id (version + file digest), and
+    the live backend kind (calibrated searches rank differently per
+    chip generation)."""
+    platform, kind = "unknown", "unknown"
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        platform, kind = d.platform, d.device_kind
+    except Exception:
+        pass
+    return {
+        "num_devices": int(num_devices),
+        "num_nodes": int(cfg.num_nodes),
+        "machine_model_version": int(cfg.machine_model_version),
+        "machine_model_file": _sha256_file(cfg.machine_model_file),
+        "platform": platform,
+        "device_kind": kind,
+    }
+
+
+def _calibration_digest() -> str:
+    """Digest of the overlap-constants table a search would actually
+    load (None when absent/invalid — load_overlap_constants ignores
+    those, so they must not split keys)."""
+    try:
+        from ..sim.calibrate import load_overlap_constants
+
+        fitted = load_overlap_constants()
+    except Exception:
+        fitted = None
+    if fitted is None:
+        return "none"
+    return _sha256_json(fitted)
+
+
+def simulator_version(cfg) -> Dict:
+    """Identity of the simulator + search configuration that ranked the
+    candidates: cost-model/measure-cache versions, the fitted
+    calibration digest, the TASO catalog identity, and every FFConfig
+    knob that shapes what the search returns."""
+    from ..sim.simulator import COST_MODEL_VERSION, OpCostModel
+
+    catalog_sha = None
+    try:
+        from ..pcg.rewrite import catalog_fingerprint, catalog_for_config
+
+        path = catalog_for_config(cfg)
+        if path:
+            catalog_sha = catalog_fingerprint(path).get("sha256")
+    except Exception:
+        catalog_sha = "unresolved"
+    return {
+        "cost_model_version": COST_MODEL_VERSION,
+        "measure_cache_version": OpCostModel.MEASURE_CACHE_VERSION,
+        "calibration_digest": _calibration_digest(),
+        "calibrated": bool(cfg.should_calibrate()),
+        "catalog_sha256": catalog_sha,
+        "search": {
+            "algo": cfg.search_algo,
+            "budget": int(cfg.search_budget),
+            "alpha": float(cfg.search_alpha),
+            "propagate": bool(cfg.search_propagate),
+            "only_data_parallel": bool(cfg.only_data_parallel),
+            "enable_parameter_parallel": bool(cfg.enable_parameter_parallel),
+            "enable_attribute_parallel": bool(cfg.enable_attribute_parallel),
+            "enable_sample_parallel": bool(cfg.enable_sample_parallel),
+            "overlap_backward_update": bool(cfg.search_overlap_backward_update),
+            "parameter_sync": str(cfg.parameter_sync.value),
+            "memory_search": bool(cfg.memory_search),
+            "memory_lambda": float(cfg.memory_lambda),
+            "memory_per_device": int(cfg.memory_per_device),
+            "segment_size": int(cfg.simulator_segment_size),
+            "rewrite_depth": int(cfg.rewrite_depth),
+            "rewrite_max_variants": int(cfg.rewrite_max_variants),
+            "remat": bool(cfg.remat),
+            "weight_update_sharding": bool(cfg.weight_update_sharding),
+            "wus_axis": cfg.wus_axis,
+            "seed": int(cfg.seed),
+        },
+    }
+
+
+# -- the composed key -------------------------------------------------------
+
+@dataclasses.dataclass
+class StoreKey:
+    """Composed store key.  `digest` is the content address; the
+    component dicts land in the entry manifest so operators can read
+    WHY two entries differ (docs/STORE.md)."""
+
+    graph: str         # graph_signature hex
+    mesh: Dict         # mesh_fingerprint
+    sim: Dict          # simulator_version
+
+    @property
+    def digest(self) -> str:
+        return _sha256_json(
+            {"graph": self.graph, "mesh": self.mesh, "sim": self.sim}
+        )
+
+    def manifest_fields(self) -> Dict:
+        return {
+            "graph_signature": self.graph,
+            "mesh": dict(self.mesh),
+            "sim": json.loads(json.dumps(self.sim, default=str)),
+        }
+
+
+def store_key_for(cfg, graph, num_devices: int) -> StoreKey:
+    """The key FFModel.compile / the elastic re-search consult the
+    store under: frontend graph x target mesh x simulator identity."""
+    return StoreKey(
+        graph=graph_signature(graph),
+        mesh=mesh_fingerprint(cfg, num_devices),
+        sim=simulator_version(cfg),
+    )
+
+
+def strategy_sha256(text: str) -> str:
+    """Digest of a serialized strategy body (manifest integrity field)."""
+    return _sha256_text(text)
+
+
+__all__ = [
+    "StoreKey",
+    "graph_signature",
+    "mesh_fingerprint",
+    "simulator_version",
+    "store_key_for",
+    "strategy_sha256",
+]

@@ -1,0 +1,327 @@
+"""StrategyStore: content-addressed persistence for searched strategies.
+
+Layout (one directory per key digest, under <root>/strategies/):
+
+    <root>/strategies/<digest>/manifest.json   # key fields + provenance
+    <root>/strategies/<digest>/strategy.json   # Strategy.to_json body
+    <root>/xla_cache/                          # JAX persistent compile cache
+
+Write discipline is checkpoint.py's verify-then-publish: serialize into
+a process-unique tmp dir, fsync, re-read and re-parse against the
+manifest digest, then one atomic os.replace into the final name — a
+mid-write kill leaves only an ignorable tmp dir, never a torn entry.
+Reads tolerate corruption the same way restores do: any unreadable /
+digest-mismatched entry counts as a miss (and is quarantined so the
+follow-up search's publish repairs it) instead of crashing the caller.
+
+The store is safe to share between processes on one filesystem:
+publishes are atomic renames, lookups never see partial writes, and a
+concurrent double-publish of the same key resolves to
+first-write-wins.  Metrics (store/hits, store/misses,
+store/publishes, store/lookup_ms, ...) flow through an optional
+obs.metrics registry into run_telemetry.jsonl.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..checkpoint import _fsync_dir, _write_json_fsync
+from ..logger import store_logger
+from ..strategy import Strategy
+from .key import StoreKey, strategy_sha256
+
+MANIFEST_VERSION = 1
+
+#: gc() only sweeps .tmp-* staging dirs older than this — a young tmp
+#: may be a LIVE concurrent publisher mid-write on the shared root
+STALE_TMP_AGE_S = 3600.0
+
+
+class StoreVerifyError(RuntimeError):
+    """A publish failed its write-time re-read verification."""
+
+
+def _json_safe(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {str(k): _json_safe(v) for k, v in obj.items()}
+        return str(obj)
+
+
+class StrategyStore:
+    """Durable strategy artifacts keyed by StoreKey digests."""
+
+    def __init__(self, root: str, registry=None):
+        self.root = os.path.abspath(root)
+        self.registry = registry
+        os.makedirs(self.strategies_dir, exist_ok=True)
+
+    @property
+    def strategies_dir(self) -> str:
+        return os.path.join(self.root, "strategies")
+
+    @property
+    def compilation_cache_dir(self) -> str:
+        """Where --compilation-cache auto points XLA's persistent cache
+        (the compiled step function's half of instant cold start)."""
+        return os.path.join(self.root, "xla_cache")
+
+    def _entry_dir(self, digest: str) -> str:
+        return os.path.join(self.strategies_dir, digest)
+
+    # -- metrics --------------------------------------------------------
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.registry is not None:
+            self.registry.counter(f"store/{name}").inc(n)
+
+    def _observe_ms(self, name: str, dt_s: float) -> None:
+        if self.registry is not None:
+            self.registry.histogram(f"store/{name}").observe(dt_s * 1e3)
+
+    # -- lookup ---------------------------------------------------------
+    def lookup(self, key: StoreKey) -> Optional[Strategy]:
+        """Strategy for `key`, or None.  A hit carries the manifest's
+        provenance as strategy.search_stats with store_hit=True — the
+        compile path surfaces it exactly like a fresh search's stats.
+        Corrupt entries are quarantined (removed) so the caller's
+        post-search publish can repair them."""
+        t0 = time.perf_counter()
+        digest = key.digest
+        d = self._entry_dir(digest)
+        try:
+            with open(os.path.join(d, "manifest.json")) as f:
+                manifest = json.load(f)
+            if manifest.get("manifest_version") != MANIFEST_VERSION:
+                # a newer (or foreign) schema: valid for ITS readers —
+                # miss without quarantining, never delete on a maybe
+                store_logger.info(
+                    "store entry %s has manifest_version %r (this "
+                    "reader speaks %d): treating as a miss",
+                    digest[:16], manifest.get("manifest_version"),
+                    MANIFEST_VERSION,
+                )
+                self._count("misses")
+                self._observe_ms("lookup_ms", time.perf_counter() - t0)
+                return None
+            if manifest.get("key_digest") != digest:
+                raise StoreVerifyError(
+                    f"manifest key_digest {manifest.get('key_digest')!r} "
+                    f"!= directory digest {digest!r}"
+                )
+            with open(os.path.join(d, "strategy.json")) as f:
+                text = f.read()
+            if strategy_sha256(text) != manifest.get("strategy_sha256"):
+                raise StoreVerifyError("strategy.json digest mismatch")
+            strategy = Strategy.from_json(text)
+        except FileNotFoundError:
+            if not os.path.isdir(d):  # clean miss: no entry at all
+                self._count("misses")
+                self._observe_ms("lookup_ms", time.perf_counter() - t0)
+                return None
+            # entry dir exists but a file is gone: a half-entry would
+            # block the publish (first-write-wins) forever — quarantine
+            # it like any other corruption so the re-search repairs it
+            store_logger.info(
+                "store entry %s is missing files: quarantined, "
+                "treating as a miss", digest[:16],
+            )
+            shutil.rmtree(d, ignore_errors=True)
+            self._count("misses")
+            self._count("corrupt_entries")
+            self._observe_ms("lookup_ms", time.perf_counter() - t0)
+            return None
+        except OSError as e:
+            # transient I/O (NFS ESTALE, EIO, a permissions blip): the
+            # entry may be perfectly valid for every other reader —
+            # miss WITHOUT quarantining, never delete on a maybe
+            store_logger.info(
+                "store entry %s unreadable (%s: %s): treating as a "
+                "miss", digest[:16], type(e).__name__, e,
+            )
+            self._count("misses")
+            self._observe_ms("lookup_ms", time.perf_counter() - t0)
+            return None
+        except Exception as e:
+            # genuine corruption (torn write survivor, bit rot, digest
+            # mismatch, a foreign/older schema): quarantine so the
+            # follow-up search's publish repairs the key — never the
+            # caller's problem either way
+            store_logger.info(
+                "corrupt store entry %s (%s: %s): quarantined, "
+                "treating as a miss", digest[:16], type(e).__name__, e,
+            )
+            shutil.rmtree(d, ignore_errors=True)
+            self._count("misses")
+            self._count("corrupt_entries")
+            self._observe_ms("lookup_ms", time.perf_counter() - t0)
+            return None
+        stats = dict(manifest.get("search_stats") or {})
+        stats["store_hit"] = True
+        stats["store_key"] = digest
+        strategy.search_stats = stats
+        if manifest.get("searched_cost") is not None:
+            strategy.search_cost = manifest["searched_cost"]
+        self._count("hits")
+        self._observe_ms("lookup_ms", time.perf_counter() - t0)
+        return strategy
+
+    # -- publish --------------------------------------------------------
+    def publish(
+        self,
+        key: StoreKey,
+        strategy: Strategy,
+        *,
+        searched_cost: Optional[float] = None,
+        search_stats: Optional[Dict] = None,
+        created_at: Optional[float] = None,
+        overwrite: bool = False,
+    ) -> bool:
+        """Write-verify-rename one entry; returns True when the entry
+        was (re)written, False when an existing entry was kept
+        (first-write-wins) or the write failed survivably.  created_at
+        is caller-supplied provenance (seconds since epoch)."""
+        digest = key.digest
+        final = self._entry_dir(digest)
+        if os.path.isdir(final) and not overwrite:
+            return False
+        text = strategy.to_json()
+        manifest = {
+            "manifest_version": MANIFEST_VERSION,
+            "key_digest": digest,
+            "key": key.manifest_fields(),
+            "strategy_sha256": strategy_sha256(text),
+            "searched_cost": (
+                None if searched_cost is None else float(searched_cost)
+            ),
+            "search_stats": _json_safe(search_stats or {}),
+            "created_at": (
+                time.time() if created_at is None else float(created_at)
+            ),
+        }
+        tmp = os.path.join(
+            self.strategies_dir,
+            f".tmp-{digest[:16]}-{os.getpid()}-{threading.get_ident()}",
+        )
+        try:
+            os.makedirs(tmp)
+            with open(os.path.join(tmp, "strategy.json"), "w") as f:
+                f.write(text)
+                f.flush()
+                os.fsync(f.fileno())
+            _write_json_fsync(os.path.join(tmp, "manifest.json"), manifest)
+            self._verify_dir(tmp, digest)
+            if os.path.isdir(final):  # overwrite=True repair path
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+            _fsync_dir(self.strategies_dir)
+        except FileExistsError:
+            # a concurrent publisher beat us into the tmp or final name:
+            # their verified entry serves the key; ours is redundant
+            shutil.rmtree(tmp, ignore_errors=True)
+            return False
+        except (OSError, StoreVerifyError) as e:
+            shutil.rmtree(tmp, ignore_errors=True)
+            if isinstance(e, OSError) and os.path.isdir(final):
+                # on Linux the concurrent-publish race surfaces as
+                # ENOTEMPTY from os.replace, not FileExistsError: the
+                # other writer's verified entry now serves the key —
+                # benign first-write-wins, not a store failure
+                return False
+            self._count("publish_failures")
+            store_logger.info(
+                "store publish failed for %s (%s: %s); search result "
+                "still used, entry not persisted",
+                digest[:16], type(e).__name__, e,
+            )
+            return False
+        self._count("publishes")
+        return True
+
+    @staticmethod
+    def _verify_dir(path: str, digest: str) -> None:
+        """Re-read a staged entry and check manifest/strategy coherence
+        (the checkpoint.py write-time verification discipline)."""
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        if manifest.get("key_digest") != digest:
+            raise StoreVerifyError("staged manifest key_digest mismatch")
+        with open(os.path.join(path, "strategy.json")) as f:
+            text = f.read()
+        if strategy_sha256(text) != manifest.get("strategy_sha256"):
+            raise StoreVerifyError("staged strategy.json digest mismatch")
+        Strategy.from_json(text)  # must parse back
+
+    # -- maintenance ----------------------------------------------------
+    def entries(self) -> List[Tuple[str, Dict]]:
+        """(digest, manifest) pairs, oldest created_at first; unreadable
+        manifests are skipped (lookup() quarantines them on access)."""
+        out = []
+        try:
+            names = os.listdir(self.strategies_dir)
+        except OSError:
+            return []
+        for name in names:
+            if name.startswith(".tmp-"):
+                continue
+            try:
+                with open(os.path.join(self.strategies_dir, name,
+                                       "manifest.json")) as f:
+                    out.append((name, json.load(f)))
+            except (OSError, ValueError):
+                continue
+        out.sort(key=lambda e: e[1].get("created_at", 0.0))
+        return out
+
+    def gc(self, keep_last: int) -> int:
+        """Keep the `keep_last` newest entries by created_at, drop the
+        rest (plus any stale tmp dirs); returns the number removed.
+        Keep/gc policy rationale: docs/STORE.md."""
+        if keep_last < 0:
+            raise ValueError(f"keep_last must be >= 0, got {keep_last}")
+        removed = 0
+        entries = self.entries()
+        drop = entries[: max(0, len(entries) - keep_last)]
+        for digest, _m in drop:
+            shutil.rmtree(os.path.join(self.strategies_dir, digest),
+                          ignore_errors=True)
+            removed += 1
+        try:
+            now = time.time()
+            for name in os.listdir(self.strategies_dir):
+                if not name.startswith(".tmp-"):
+                    continue
+                p = os.path.join(self.strategies_dir, name)
+                try:
+                    age = now - os.path.getmtime(p)
+                except OSError:
+                    continue  # the publisher just renamed it away
+                if age > STALE_TMP_AGE_S:
+                    # old enough that its writer is dead, not mid-write
+                    shutil.rmtree(p, ignore_errors=True)
+        except OSError:
+            pass
+        if removed:
+            self._count("gc_removed", removed)
+        return removed
+
+    def import_strategy(self, key: StoreKey, path: str, *,
+                        created_at: Optional[float] = None,
+                        overwrite: bool = False, **meta) -> bool:
+        """Promote an on-disk Strategy JSON (examples/strategies/*.json)
+        into a store entry — Strategy.load stays the compatibility
+        surface; the store gains a verified, key-addressed copy."""
+        strategy = Strategy.load(path)
+        stats = dict(meta.pop("search_stats", {}) or {})
+        stats.setdefault("imported_from", os.path.basename(path))
+        return self.publish(
+            key, strategy, search_stats=stats, created_at=created_at,
+            overwrite=overwrite, **meta,
+        )

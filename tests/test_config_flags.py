@@ -265,6 +265,43 @@ def test_serving_config_validated():
         FFConfig(serving_slots=0)
 
 
+def test_store_cli_flags_parse(monkeypatch):
+    cfg = FFConfig.from_args([
+        "--strategy-store", "/tmp/fleet_store",
+        "--compilation-cache", "/tmp/xla",
+    ])
+    assert cfg.strategy_store == "/tmp/fleet_store"
+    assert cfg.resolve_store_dir() == "/tmp/fleet_store"
+    assert cfg.compilation_cache == "/tmp/xla"
+    # bare --compilation-cache ties the XLA cache to the store root
+    auto = FFConfig.from_args(["--strategy-store", "/tmp/s",
+                               "--compilation-cache"])
+    assert auto.compilation_cache == "auto"
+    # --no-strategy-store opts out even when the fleet env var is set
+    monkeypatch.setenv("FLEXFLOW_TPU_STORE_DIR", "/tmp/fleet_store")
+    off = FFConfig.from_args(["--no-strategy-store"])
+    assert off.strategy_store == "none"
+    assert off.resolve_store_dir() is None
+    # defaults: no store unless the env var names one
+    base = FFConfig.from_args([])
+    assert base.strategy_store is None
+    assert base.compilation_cache is None
+    assert base.resolve_store_dir() == "/tmp/fleet_store"  # env fallback
+    monkeypatch.delenv("FLEXFLOW_TPU_STORE_DIR")
+    assert base.resolve_store_dir() is None
+
+
+def test_store_config_validated():
+    with pytest.raises(ValueError):
+        FFConfig(compilation_cache="")
+    with pytest.raises(ValueError):
+        FFConfig(compilation_cache="   ")
+    # None disables, paths and "auto" are fine
+    FFConfig(compilation_cache=None)
+    FFConfig(compilation_cache="auto")
+    FFConfig(compilation_cache="/tmp/xla")
+
+
 def test_resilience_config_validated():
     with pytest.raises(ValueError):
         FFConfig(nan_policy="bogus")

@@ -1,0 +1,472 @@
+"""Persistent strategy + compile artifact store (flexflow_tpu/store/,
+docs/STORE.md): key invalidation matrix, warm-hit bit-identity, corrupt
+entry tolerance, supervisor elastic fast path, store metrics, gc, the
+shipped-artifact import tool, and the crash-safe merged op-cost
+persistence it rides with."""
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.store import (
+    StrategyStore,
+    cached_search,
+    store_from_config,
+    store_key_for,
+)
+
+BUDGET = 8  # tiny unity budget: enough to exercise the real search
+
+
+def _mlp(cfg, extra_layer=False):
+    ff = FFModel(cfg)
+    x = ff.create_tensor([cfg.batch_size, 16], name="x")
+    t = ff.dense(x, 32, name="fc1")
+    t = ff.relu(t)
+    if extra_layer:
+        t = ff.dense(t, 32, name="fc_extra")
+    t = ff.dense(t, 8, name="fc2")
+    ff.softmax(t)
+    return ff
+
+
+def _cfg(store, n=4, **kw):
+    return FFConfig(batch_size=8, num_devices=n, search_budget=BUDGET,
+                    strategy_store=str(store), **kw)
+
+
+def _compile(ff, devices):
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), devices=devices)
+    return ff
+
+
+def _entries(store_dir):
+    d = os.path.join(str(store_dir), "strategies")
+    return sorted(
+        n for n in os.listdir(d) if not n.startswith(".tmp-")
+    ) if os.path.isdir(d) else []
+
+
+# -- warm hit: search skipped, bit-identical strategy ----------------------
+
+def test_warm_hit_is_bit_identical_and_trains_identically(
+        tmp_path, devices8):
+    devs = devices8[:4]
+    ff1 = _compile(_mlp(_cfg(tmp_path)), devs)
+    assert ff1.strategy.search_stats["store_hit"] is False
+    assert len(_entries(tmp_path)) == 1
+
+    ff2 = _compile(_mlp(_cfg(tmp_path)), devs)
+    # the acceptance bar: warm compile skips the search entirely and
+    # restores the PUBLISHED strategy bit-identically
+    assert ff2.strategy.search_stats["store_hit"] is True
+    assert ff2.strategy.to_json() == ff1.strategy.to_json()
+    assert len(_entries(tmp_path)) == 1  # no duplicate publish
+
+    # restored strategy applies and trains one step matching the fresh
+    # search (same seed -> same init -> bit-identical loss)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 8, (8,))
+    l1 = float(ff1.train_step({"x": x}, y)["loss"])
+    l2 = float(ff2.train_step({"x": x}, y)["loss"])
+    assert l1 == l2
+
+
+def test_store_off_by_default(tmp_path, devices8, monkeypatch):
+    monkeypatch.delenv("FLEXFLOW_TPU_STORE_DIR", raising=False)
+    cfg = FFConfig(batch_size=8, num_devices=2, search_budget=BUDGET)
+    ff = _compile(_mlp(cfg), devices8[:2])
+    assert "store_hit" not in (ff.strategy.search_stats or {})
+
+
+def test_env_var_store_and_explicit_off(tmp_path, devices8, monkeypatch):
+    monkeypatch.setenv("FLEXFLOW_TPU_STORE_DIR", str(tmp_path))
+    cfg = FFConfig(batch_size=8, num_devices=2, search_budget=BUDGET)
+    assert cfg.resolve_store_dir() == str(tmp_path)
+    ff = _compile(_mlp(cfg), devices8[:2])
+    assert ff.strategy.search_stats["store_hit"] is False
+    assert len(_entries(tmp_path)) == 1
+    # --no-strategy-store wins over the env var
+    off = FFConfig(batch_size=8, num_devices=2, search_budget=BUDGET,
+                   strategy_store="none")
+    assert off.resolve_store_dir() is None
+
+
+# -- key invalidation matrix -----------------------------------------------
+
+def test_changed_mesh_misses(tmp_path, devices8):
+    _compile(_mlp(_cfg(tmp_path, n=4)), devices8[:4])
+    ff = _compile(_mlp(_cfg(tmp_path, n=2)), devices8[:2])
+    assert ff.strategy.search_stats["store_hit"] is False
+    assert len(_entries(tmp_path)) == 2
+
+
+def test_changed_graph_misses(tmp_path, devices8):
+    devs = devices8[:4]
+    _compile(_mlp(_cfg(tmp_path)), devs)
+    ff = _compile(_mlp(_cfg(tmp_path), extra_layer=True), devs)
+    assert ff.strategy.search_stats["store_hit"] is False
+    assert len(_entries(tmp_path)) == 2
+
+
+def test_changed_calibration_digest_misses(tmp_path, devices8,
+                                           monkeypatch):
+    devs = devices8[:4]
+    _compile(_mlp(_cfg(tmp_path)), devs)
+    # install a VALID fitted calibration table (load_overlap_constants
+    # accepts it for the cpu backend) under a fresh cache dir: the
+    # simulator-version digest changes, so the published entry is stale
+    cache = tmp_path / "calib_cache"
+    cache.mkdir()
+    monkeypatch.setenv("FLEXFLOW_TPU_CACHE_DIR", str(cache))
+    from flexflow_tpu.sim.calibrate import (load_overlap_constants,
+                                            save_overlap_constants)
+
+    save_overlap_constants({
+        "compute_scale": 1.5, "comm_scale": 1.0, "sync_scale": 1.0,
+        "overlap_fraction": 0.3, "sync_overlap_fraction": 0.3,
+        "fitted_on": "cpu",
+    })
+    assert load_overlap_constants() is not None  # the table is live
+    ff = _compile(_mlp(_cfg(tmp_path)), devs)
+    assert ff.strategy.search_stats["store_hit"] is False
+    assert len(_entries(tmp_path)) == 2
+
+
+def test_changed_search_config_misses(tmp_path, devices8):
+    devs = devices8[:4]
+    _compile(_mlp(_cfg(tmp_path)), devs)
+    ff = _compile(_mlp(_cfg(tmp_path, enable_parameter_parallel=True)),
+                  devs)
+    assert ff.strategy.search_stats["store_hit"] is False
+    assert len(_entries(tmp_path)) == 2
+
+
+# -- corruption tolerance --------------------------------------------------
+
+@pytest.mark.parametrize("corruption", ["truncate", "garbage", "missing"])
+def test_corrupt_entry_falls_back_to_search(tmp_path, devices8,
+                                            corruption):
+    devs = devices8[:4]
+    _compile(_mlp(_cfg(tmp_path)), devs)
+    (digest,) = _entries(tmp_path)
+    spath = os.path.join(str(tmp_path), "strategies", digest,
+                         "strategy.json")
+    if corruption == "truncate":
+        with open(spath) as f:
+            text = f.read()
+        with open(spath, "w") as f:
+            f.write(text[: len(text) // 2])
+    elif corruption == "garbage":
+        with open(spath, "w") as f:
+            f.write("{not json")
+    else:
+        os.unlink(spath)
+    ff = _compile(_mlp(_cfg(tmp_path)), devs)  # no crash
+    assert ff.strategy.search_stats["store_hit"] is False
+    # the corrupt entry was quarantined and the fresh search re-published
+    (redigest,) = _entries(tmp_path)
+    assert redigest == digest
+    ff3 = _compile(_mlp(_cfg(tmp_path)), devs)
+    assert ff3.strategy.search_stats["store_hit"] is True
+
+
+def test_unwritable_root_degrades_to_store_off(devices8):
+    cfg = FFConfig(batch_size=8, num_devices=2, search_budget=BUDGET,
+                   strategy_store="/proc/definitely/not/writable")
+    assert store_from_config(cfg) is None
+    ff = _compile(_mlp(cfg), devices8[:2])  # search still runs fine
+    assert "store_hit" not in (ff.strategy.search_stats or {})
+
+
+# -- supervisor elastic fast path ------------------------------------------
+
+@pytest.mark.parametrize("warm", [False, True])
+def test_supervisor_elastic_consults_store(tmp_path, devices8, warm):
+    from flexflow_tpu.resilience import FaultPlan
+    from flexflow_tpu.resilience.faults import FaultKind
+
+    def run(ckpt_dir):
+        cfg = _cfg(tmp_path, n=8, checkpoint_every=1, retry_backoff=0.0)
+        ff = _compile(_mlp(cfg), devices8)
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 16).astype(np.float32)
+        y = rng.randint(0, 8, (32,)).astype(np.int32)
+        plan = FaultPlan.single(2, FaultKind.DEVICE_LOSS, survivors=4)
+        report = ff.fit_resilient({"x": x}, y, num_steps=4, batch_size=8,
+                                  directory=str(ckpt_dir),
+                                  fault_plan=plan)
+        return ff, report
+
+    ff1, report1 = run(tmp_path / "ck1")
+    # cold: the degraded-mesh key missed, the re-search ran and
+    # published — recovery still correct
+    assert report1.final_step == 4
+    assert report1.counters["re_searches"] == 1
+    assert report1.counters["re_search_store_hits"] == 0
+    assert ff1.strategy.search_stats["store_hit"] is False
+    assert len(_entries(tmp_path)) == 2  # 8-device + 4-survivor keys
+    if warm:
+        ff2, report2 = run(tmp_path / "ck2")
+        assert report2.final_step == 4
+        assert report2.counters["re_search_store_hits"] == 1
+        # the recovered model runs under the RESTORED degraded strategy
+        assert ff2.strategy.search_stats["store_hit"] is True
+        assert ff2.strategy.to_json() == ff1.strategy.to_json()
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_store_metrics_reach_telemetry(tmp_path, devices8):
+    devs = devices8[:4]
+    cfg1 = _cfg(tmp_path, telemetry=True)
+    _compile(_mlp(cfg1), devs)
+    cfg2 = _cfg(tmp_path, telemetry=True)
+    ff2 = _compile(_mlp(cfg2), devs)
+    recs = {r["name"]: r for r in ff2.telemetry.metrics.drain()
+            if r.get("name", "").startswith("store/")}
+    assert recs["store/hits"]["value"] == 1
+    assert recs["store/lookup_ms"]["count"] == 1
+    # the miss + publish land on the searching model's own registry
+    cfg3 = _cfg(tmp_path / "fresh", telemetry=True)
+    ff3 = _compile(_mlp(cfg3), devs)
+    recs3 = {r["name"]: r for r in ff3.telemetry.metrics.drain()
+             if r.get("name", "").startswith("store/")}
+    assert recs3["store/misses"]["value"] == 1
+    assert recs3["store/publishes"]["value"] == 1
+
+
+def test_telemetry_summary_renders_store_section(tmp_path, devices8):
+    import subprocess
+    import sys
+
+    trace_dir = tmp_path / "trace"
+    cfg = _cfg(tmp_path / "store", trace_dir=str(trace_dir))
+    ff = _compile(_mlp(cfg), devices8[:4])
+    ff.telemetry.flush()
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "telemetry_summary.py"),
+         str(trace_dir)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "Store" in out
+    assert "misses" in out and "publishes" in out
+
+
+# -- direct store API: gc, import, first-write-wins ------------------------
+
+def test_gc_keeps_newest_entries(tmp_path, devices8):
+    store = StrategyStore(str(tmp_path))
+    cfgs = [_cfg(tmp_path, n=n) for n in (1, 2, 4)]
+    keys = []
+    for cfg in cfgs:
+        ff = _mlp(cfg)
+        key = store_key_for(cfg, ff.layers, cfg.num_devices)
+        keys.append(key)
+    from flexflow_tpu.strategy import data_parallel_strategy
+
+    for i, key in enumerate(keys):
+        assert store.publish(key, data_parallel_strategy(2),
+                             created_at=1000.0 + i)
+    assert store.gc(keep_last=2) == 1
+    kept = {d for d, _ in store.entries()}
+    assert keys[0].digest not in kept
+    assert {keys[1].digest, keys[2].digest} == kept
+    # idempotent below the cap; keep_last=0 empties
+    assert store.gc(keep_last=2) == 0
+    assert store.gc(keep_last=0) == 2
+    assert store.entries() == []
+
+
+def test_newer_manifest_version_misses_without_quarantine(tmp_path):
+    from flexflow_tpu.strategy import data_parallel_strategy
+
+    cfg = _cfg(tmp_path, n=2)
+    ff = _mlp(cfg)
+    store = StrategyStore(str(tmp_path))
+    key = store_key_for(cfg, ff.layers, 2)
+    assert store.publish(key, data_parallel_strategy(2), created_at=1.0)
+    mpath = os.path.join(str(tmp_path), "strategies", key.digest,
+                         "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["manifest_version"] = 99  # a future writer's schema
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    assert store.lookup(key) is None  # miss for THIS reader...
+    assert os.path.isdir(os.path.dirname(mpath))  # ...but NOT deleted
+
+
+def test_gc_spares_young_tmp_dirs(tmp_path):
+    store = StrategyStore(str(tmp_path))
+    young = os.path.join(store.strategies_dir, ".tmp-young-1-1")
+    stale = os.path.join(store.strategies_dir, ".tmp-stale-1-1")
+    os.makedirs(young)
+    os.makedirs(stale)
+    os.utime(stale, (1.0, 1.0))  # writer long dead
+    store.gc(keep_last=0)
+    assert os.path.isdir(young)     # maybe a live concurrent publisher
+    assert not os.path.isdir(stale)
+
+
+def test_publish_first_write_wins_and_overwrite(tmp_path):
+    from flexflow_tpu.strategy import data_parallel_strategy
+
+    cfg = _cfg(tmp_path, n=2)
+    ff = _mlp(cfg)
+    store = StrategyStore(str(tmp_path))
+    key = store_key_for(cfg, ff.layers, 2)
+    s2, s4 = data_parallel_strategy(2), data_parallel_strategy(4)
+    assert store.publish(key, s2, created_at=1.0)
+    assert not store.publish(key, s4, created_at=2.0)  # kept existing
+    assert store.lookup(key).to_json() == s2.to_json()
+    assert store.publish(key, s4, created_at=3.0, overwrite=True)
+    assert store.lookup(key).to_json() == s4.to_json()
+
+
+def test_import_tool_promotes_shipped_artifacts(tmp_path, devices8):
+    import sys
+
+    tools_dir = os.path.join(os.path.dirname(__file__), "..", "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        from strategy_store_import import import_default_jobs
+    finally:
+        sys.path.remove(tools_dir)
+    strategies_dir = os.path.join(os.path.dirname(__file__), "..",
+                                  "examples", "strategies")
+    results = import_default_jobs(str(tmp_path), strategies_dir, 8)
+    assert len(results) == 3 and all(written for _, _, written in results)
+    store = StrategyStore(str(tmp_path))
+    assert len(store.entries()) == 3
+    # Strategy.load stays the compatibility surface: the promoted entry
+    # round-trips to exactly the shipped JSON's strategy
+    from flexflow_tpu.strategy import Strategy
+
+    name, digest, _ = results[0]
+    shipped = Strategy.load(os.path.join(strategies_dir, f"{name}.json"))
+    with open(os.path.join(str(tmp_path), "strategies", digest,
+                           "strategy.json")) as f:
+        assert Strategy.from_json(f.read()).to_json() == shipped.to_json()
+
+
+# -- compilation cache knob -------------------------------------------------
+
+def test_compilation_cache_auto_ties_to_store_root(tmp_path, devices8):
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        cfg = _cfg(tmp_path, n=2, compilation_cache="auto")
+        _compile(_mlp(cfg), devices8[:2])
+        cache_dir = os.path.join(str(tmp_path), "xla_cache")
+        assert os.path.isdir(cache_dir)
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+    finally:
+        # global jax config: don't leak a tmp cache dir into the rest
+        # of the test session
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_compilation_cache_auto_without_store_raises(monkeypatch):
+    from flexflow_tpu.store import enable_compilation_cache
+
+    monkeypatch.delenv("FLEXFLOW_TPU_STORE_DIR", raising=False)
+    cfg = FFConfig(batch_size=8, strategy_store="none",
+                   compilation_cache="auto")
+    with pytest.raises(ValueError, match="no store is configured"):
+        enable_compilation_cache(cfg)
+
+
+# -- op-cost persistence: crash-safe + merge-on-save ------------------------
+
+def test_save_persistent_merges_concurrent_entries(tmp_path):
+    from flexflow_tpu.sim.machine_model import SimpleMachineModel
+    from flexflow_tpu.sim.simulator import OpCostModel
+
+    path = str(tmp_path / "op_costs.json")
+    machine = SimpleMachineModel(devices_per_node=1)
+    a = OpCostModel(machine, cache_path=path)
+    b = OpCostModel(machine, cache_path=path)  # both loaded empty
+    a._persistent["k_a"] = 1.0
+    a._dirty = True
+    b._persistent["k_b"] = 2.0
+    b._dirty = True
+    a.save_persistent()
+    b.save_persistent()  # must NOT clobber a's entry (merge-on-save)
+    with open(path) as f:
+        data = json.load(f)
+    assert data == {"k_a": 1.0, "k_b": 2.0}
+    # our own fresher measurement wins a key collision
+    a._persistent["k_b"] = 9.0
+    a._dirty = True
+    a.save_persistent()
+    with open(path) as f:
+        assert json.load(f)["k_b"] == 9.0
+
+
+def test_save_persistent_tolerates_wrong_shape_file(tmp_path):
+    from flexflow_tpu.sim.machine_model import SimpleMachineModel
+    from flexflow_tpu.sim.simulator import OpCostModel
+
+    path = str(tmp_path / "op_costs.json")
+    with open(path, "w") as f:
+        f.write("[1, 2, 3]")  # valid JSON, not a {key: float} mapping
+    machine = SimpleMachineModel(devices_per_node=1)
+    a = OpCostModel(machine, cache_path=path)
+    a._persistent["k"] = 1.0
+    a._dirty = True
+    a.save_persistent()  # must not crash the end of a search
+    with open(path) as f:
+        assert json.load(f) == {"k": 1.0}
+
+
+def test_save_persistent_crash_leaves_file_intact(tmp_path, monkeypatch):
+    from flexflow_tpu.sim.machine_model import SimpleMachineModel
+    from flexflow_tpu.sim.simulator import OpCostModel
+
+    path = str(tmp_path / "op_costs.json")
+    machine = SimpleMachineModel(devices_per_node=1)
+    a = OpCostModel(machine, cache_path=path)
+    a._persistent["k"] = 1.0
+    a._dirty = True
+    a.save_persistent()
+
+    b = OpCostModel(machine, cache_path=path)
+    b._persistent["k2"] = 2.0
+    b._dirty = True
+    monkeypatch.setattr(os, "replace",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("kill")))
+    with pytest.raises(OSError):
+        b.save_persistent()
+    monkeypatch.undo()
+    # the published file never went through a torn state, and the
+    # failed writer's tmp was cleaned up
+    with open(path) as f:
+        assert json.load(f) == {"k": 1.0}
+    assert [n for n in os.listdir(str(tmp_path))
+            if ".tmp-" in n] == []
+
+
+def test_cached_search_passthrough_without_store(devices8):
+    cfg = FFConfig(batch_size=8, num_devices=2, search_budget=0,
+                   strategy_store="none")
+    ff = _mlp(cfg)
+    calls = []
+
+    def fake_search():
+        calls.append(1)
+        from flexflow_tpu.strategy import data_parallel_strategy
+
+        return data_parallel_strategy(2)
+
+    s = cached_search(ff, 2, fake_search)
+    assert calls == [1]
+    assert getattr(s, "search_stats", None) is None  # untouched

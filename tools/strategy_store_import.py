@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Promote shipped strategy artifacts (examples/strategies/*.json) into
+a StrategyStore so runs hit the store instead of needing
+--import-strategy plumbing.
+
+The store is content-addressed by (graph signature, mesh fingerprint,
+simulator version), so an import must rebuild the FRONTEND graph the
+artifact was searched for and recompute the key under the SAME config
+the consuming run will compile with.  The builder registry is
+scripts/search_strategies.JOBS — the repo's single source of truth for
+shipped artifacts — so the promoted keys match what
+`FFModel.compile` computes for those models.
+
+Usage (hermetic CPU mesh, matching the artifacts' 8-device search):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/strategy_store_import.py --store /path/to/store [-n 8]
+
+`Strategy.load` / --import-strategy keep working unchanged — the store
+entry is an additional, verified, key-addressed copy.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, ".."))
+sys.path.insert(0, os.path.join(_HERE, "..", "scripts"))
+
+
+def import_default_jobs(store_root: str, strategies_dir: str,
+                        num_devices: int, overwrite: bool = False):
+    """Promote each JOBS artifact; returns [(name, digest, written)]."""
+    import search_strategies as ss  # scripts/ single source of truth
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.store import StrategyStore, store_key_for
+
+    store = StrategyStore(store_root)
+    results = []
+    for name, build, batch, cfg_kw in ss.JOBS:
+        path = os.path.join(strategies_dir, f"{name}.json")
+        if not os.path.exists(path):
+            print(f"skip {name}: no artifact at {path}")
+            continue
+        # the cfg the artifact was searched under (search_strategies
+        # _searched): budget 500 + the job's flags — the key must match
+        # what a consuming compile with that cfg computes
+        cfg = FFConfig(batch_size=batch, num_devices=num_devices,
+                       search_budget=500, **cfg_kw)
+        ff = FFModel(cfg)
+        getattr(ss, build)(ff, cfg)  # frontend graph only — no compile
+        key = store_key_for(cfg, ff.layers, num_devices)
+        written = store.import_strategy(
+            key, path, created_at=time.time(), overwrite=overwrite,
+            search_stats={"imported_job": name},
+        )
+        results.append((name, key.digest, written))
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--store", required=True,
+                   help="store root (FLEXFLOW_TPU_STORE_DIR of the fleet)")
+    p.add_argument("--strategies",
+                   default=os.path.join(_HERE, "..", "examples",
+                                        "strategies"),
+                   help="directory of shipped *.json artifacts")
+    p.add_argument("-n", "--num-devices", type=int, default=8,
+                   help="device count the artifacts were searched for")
+    p.add_argument("--overwrite", action="store_true",
+                   help="replace existing entries for matching keys")
+    args = p.parse_args(argv)
+
+    results = import_default_jobs(
+        args.store, os.path.abspath(args.strategies), args.num_devices,
+        overwrite=args.overwrite,
+    )
+    for name, digest, written in results:
+        state = "imported" if written else "kept existing"
+        print(f"{name}: {state} -> {digest[:16]}")
+    if not results:
+        print("nothing imported", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

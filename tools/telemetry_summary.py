@@ -100,6 +100,23 @@ def summarize(records: List[Dict]) -> str:
     ]
     out.append(_section("Search", rows))
 
+    rows = []
+    for name, rec in sorted(metrics.items()):
+        if not name.startswith("store/"):
+            continue
+        short = name.split("/", 1)[1]
+        if rec.get("kind") == "histogram":
+            # lookup latency: render the streaming summary
+            rows.append((
+                short,
+                f"n={rec.get('count', 0)} mean={_fmt(rec.get('mean', 0.0))} "
+                f"min={_fmt(rec.get('min', 0.0))} "
+                f"max={_fmt(rec.get('max', 0.0))}",
+            ))
+        else:
+            rows.append((short, rec.get("value", 0.0)))
+    out.append(_section("Store", rows))
+
     rows = [
         (name.split("/", 1)[1], rec.get("value", 0.0))
         for name, rec in sorted(metrics.items())
