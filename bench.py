@@ -853,6 +853,140 @@ def bench_serving(dev, on_tpu):
     }
 
 
+def bench_serving_resilience(dev, on_tpu):
+    """Replicated-front availability leg (manifest v12): the Poisson
+    workload of the serving leg against a 2-replica ServingFront with
+    a SEEDED replica kill (injected hung decode step -> StepWatchdog
+    taxonomy -> supervised restart) fired mid-run.  Reports
+    availability (completed/submitted — the acceptance bar is >= 0.99
+    with the fault injected), p99 TTFT before/during/after the fault
+    window, recovery time, and the requeue/restart counters.  Greedy
+    decoding keeps every completion token-identical to a fault-free
+    run — the front requeues stranded requests instead of failing
+    them."""
+    import time as _time
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+    from flexflow_tpu.obs.metrics import MetricsRegistry
+    from flexflow_tpu.resilience.faults import FaultKind, FaultPlan
+    from flexflow_tpu.serving import ServingFront
+    from flexflow_tpu.serving.loadgen import run_loadgen, sample_workload
+
+    leg = MANIFEST["legs"]["serving_resilience"]
+    if on_tpu:
+        vocab, max_seq = leg["vocab"], leg["max_seq"]
+        hidden, layers, heads = leg["hidden"], leg["layers"], leg["heads"]
+        inter, slots = leg["intermediate"], leg["slots"]
+        page, n_req = leg["kv_page_size"], leg["requests"]
+        rate, kill_step = leg["offered_rps"], leg["kill_step"]
+        plen_range = tuple(leg["prompt_len_range"])
+        mnt_range = tuple(leg["max_new_range"])
+    else:
+        vocab, max_seq = 64, 64
+        hidden, layers, heads, inter = 128, 2, 4, 256
+        slots, page, n_req, rate = 4, 8, 48, 400.0
+        plen_range, mnt_range = (2, 8), (2, 10)
+        kill_step = 80  # ~mid-run: the smoke workload spans ~150 steps
+
+    cfg = FFConfig(batch_size=slots, num_devices=1,
+                   serving_slots=slots, kv_page_size=page,
+                   serving_replicas=2, serving_step_timeout=0.0,
+                   serving_max_restarts=3, request_retry_limit=3)
+    ff = FFModel(cfg)
+    build_gpt(ff, batch_size=slots, seq_length=max_seq,
+              hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=inter, vocab_size=vocab)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (slots, max_seq)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                          (slots, max_seq)).copy()
+    ff.train_step({"input": ids, "positions": pos}, ids)  # real weights
+
+    reg = MetricsRegistry()
+    front = ServingFront.from_trained(
+        ff, devices=[dev], registry=reg, retry_backoff=0.01,
+        fault_plans={0: FaultPlan.single(kill_step,
+                                         FaultKind.HUNG_STEP)},
+    )
+    try:
+        # warm BOTH replicas' decode-step compiles before timing: more
+        # concurrent warm requests than one replica's slots forces the
+        # dispatcher to spread them
+        warm = [front.generate_async([1, 2], 2)
+                for _ in range(2 * slots)]
+        for h in warm:
+            h.wait(300.0)
+        wl_rng = np.random.RandomState(11)
+        workload = sample_workload(wl_rng, n_req, vocab,
+                                   prompt_len_range=plen_range,
+                                   max_new_range=mnt_range)
+        t0 = _time.monotonic()
+        report = run_loadgen(front, workload, rate, seed=7,
+                             detail=True)
+        rep0 = front.replicas[0]
+        # the rebuild pays a decode-twin compile, which can outlast a
+        # short smoke run — wait it out so recovery time is recorded
+        deadline = _time.monotonic() + 120.0
+        while (_time.monotonic() < deadline
+               and rep0.state == "restarting"):
+            _time.sleep(0.05)
+        death_s = (rep0.last_death_t - t0
+                   if rep0.last_death_t is not None else None)
+        recover_s = (rep0.last_live_t - t0
+                     if rep0.last_death_t is not None
+                     and rep0.last_live_t is not None
+                     and rep0.last_live_t > rep0.last_death_t else None)
+    finally:
+        front.close()
+
+    def p99(vals):
+        return (round(float(np.percentile(vals, 99)) * 1e3, 2)
+                if vals else None)
+
+    records = report.pop("records", [])
+    # the fault window runs from the death until the replica is LIVE
+    # again; on short smoke runs recovery can postdate the last request
+    fault_end = recover_s if recover_s is not None else float("inf")
+    before = [r["ttft_s"] for r in records
+              if r.get("ok") and death_s is not None
+              and r["submit_s"] < death_s]
+    during = [r["ttft_s"] for r in records
+              if r.get("ok") and death_s is not None
+              and death_s <= r["submit_s"] < fault_end]
+    after = [r["ttft_s"] for r in records
+             if r.get("ok") and recover_s is not None
+             and r["submit_s"] >= fault_end]
+    availability = report["completed"] / max(report["requests"], 1)
+    return {
+        "workload": (
+            f"{n_req} reqs, Poisson {rate} rps, 2 replicas, "
+            f"seeded replica-0 kill at decode step {kill_step}"
+        ),
+        "availability": round(availability, 4),
+        "completed": report["completed"],
+        "submitted": report["requests"],
+        "failures": report["failures"],
+        "fault": {
+            "death_at_s": round(death_s, 3) if death_s is not None else None,
+            "recovery_s": (round(rep0.last_recovery_s, 3)
+                           if rep0.last_recovery_s is not None else None),
+            "replica_deaths": sum(r.deaths for r in front.replicas),
+            "replica_restarts": sum(r.restarts for r in front.replicas),
+            "requeued_requests": front.requeued_requests,
+        },
+        "ttft_p99_ms": {
+            "before_fault": p99(before),
+            "during_fault": p99(during),
+            "after_recovery": p99(after),
+        },
+        "tokens_per_s": report.get("tokens_per_s", 0.0),
+    }
+
+
 def _outage_line(reason: str):
     # tunnel/backend outage: emit a diagnostic JSON line instead of a
     # stacktrace/hang so the capture records WHY there are no numbers
@@ -914,6 +1048,8 @@ def main():
     gc.collect()
     serving = bench_serving(dev, on_tpu)
     gc.collect()
+    serving_resilience = bench_serving_resilience(dev, on_tpu)
+    gc.collect()
     cold_start = bench_cold_start(dev, on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
@@ -934,6 +1070,7 @@ def main():
                  "bert_long_context": bert_long, "dlrm": dlrm,
                  "moe_dispatch": moe, "weight_update": wu,
                  "checkpoint": ckpt, "serving": serving,
+                 "serving_resilience": serving_resilience,
                  "cold_start": cold_start},
     }
     print(json.dumps(result))

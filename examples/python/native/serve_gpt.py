@@ -6,10 +6,17 @@ never reached).
 --serving-mode continuous (the default) runs the iteration-level
 scheduler on the paged KV-cache pool (serving/scheduler.py);
 --serving-mode static falls back to the whole-scan GenerationBatcher.
+Continuous mode always serves through a ServingFront
+(serving/front.py) — even --serving-replicas 1 gains the decode-step
+watchdog (--serving-step-timeout) and budget-capped restart
+supervision; N >= 2 adds queue handoff on replica death (requeues
+onto survivors) and /v2/health per-replica liveness aggregation.
 
 Run: python serve_gpt.py [-e STEPS] [-b BATCH]
                          [--serving-mode continuous|static]
                          [--kv-page-size N] [--serving-slots N]
+                         [--serving-replicas N]
+                         [--serving-step-timeout S]
 """
 import argparse
 import json
@@ -20,8 +27,7 @@ import numpy as np
 
 from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
 from flexflow_tpu.models.transformer import build_gpt
-from flexflow_tpu.serving import (ContinuousScheduler, GenerationBatcher,
-                                  GenerationEngine)
+from flexflow_tpu.serving import GenerationBatcher, GenerationEngine
 from flexflow_tpu.serving.server import serve_http
 
 V, S = 64, 24
@@ -59,9 +65,23 @@ def main():
         page = serving_cfg.kv_page_size
         if S % page:  # the demo model's position table is small
             page = 4
-        batcher = ContinuousScheduler.from_trained(
-            ff, batch_slots=serving_cfg.serving_slots, page_size=page,
-            num_blocks=serving_cfg.kv_pool_blocks or None)
+        # the front supervises even a SINGLE replica (watchdog +
+        # budget-capped restarts — the config.py contract for
+        # --serving-step-timeout at replicas=1), so continuous mode
+        # always serves through it
+        from flexflow_tpu.serving import ServingFront
+
+        ff.config.serving_replicas = serving_cfg.serving_replicas
+        ff.config.serving_slots = serving_cfg.serving_slots
+        ff.config.kv_page_size = page
+        ff.config.kv_pool_blocks = serving_cfg.kv_pool_blocks
+        ff.config.serving_step_timeout = \
+            serving_cfg.serving_step_timeout
+        ff.config.serving_max_restarts = \
+            serving_cfg.serving_max_restarts
+        ff.config.request_retry_limit = \
+            serving_cfg.request_retry_limit
+        batcher = ServingFront.from_trained(ff)
     else:
         engine = GenerationEngine(ff, batch_size=b)
         batcher = GenerationBatcher(engine, flush_timeout_s=0.02)

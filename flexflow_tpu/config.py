@@ -205,6 +205,15 @@ class FFConfig:
     kv_page_size: int = 16     # tokens per KV block (must divide max_seq)
     kv_pool_blocks: int = 0    # physical blocks incl. scratch; 0 = auto
     serving_slots: int = 8     # continuous decode batch slots
+    # replicated front (serving/front.py, docs/SERVING.md "Replicated
+    # front"): N supervised ContinuousScheduler replicas behind one
+    # admission queue.  1 = single supervised replica (still gains the
+    # watchdog + restart supervision); the decode-step watchdog is off
+    # at 0 like the training step_timeout.
+    serving_replicas: int = 1
+    serving_step_timeout: float = 0.0  # decode-step watchdog deadline, s
+    serving_max_restarts: int = 3      # per-replica restart budget
+    request_retry_limit: int = 2       # requeues before a 503 retriable
 
     def __post_init__(self):
         if self.serving_mode not in SERVING_MODES:
@@ -224,6 +233,25 @@ class FFConfig:
         if self.serving_slots < 1:
             raise ValueError(
                 f"serving_slots must be >= 1, got {self.serving_slots}"
+            )
+        if self.serving_replicas < 1:
+            raise ValueError(
+                f"serving_replicas must be >= 1, got {self.serving_replicas}"
+            )
+        if self.serving_step_timeout < 0:
+            raise ValueError(
+                f"serving_step_timeout must be >= 0 (0 = watchdog off), "
+                f"got {self.serving_step_timeout}"
+            )
+        if self.serving_max_restarts < 0:
+            raise ValueError(
+                f"serving_max_restarts must be >= 0, "
+                f"got {self.serving_max_restarts}"
+            )
+        if self.request_retry_limit < 0:
+            raise ValueError(
+                f"request_retry_limit must be >= 0, "
+                f"got {self.request_retry_limit}"
             )
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(
@@ -391,6 +419,15 @@ class FFConfig:
                        type=int, default=0)
         p.add_argument("--serving-slots", dest="serving_slots", type=int,
                        default=8)
+        p.add_argument("--serving-replicas", dest="serving_replicas",
+                       type=int, default=1)
+        p.add_argument("--serving-step-timeout",
+                       dest="serving_step_timeout", type=float,
+                       default=0.0)
+        p.add_argument("--serving-max-restarts",
+                       dest="serving_max_restarts", type=int, default=3)
+        p.add_argument("--request-retry-limit",
+                       dest="request_retry_limit", type=int, default=2)
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -450,6 +487,10 @@ class FFConfig:
             kv_page_size=args.kv_page_size,
             kv_pool_blocks=args.kv_pool_blocks,
             serving_slots=args.serving_slots,
+            serving_replicas=args.serving_replicas,
+            serving_step_timeout=args.serving_step_timeout,
+            serving_max_restarts=args.serving_max_restarts,
+            request_retry_limit=args.request_retry_limit,
         )
 
 

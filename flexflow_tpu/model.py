@@ -1293,6 +1293,14 @@ class FFModel:
             devices=devices if devices is not None else args["devices"],
         )
         self.set_weights(saved_w)
+        # optimizer slots mirror the weight tree (SGD v, Adam m/v), so
+        # a pipeline<->per-op strategy change re-maps them through the
+        # same layout adaptation; scalar entries (Adam t) pass through
+        saved_opt = {
+            k: self._adapt_weight_layout(sub) if isinstance(sub, dict)
+            else sub
+            for k, sub in saved_opt.items()
+        }
         self._opt_state = device_put_like(saved_opt, self._opt_state)
         self._state = device_put_like(saved_state, self._state)
         self._rng = saved_rng
@@ -1325,7 +1333,63 @@ class FFModel:
     def get_weights(self) -> Dict[str, Dict[str, np.ndarray]]:
         return jax.tree.map(np.asarray, self._weights)
 
+    def _adapt_weight_layout(self, weights):
+        """Convert a weight-shaped pytree between the per-op layout and
+        the pipeline-stacked layout (the '__pipeline__' group of
+        executor.py, keyed '<j>.<name>' with the block dim leading) to
+        match the CURRENT executor.  recompile carries trained state by
+        op/weight name across strategies; when exactly one side of the
+        carry is a PIPELINE strategy the names disagree — this is the
+        mapping that makes the carry land (ROADMAP: elastic recompile
+        onto a pipeline strategy died on this key mismatch)."""
+        plan = getattr(self.executor, "pipeline_plan", None)
+        has_stacked = "__pipeline__" in weights
+        if (plan is not None) == has_stacked:
+            return weights  # layouts already agree
+        if plan is not None:
+            # per-op -> stacked: gather each template weight across the
+            # L blocks onto a leading dim (matches init_weights' layout)
+            block_names = {op.name for blk in plan.blocks for op in blk}
+            out = {k: dict(v) for k, v in weights.items()
+                   if k not in block_names}
+            entry = {}
+            for j, t_op in enumerate(plan.blocks[0]):
+                for spec in t_op.weight_specs:
+                    entry[f"{j}.{spec.name}"] = np.stack([
+                        np.asarray(weights[blk[j].name][spec.name])
+                        for blk in plan.blocks
+                    ])
+            out["__pipeline__"] = entry
+            return out
+        # stacked -> per-op: unstack onto the block ops of the current
+        # graph (find_repeated_blocks is deterministic on the graph
+        # structure, so block order and template op order match the
+        # plan that produced the stacked tree)
+        from .pcg.segments import find_repeated_blocks
+
+        blocks = find_repeated_blocks(self.layers)
+        if not blocks:
+            raise ValueError(
+                "weights carry a '__pipeline__' group but the current "
+                "graph has no repeated block stack to unstack it onto"
+            )
+        out = {k: dict(v) for k, v in weights.items()
+               if k != "__pipeline__"}
+        for key, stacked in weights["__pipeline__"].items():
+            j_s, wname = key.split(".", 1)
+            j = int(j_s)
+            arr = np.asarray(stacked)
+            if arr.shape[0] != len(blocks):
+                raise ValueError(
+                    f"stacked weight {key!r} has {arr.shape[0]} block "
+                    f"layers but the graph repeats {len(blocks)} blocks"
+                )
+            for l, blk in enumerate(blocks):
+                out.setdefault(blk[j].name, {})[wname] = arr[l]
+        return out
+
     def set_weights(self, weights: Dict[str, Dict[str, np.ndarray]]):
+        weights = self._adapt_weight_layout(weights)
         shardings = self.executor.weight_shardings()
         self._weights = jax.tree.map(
             lambda v, s: jax.device_put(jnp.asarray(v), s), weights, shardings

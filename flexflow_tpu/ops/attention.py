@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel.shard_map_compat import shard_map as _shard_map
+
 from ..fftype import DataType, OperatorType
 from ..initializer import DEFAULT_WEIGHT_INIT, GlorotUniform
 from ..tensor import ParallelDim, ParallelTensorShape
@@ -552,7 +554,7 @@ class MultiHeadAttention(Op):
         batch_spec, _, head_spec = self._view_specs()
         spec = PartitionSpec(batch_spec, None, head_spec, None)
         fn = functools.partial(mha_flash, scale=scale, causal=p.causal)
-        return jax.shard_map(
+        return _shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )(qh, kh, vh)

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .shard_map_compat import shard_map as _shard_map
+
 
 def gpipe(
     stage_fn: Callable,
@@ -226,7 +228,7 @@ def pipelined_apply(
         lambda a: P(pp_axis, *([None] * (a.ndim - 1))), stacked_params
     )
     in_x = P(dp_axis, *([None] * (x.ndim - 1)))
-    return jax.shard_map(
+    return _shard_map(
         spmd,
         mesh=mesh,
         in_specs=(param_specs, in_x),
@@ -391,7 +393,7 @@ def make_pipelined_transformer_step(
 
     @jax.jit
     def ofob_step(params, x, y):
-        loss, grads = jax.shard_map(
+        loss, grads = _shard_map(
             spmd_1f1b, mesh=mesh,
             in_specs=(param_specs, in_x, in_y),
             out_specs=(P(), param_specs),

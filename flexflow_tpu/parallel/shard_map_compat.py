@@ -1,0 +1,23 @@
+"""shard_map across jax versions.
+
+`jax.shard_map` is the stable top-level API on newer jax; on older
+releases (e.g. the 0.4.x line) it lives at
+`jax.experimental.shard_map.shard_map` with `check_rep` in place of
+`check_vma`.  Every shard_map lowering in this repo (GPipe pipeline,
+ring/flash attention) routes through this one shim so the kernels run
+on whichever jax the host ships instead of dying on an AttributeError.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)

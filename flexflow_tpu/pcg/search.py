@@ -17,7 +17,9 @@ def mcmc_search(model, num_devices: int) -> Strategy:
     return mcmc_optimize(model, num_devices)
 
 
-def unity_search(model, num_devices: int) -> Strategy:
+def unity_search(model, num_devices: int,
+                 enable_pipeline: bool = True) -> Strategy:
     from .unity import unity_optimize
 
-    return unity_optimize(model, num_devices)
+    return unity_optimize(model, num_devices,
+                          enable_pipeline=enable_pipeline)

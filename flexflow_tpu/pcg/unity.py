@@ -106,6 +106,7 @@ class UnitySearch:
         weight_update_sharding: bool = False,
         wus_axis: str = "data",
         registry=None,
+        enable_pipeline: bool = True,
     ):
         # obs.metrics.MetricsRegistry (or None): final counters also
         # land in run telemetry, not just the log line
@@ -133,6 +134,11 @@ class UnitySearch:
         self.xfers = list(xfers) if xfers is not None else generate_all_pcg_xfers()
         self.enable_parameter_parallel = enable_parameter_parallel
         self.enable_attribute_parallel = enable_attribute_parallel
+        # pipeline-parallel candidates (_pp_candidates) can be switched
+        # off by callers whose carried state cannot map onto the GPipe
+        # stacked weight layout (the supervisor's elastic re-search —
+        # checkpoint reshard-restore is per-op-keyed)
+        self.enable_pipeline = enable_pipeline
         self.budget = budget  # 0 = unbounded; else cap on segment evaluations
         self.memory_budget = memory_budget
         self.optimizer_slots = optimizer_slots
@@ -1074,6 +1080,8 @@ class UnitySearch:
         from ..parallel.pipeline_plan import plan_pipeline
         from .segments import find_repeated_blocks
 
+        if not self.enable_pipeline:
+            return
         blocks = find_repeated_blocks(self.graph)
         L = len(blocks)
         if L < 2:
@@ -1246,7 +1254,8 @@ def _sync_mode(pst) -> str:
     return "allreduce"
 
 
-def unity_optimize(model, num_devices: int) -> Strategy:
+def unity_optimize(model, num_devices: int,
+                   enable_pipeline: bool = True) -> Strategy:
     """Entry used by FFModel.compile (reference GRAPH_OPTIMIZE_TASK_ID ->
     Graph::graph_optimize_task graph.cc:2046)."""
     from ..sim.machine_model import make_machine_model
@@ -1302,6 +1311,7 @@ def unity_optimize(model, num_devices: int) -> Strategy:
         registry=getattr(
             getattr(model, "telemetry", None), "metrics", None
         ),
+        enable_pipeline=enable_pipeline,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()

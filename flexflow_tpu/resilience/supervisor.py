@@ -157,6 +157,9 @@ class TrainingSupervisor:
             if preempt_grace is None else bool(preempt_grace)
         )
         self._preempt: Optional[str] = None
+        # survivor count -> pipeline-excluded re-search winner (see
+        # _search_strategy: these cannot ride the shared store)
+        self._np_strategies: Dict[int, object] = {}
         keep = cfg.checkpoint_keep if keep is None else keep
         if backend == "orbax":
             from ..checkpoint import CheckpointManager
@@ -184,6 +187,8 @@ class TrainingSupervisor:
             "re_searches": 0,
             "re_search_store_hits": 0,  # elastic re-searches answered
                                         # by the strategy store
+            "re_search_pipeline_excluded": 0,  # pipeline winners re-run
+                                               # without pp candidates
         }
 
     # -- deterministic batching -----------------------------------------
@@ -275,11 +280,12 @@ class TrainingSupervisor:
             from ..pcg.search import mcmc_search, unity_search
             from ..store import cached_search
 
-            def _run():
+            def _run(enable_pipeline: bool = True):
                 if cfg.search_algo == "mcmc":
                     s = mcmc_search(self.ff, num_devices)
                 else:
-                    s = unity_search(self.ff, num_devices)
+                    s = unity_search(self.ff, num_devices,
+                                     enable_pipeline=enable_pipeline)
                 # same pre-publish provenance stamp as FFModel.compile's
                 # search path: a store entry restored on another host
                 # must carry the catalog identity its rewrite trace was
@@ -287,10 +293,36 @@ class TrainingSupervisor:
                 self.ff._stamp_catalog(s)
                 return s
 
+            cached = self._np_strategies.get(num_devices)
+            if cached is not None:
+                # a previous loss at this survivor count already paid
+                # the pipeline-excluded re-search; reuse it instead of
+                # re-paying two searches in the recovery path
+                return cached
             strategy = cached_search(self.ff, num_devices, _run)
-            if (getattr(strategy, "search_stats", None) or {}).get(
+            if getattr(strategy, "pipeline", None):
+                # the carried state is restored from a PER-OP-keyed
+                # checkpoint; reshard-restore cannot map it onto the
+                # GPipe stacked weight layout mid-run (ROADMAP
+                # pre-existing bug) — re-search with pipeline
+                # candidates off.  Not published to the store (the
+                # entry for this key legitimately IS the pipeline
+                # winner for a fresh compile) but memoized in-process
+                # so repeated losses don't re-pay the double search.
+                self.counters["re_search_pipeline_excluded"] += 1
+                self.log.info(
+                    "elastic re-search for %d devices chose a pipeline "
+                    "strategy; excluding pipeline candidates (carried "
+                    "state cannot reshard onto the stacked layout)",
+                    num_devices,
+                )
+                strategy = _run(enable_pipeline=False)
+                self._np_strategies[num_devices] = strategy
+            elif (getattr(strategy, "search_stats", None) or {}).get(
                 "store_hit"
             ):
+                # counted only when the hit is actually USED (a
+                # discarded pipeline hit is not a fast path)
                 self.counters["re_search_store_hits"] += 1
             return strategy
         from ..strategy import data_parallel_strategy

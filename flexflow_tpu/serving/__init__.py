@@ -14,14 +14,24 @@ coalesced up front) and `ContinuousScheduler` runs CONTINUOUS
 (iteration-level) batches on a paged KV-cache pool — sequences are
 admitted and retired at every decode step, so heterogeneous lengths
 share device time and HBM instead of padding to the batch max.
+
+`ServingFront` (docs/SERVING.md "Replicated front") puts N supervised
+`ServingReplica`s — each a ContinuousScheduler under the resilience
+primitives (fault injection, decode-step watchdog, budget-capped
+restarts) — behind one admission queue: replica deaths requeue
+in-flight requests onto survivors instead of failing the service.
 """
 from .batcher import DynamicBatcher
 from .engine import InferenceEngine
+from .front import FrontRequest, ServiceUnavailable, ServingFront
 from .generation import GenerationBatcher, GenerationEngine
 from .kv_pool import KVPool
+from .replica import ServingReplica, SupervisedDecodeModel
 from .scheduler import ContinuousScheduler, PagedKVDecodeModel
 from .server import serve_http
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "GenerationEngine",
            "GenerationBatcher", "ContinuousScheduler",
-           "PagedKVDecodeModel", "KVPool", "serve_http"]
+           "PagedKVDecodeModel", "KVPool", "serve_http",
+           "ServingFront", "ServingReplica", "SupervisedDecodeModel",
+           "FrontRequest", "ServiceUnavailable"]

@@ -1,0 +1,466 @@
+"""ServingFront: one admission queue, N supervised replicas.
+
+PR 6's continuous engine is a single `ContinuousScheduler`: its death
+takes the whole service down with every queued and in-flight request.
+The front makes availability a property of the FLEET instead:
+
+  * **one shared admission queue.**  Requests are validated and queued
+    at the front; a dispatcher hands them to the least-loaded LIVE
+    replica, capped at each replica's decode-slot count, so a replica
+    death can only strand the bounded set it was actually running —
+    the backlog stays at the front, untouched (queue handoff).
+  * **supervised replicas** (serving/replica.py): each wraps a
+    `ContinuousScheduler` + decode model under the resilience
+    primitives — `StepWatchdog(step_timeout)` around the decode
+    dispatch, seeded `FaultPlan` injection, jittered-backoff
+    `RetryPolicy` with a restart budget, device-loss rebuilds on the
+    surviving mesh warmed through the strategy store.
+  * **requeue with a bounded retry count.**  A request stranded by a
+    replica death (or failed by a transient step fault) goes back to
+    the HEAD of the admission queue and runs again on a surviving
+    replica — greedy decoding makes the retry token-identical.  A
+    request that exhausts `request_retry_limit` fails with a 503
+    RETRIABLE error, never a client error: the front never punishes a
+    request it admitted.
+  * **load shedding, not unbounded queueing.**  While ZERO replicas
+    are live, new submissions are refused with `ServiceUnavailable`
+    (HTTP 503 + Retry-After via server.py) instead of growing the
+    queue without a server; already-admitted requests keep waiting for
+    the restart.  If every replica goes PERMANENTLY dead (budget
+    exhausted), the queue is failed retriably — no recovery is coming.
+
+API-compatible with the batcher contract (generate / generate_async /
+latency_stats / stats / close / worker_alive), plus `health()` for
+/v2/health's ok | degraded | down aggregation.  Metrics
+(serving/replica_restarts, replica_deaths, requeued_requests,
+shed_requests, per-replica queue-depth gauges) ride the shared
+obs.metrics registry.  docs/SERVING.md "Replicated front".
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..logger import resilience_logger
+from ..resilience.faults import FaultPlan
+from ..resilience.retry import RetryPolicy
+from .replica import ServingReplica
+
+
+class ServiceUnavailable(RuntimeError):
+    """The front cannot take (or finish) this request right now; the
+    client should back off and retry.  server.py maps it to HTTP 503
+    with a Retry-After header from `retry_after_s`."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class FrontRequest:
+    """Front-level future for one admitted request.  Mirrors the
+    scheduler handle surface the loadgen and server consume (wait /
+    t_submit / t_first_token / t_done / n_generated), independent of
+    which replica — or how many, after requeues — ran it."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "event",
+                 "result", "error", "t_submit", "t_first_token",
+                 "t_done", "n_generated", "retries")
+
+    def __init__(self, prompt, max_new_tokens, temperature):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.event = threading.Event()
+        self.result: Optional[List[int]] = None
+        self.error: Optional[Exception] = None
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.n_generated = 0
+        self.retries = 0  # requeues consumed (replica deaths/faults)
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.event.wait(timeout):
+            raise TimeoutError("generation request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class ServingFront:
+    """N supervised ContinuousScheduler replicas behind one queue.
+
+    `model_factory(replica_id, survivors=None)` builds one replica's
+    decode model (see ServingReplica).  `fault_plans` optionally maps
+    replica id -> FaultPlan for seeded fault injection; `step_timeout`
+    arms each replica's decode-step watchdog; `max_restarts` /
+    `retry_backoff` bound each replica's supervised restarts;
+    `request_retry_limit` bounds per-request requeues.
+    """
+
+    def __init__(
+        self,
+        model_factory: Callable,
+        num_replicas: int = 2,
+        *,
+        eos_id: int = -1,
+        registry=None,
+        seed: int = 0,
+        step_timeout: float = 0.0,
+        max_restarts: int = 3,
+        retry_backoff: float = 0.1,
+        request_retry_limit: int = 2,
+        fault_plans: Optional[Dict[int, FaultPlan]] = None,
+        latency_window: int = 1024,
+        close_timeout_s: float = 5.0,
+        shed_retry_after_s: float = 1.0,
+        sleep: Callable[[float], None] = time.sleep,
+        logger=resilience_logger,
+    ):
+        if num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {num_replicas}")
+        if request_retry_limit < 0:
+            raise ValueError(
+                f"request_retry_limit must be >= 0, "
+                f"got {request_retry_limit}")
+        self.registry = registry
+        self.request_retry_limit = int(request_retry_limit)
+        self.shed_retry_after_s = float(shed_retry_after_s)
+        self.log = logger
+        self._cv = threading.Condition()
+        self._admission: "deque[FrontRequest]" = deque()
+        self._closed = False
+        self.requests_done = 0
+        self.shed_requests = 0
+        self.requeued_requests = 0
+        self._latencies = deque(maxlen=latency_window)
+        self._ttfts = deque(maxlen=latency_window)
+        self._lat_lock = threading.Lock()
+        plans = fault_plans or {}
+        self.replicas: List[ServingReplica] = [
+            ServingReplica(
+                i, model_factory,
+                eos_id=eos_id, registry=registry,
+                seed=seed,
+                step_timeout=step_timeout,
+                retry=RetryPolicy(max_restarts=max_restarts,
+                                  base_backoff=retry_backoff, seed=seed + i),
+                fault_plan=plans.get(i),
+                close_timeout_s=close_timeout_s,
+                sleep=sleep,
+                logger=logger,
+            )
+            for i in range(num_replicas)
+        ]
+        self.max_seq = self.replicas[0].scheduler.model.max_seq
+        for r in self.replicas:
+            r.on_state_change = self._on_replica_state
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="serving-front-dispatch",
+        )
+        self._dispatcher.start()
+
+    @classmethod
+    def from_trained(cls, ff_train, num_replicas: Optional[int] = None,
+                     *, devices=None, eos_id: int = -1, registry=None,
+                     fault_plans: Optional[Dict[int, FaultPlan]] = None,
+                     **kw) -> "ServingFront":
+        """Replicated front over a trained GPT, honoring the FFConfig
+        serving knobs (--serving-replicas / --serving-step-timeout /
+        --serving-max-restarts / --request-retry-limit plus the PR 6
+        pool geometry).  Each replica compiles its own paged decode
+        twin; with the strategy store configured the N-1 later compiles
+        (and every post-death rebuild) restore instead of re-searching
+        (docs/STORE.md).  A device-loss rebuild truncates `devices` to
+        the surviving count."""
+        from .scheduler import PagedKVDecodeModel
+
+        cfg = ff_train.config
+
+        def factory(replica_id, survivors=None):
+            devs = devices
+            if survivors is not None and devs is not None:
+                devs = devs[:survivors]
+            return PagedKVDecodeModel(
+                ff_train,
+                batch_slots=cfg.serving_slots,
+                page_size=cfg.kv_page_size,
+                num_blocks=cfg.kv_pool_blocks or None,
+                devices=devs,
+            )
+
+        kw.setdefault("step_timeout", cfg.serving_step_timeout)
+        kw.setdefault("max_restarts", cfg.serving_max_restarts)
+        kw.setdefault("request_retry_limit", cfg.request_retry_limit)
+        kw.setdefault("seed", cfg.seed)
+        return cls(
+            factory,
+            cfg.serving_replicas if num_replicas is None else num_replicas,
+            eos_id=eos_id, registry=registry, fault_plans=fault_plans,
+            **kw,
+        )
+
+    # -- replica events --------------------------------------------------
+    def _on_replica_state(self, replica: ServingReplica) -> None:
+        with self._cv:
+            self._cv.notify_all()
+
+    def _live(self) -> List[ServingReplica]:
+        return [r for r in self.replicas if r.alive]
+
+    def _all_permanently_dead(self) -> bool:
+        return all(r.state == "dead" for r in self.replicas)
+
+    # -- client API ------------------------------------------------------
+    def generate_async(self, prompt, max_new_tokens: int = 16,
+                       temperature: float = 0.0) -> FrontRequest:
+        if self._closed:
+            raise RuntimeError("ServingFront is closed")
+        # validate at admission (the batcher convention: a bad request
+        # fails alone, synchronously, as a client error)
+        req = FrontRequest(prompt, max_new_tokens, temperature)
+        if not 1 <= len(req.prompt) < self.max_seq:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} outside "
+                f"[1, {self.max_seq})")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with self._cv:
+            if not self._live():
+                # all replicas down: shed instead of queueing against
+                # a service that may never come back
+                self.shed_requests += 1
+                if self.registry is not None:
+                    self.registry.counter("serving/shed_requests").inc()
+                raise ServiceUnavailable(
+                    "all serving replicas are down",
+                    retry_after_s=self.shed_retry_after_s,
+                )
+            self._admission.append(req)
+            self._cv.notify_all()
+        return req
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 temperature: float = 0.0,
+                 timeout: Optional[float] = 60.0) -> List[int]:
+        return self.generate_async(
+            prompt, max_new_tokens, temperature).wait(timeout)
+
+    # -- dispatch --------------------------------------------------------
+    def _pick_replica(self) -> Optional[ServingReplica]:
+        """Least-outstanding live replica with dispatch headroom (the
+        cap keeps the backlog at the FRONT, where a replica death
+        can't strand it)."""
+        best = None
+        for r in self.replicas:
+            sched = r.scheduler  # may concurrently flip to None on death
+            if r.state != "live" or sched is None:
+                continue
+            if r.outstanding >= sched.model.batch_slots:
+                continue
+            if best is None or r.outstanding < best.outstanding:
+                best = r
+        return best
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                replica = None
+                while not self._closed:
+                    if self._admission:
+                        if self._all_permanently_dead():
+                            break
+                        replica = self._pick_replica()
+                        if replica is not None:
+                            break
+                    self._cv.wait(0.2)
+                if self._closed:
+                    return
+                req = self._admission.popleft()
+                if replica is None:  # every replica permanently dead
+                    self._fail(req, ServiceUnavailable(
+                        "all serving replicas are permanently dead "
+                        "(restart budgets exhausted)",
+                        retry_after_s=self.shed_retry_after_s,
+                    ))
+                    continue
+                replica.outstanding += 1
+                self._observe_depth(replica)
+            try:
+                replica.submit(
+                    req.prompt, req.max_new_tokens, req.temperature,
+                    on_done=lambda h, _req=req, _r=replica:
+                        self._on_settle(_req, _r, h),
+                )
+            except ValueError as e:
+                # pool geometry can never serve it: the request's
+                # problem, fail alone
+                with self._cv:
+                    replica.outstanding -= 1
+                    self._observe_depth(replica)
+                self._fail(req, e)
+            except Exception:
+                # the replica died between pick and submit: back to the
+                # queue head (dispatch never started — no retry spent)
+                with self._cv:
+                    replica.outstanding -= 1
+                    self._observe_depth(replica)
+                    self._admission.appendleft(req)
+
+    def _observe_depth(self, replica: ServingReplica) -> None:
+        if self.registry is not None:
+            self.registry.gauge(
+                f"serving/replica/{replica.replica_id}/queue_depth"
+            ).set(replica.outstanding)
+
+    # -- settlement ------------------------------------------------------
+    def _fail(self, req: FrontRequest, err: Exception) -> None:
+        req.error = err
+        req.event.set()
+
+    def _complete(self, req: FrontRequest, handle) -> None:
+        req.result = handle.result
+        req.n_generated = handle.n_generated
+        req.t_first_token = handle.t_first_token
+        req.t_done = handle.t_done or time.monotonic()
+        with self._lat_lock:
+            self._latencies.append(req.t_done - req.t_submit)
+            if req.t_first_token is not None:
+                self._ttfts.append(req.t_first_token - req.t_submit)
+            # settles arrive from every replica's worker thread; the
+            # += below is not atomic, so it rides the same lock
+            self.requests_done += 1
+        req.event.set()
+
+    def _on_settle(self, req: FrontRequest, replica: ServingReplica,
+                   handle) -> None:
+        """Completion hook, fired once per replica-side handle on
+        whichever thread settled it (decode loop, drain, or the
+        submit-raced close path)."""
+        with self._cv:
+            replica.outstanding -= 1
+            self._observe_depth(replica)
+            self._cv.notify_all()
+        err = handle.error
+        if err is None:
+            self._complete(req, handle)
+            return
+        if isinstance(err, ValueError):
+            self._fail(req, err)  # unservable as posed, retry won't help
+            return
+        if self._closed:
+            self._fail(req, RuntimeError("ServingFront is closed"))
+            return
+        # replica death, hung step, or transient step fault: the
+        # request was ADMITTED, so it never gets a non-retriable error
+        req.retries += 1
+        if req.retries > self.request_retry_limit:
+            self._fail(req, ServiceUnavailable(
+                f"request failed {req.retries} times across replicas "
+                f"(last: {type(err).__name__}: {err})",
+                retry_after_s=self.shed_retry_after_s,
+            ))
+            return
+        self.requeued_requests += 1
+        if self.registry is not None:
+            self.registry.counter("serving/requeued_requests").inc()
+        with self._cv:
+            if self._closed:
+                # close() may have drained the queue between the check
+                # above and here; a late requeue would park the client
+                # for its full timeout with no dispatcher left
+                self._fail(req, RuntimeError("ServingFront is closed"))
+                return
+            self._admission.appendleft(req)  # keep its seniority
+            self._cv.notify_all()
+
+    # -- stats / health --------------------------------------------------
+    @property
+    def worker_alive(self) -> bool:
+        return self._dispatcher.is_alive() and not self._all_permanently_dead()
+
+    @property
+    def batches_run(self) -> int:
+        return sum(r.stats()["batches_run"] for r in self.replicas)
+
+    @property
+    def tokens_generated(self) -> int:
+        return sum(r.stats()["tokens_generated"] for r in self.replicas)
+
+    def latency_stats(self) -> Dict[str, float]:
+        from .batcher import latency_percentiles
+
+        return latency_percentiles(self._latencies, self._lat_lock)
+
+    def ttft_stats(self) -> Dict[str, float]:
+        from .batcher import latency_percentiles
+
+        return latency_percentiles(self._ttfts, self._lat_lock)
+
+    def health(self) -> Dict:
+        """ok = every replica live; degraded = some down, still
+        serving; down = nothing live (server.py rides this to HTTP
+        200/200/503)."""
+        live = len(self._live())
+        n = len(self.replicas)
+        if self._closed or live == 0:
+            status = "down"
+        elif live == n:
+            status = "ok"
+        else:
+            status = "degraded"
+        return {
+            "status": status,
+            "replicas_live": live,
+            "replicas": [
+                {"id": r.replica_id, "state": r.state,
+                 "restarts": r.restarts, "deaths": r.deaths}
+                for r in self.replicas
+            ],
+        }
+
+    def stats(self) -> Dict:
+        with self._cv:
+            queued = len(self._admission)
+            replicas = [r.stats() for r in self.replicas]
+        if self.registry is not None:
+            self.registry.gauge("serving/replicas_live").set(
+                len(self._live()))
+        return {
+            "mode": "replicated",
+            "replicas_live": len(self._live()),
+            "queue_depth": queued + sum(r["outstanding"]
+                                        for r in replicas),
+            "requests_done": self.requests_done,
+            "requeued_requests": self.requeued_requests,
+            "shed_requests": self.shed_requests,
+            "tokens_generated": sum(r["tokens_generated"]
+                                    for r in replicas),
+            "steps": sum(r["batches_run"] for r in replicas),
+            "ttft": self.ttft_stats(),
+            "latency": self.latency_stats(),
+            "replicas": replicas,
+        }
+
+    # -- shutdown --------------------------------------------------------
+    def close(self, timeout_s: Optional[float] = None):
+        """Stop dispatching, close every replica (each close is
+        BOUNDED — a wedged decode step cannot hang front shutdown),
+        and fail whatever is still queued, promptly."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        self._dispatcher.join(timeout=2.0)
+        for r in self.replicas:
+            r.close(timeout_s)
+        err = RuntimeError("ServingFront is closed")
+        with self._cv:
+            while self._admission:
+                self._fail(self._admission.popleft(), err)

@@ -61,29 +61,47 @@ def sample_workload(rng: np.random.RandomState, n_requests: int,
 
 def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
                 temperature: float = 0.0, timeout_s: float = 120.0,
-                on_submit: Optional[Callable] = None) -> Dict:
+                on_submit: Optional[Callable] = None,
+                detail: bool = False) -> Dict:
     """Fire `requests` [(prompt, max_new_tokens), ...] at Poisson
     arrivals of `rate_rps`, wait for completion, report SLOs.
 
     Failed/timed-out requests are counted, excluded from latency
     summaries, and never crash the run (the server keeps them going;
-    the loadgen just stops waiting)."""
+    the loadgen just stops waiting).
+
+    detail=True adds per-request `records` (submit_s relative to the
+    run start, ok, ttft_s, done_s) covering failures too — the
+    serving_resilience bench leg buckets these around a fault window."""
     rng = np.random.RandomState(seed)
     gaps = rng.exponential(1.0 / rate_rps, size=len(requests))
     t0 = time.monotonic()
     next_at = t0
     handles = []
+    results = []
+    records = []
+    failures = 0
     for (prompt, mnt), gap in zip(requests, gaps):
         next_at += gap
         delay = next_at - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        h = batcher.generate_async(prompt, mnt, temperature)
+        try:
+            h = batcher.generate_async(prompt, mnt, temperature)
+        except Exception:
+            # refused at admission (a replicated front sheds with 503
+            # + Retry-After while zero replicas are live): a failure
+            # for the report, never a crash — open-loop arrivals keep
+            # firing at the clock
+            failures += 1
+            records.append({
+                "submit_s": round(time.monotonic() - t0, 4),
+                "ok": False, "rejected": True,
+            })
+            continue
         handles.append((h, len(prompt), mnt))
         if on_submit is not None:
             on_submit(h)
-    results = []
-    failures = 0
     # ONE deadline across all waits (the server.py /v2/generate
     # convention): a wedged engine costs ~timeout_s total, not
     # timeout_s per outstanding handle
@@ -93,6 +111,8 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
             toks = h.wait(max(0.0, wait_deadline - time.monotonic()))
         except Exception:
             failures += 1
+            records.append({"submit_s": round(h.t_submit - t0, 4),
+                            "ok": False})
             continue
         # every handle flavor stamps t_submit at generate_async time —
         # the loadgen's submit clock.  t_done/t_first_token exist only
@@ -110,12 +130,17 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
             "n_generated": n_gen,
             "gen_s": t_done - t_first,
         })
+        records.append({"submit_s": round(t_submit - t0, 4), "ok": True,
+                        "ttft_s": round(t_first - t_submit, 4),
+                        "done_s": round(t_done - t0, 4)})
     report = {
         "offered_rps": rate_rps,
         "requests": len(requests),
         "completed": len(results),
         "failures": failures,
     }
+    if detail:
+        report["records"] = records
     if results:
         makespan = max(r["done"] for r in results) - t0
         total_tokens = sum(r["n_generated"] for r in results)

@@ -1,0 +1,319 @@
+"""Supervised serving replica: one ContinuousScheduler under the
+training-side resilience primitives (resilience/).
+
+The training supervisor (resilience/supervisor.py) classifies failures
+into transients (restore + retry) and device-loss-style faults
+(re-search + recompile + reshard-restore).  A serving replica inherits
+the same taxonomy, adapted to a stateless decode engine:
+
+  * **transient step exception** — the scheduler's existing per-step
+    handling stands: only the in-flight batch fails (the front requeues
+    those requests), the replica keeps serving;
+  * **hung decode step** — the decode dispatch runs under a
+    `StepWatchdog(step_timeout)`; a dispatch that never returns raises
+    `HungStepTimeout` instead of wedging the worker forever.  That (and
+    its injected twin `HungStepFault`) is FATAL to the engine: the
+    wedged collective state only resets with a rebuilt engine;
+  * **device loss** — `DeviceLossFault(survivors=k)` kills the engine
+    and the rebuild happens on the surviving device count; the model
+    factory's compile consults the strategy store's degraded-mesh key
+    first (docs/STORE.md), so the re-search is warm whenever any
+    replica or training run has paid it before.
+
+Fatal faults are marked with ``fatal_to_engine = True`` — the
+scheduler's contract for "drain everything and die" (scheduler.py) —
+which fires the replica's `on_death` hook.  The replica's supervisor
+thread then restarts the engine under a jittered-backoff `RetryPolicy`
+with a hard restart budget; a replica that outruns the budget goes
+permanently ``dead`` and `/v2/health` says so.
+
+Fault injection is the training side's seeded `FaultPlan`: the plan's
+step index counts DECODE steps (cumulative across restarts), so a
+replica-kill benchmark replays exactly (bench.py serving_resilience).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..logger import resilience_logger
+from ..resilience.faults import DeviceLossFault, FaultPlan, HungStepFault
+from ..resilience.retry import RetryPolicy
+from ..resilience.watchdog import HungStepTimeout, StepWatchdog
+from .scheduler import ContinuousScheduler
+
+#: failures that kill the ENGINE, not just the in-flight batch — the
+#: supervisor answers them with a restart (cf. supervisor.HUNG_FAULTS)
+FATAL_DECODE_FAULTS = (DeviceLossFault, HungStepFault, HungStepTimeout)
+
+#: per-replica scheduler counters folded into `stats()` across restarts
+_CARRIED_COUNTERS = ("batches_run", "requests_done", "tokens_generated",
+                     "step_failures")
+
+
+class SupervisedDecodeModel:
+    """Decode-model wrapper adding the resilience instrumentation to
+    every step: seeded fault injection, then the watchdog-bounded
+    dispatch.  Proxies the geometry attributes ContinuousScheduler
+    reads (batch_slots, page_size, num_blocks, ...)."""
+
+    def __init__(self, model, watchdog: StepWatchdog,
+                 fault_plan: FaultPlan, step_counter):
+        self._model = model
+        self._watchdog = watchdog
+        self._fault_plan = fault_plan
+        self._steps = step_counter  # replica-lifetime, restart-spanning
+        for name in ("batch_slots", "page_size", "num_blocks",
+                     "max_blocks_per_seq", "max_seq", "vocab"):
+            setattr(self, name, getattr(model, name))
+
+    def reset(self):
+        reset = getattr(self._model, "reset", None)
+        if reset is not None:
+            reset()
+
+    def step(self, tokens, seq_lens, block_tables):
+        idx = next(self._steps)
+        try:
+            self._fault_plan.check_step(idx)
+            return self._watchdog.sync(
+                lambda: self._model.step(tokens, seq_lens, block_tables),
+                step=idx,
+            )
+        except FATAL_DECODE_FAULTS as e:
+            # the scheduler must drain-and-die, not fail-in-flight-only
+            e.fatal_to_engine = True
+            raise
+
+
+class ServingReplica:
+    """One supervised engine slot of a ServingFront.
+
+    `model_factory(replica_id, survivors=None)` builds the decode model
+    (a PagedKVDecodeModel for real GPTs; anything with the same step
+    contract in tests).  `survivors` is the device count a
+    DeviceLossFault left standing — a real factory maps it to a device
+    list and recompiles, which consults the strategy store's
+    degraded-mesh key before paying a search (docs/STORE.md).
+
+    States: ``live`` (serving), ``restarting`` (death observed, rebuild
+    pending/underway), ``dead`` (restart budget exhausted — permanent),
+    ``closed``.  `on_state_change` (set by the front) fires on every
+    transition so the dispatcher never polls.
+    """
+
+    def __init__(
+        self,
+        replica_id: int,
+        model_factory: Callable,
+        *,
+        eos_id: int = -1,
+        registry=None,
+        seed: int = 0,
+        step_timeout: float = 0.0,
+        retry: Optional[RetryPolicy] = None,
+        fault_plan: Optional[FaultPlan] = None,
+        close_timeout_s: float = 5.0,
+        sleep: Callable[[float], None] = time.sleep,
+        logger=resilience_logger,
+    ):
+        self.replica_id = int(replica_id)
+        self.model_factory = model_factory
+        self.eos_id = int(eos_id)
+        self.registry = registry
+        self.seed = int(seed)
+        self.retry = retry or RetryPolicy()
+        self.fault_plan = fault_plan or FaultPlan()
+        self.watchdog = StepWatchdog(step_timeout)
+        self.close_timeout_s = float(close_timeout_s)
+        self.sleep = sleep
+        self.log = logger
+        self.on_state_change: Optional[Callable] = None
+        # dispatch bookkeeping owned by the front (under ITS lock)
+        self.outstanding = 0
+        self.state = "restarting"  # -> live after the first build
+        self.restarts = 0       # successful rebuilds
+        self.deaths = 0         # fatal engine exits observed
+        self.last_death_t: Optional[float] = None
+        self.last_live_t: Optional[float] = None
+        self.last_recovery_s: Optional[float] = None
+        self.last_error: Optional[Exception] = None
+        self.scheduler: Optional[ContinuousScheduler] = None
+        self._steps = itertools.count()  # decode-step index, all lives
+        self._carried: Dict[str, int] = {k: 0 for k in _CARRIED_COUNTERS}
+        self._survivors: Optional[int] = None
+        self._death_evt = threading.Event()
+        self._closed = False
+        self._build()
+        self._set_state("live")
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True,
+            name=f"serving-replica-{replica_id}",
+        )
+        self._supervisor.start()
+
+    # -- state ----------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        return self.state == "live" and self.scheduler is not None
+
+    def _set_state(self, state: str) -> None:
+        if self._closed and state != "closed":
+            return  # a rebuild that raced close() must not resurrect us
+        self.state = state
+        if state == "live":
+            self.last_live_t = time.monotonic()
+            if self.last_death_t is not None:
+                self.last_recovery_s = self.last_live_t - self.last_death_t
+        hook = self.on_state_change
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:  # noqa: BLE001 — never kill the supervisor
+                pass
+
+    def _count(self, name: str) -> None:
+        if self.registry is not None:
+            self.registry.counter(f"serving/{name}").inc()
+
+    # -- engine lifecycle ------------------------------------------------
+    def _build(self) -> None:
+        model = self.model_factory(self.replica_id,
+                                   survivors=self._survivors)
+        wrapped = SupervisedDecodeModel(model, self.watchdog,
+                                        self.fault_plan, self._steps)
+        self.scheduler = ContinuousScheduler(
+            wrapped,
+            eos_id=self.eos_id,
+            registry=self.registry,
+            seed=self.seed + 7919 * self.replica_id,
+            close_timeout_s=self.close_timeout_s,
+            on_death=self._on_death,
+        )
+
+    def _on_death(self, exc: Exception) -> None:
+        """Runs on the dying scheduler worker: record why, flip the
+        state so the dispatcher stops routing here, and wake the
+        supervisor thread to do the heavy rebuild off this stack."""
+        self.last_error = exc
+        self.last_death_t = time.monotonic()
+        if isinstance(exc, DeviceLossFault):
+            self._survivors = exc.survivors
+        self.deaths += 1
+        self._count("replica_deaths")
+        self.log.info("serving replica %d died: %s", self.replica_id, exc)
+        self._set_state("restarting")
+        self._death_evt.set()
+
+    def _fold_carried(self) -> None:
+        sched = self.scheduler
+        if sched is None:
+            return
+        for k in _CARRIED_COUNTERS:
+            self._carried[k] += int(getattr(sched, k, 0))
+
+    def _supervise(self) -> None:
+        """Restart loop: each observed death costs one unit of the
+        retry budget; past the budget the replica is permanently dead
+        (a replica that dies on every rebuild must fail loudly, not
+        flap forever)."""
+        while True:
+            self._death_evt.wait()
+            self._death_evt.clear()
+            if self._closed:
+                return
+            self._fold_carried()
+            self.scheduler = None
+            attempt = self.deaths
+            if not self.retry.admits(attempt):
+                self._set_state("dead")
+                self.log.info(
+                    "serving replica %d: restart budget (%d) exhausted — "
+                    "permanently dead", self.replica_id,
+                    self.retry.max_restarts,
+                )
+                continue  # stay parked until close()
+            self.sleep(self.retry.backoff(attempt))
+            if self._closed:
+                return
+            try:
+                self._build()
+            except Exception as e:  # noqa: BLE001 — a failed rebuild is
+                # another death: budget-capped, never an escaped crash
+                self.last_error = e
+                self.deaths += 1
+                self._count("replica_deaths")
+                self.log.info(
+                    "serving replica %d rebuild failed: %s",
+                    self.replica_id, e,
+                )
+                self._death_evt.set()
+                continue
+            if self._closed:
+                # close() raced the rebuild (its bounded join expired
+                # while _build was compiling): the fresh engine must
+                # not leak a worker thread or flip us back to live
+                sched = self.scheduler
+                self.scheduler = None
+                if sched is not None:
+                    sched.close(self.close_timeout_s)
+                return
+            self.restarts += 1
+            self._count("replica_restarts")
+            self._survivors_note()
+            self._set_state("live")
+
+    def _survivors_note(self) -> None:
+        if self._survivors is not None:
+            self.log.info(
+                "serving replica %d restarted on %d surviving devices "
+                "(restart %d)", self.replica_id, self._survivors,
+                self.restarts,
+            )
+        else:
+            self.log.info("serving replica %d restarted (restart %d)",
+                          self.replica_id, self.restarts)
+
+    # -- front-facing ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens, temperature, on_done):
+        sched = self.scheduler
+        if self.state != "live" or sched is None:
+            raise RuntimeError(
+                f"serving replica {self.replica_id} is {self.state}")
+        return sched.generate_async(prompt, max_new_tokens, temperature,
+                                    on_done=on_done)
+
+    def stats(self) -> Dict:
+        sched = self.scheduler
+        out = {
+            "id": self.replica_id,
+            "state": self.state,
+            "restarts": self.restarts,
+            "deaths": self.deaths,
+            "outstanding": self.outstanding,
+            "last_recovery_s": self.last_recovery_s,
+        }
+        for k in _CARRIED_COUNTERS:
+            out[k] = self._carried[k] + int(getattr(sched, k, 0) or 0)
+        if sched is not None:
+            out["queue_depth"] = sched.stats()["queue_depth"]
+        return out
+
+    def close(self, timeout_s: Optional[float] = None) -> None:
+        self._closed = True
+        self._death_evt.set()  # unpark the supervisor so it exits
+        bound = timeout_s if timeout_s is not None else self.close_timeout_s
+        sched = self.scheduler
+        if sched is not None:
+            sched.close(bound)
+        self._supervisor.join(timeout=2.0)
+        # a rebuild may have landed between the close above and the
+        # supervisor noticing _closed; the supervisor's own post-build
+        # check handles the still-in-_build case
+        sched = self.scheduler
+        self.scheduler = None
+        if sched is not None:
+            sched.close(bound)
+        self._set_state("closed")

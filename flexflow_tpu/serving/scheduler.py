@@ -114,13 +114,20 @@ class PagedKVDecodeModel:
 class _PendingSeq:
     """Future-style handle for one continuous-mode request.  Besides
     the final token list it records the SLO timestamps the loadgen and
-    telemetry consume: submit, first generated token (TTFT), done."""
+    telemetry consume: submit, first generated token (TTFT), done.
+
+    `on_done` (set at submission, never after) fires exactly once when
+    the request settles — success, fault, or drain — on whichever
+    thread settled it.  The replicated front (serving/front.py) rides
+    it to route completions/requeues without polling handles."""
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
                  "event", "result", "error", "t_submit", "t_first_token",
-                 "t_done", "n_generated")
+                 "t_done", "n_generated", "on_done", "_settle_lock",
+                 "_settled")
 
-    def __init__(self, prompt, max_new_tokens, temperature, seed):
+    def __init__(self, prompt, max_new_tokens, temperature, seed,
+                 on_done=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -132,6 +139,24 @@ class _PendingSeq:
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
         self.n_generated = 0
+        self.on_done = on_done
+        self._settle_lock = threading.Lock()
+        self._settled = False
+
+    def _settle(self) -> None:
+        """Wake the waiter and fire the completion hook — exactly once,
+        even when a drain races the submit path's late-enqueue check
+        (both may settle the same request; the second is a no-op)."""
+        with self._settle_lock:
+            if self._settled:
+                return
+            self._settled = True
+        self.event.set()
+        if self.on_done is not None:
+            try:
+                self.on_done(self)
+            except Exception:  # noqa: BLE001 — a hook must never kill
+                pass           # the decode loop or a drain
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.event.wait(timeout):
@@ -169,7 +194,8 @@ class ContinuousScheduler:
     def __init__(self, model, pool: Optional[KVPool] = None,
                  eos_id: int = -1, registry=None, seed: int = 0,
                  latency_window: int = 1024,
-                 close_timeout_s: float = 60.0):
+                 close_timeout_s: float = 60.0,
+                 on_death=None):
         self.model = model
         self.pool = pool or KVPool(
             model.num_blocks, model.page_size, model.max_blocks_per_seq)
@@ -194,6 +220,9 @@ class ContinuousScheduler:
         self._next_seq_id = 0
         self._seed = itertools.count(int(seed) + 1)
         self._close_timeout_s = float(close_timeout_s)
+        # fired (with the exception) when the worker dies on a fault —
+        # NOT on a clean close.  The replica supervisor's death signal.
+        self._on_death = on_death
         self.batches_run = 0       # decode steps executed
         self.requests_done = 0
         self.tokens_generated = 0
@@ -221,14 +250,17 @@ class ContinuousScheduler:
             prompt, max_new_tokens, temperature).wait(timeout)
 
     def generate_async(self, prompt, max_new_tokens: int = 16,
-                       temperature: float = 0.0) -> _PendingSeq:
+                       temperature: float = 0.0,
+                       on_done=None) -> _PendingSeq:
         if self._stop.is_set():
             raise RuntimeError("ContinuousScheduler is closed")
         # validate HERE so a bad request fails alone (the batcher
         # convention); continuous mode has no same-temperature
-        # restriction — sampling is host-side per row
+        # restriction — sampling is host-side per row.  on_done rides
+        # the handle from birth, so a completion can never race the
+        # caller attaching it.
         p = _PendingSeq(prompt, max_new_tokens, temperature,
-                        next(self._seed))
+                        next(self._seed), on_done=on_done)
         if not 1 <= len(p.prompt) < self.model.max_seq:
             raise ValueError(
                 f"prompt length {len(p.prompt)} outside [1, "
@@ -238,7 +270,7 @@ class ContinuousScheduler:
         self._queue.put(p)
         if self._stop.is_set():  # close() raced the put
             p.error = RuntimeError("ContinuousScheduler is closed")
-            p.event.set()
+            p._settle()
         return p
 
     @property
@@ -280,17 +312,24 @@ class ContinuousScheduler:
             "latency": self.latency_stats(),
         }
 
-    def close(self):
+    def close(self, timeout_s: Optional[float] = None):
         """Stop the loop and drain: in-flight sequences fail with a
         closed error (their blocks are freed), queued requests fail
         without hanging out their timeout.  The worker owns _slots and
         _waiting, so the full drain runs EITHER on the worker's way out
         of _loop OR here once the worker is confirmed dead — never
-        concurrently; the thread-safe arrival queue is always drained."""
+        concurrently; the thread-safe arrival queue is always drained.
+
+        The wait for the worker is BOUNDED (`timeout_s`, defaulting to
+        the constructor's close_timeout_s): a worker wedged inside a
+        hung device dispatch cannot hold shutdown hostage — the drain
+        proceeds without it."""
         self._stop.set()
-        deadline = time.monotonic() + self._close_timeout_s
+        if timeout_s is None:
+            timeout_s = self._close_timeout_s
+        deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline and self._worker.is_alive():
-            self._worker.join(timeout=0.2)
+            self._worker.join(timeout=min(0.2, max(0.0, timeout_s)))
         err = RuntimeError("ContinuousScheduler closed")
         # Drain even if the worker outlived the deadline (a device step
         # wedged mid-dispatch): waiters must not sit out their full
@@ -305,7 +344,7 @@ class ContinuousScheduler:
             except queue.Empty:
                 break
             p.error = err
-            p.event.set()
+            p._settle()
 
     # -- worker ---------------------------------------------------------
     def _free_slot_buffers(self, slot: int):
@@ -326,20 +365,20 @@ class ContinuousScheduler:
                 except KeyError:
                     pass  # the racing drain already freed it
                 s.req.error = err
-                s.req.event.set()
+                s.req._settle()
                 self._free_slot_buffers(i)
         self._slots = [None] * self.model.batch_slots
         while self._waiting:
             p = self._waiting.popleft()
             p.error = err
-            p.event.set()
+            p._settle()
         while True:
             try:
                 p = self._queue.get_nowait()
             except queue.Empty:
                 break
             p.error = err
-            p.event.set()
+            p._settle()
 
     def _admit(self):
         """Pull arrivals, then admit FIFO into free slots while the
@@ -365,7 +404,7 @@ class ContinuousScheduler:
                 # alone instead of wedging the FIFO head forever
                 self._waiting.popleft()
                 req.error = e
-                req.event.set()
+                req._settle()
                 continue
             if not admitted:
                 if self.pool.reserved_blocks == 0:
@@ -376,7 +415,7 @@ class ContinuousScheduler:
                         f"request needs {self.pool.blocks_for(len(req.prompt) + max_new)} "
                         f"KV blocks but the pool only has "
                         f"{self.pool.usable_blocks}")
-                    req.event.set()
+                    req._settle()
                     continue
                 if self.registry is not None:
                     self.registry.counter(
@@ -396,12 +435,28 @@ class ContinuousScheduler:
         """Thread body: run the decode loop, then drain no matter how
         it exited — a crash fails pending requests immediately instead
         of parking them for their full wait timeout (and leaves
-        worker_alive False for the /v2/health degraded check)."""
+        worker_alive False for the /v2/health degraded check).  A
+        fatal exit additionally fires on_death so a supervisor
+        (serving/replica.py) learns of the death without polling."""
         err: Exception = RuntimeError("ContinuousScheduler closed")
+        fatal: Optional[Exception] = None
         try:
             self._decode_loop()
         except Exception as e:  # scheduler bug / pool invariant breach
-            err = e
+            err = fatal = e
+        if fatal is not None:
+            # the engine is dead for NEW submissions too: flip the
+            # closed flag and notify the supervisor BEFORE failing the
+            # pending requests, so a front's requeue callbacks already
+            # see this replica as down and route elsewhere (otherwise
+            # a requeue can race back onto this dead engine and park
+            # until its client timeout)
+            self._stop.set()
+            if self._on_death is not None:
+                try:
+                    self._on_death(fatal)
+                except Exception:  # noqa: BLE001 — the worker is
+                    pass           # exiting; never mask the drain
         self._drain(err)
 
     def _decode_loop(self):
@@ -426,7 +481,16 @@ class ContinuousScheduler:
             try:
                 logits = self.model.step(
                     self._tokens, self._slens, self._btab)
-            except Exception as e:  # fail in-flight only; queued survive
+            except Exception as e:
+                if getattr(e, "fatal_to_engine", False):
+                    # device-loss-style fault (hung dispatch, lost
+                    # device — serving/replica.py marks them): the
+                    # ENGINE is gone, not just this batch.  Propagate
+                    # so _loop drains everything and fires on_death —
+                    # the supervisor restarts the replica.
+                    raise
+                # transient step fault: fail in-flight only; queued
+                # survive on the same engine
                 self.step_failures += 1
                 if self.registry is not None:
                     self.registry.counter("serving/step_failures").inc()
@@ -435,7 +499,7 @@ class ContinuousScheduler:
                         continue
                     self.pool.retire(live.seq_id)
                     live.req.error = e
-                    live.req.event.set()
+                    live.req._settle()
                     self._slots[i] = None
                     self._free_slot_buffers(i)
                 # a step that died mid-execution may have consumed the
@@ -506,7 +570,7 @@ class ContinuousScheduler:
                 reg.histogram("serving/per_token_ms").observe(
                     (req.t_done - req.t_first_token) * 1e3
                     / (req.n_generated - 1))
-        req.event.set()
+        req._settle()
 
     def _observe_step(self):
         if self.registry is None:

@@ -13,12 +13,21 @@ POST /v2/generate  {"prompt": [ids...]} or {"prompts": [[ids...], ...]},
 GET  /v2/health    -> {"status": "ok"|"degraded", "requests": N}
                    ("degraded" when a batcher's worker thread has
                    died: the endpoint would accept requests that can
-                   never complete.  Degraded rides HTTP 503 so
-                   status-code-only probes drop the backend too)
+                   never complete.  A single engine's degraded rides
+                   HTTP 503 so status-code-only probes drop the
+                   backend too.  A ServingFront generator aggregates
+                   per-replica liveness instead: ok (all live, 200),
+                   degraded (some live — still serving, 200), down
+                   (none live, 503), with a "replicas" detail list)
 GET  /v2/stats     -> batch/request counters + latency percentiles
                    (+ a "continuous" block when the generator is a
                    ContinuousScheduler: queue depth, KV pool
-                   occupancy/fragmentation, TTFT percentiles)
+                   occupancy/fragmentation, TTFT percentiles; a
+                   ServingFront adds a per-replica block under
+                   "replicas")
+
+Shed/exhausted-retry requests (front.ServiceUnavailable) return 503
+with a Retry-After header.
 """
 from __future__ import annotations
 
@@ -44,11 +53,13 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, code: int, payload: dict):
+        def _send(self, code: int, payload: dict, headers=None):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -57,6 +68,25 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
             if self.path == "/v2/health":
                 served = getattr(src, "batches_run",
                                  getattr(src, "requests_served", 0))
+                front = next(
+                    (obj for obj in (generator, batcher)
+                     if obj is not None and hasattr(obj, "health")),
+                    None,
+                )
+                if front is not None:
+                    # replicated front (serving/front.py): per-replica
+                    # liveness aggregates to ok | degraded | down.
+                    # Degraded still SERVES (surviving replicas), so it
+                    # rides 200 — only "down" (zero live replicas) gets
+                    # the 503 that makes status-code-only probes drop
+                    # the backend
+                    payload = dict(front.health())
+                    payload["requests"] = served
+                    self._send(
+                        503 if payload["status"] == "down" else 200,
+                        payload,
+                    )
+                    return
                 # a dead worker thread leaves the endpoint accepting
                 # requests that only ever time out — report degraded
                 # so health checks catch it (ISSUE 6 satellite)
@@ -66,9 +96,9 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
                     and getattr(obj, "worker_alive", True) is False
                 ]
                 status = "degraded" if dead else "ok"
-                # degraded rides a 503 so status-code-only probes
-                # (k8s, LBs) drop the backend too, not just readers
-                # of the JSON body
+                # a single engine that degrades cannot serve at all, so
+                # its degraded rides a 503 for status-code-only probes
+                # (k8s, LBs), not just readers of the JSON body
                 self._send(200 if not dead else 503,
                            {"status": status, "requests": served})
             elif self.path == "/v2/stats":
@@ -142,6 +172,20 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
                 # out of range): the client's fault, not retriable
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
             except Exception as e:
+                retry_after = getattr(e, "retry_after_s", None)
+                if retry_after is not None:
+                    # load shed / replica-retries exhausted
+                    # (front.ServiceUnavailable): 503 + Retry-After so
+                    # well-behaved clients back off instead of
+                    # hammering a front with zero live replicas
+                    self._send(
+                        503,
+                        {"error": f"{type(e).__name__}: {e}",
+                         "retriable": True},
+                        headers={"Retry-After":
+                                 str(max(1, int(round(retry_after))))},
+                    )
+                    return
                 # engine fault (failed decode step, closed batcher):
                 # the server's fault — 500 so clients/load balancers
                 # retry instead of dropping a well-formed request

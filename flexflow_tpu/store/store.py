@@ -17,9 +17,12 @@ follow-up search's publish repairs it) instead of crashing the caller.
 The store is safe to share between processes on one filesystem:
 publishes are atomic renames, lookups never see partial writes, and a
 concurrent double-publish of the same key resolves to
-first-write-wins.  Metrics (store/hits, store/misses,
-store/publishes, store/lookup_ms, ...) flow through an optional
-obs.metrics registry into run_telemetry.jsonl.
+first-write-wins — EXCEPT that a publish carrying a strictly better
+`searched_cost` replaces the incumbent (the best-cost upgrade policy:
+a longer-budget search or a replica's degraded-mesh re-search improves
+the shared entry).  Metrics (store/hits, store/misses,
+store/publishes, store/best_cost_upgrades, store/lookup_ms, ...) flow
+through an optional obs.metrics registry into run_telemetry.jsonl.
 """
 from __future__ import annotations
 
@@ -187,11 +190,25 @@ class StrategyStore:
         """Write-verify-rename one entry; returns True when the entry
         was (re)written, False when an existing entry was kept
         (first-write-wins) or the write failed survivably.  created_at
-        is caller-supplied provenance (seconds since epoch)."""
+        is caller-supplied provenance (seconds since epoch).
+
+        Best-cost upgrade policy: a publish carrying a STRICTLY better
+        (lower) `searched_cost` than the existing entry's replaces it —
+        so a longer-budget search, or a serving replica's degraded-mesh
+        re-search that beat the fleet entry, improves the shared store
+        instead of being dropped on first-write-wins.  Equal or worse
+        costs (and cost-less publishes) still lose to the incumbent."""
         digest = key.digest
         final = self._entry_dir(digest)
+        upgrading = False
         if os.path.isdir(final) and not overwrite:
-            return False
+            if not self._upgrades_cost(final, searched_cost):
+                return False
+            overwrite = upgrading = True
+            store_logger.info(
+                "store entry %s: replacing with strictly better "
+                "searched_cost %.6g", digest[:16], searched_cost,
+            )
         text = strategy.to_json()
         manifest = {
             "manifest_version": MANIFEST_VERSION,
@@ -218,6 +235,15 @@ class StrategyStore:
                 os.fsync(f.fileno())
             _write_json_fsync(os.path.join(tmp, "manifest.json"), manifest)
             self._verify_dir(tmp, digest)
+            if (upgrading and os.path.isdir(final)
+                    and not self._upgrades_cost(final, searched_cost)):
+                # the incumbent changed while we serialized (a
+                # concurrent publisher landed something at least as
+                # good): dropping our copy keeps the best entry.  The
+                # remaining replace-after-check window is microseconds
+                # — an accepted cost of the lock-free shared store.
+                shutil.rmtree(tmp, ignore_errors=True)
+                return False
             if os.path.isdir(final):  # overwrite=True repair path
                 shutil.rmtree(final)
             os.replace(tmp, final)
@@ -243,7 +269,24 @@ class StrategyStore:
             )
             return False
         self._count("publishes")
+        if upgrading:  # counted only once the replacement actually landed
+            self._count("best_cost_upgrades")
         return True
+
+    def _upgrades_cost(self, entry_dir: str,
+                       searched_cost: Optional[float]) -> bool:
+        """True when `searched_cost` strictly beats the published
+        entry's.  Unreadable/partial incumbents do NOT upgrade-replace
+        here — lookup() owns quarantine policy (a transient I/O blip
+        must not let a publish clobber a healthy entry)."""
+        if searched_cost is None:
+            return False
+        try:
+            with open(os.path.join(entry_dir, "manifest.json")) as f:
+                existing = json.load(f).get("searched_cost")
+        except (OSError, ValueError):
+            return False
+        return existing is not None and float(searched_cost) < float(existing)
 
     @staticmethod
     def _verify_dir(path: str, digest: str) -> None:

@@ -265,6 +265,33 @@ def test_serving_config_validated():
         FFConfig(serving_slots=0)
 
 
+def test_serving_front_cli_flags_parse():
+    cfg = FFConfig.from_args([
+        "--serving-replicas", "3", "--serving-step-timeout", "2.5",
+        "--serving-max-restarts", "5", "--request-retry-limit", "4",
+    ])
+    assert cfg.serving_replicas == 3
+    assert cfg.serving_step_timeout == 2.5
+    assert cfg.serving_max_restarts == 5
+    assert cfg.request_retry_limit == 4
+    base = FFConfig.from_args([])
+    assert base.serving_replicas == 1
+    assert base.serving_step_timeout == 0.0  # decode watchdog off
+    assert base.serving_max_restarts == 3
+    assert base.request_retry_limit == 2
+
+
+def test_serving_front_config_validated():
+    with pytest.raises(ValueError):
+        FFConfig(serving_replicas=0)
+    with pytest.raises(ValueError):
+        FFConfig(serving_step_timeout=-1.0)
+    with pytest.raises(ValueError):
+        FFConfig(serving_max_restarts=-1)
+    with pytest.raises(ValueError):
+        FFConfig(request_retry_limit=-1)
+
+
 def test_store_cli_flags_parse(monkeypatch):
     cfg = FFConfig.from_args([
         "--strategy-store", "/tmp/fleet_store",

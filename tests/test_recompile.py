@@ -124,6 +124,94 @@ def test_recompile_in_training_loop_via_cache_score(devices8):
     assert ff.mesh.devices.size == 2
 
 
+def _stacked_model(devices, layers=4, batch=16, hidden=32, classes=4,
+                   momentum=0.9):
+    cfg = FFConfig(batch_size=batch, num_devices=len(devices))
+    ff = FFModel(cfg)
+    x = ff.create_tensor([batch, hidden], name="x")
+    t = x
+    for i in range(layers):
+        t = ff.dense(t, hidden, activation=ActiMode.RELU, name=f"blk{i}")
+    t = ff.dense(t, classes, name="head")
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05, momentum=momentum),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+               devices=devices)
+    return ff
+
+
+def _pp_strategy(dp, pp, M):
+    from flexflow_tpu.strategy import Strategy
+
+    axes = {"data": dp, "pipe": pp} if dp > 1 else {"pipe": pp}
+    s = Strategy(
+        mesh_axes=axes,
+        pipeline={"degree": pp, "num_microbatches": M, "axis": "pipe",
+                  "dp_axis": "data" if dp > 1 else None},
+    )
+    if dp > 1:
+        s.edge_ops["__inputs__"] = [("repartition",
+                                     {"dim": 0, "degree": dp})]
+    return s
+
+
+def test_recompile_onto_pipeline_carries_weights(devices8):
+    """ROADMAP pre-existing bug: recompile's weight carry died on the
+    '__pipeline__' vs per-op key mismatch in set_weights.  The layout
+    adaptation maps per-op trained weights onto the GPipe stacked
+    layout (and the optimizer slots with them): outputs match across
+    the swap and training continues."""
+    ff = _stacked_model(devices8[:4])
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 32).astype(np.float32)
+    ys = rng.randint(0, 4, 64).astype(np.int32)
+    ff.fit(xs, ys, epochs=1, verbose=False)
+    w_before = ff.get_weights()
+    before = np.asarray(ff.forward({"x": xs[:16]}))
+
+    ff.recompile(strategy=_pp_strategy(2, 2, 4),
+                 devices=list(ff.mesh.devices.flat)[:4])
+    assert set(ff.get_weights()) == {"__pipeline__", "head"}
+    stacked = ff.get_weights()["__pipeline__"]
+    for k in range(4):
+        np.testing.assert_array_equal(stacked["0.kernel"][k],
+                                      w_before[f"blk{k}"]["kernel"])
+        np.testing.assert_array_equal(stacked["0.bias"][k],
+                                      w_before[f"blk{k}"]["bias"])
+    after = np.asarray(ff.forward({"x": xs[:16]}))
+    np.testing.assert_allclose(before, after, rtol=2e-5, atol=2e-5)
+    hist = ff.fit(xs, ys, epochs=1, verbose=False)
+    assert np.isfinite(hist[-1].sparse_cce_loss)
+
+
+def test_recompile_off_pipeline_carries_weights(devices8):
+    """The reverse mapping: a pipeline-compiled model recompiles onto a
+    per-op strategy with the stacked weights unstacked by block."""
+    ff = _stacked_model(devices8[:4])
+    # swap to pipeline first, train a step there, then come back
+    ff.recompile(strategy=_pp_strategy(2, 2, 4),
+                 devices=list(ff.mesh.devices.flat)[:4])
+    rng = np.random.RandomState(1)
+    xs = rng.randn(32, 32).astype(np.float32)
+    ys = rng.randint(0, 4, 32).astype(np.int32)
+    ff.train_step({"x": xs[:16]}, ys[:16])
+    stacked = ff.get_weights()["__pipeline__"]
+    before = np.asarray(ff.forward({"x": xs[:16]}))
+
+    ff.recompile(strategy=data_parallel_strategy(2),
+                 devices=list(ff.mesh.devices.flat)[:2])
+    w = ff.get_weights()
+    assert "__pipeline__" not in w
+    for k in range(4):
+        np.testing.assert_array_equal(w[f"blk{k}"]["kernel"],
+                                      stacked["0.kernel"][k])
+    after = np.asarray(ff.forward({"x": xs[:16]}))
+    np.testing.assert_allclose(before, after, rtol=2e-5, atol=2e-5)
+    m = ff.train_step({"x": xs[:16]}, ys[:16])
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_cache_score_drives_recompile_trigger(devices8):
     """moe.cc:39-98 parity: a Cache op's score_fn is polled each fit
     batch; its running average feeds a RecompileState trigger."""

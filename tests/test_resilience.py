@@ -367,6 +367,43 @@ def test_device_loss_researches_with_unity(devices8, tmp_path):
     assert all(np.isfinite(v) for v in rep.losses)
 
 
+@pytest.mark.slow
+def test_device_loss_pipeline_candidate_excluded(devices8, tmp_path):
+    """ISSUE 8 satellite — the ROADMAP pre-existing bug's exact repro:
+    8->4 device loss on a 3x64-dense MLP (batch 16, budget 50,
+    enable_parameter_parallel) makes the degraded-mesh re-search
+    return a PIPELINE candidate, which used to kill recovery on the
+    '__pipeline__' vs per-op key mismatch in set_weights (and would
+    then fail checkpoint reshard-restore).  The supervisor now
+    excludes pipeline candidates from elastic re-search — carried
+    checkpoints are per-op-keyed — and recovery completes."""
+    cfg = FFConfig(batch_size=16, num_devices=8, search_budget=50,
+                   enable_parameter_parallel=True, rewrite_depth=1,
+                   rewrite_max_variants=1)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 8], name="x")
+    t = x
+    for _ in range(3):
+        t = ff.dense(t, 64, activation=ActiMode.RELU)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devices8)
+    xs, ys = _data(128)
+    plan = FaultPlan.single(3, FaultKind.DEVICE_LOSS, survivors=4)
+    sup = TrainingSupervisor(ff, str(tmp_path), checkpoint_every=2,
+                             fault_plan=plan, sleep=NO_SLEEP)
+    rep = sup.run(xs, ys, num_steps=6)
+    assert rep.final_step == 6
+    assert rep.counters["device_losses"] == 1
+    # the repro's whole point: the re-search DID pick pipeline first
+    assert rep.counters["re_search_pipeline_excluded"] == 1
+    assert ff.strategy.pipeline is None
+    assert ff.strategy.total_devices <= 4
+    assert all(np.isfinite(v) for v in rep.losses)
+
+
 # -- fit integration -----------------------------------------------------
 
 def test_fit_resilient_entrypoint(devices8, tmp_path):

@@ -331,6 +331,37 @@ def test_publish_first_write_wins_and_overwrite(tmp_path):
     assert store.lookup(key).to_json() == s4.to_json()
 
 
+def test_publish_best_cost_upgrades_entry(tmp_path):
+    """ISSUE 8 satellite: a publish with a STRICTLY better
+    searched_cost replaces the incumbent (so a replica's degraded-mesh
+    re-search can improve the shared fleet entry); equal/worse/costless
+    publishes still lose to first-write-wins."""
+    from flexflow_tpu.obs.metrics import MetricsRegistry
+    from flexflow_tpu.strategy import data_parallel_strategy
+
+    cfg = _cfg(tmp_path, n=2)
+    ff = _mlp(cfg)
+    reg = MetricsRegistry()
+    store = StrategyStore(str(tmp_path), registry=reg)
+    key = store_key_for(cfg, ff.layers, 2)
+    s2, s4 = data_parallel_strategy(2), data_parallel_strategy(4)
+    assert store.publish(key, s2, searched_cost=10.0, created_at=1.0)
+    # worse, equal, and cost-less publishes all keep the incumbent
+    assert not store.publish(key, s4, searched_cost=11.0, created_at=2.0)
+    assert not store.publish(key, s4, searched_cost=10.0, created_at=3.0)
+    assert not store.publish(key, s4, created_at=4.0)
+    assert store.lookup(key).to_json() == s2.to_json()
+    assert reg.counter("store/best_cost_upgrades").value == 0
+    # strictly better: the entry upgrades in place
+    assert store.publish(key, s4, searched_cost=7.5, created_at=5.0)
+    hit = store.lookup(key)
+    assert hit.to_json() == s4.to_json()
+    assert hit.search_cost == 7.5
+    assert reg.counter("store/best_cost_upgrades").value == 1
+    # and the upgraded entry defends its cost the same way
+    assert not store.publish(key, s2, searched_cost=8.0, created_at=6.0)
+
+
 def test_import_tool_promotes_shipped_artifacts(tmp_path, devices8):
     import sys
 
