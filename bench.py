@@ -737,6 +737,150 @@ def bench_cold_start(dev, on_tpu):
     return result
 
 
+def bench_host_loss(dev, on_tpu):
+    """Host-loss leg (manifest v13): what the durable offload tier
+    costs in steady state and what it buys after a full host loss.
+
+    Block 1 — steady-state overhead: the same supervised training run
+    with the checkpoint mirror OFF vs ON (filesystem blob backend);
+    the mirror uploads on a background thread, so the per-step delta
+    should be noise.
+
+    Block 2 — fresh-host recovery: after the offload-ON run, the
+    entire local checkpoint directory AND strategy store are deleted
+    (the host loss).  Time-to-first-step on a brand-new "host":
+    compile (warm REMOTE strategy store — the search is skipped) +
+    restore from REMOTE_LATEST + one training step, vs a fully cold
+    start (fresh search, no checkpoint, training from step 0)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.fftype import ActiMode
+    from flexflow_tpu.optimizer import SGDOptimizer
+
+    leg = MANIFEST["legs"]["host_loss"]
+    hidden, layers = leg["hidden"], leg["layers"]
+    classes, batch = leg["classes"], leg["batch"]
+    steps, every = leg["steps"], leg["checkpoint_every"]
+
+    devs = jax.devices()
+    n = min(len(devs), 8)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch * 4, leg["input_dim"]).astype(np.float32)
+    ys = rng.randint(0, classes, size=batch * 4).astype(np.int32)
+
+    def build(store_root=None, remote=None, budget=0):
+        cfg = FFConfig(batch_size=batch, num_devices=n,
+                       search_budget=budget, strategy_store=store_root,
+                       remote_store=remote, checkpoint_every=every,
+                       enable_parameter_parallel=bool(budget),
+                       retry_backoff=0.0)
+        ff = FFModel(cfg)
+        t = ff.create_tensor([batch, leg["input_dim"]], name="x")
+        for _ in range(layers):
+            t = ff.dense(t, hidden, activation=ActiMode.RELU)
+        t = ff.dense(t, classes)
+        ff.softmax(t)
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   devices=devs[:n])
+        return ff
+
+    def run_steps(ff, ckpt_dir, num_steps, resume=False):
+        t0 = time.perf_counter()
+        report = ff.fit_resilient({"x": xs}, ys, num_steps=num_steps,
+                                  batch_size=batch, directory=ckpt_dir,
+                                  resume=resume)
+        return time.perf_counter() - t0, report
+
+    roots = {name: tempfile.mkdtemp(prefix=f"host_loss_{name}_")
+             for name in ("ck_off", "ck_on", "blob", "store", "store2",
+                          "ck_fresh", "ck_cold")}
+    try:
+        # -- block 1: steady-state step-time overhead, offload off/on --
+        # both runs share the strategy store, so the OFF baseline
+        # executes the SAME searched strategy (warm hit) and the delta
+        # isolates the mirror, not a strategy difference.  The ON model
+        # builds FIRST: its fresh search publishes through to the fleet
+        # mirror (a warm local hit would not), which block 2 relies on
+        ff_on = build(store_root=roots["store"], remote=roots["blob"],
+                      budget=leg["search_budget"])
+        ff_off = build(store_root=roots["store"],
+                       budget=leg["search_budget"])
+        assert ff_on.strategy.to_json() == ff_off.strategy.to_json()
+        # identical 2-step warmup each, so neither timed run pays the
+        # process's one-time XLA/first-touch costs
+        for ff in (ff_off, ff_on):
+            for _ in range(2):
+                ff.train_step({"x": xs[:batch]}, ys[:batch])
+        off_s, off_rep = run_steps(ff_off, roots["ck_off"], steps)
+        on_s, on_rep = run_steps(ff_on, roots["ck_on"], steps)
+        assert off_rep.final_step == steps and on_rep.final_step == steps
+        assert on_rep.counters["offload_uploads"] >= 1
+        step_ms_off = off_s / steps * 1e3
+        step_ms_on = on_s / steps * 1e3
+        del ff_off, ff_on
+
+        # -- block 2: the host dies — local ckpts + store are GONE ----
+        shutil.rmtree(roots["ck_on"])
+        shutil.rmtree(roots["store"])
+
+        t0 = time.perf_counter()
+        ff_warm = build(store_root=roots["store2"], remote=roots["blob"],
+                        budget=leg["search_budget"])
+        warm_report = ff_warm.fit_resilient(
+            {"x": xs}, ys, num_steps=steps + 1, batch_size=batch,
+            directory=roots["ck_fresh"], resume=True,
+        )
+        warm_s = time.perf_counter() - t0
+        assert warm_report.final_step == steps + 1
+        warm_store_hit = bool(
+            (ff_warm.strategy.search_stats or {}).get("store_hit")
+        )
+
+        t0 = time.perf_counter()
+        # store_root="none" is the explicit opt-out: a bare None would
+        # fall through to $FLEXFLOW_TPU_STORE_DIR and the "cold" compile
+        # could warm-hit (and pollute) the user's fleet store
+        ff_cold = build(store_root="none", budget=leg["search_budget"])
+        cold_report = ff_cold.fit_resilient(
+            {"x": xs}, ys, num_steps=1, batch_size=batch,
+            directory=roots["ck_cold"],
+        )
+        cold_s = time.perf_counter() - t0
+        assert cold_report.final_step == 1
+
+        return {
+            "workload": (
+                f"{layers}L h{hidden} MLP, {steps} supervised steps, "
+                f"checkpoint_every={every}, filesystem blob backend, "
+                f"{n} devices"
+            ),
+            "step_ms_offload_off": round(step_ms_off, 2),
+            "step_ms_offload_on": round(step_ms_on, 2),
+            "offload_overhead_pct": round(
+                (step_ms_on - step_ms_off) / max(step_ms_off, 1e-9) * 100, 1
+            ),
+            "offload_uploads": int(on_rep.counters["offload_uploads"]),
+            "offload_bytes": int(on_rep.counters["offload_bytes"]),
+            "recovery": {
+                # fresh host: warm remote strategy store + remote restore
+                "warm_remote_time_to_first_step_s": round(warm_s, 3),
+                "warm_store_hit": warm_store_hit,
+                "resumed_from_step": steps,
+                # no remote tier: full search, training restarts at 0
+                "cold_start_time_to_first_step_s": round(cold_s, 3),
+                "progress_kept_steps": steps,
+            },
+        }
+    finally:
+        for path in roots.values():
+            shutil.rmtree(path, ignore_errors=True)
+
+
 def bench_serving(dev, on_tpu):
     """Generation-serving throughput leg (manifest v10): the same
     mixed-length workload and Poisson arrival sequence through the
@@ -1051,6 +1195,8 @@ def main():
     serving_resilience = bench_serving_resilience(dev, on_tpu)
     gc.collect()
     cold_start = bench_cold_start(dev, on_tpu)
+    gc.collect()
+    host_loss = bench_host_loss(dev, on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
@@ -1071,7 +1217,7 @@ def main():
                  "moe_dispatch": moe, "weight_update": wu,
                  "checkpoint": ckpt, "serving": serving,
                  "serving_resilience": serving_resilience,
-                 "cold_start": cold_start},
+                 "cold_start": cold_start, "host_loss": host_loss},
     }
     print(json.dumps(result))
 

@@ -28,6 +28,20 @@ Durability layer (docs/RESILIENCE.md "Async checkpointing"):
     every mismatched leaf, instead of a cryptic reshape/resharding
     traceback.  Mesh-size and weight-update-sharding layout changes
     remain *compatible* by design — reshard-on-restore handles them.
+  * **pipeline layout mapping** — a checkpoint saved under a per-op
+    strategy restores onto a pipeline (`__pipeline__` stacked) executor
+    and vice versa: restore routes the weight and optimizer-slot trees
+    through `FFModel._adapt_weight_layout` before spec validation, so
+    the supervisor's elastic re-search may pick pipeline winners
+    mid-run (the former `re_search_pipeline_excluded` gate is gone).
+  * **remote tier** — with a configured offload tier
+    (`resilience/offload.py`, FFConfig.remote_store), every verified
+    local publish is mirrored to object storage off the critical path,
+    and restore walks local -> remote PER CHECKPOINT: a corrupt local
+    step falls back to its verified remote mirror (downloaded,
+    crc-verified, materialized locally) before giving up progress to
+    an older step; a brand-new empty host restores entirely from the
+    remote tier.
 """
 from __future__ import annotations
 
@@ -189,7 +203,7 @@ class CheckpointManager:
     a step is orbax's commit protocol; the per-leaf crc32 manifest is a
     LocalCheckpointManager feature."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3, remote=None):
         import orbax.checkpoint as ocp
 
         self.directory = os.path.abspath(directory)
@@ -202,6 +216,12 @@ class CheckpointManager:
         )
         self._ocp = ocp
         self._latest = _LatestPointer(self.directory)
+        # remote tier (resilience/offload.py RemoteCheckpointStore):
+        # restore-side fallback only — the mirror's flat-npz format is
+        # backend-agnostic, so an orbax run can recover from a mirror a
+        # LocalCheckpointManager uploaded (uploading is the local
+        # manager's job; orbax's own commit layout is not mirrored)
+        self.remote = remote
         # wait=False (step, submit_time, registry) not yet drained
         self._pending: List[Tuple[int, float, Any]] = []
 
@@ -286,8 +306,30 @@ class CheckpointManager:
             return None
         return step
 
+    def any_restorable(self) -> bool:
+        """True when either the orbax directory or the remote mirror
+        tier holds at least one restorable checkpoint."""
+        if self.latest_step() is not None:
+            return True
+        if self.remote is None:
+            return False
+        try:
+            return bool(self.remote.list_steps())
+        except Exception:  # noqa: BLE001 — unreachable mirror
+            return False
+
     def all_steps(self):
         return list(self._mgr.all_steps())
+
+    def _mirrored_steps(self) -> set:
+        """Steps the remote tier can serve (empty on any store failure —
+        the caller then surfaces its local error instead)."""
+        if self.remote is None:
+            return set()
+        try:
+            return set(self.remote.list_steps())
+        except Exception:  # noqa: BLE001 — unreachable mirror
+            return set()
 
     def restore(self, ff, step: Optional[int] = None) -> int:
         """Load a step (default: latest) into a compiled FFModel,
@@ -297,23 +339,78 @@ class CheckpointManager:
         With step=None a corrupt/partial/incompatible latest checkpoint
         is skipped and the previous one restored instead (the crash
         that truncated the write is usually the crash being recovered
-        from); an explicitly requested step stays strict."""
+        from); an explicitly requested step stays strict.  With a
+        remote tier configured, steps the local directory cannot serve
+        fall back to their verified remote mirrors."""
         if step is not None:
-            return self._restore_step(ff, step)
+            try:
+                return self._restore_step(ff, step)
+            except CheckpointCompatibilityError as compat_err:
+                # UNLIKE the npz manager (where both tiers share one
+                # verify-adapt path) the orbax local restore cannot
+                # adapt per-op <-> __pipeline__ layouts, but the flat-npz
+                # mirror restore can — try it before giving up
+                if self.remote is None or step not in self._mirrored_steps():
+                    raise
+                try:
+                    return self._restore_remote_step(ff, step)
+                except Exception:  # noqa: BLE001
+                    raise compat_err  # the actionable report, not blob noise
+            except Exception:
+                if self.remote is None:
+                    raise
+                if step not in self._mirrored_steps():
+                    raise  # surface the local failure, not BlobNotFound
+                return self._restore_remote_step(ff, step)
         steps = sorted(self._mgr.all_steps(), reverse=True)
-        if not steps:
+        remote_steps: List[int] = []
+        if self.remote is not None:
+            try:
+                remote_steps = sorted(self.remote.list_steps(), reverse=True)
+            except Exception as e:  # noqa: BLE001 — any store failure
+                _log.warning(
+                    "remote checkpoint tier unlistable (%s); restoring "
+                    "from the local tier only", e,
+                )
+        if not steps and not remote_steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         last_err: Optional[Exception] = None
-        for s in steps:
-            try:
-                restored = self._restore_step(ff, s)
-            except Exception as e:  # noqa: BLE001 — orbax raises various
-                _log.warning(
-                    "checkpoint step %d in %s unrestorable (%s); "
-                    "falling back to the previous step", s, self.directory, e,
-                )
-                last_err = e
-                continue
+        # ONE newest-first walk over BOTH tiers — an older local step
+        # must never win over a newer verified remote-only mirror
+        for s in sorted(set(steps) | set(remote_steps), reverse=True):
+            if s in steps:
+                try:
+                    restored = self._restore_step(ff, s)
+                except Exception as e:  # noqa: BLE001 — orbax raises various
+                    if s in remote_steps:
+                        try:
+                            restored = self._restore_remote_step(ff, s)
+                        except Exception as re_err:  # noqa: BLE001
+                            _log.warning(
+                                "checkpoint step %d unrestorable locally "
+                                "(%s) and remotely (%s); falling back",
+                                s, e, re_err,
+                            )
+                            last_err = re_err
+                            continue
+                    else:
+                        _log.warning(
+                            "checkpoint step %d in %s unrestorable (%s); "
+                            "falling back to the previous step",
+                            s, self.directory, e,
+                        )
+                        last_err = e
+                        continue
+            else:
+                try:
+                    restored = self._restore_remote_step(ff, s)
+                except Exception as e:  # noqa: BLE001
+                    _log.warning(
+                        "remote checkpoint step %d unrestorable (%s); "
+                        "falling back to the previous step", s, e,
+                    )
+                    last_err = e
+                    continue
             if last_err is not None:
                 _log.warning(
                     "restored OLDER step %d from %s — newer step(s) were "
@@ -322,6 +419,42 @@ class CheckpointManager:
                 )
             return restored
         raise last_err
+
+    def _restore_remote_step(self, ff, step: int) -> int:
+        """Fill the model from a remote mirror (flat-npz format): crc
+        re-verify the downloaded bytes, adapt layouts, device_put onto
+        the current shardings."""
+        import io
+
+        from jax.tree_util import tree_unflatten
+
+        files = self.remote.download_step(step)
+        manifest = json.loads(files["manifest.json"])
+        meta = json.loads(files["meta.json"])
+        with np.load(io.BytesIO(files["state.npz"])) as data:
+            arrays = {key: data[key] for key in data.files}
+        target = {
+            "weights": ff._weights,
+            "opt_state": ff._opt_state,
+            "op_state": ff._state,
+            "rng": jax.random.key_data(ff._rng),
+        }
+        new_leaves, treedef = _verify_adapt_put(
+            ff, target, arrays, manifest, meta, step
+        )
+        restored = tree_unflatten(treedef, new_leaves)
+        ff._weights = restored["weights"]
+        ff._opt_state = restored["opt_state"]
+        ff._state = restored["op_state"]
+        ff._rng = jax.random.wrap_key_data(restored["rng"])
+        if hasattr(ff, "sync_decode_pos"):
+            ff.sync_decode_pos()
+        registry = registry_of(ff)
+        if registry is not None:
+            registry.counter("resilience/offload_remote_restores").inc()
+        _log.info("step %d restored from the remote tier (orbax local "
+                  "tier could not serve it)", step)
+        return int(step)
 
     def _restore_step(self, ff, step: int) -> int:
         ocp = self._ocp
@@ -399,6 +532,140 @@ def _tree_specs(tree) -> Dict[str, Dict[str, Any]]:
     }
 
 
+def _verify_adapt_put(ff, target, arrays: Dict[str, np.ndarray],
+                      manifest: Optional[Dict], meta: Optional[Dict],
+                      step: int):
+    """The shared restore core for flat (keystr-keyed) checkpoints:
+    crc-verify against the manifest's saved-layout keys FIRST (so
+    corruption surfaces as a verify error and falls back, never
+    masquerading as a layout problem), map per-op <-> `__pipeline__`
+    stacked layouts onto the current executor, validate leaf specs,
+    then device_put every leaf onto the target's shardings.  Returns
+    (new_leaves, treedef) for the target tree."""
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    if manifest is not None:
+        for key, spec in manifest["leaves"].items():
+            arr = arrays.get(key)
+            if arr is None:
+                raise CheckpointVerifyError(
+                    f"step {step}: leaf {key!r} in manifest but not in "
+                    "state.npz"
+                )
+            crc = _leaf_crc(arr)
+            if crc != spec["crc32"]:
+                raise CheckpointVerifyError(
+                    f"step {step}: leaf {key!r} crc32 {crc:#010x} "
+                    f"!= manifest {spec['crc32']:#010x}"
+                )
+        # every saved leaf must be covered: a manifest that lists fewer
+        # leaves than state.npz (torn/older/hand-edited) would otherwise
+        # let the uncovered bytes restore with no integrity check at all
+        unverified = sorted(set(arrays) - set(manifest["leaves"]))
+        if unverified:
+            shown = ", ".join(repr(k) for k in unverified[:5])
+            more = (f", ... {len(unverified) - 5} more"
+                    if len(unverified) > 5 else "")
+            raise CheckpointVerifyError(
+                f"step {step}: leaves in state.npz but missing from the "
+                f"manifest (unverifiable): {shown}{more}"
+            )
+    arrays = _adapt_saved_layout(ff, arrays)
+    leaves, treedef = tree_flatten_with_path(target)
+    # layout validation before materializing: one clear error naming
+    # every mismatched leaf beats a KeyError/reshape traceback from
+    # whichever leaf happened to differ
+    saved_specs = {
+        key: {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+        for key, arr in arrays.items()
+    }
+    current_specs = {
+        keystr(path): {
+            "shape": list(cur.shape),
+            "dtype": str(cur.dtype),
+        }
+        for path, cur in leaves
+    }
+    mismatches = _spec_mismatches(saved_specs, current_specs)
+    if mismatches:
+        raise CheckpointCompatibilityError(step, mismatches, meta)
+    new_leaves = []
+    for path, cur in leaves:
+        arr = arrays[keystr(path)]
+        sh = getattr(cur, "sharding", None)
+        new_leaves.append(
+            jax.device_put(arr, sh) if sh is not None else arr
+        )
+    return new_leaves, treedef
+
+
+_KEYSTR_TOKEN_RE = re.compile(r"\['([^']*)'\]")
+
+
+def _unflatten_keystr(flat: Dict[str, Any]) -> Optional[Dict]:
+    """Rebuild the nested dict tree a keystr-keyed flat mapping came
+    from.  Returns None when any key is not a pure string-keyed dict
+    path (lists/custom nodes) — callers then skip layout adaptation and
+    let spec validation report the mismatch."""
+    root: Dict = {}
+    for key, leaf in flat.items():
+        toks = _KEYSTR_TOKEN_RE.findall(key)
+        if not toks or "".join(f"['{t}']" for t in toks) != key:
+            return None
+        d = root
+        for t in toks[:-1]:
+            d = d.setdefault(t, {})
+            if not isinstance(d, dict):
+                return None
+        d[toks[-1]] = leaf
+    return root
+
+
+def _flatten_keystr(tree) -> Dict[str, Any]:
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    leaves, _ = tree_flatten_with_path(tree)
+    return {keystr(path): leaf for path, leaf in leaves}
+
+
+def _adapt_saved_layout(ff, arrays: Dict[str, np.ndarray]
+                        ) -> Dict[str, np.ndarray]:
+    """Map a flat saved state between the per-op and the
+    `__pipeline__`-stacked weight layouts to match the CURRENT
+    executor, reusing `FFModel._adapt_weight_layout` for the weight
+    tree and each weight-shaped optimizer-slot subtree (exactly
+    recompile's carry).  This is what lets the supervisor's elastic
+    re-search restore a per-op-keyed checkpoint onto a freshly
+    compiled pipeline strategy (and back).  A failed adaptation
+    returns the arrays unchanged so spec validation reports the real
+    mismatch instead of a mapping traceback."""
+    saved_pp = any(
+        k.startswith("['weights']['__pipeline__']") for k in arrays
+    )
+    cur_pp = "__pipeline__" in (getattr(ff, "_weights", None) or {})
+    if saved_pp == cur_pp:
+        return arrays
+    adapt = getattr(ff, "_adapt_weight_layout", None)
+    nested = _unflatten_keystr(arrays)
+    if adapt is None or nested is None or "weights" not in nested:
+        return arrays
+    try:
+        out = dict(nested)
+        out["weights"] = adapt(nested["weights"])
+        if isinstance(nested.get("opt_state"), dict):
+            out["opt_state"] = {
+                k: adapt(sub) if isinstance(sub, dict) else sub
+                for k, sub in nested["opt_state"].items()
+            }
+        return _flatten_keystr(out)
+    except Exception as e:  # genuinely incompatible trees
+        _log.warning(
+            "pipeline layout adaptation failed (%s); restoring with the "
+            "saved layout as-is", e,
+        )
+        return arrays
+
+
 def _spec_mismatches(saved: Dict[str, Dict], current: Dict[str, Dict]
                      ) -> List[str]:
     """Human-readable list of structural differences between a saved
@@ -463,11 +730,19 @@ class LocalCheckpointManager:
     # durability layer must never be the thing that kills the run.
     MAX_PENDING_SAVES = 2
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 offloader=None, remote=None):
         if max_to_keep < 1:
             raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
+        # second durability tier (resilience/offload.py): the offloader
+        # mirrors every verified publish; `remote` alone is enough for
+        # restore-only consumers (a fresh host, tools/checkpoint_fsck)
+        self.offloader = offloader
+        self.remote = remote if remote is not None else (
+            offloader.remote if offloader is not None else None
+        )
         os.makedirs(self.directory, exist_ok=True)
         # tmp dirs from a writer that died mid-save are dead weight
         for name in os.listdir(self.directory):
@@ -502,6 +777,12 @@ class LocalCheckpointManager:
         if step is None or not os.path.isdir(self._path(step)):
             return None
         return step
+
+    def any_restorable(self) -> bool:
+        """True when EITHER tier holds at least one checkpoint — the
+        resume gate for a fresh host whose local directory is empty but
+        whose remote mirror survived the old host's loss."""
+        return self.latest_step() is not None or bool(self._remote_steps())
 
     @staticmethod
     def _state_tree(ff):
@@ -619,6 +900,36 @@ class LocalCheckpointManager:
             registry.histogram("resilience/ckpt_write_latency_s").observe(
                 time.perf_counter() - t0
             )
+        self._offload_published(step)
+
+    def _offload_published(self, step: int, force: bool = False) -> bool:
+        """Hand one just-published (verified) step to the offload tier.
+        The bytes are re-read from the published dir so the mirror
+        uploads exactly what write-time verification passed.  Runs on
+        the async writer thread for wait=False saves — already off the
+        step path — and never raises into the publish (the local tier
+        must stay intact even when the mirror is broken)."""
+        if self.offloader is None:
+            return False
+        final = self._path(step)
+        try:
+            files = {}
+            for name in ("state.npz", "meta.json", "manifest.json"):
+                with open(os.path.join(final, name), "rb") as f:
+                    files[name] = f.read()
+        except OSError as e:  # pruned/raced away: the mirror skips it
+            _log.warning(
+                "offload of step %d skipped: published files unreadable "
+                "(%s)", step, e,
+            )
+            return False
+        return self.offloader.maybe_submit(step, files, force=force)
+
+    def offload_step(self, step: int) -> bool:
+        """Force-mirror one published step regardless of cadence (the
+        supervisor's emergency-save path: the last checkpoint before a
+        preemption must reach the durable tier)."""
+        return self._offload_published(step, force=True)
 
     @staticmethod
     def _verify_dir(path: str, manifest: Optional[Dict] = None) -> Dict:
@@ -640,6 +951,15 @@ class LocalCheckpointManager:
                     raise CheckpointVerifyError(
                         f"{path}: leaf {key!r} crc32 {crc:#010x} != "
                         f"manifest {spec['crc32']:#010x}"
+                    )
+            # restore refuses leaves the manifest can't vouch for, so
+            # verification must too — a step with extra npz leaves
+            # would verify green here and then fail to restore
+            for key in data.files:
+                if key not in manifest["leaves"]:
+                    raise CheckpointVerifyError(
+                        f"{path}: leaf {key!r} in state.npz but missing "
+                        "from the manifest (unverifiable)"
                     )
         return manifest
 
@@ -664,21 +984,99 @@ class LocalCheckpointManager:
                 shutil.rmtree(self._path(s), ignore_errors=True)
 
     # -- restore --------------------------------------------------------
+    def _remote_steps(self) -> List[int]:
+        """Steps the remote tier claims to hold; empty when no remote
+        is configured or the remote is unreachable (restore then runs
+        local-only — the mirror is an upgrade, never a dependency)."""
+        if self.remote is None:
+            return []
+        try:
+            return self.remote.list_steps()
+        except Exception as e:  # noqa: BLE001 — any store failure
+            _log.warning(
+                "remote checkpoint tier unlistable (%s); restoring from "
+                "the local tier only", e,
+            )
+            return []
+
+    def _materialize_remote(self, step: int) -> None:
+        """Download one remote step, crc-verify the downloaded bytes in
+        a staging dir, and atomically publish it as a LOCAL step dir —
+        after this the normal local load path (and every later restore)
+        serves it.  A torn/corrupt remote copy never lands locally."""
+        files = self.remote.download_step(step)
+        tmp = os.path.join(
+            self.directory,
+            f".tmp-remote-{step}-{os.getpid()}-{next(self._tmp_ids)}",
+        )
+        os.makedirs(tmp)
+        try:
+            for name, data in files.items():
+                with open(os.path.join(tmp, name), "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+            self._verify_dir(tmp)
+            with open(os.path.join(tmp, "meta.json")) as f:
+                json.load(f)  # must parse before the dir can publish
+            final = self._path(step)
+            if os.path.exists(final):
+                # the corrupt local copy loses to its verified mirror
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+            _fsync_dir(self.directory)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._latest.advance(step)
+
     def restore(self, ff, step: Optional[int] = None) -> int:
         """Load a step (default: latest, falling back past corrupt or
         incompatible ones) into a compiled FFModel, re-verifying the
         crc32 manifest and resharding every leaf onto the current
-        executor's shardings.  Returns the restored step."""
+        executor's shardings.  Returns the restored step.
+
+        With a remote tier configured the walk is PER CHECKPOINT,
+        local -> remote: a corrupt/missing local step falls back to its
+        verified remote mirror (downloaded + re-verified + materialized
+        locally) before any progress is given up to an older step — a
+        brand-new empty directory restores entirely from remote."""
         from jax.tree_util import tree_unflatten
 
         strict = step is not None
-        candidates = [step] if strict else list(reversed(self.all_steps()))
+        local_steps = set(self.all_steps())
+        remote_steps = set(self._remote_steps())
+        candidates = ([step] if strict
+                      else sorted(local_steps | remote_steps, reverse=True))
         if not candidates:
-            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+            where = f"no checkpoints in {self.directory}"
+            if self.remote is not None:
+                where += " (remote tier empty too)"
+            raise FileNotFoundError(where)
         last_err: Optional[Exception] = None
+        registry = registry_of(ff)
         for s in candidates:
+            from_remote = False
             try:
-                new_leaves, treedef = self._load_step(ff, s)
+                if s in local_steps or (strict and s not in remote_steps):
+                    try:
+                        new_leaves, treedef = self._load_step(ff, s)
+                    except CheckpointCompatibilityError:
+                        raise  # the mirror is byte-identical: same result
+                    except Exception as e:
+                        if self.remote is None or s not in remote_steps:
+                            raise
+                        _log.warning(
+                            "local step %d unrestorable (%s); trying its "
+                            "remote mirror", s, e,
+                        )
+                        self._materialize_remote(s)
+                        new_leaves, treedef = self._load_step(ff, s)
+                        from_remote = True
+                else:
+                    self._materialize_remote(s)
+                    new_leaves, treedef = self._load_step(ff, s)
+                    from_remote = True
             except Exception as e:  # unreadable/partial -> previous step
                 if strict:
                     raise
@@ -697,6 +1095,15 @@ class LocalCheckpointManager:
                 # newer steps failed verification: re-point LATEST at
                 # the step that actually restored
                 self._latest.advance(s, force=True)
+            if from_remote:
+                _log.info(
+                    "step %d restored from the remote tier into %s",
+                    s, self.directory,
+                )
+                if registry is not None:
+                    registry.counter(
+                        "resilience/offload_remote_restores"
+                    ).inc()
             restored = tree_unflatten(treedef, new_leaves)
             ff._weights = restored["weights"]
             ff._opt_state = restored["opt_state"]
@@ -722,46 +1129,9 @@ class LocalCheckpointManager:
         with np.load(os.path.join(self._path(step), "state.npz")) as data:
             # one decompression per leaf: each data[key] access re-reads
             arrays = {key: data[key] for key in data.files}
-        target = self._state_tree(ff)
-        leaves, treedef = tree_flatten_with_path(target)
-        # layout validation FIRST: one clear error naming every
-        # mismatched leaf beats a KeyError/reshape traceback from
-        # whichever leaf happened to differ
-        saved_specs = {
-            key: {"shape": list(arr.shape), "dtype": str(arr.dtype)}
-            for key, arr in arrays.items()
-        }
-        current_specs = {
-            keystr(path): {
-                "shape": list(cur.shape),
-                "dtype": str(cur.dtype),
-            }
-            for path, cur in leaves
-        }
-        mismatches = _spec_mismatches(saved_specs, current_specs)
-        if mismatches:
-            raise CheckpointCompatibilityError(step, mismatches, meta)
-        new_leaves = []
-        for path, cur in leaves:
-            key = keystr(path)
-            arr = arrays[key]
-            if manifest is not None:
-                spec = manifest["leaves"].get(key)
-                if spec is None:
-                    raise CheckpointVerifyError(
-                        f"step {step}: leaf {key!r} missing from manifest"
-                    )
-                crc = _leaf_crc(arr)
-                if crc != spec["crc32"]:
-                    raise CheckpointVerifyError(
-                        f"step {step}: leaf {key!r} crc32 {crc:#010x} "
-                        f"!= manifest {spec['crc32']:#010x}"
-                    )
-            sh = getattr(cur, "sharding", None)
-            new_leaves.append(
-                jax.device_put(arr, sh) if sh is not None else arr
-            )
-        return new_leaves, treedef
+        return _verify_adapt_put(
+            ff, self._state_tree(ff), arrays, manifest, meta, step
+        )
 
     def restore_meta(self, step: Optional[int] = None) -> Dict[str, Any]:
         if step is None:

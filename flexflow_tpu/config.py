@@ -184,6 +184,22 @@ class FFConfig:
     max_restarts: int = 3      # restore-and-retry budget per run
     retry_backoff: float = 0.1  # base backoff seconds (exponential, jittered)
     nan_policy: str = "raise"  # raise | skip_step | restore | off
+    # -- durable offload tier (resilience/offload.py, store/blobstore.py;
+    #    docs/RESILIENCE.md "Durable offload & host-loss recovery"):
+    #    mirror every verified local checkpoint — and the strategy
+    #    store — to an object store so a FULL HOST LOSS keeps a restore
+    #    target.  URI: file:///path or a bare path (filesystem backend;
+    #    an NFS mount used this way is a production deployment);
+    #    gs://... names the cloud backend once its SDK is provisioned.
+    #    None/"none" = offload off (single-tier, pre-PR-9 behavior).
+    remote_store: Optional[str] = None
+    offload_every: int = 1   # mirror every Nth verified local checkpoint
+    remote_keep: int = 3     # keep-last-k retention in the remote tier
+    # how long a preempted worker waits for its peers' barrier posts
+    # before committing the best agreement so far — size it WELL below
+    # the platform's preemption grace window, since the emergency save
+    # only starts after the rendezvous returns
+    barrier_timeout: float = 30.0
 
     # -- observability (obs/, docs/OBSERVABILITY.md).  trace_dir turns
     #    on the full telemetry pipeline and names where the artifacts
@@ -278,6 +294,18 @@ class FFConfig:
             raise ValueError(
                 f"step_timeout must be >= 0 (0 = watchdog off), "
                 f"got {self.step_timeout}"
+            )
+        if self.offload_every < 1:
+            raise ValueError(
+                f"offload_every must be >= 1, got {self.offload_every}"
+            )
+        if self.remote_keep < 1:
+            raise ValueError(
+                f"remote_keep must be >= 1, got {self.remote_keep}"
+            )
+        if self.barrier_timeout <= 0:
+            raise ValueError(
+                f"barrier_timeout must be > 0, got {self.barrier_timeout}"
             )
         if not self.wus_axis:
             raise ValueError("wus_axis must be a non-empty mesh axis name")
@@ -407,6 +435,16 @@ class FFConfig:
                        default=0.1)
         p.add_argument("--nan-policy", dest="nan_policy", type=str,
                        default="raise", choices=NAN_POLICIES)
+        p.add_argument("--remote-store", dest="remote_store", type=str,
+                       default=None)
+        p.add_argument("--no-remote-store", dest="remote_store",
+                       action="store_const", const="none")
+        p.add_argument("--offload-every", dest="offload_every", type=int,
+                       default=1)
+        p.add_argument("--remote-keep", dest="remote_keep", type=int,
+                       default=3)
+        p.add_argument("--barrier-timeout", dest="barrier_timeout",
+                       type=float, default=30.0)
         p.add_argument("--trace-dir", dest="trace_dir", type=str, default=None)
         p.add_argument("--telemetry", dest="telemetry", action="store_true")
         p.add_argument("--profile-steps", dest="profile_steps", type=str,
@@ -480,6 +518,10 @@ class FFConfig:
             max_restarts=args.max_restarts,
             retry_backoff=args.retry_backoff,
             nan_policy=args.nan_policy,
+            remote_store=args.remote_store,
+            offload_every=args.offload_every,
+            remote_keep=args.remote_keep,
+            barrier_timeout=args.barrier_timeout,
             trace_dir=args.trace_dir,
             telemetry=args.telemetry,
             profile_steps=args.profile_steps,

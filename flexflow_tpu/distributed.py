@@ -6,13 +6,24 @@ host runs the same script, `jax.distributed` joins them into one
 runtime, and XLA SPMD spans all chips.  This module owns that join plus
 the per-host batch-feeding helper the docs previously asked users to
 hand-write (docs/MULTI-NODE.md).
+This module also owns the cross-host *preemption barrier*
+(`preemption_barrier`): a blob-store rendezvous keyed by run id so that
+when a preemption notice lands, every worker's SIGTERM emergency
+checkpoint commits the SAME step — the first cross-host coordination
+primitive on the path to pod-scale placement (docs/RESILIENCE.md
+"Durable offload & host-loss recovery").
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
-from typing import Dict, Optional, Sequence
+import time
+from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
+
+_log = logging.getLogger("flexflow_tpu.distributed")
 
 _initialized = False
 
@@ -85,6 +96,111 @@ def initialize(
         _initialized = True
         return False
     return jax.process_count() > 1
+
+
+def preemption_barrier(
+    blob,
+    run_id: str,
+    step: int,
+    *,
+    host_id: Optional[int] = None,
+    num_hosts: Optional[int] = None,
+    timeout_s: float = 30.0,
+    poll_s: float = 0.05,
+    sleep: Callable[[float], None] = time.sleep,
+) -> int:
+    """Blob-store rendezvous for coordinated emergency checkpoints.
+
+    When the TPU runtime preempts a slice it SIGTERMs every host; each
+    host's supervisor finishes its in-flight step and must then write
+    an emergency checkpoint.  Without coordination the hosts can name
+    DIFFERENT steps (one was a step ahead when the signal landed) and
+    the resume target becomes ambiguous.  This barrier has every worker
+    post its boundary step under `barrier/<run_id>/host_<i>` and wait
+    for the full quorum; the agreed commit step is the MAXIMUM posted.
+    Hosts behind the maximum can always reach it — the step loop is
+    deterministic and their data is local — so the supervisor runs a
+    lagging host FORWARD to the agreed step before its emergency save,
+    and every host commits the same (newest) state.
+
+    `host_id`/`num_hosts` default to the jax runtime's process index
+    and count; a single-process run returns `step` immediately.  The
+    deadline is hard: a quorum that never completes (a peer died before
+    posting) times out and returns the best agreement so far — during a
+    preemption, waiting forever loses the checkpoint entirely, which is
+    strictly worse than an unagreed step name.  Deterministic `sleep`
+    injection keeps the barrier testable without wall-clock waits.
+
+    Posts persist after agreement (deleting them would race slower
+    readers out of their quorum), so every supervisor run() clears
+    `barrier/<run_id>/` before training starts — see
+    `clear_preemption_barrier` — and `run_id` must be unique per
+    logical run on a shared blob root.
+    """
+    from .store.blobstore import BlobStoreError
+
+    if host_id is None or num_hosts is None:
+        import jax
+
+        host_id = jax.process_index() if host_id is None else host_id
+        num_hosts = jax.process_count() if num_hosts is None else num_hosts
+    if num_hosts <= 1:
+        return int(step)
+    prefix = f"barrier/{run_id}/"
+    key = f"{prefix}host_{host_id:05d}"
+    payload = json.dumps({"host": int(host_id), "step": int(step)}).encode()
+    try:
+        blob.put(key, payload)
+    except BlobStoreError as e:
+        _log.warning(
+            "preemption barrier post failed (%s); committing step %d "
+            "without cross-host agreement", e, step,
+        )
+        return int(step)
+    deadline = time.monotonic() + timeout_s
+    agreed = int(step)
+    while True:
+        posts = []
+        try:
+            for k in blob.list(prefix):
+                try:
+                    posts.append(int(json.loads(blob.get(k))["step"]))
+                except (BlobStoreError, ValueError, KeyError, TypeError):
+                    continue  # a peer's post mid-write: next poll sees it
+        except BlobStoreError:
+            posts = []
+        if posts:
+            # max: the newest state any host holds; laggards run
+            # forward to it (never backward — state can't rewind)
+            agreed = max(posts + [int(step)])
+        if len(posts) >= num_hosts:
+            return agreed
+        if time.monotonic() >= deadline:
+            _log.warning(
+                "preemption barrier timed out with %d/%d hosts posted; "
+                "committing step %d", len(posts), num_hosts, agreed,
+            )
+            return agreed
+        sleep(poll_s)
+
+
+def clear_preemption_barrier(blob, run_id: str) -> int:
+    """Remove every post under `barrier/<run_id>/` — called by the
+    supervisor at the START of each run so a previous incarnation's
+    rendezvous (the preemption this run is resuming from) can never
+    satisfy a future quorum with stale steps.  Returns the count
+    removed; failures are swallowed (an unreachable store just means
+    nothing to clear or a degraded later barrier)."""
+    from .store.blobstore import BlobStoreError
+
+    removed = 0
+    try:
+        for k in blob.list(f"barrier/{run_id}/"):
+            if blob.delete(k):
+                removed += 1
+    except BlobStoreError as e:
+        _log.info("preemption-barrier clear failed (%s)", e)
+    return removed
 
 
 def shard_host_batch(

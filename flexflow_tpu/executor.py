@@ -262,13 +262,20 @@ class GraphExecutor:
         along the wus axis — and scalar entries (Adam's t) onto a
         mesh-replicated sharding (an eagerly created scalar carries a
         single-device sharding that checkpoint restore would otherwise
-        commit to, wedging multi-device steps).  No-op when
-        weight-update sharding is off: slots then inherit each weight's
-        strategy sharding from init_state."""
-        if self.wus_axis is None:
+        commit to, wedging multi-device steps).  When weight-update
+        sharding is off (or its axis collapsed on the searched mesh)
+        the slot trees inherit each weight's strategy sharding from
+        init_state, but scalar entries still get the replicated put —
+        the wedge doesn't care whether ZeRO-1 is on."""
+        if self.mesh.devices.size <= 1:
             return opt_state
-        sh = self.wus_shardings()
         rep = NamedSharding(self.mesh, PartitionSpec())
+        if self.wus_axis is None:
+            return {
+                k: sub if isinstance(sub, dict) else jax.device_put(sub, rep)
+                for k, sub in opt_state.items()
+            }
+        sh = self.wus_shardings()
         return {
             k: (
                 jax.tree.map(lambda v, s: jax.device_put(v, s), sub, sh)

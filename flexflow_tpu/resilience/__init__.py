@@ -7,6 +7,7 @@ mesh (supervisor.py).  See docs/RESILIENCE.md.
 """
 from .async_writer import AsyncCheckpointWriter
 from .faults import (
+    BLOB_FAULT_KINDS,
     CheckpointWriteFault,
     DeviceLossFault,
     Fault,
@@ -16,6 +17,12 @@ from .faults import (
     InjectedFault,
     PreemptionFault,
     StepFault,
+)
+from .offload import (
+    CheckpointOffloader,
+    RemoteCheckpointStore,
+    RemoteVerifyError,
+    offloader_from_config,
 )
 from .retry import RetryPolicy
 from .supervisor import (
@@ -27,8 +34,13 @@ from .watchdog import HungStepTimeout, StepWatchdog
 
 __all__ = [
     "AsyncCheckpointWriter",
+    "BLOB_FAULT_KINDS",
+    "CheckpointOffloader",
     "CheckpointWriteFault",
     "DeviceLossFault",
+    "RemoteCheckpointStore",
+    "RemoteVerifyError",
+    "offloader_from_config",
     "Fault",
     "FaultKind",
     "FaultPlan",

@@ -23,6 +23,26 @@ Fault matrix (see docs/RESILIENCE.md):
                                           twin of a real watchdog
                                           HungStepTimeout)
   nan_loss          (batch poisoned)      per FFConfig.nan_policy
+
+Object-store fault matrix (store/blobstore.py FaultyBlobStore consumes
+these; the training loop never sees them directly — the offload tier
+retries/degrades, docs/RESILIENCE.md "Durable offload"):
+
+  kind                 effect                    offloader reaction
+  -------------------  ------------------------  ----------------------
+  blob_transient       one op raises             retry under the
+                       BlobUnavailableError      backoff budget
+  blob_partial_upload  one put lands truncated   remote crc verify
+                       bytes                     fails; REMOTE_LATEST
+                                                 stays; step quarantined
+  blob_latency         one op sleeps delay_s     absorbed off the
+                                                 critical path
+  blob_unavailable     `ops` consecutive ops     degrade to local-only
+                       raise                     with a counter
+
+For blob kinds, `Fault.step` is the FaultyBlobStore *operation index*
+(fire at or after the Nth blob op), not a training step — an upload's
+op count is deterministic, so seeded plans replay exactly.
 """
 from __future__ import annotations
 
@@ -48,6 +68,21 @@ class FaultKind(str, enum.Enum):
     # exactly one step, driving the loss non-finite (exercises
     # FFConfig.nan_policy end to end without faking metrics)
     NAN_LOSS = "nan_loss"
+    # -- object-store faults (consumed by store.blobstore.FaultyBlobStore,
+    #    never raised into the training loop; step = blob op index) ------
+    BLOB_TRANSIENT = "blob_transient"
+    BLOB_PARTIAL_UPLOAD = "blob_partial_upload"
+    BLOB_LATENCY = "blob_latency"
+    BLOB_UNAVAILABLE = "blob_unavailable"
+
+
+#: FaultKinds handled by FaultyBlobStore rather than the supervisor
+BLOB_FAULT_KINDS = frozenset({
+    FaultKind.BLOB_TRANSIENT,
+    FaultKind.BLOB_PARTIAL_UPLOAD,
+    FaultKind.BLOB_LATENCY,
+    FaultKind.BLOB_UNAVAILABLE,
+})
 
 
 class InjectedFault(RuntimeError):
@@ -181,13 +216,37 @@ class FaultPlan:
         return inputs
 
     def check_checkpoint(self, step: int) -> None:
-        """Fail the first checkpoint save attempted at or after the
-        fault's step (cadence rarely lands exactly on it), once."""
+        """Fail the first LOCAL checkpoint save attempted at or after
+        the fault's step (cadence rarely lands exactly on it), once.
+        Faults with payload target="remote" belong to the uploader path
+        (check_offload) and are skipped here."""
         for f in self.faults:
             if f.fired or f.kind != FaultKind.CHECKPOINT_WRITE or step < f.step:
                 continue
+            if f.payload.get("target") == "remote":
+                continue
             f.fired = True
             raise CheckpointWriteFault(step)
+
+    def check_offload(self, step: int) -> None:
+        """The uploader-path twin of check_checkpoint: fail the first
+        remote mirror attempt at or after the fault's step, once.  Only
+        CHECKPOINT_WRITE faults with payload target="remote" fire here —
+        a plan can break the local write, the upload, or both
+        independently."""
+        for f in self.faults:
+            if f.fired or f.kind != FaultKind.CHECKPOINT_WRITE or step < f.step:
+                continue
+            if f.payload.get("target") != "remote":
+                continue
+            f.fired = True
+            raise CheckpointWriteFault(step, target="remote")
+
+    def blob_faults(self) -> List[Fault]:
+        """The plan's object-store faults (consumed by
+        store.blobstore.FaultyBlobStore; the supervisor's own injection
+        points ignore these kinds)."""
+        return [f for f in self.faults if f.kind in BLOB_FAULT_KINDS]
 
     # -- introspection / replay -----------------------------------------
     def remaining(self) -> List[Fault]:

@@ -39,6 +39,7 @@ the same mesh (tests/test_resilience.py enforces this).
 from __future__ import annotations
 
 import dataclasses
+import os
 import signal
 import threading
 import time
@@ -120,6 +121,9 @@ class TrainingSupervisor:
         async_save: Optional[bool] = None,
         step_timeout: Optional[float] = None,
         preempt_grace: Optional[bool] = None,
+        offloader=None,
+        blob_store=None,
+        run_id: Optional[str] = None,
         sleep: Callable[[float], None] = time.sleep,
         logger=resilience_logger,
     ):
@@ -157,18 +161,40 @@ class TrainingSupervisor:
             if preempt_grace is None else bool(preempt_grace)
         )
         self._preempt: Optional[str] = None
-        # survivor count -> pipeline-excluded re-search winner (see
-        # _search_strategy: these cannot ride the shared store)
-        self._np_strategies: Dict[int, object] = {}
+        # durable offload tier (resilience/offload.py): mirrors every
+        # verified local checkpoint to object storage off the critical
+        # path.  Tests inject a pre-built offloader (or a faulty blob
+        # store); production resolves FFConfig.remote_store.
+        self.offloader = offloader
+        if self.offloader is None:
+            from .offload import offloader_from_config
+
+            self.offloader = offloader_from_config(
+                cfg, blob=blob_store, fault_plan=self.fault_plan,
+                registry=registry_of(ff), sleep=sleep,
+            )
+        # names the cross-host preemption-barrier rendezvous in the blob
+        # store; every worker of one run must agree on it
+        self._run_id_defaulted = run_id is None
+        self.run_id = run_id or os.path.basename(
+            os.path.abspath(directory)
+        ) or "run"
+        self.barrier_timeout = float(getattr(cfg, "barrier_timeout", 30.0))
         keep = cfg.checkpoint_keep if keep is None else keep
         if backend == "orbax":
             from ..checkpoint import CheckpointManager
 
-            self.manager = CheckpointManager(directory, max_to_keep=keep)
+            self.manager = CheckpointManager(
+                directory, max_to_keep=keep,
+                remote=(self.offloader.remote
+                        if self.offloader is not None else None),
+            )
         elif backend == "local":
             from ..checkpoint import LocalCheckpointManager
 
-            self.manager = LocalCheckpointManager(directory, max_to_keep=keep)
+            self.manager = LocalCheckpointManager(
+                directory, max_to_keep=keep, offloader=self.offloader,
+            )
         else:
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.counters: Dict[str, float] = {
@@ -187,8 +213,6 @@ class TrainingSupervisor:
             "re_searches": 0,
             "re_search_store_hits": 0,  # elastic re-searches answered
                                         # by the strategy store
-            "re_search_pipeline_excluded": 0,  # pipeline winners re-run
-                                               # without pp candidates
         }
 
     # -- deterministic batching -----------------------------------------
@@ -242,6 +266,18 @@ class TrainingSupervisor:
                 "async checkpoint save failed at step %d: %s", failed_step, err
             )
 
+    def _drain_offloader(self) -> None:
+        """Wait out pending remote mirrors.  Upload failures were
+        already folded into the offloader's counters by its budget
+        logic; anything returned here is an uploader-thread crash."""
+        if self.offloader is None:
+            return
+        for failed_step, err in self.offloader.drain():
+            self.counters["checkpoint_failures"] += 1
+            self.log.info(
+                "offload uploader crashed at step %d: %s", failed_step, err
+            )
+
     def _restore_latest(self, step: int) -> int:
         # a pending async save may be the newest durable state — let it
         # land (or fail) before picking the restore target
@@ -280,12 +316,11 @@ class TrainingSupervisor:
             from ..pcg.search import mcmc_search, unity_search
             from ..store import cached_search
 
-            def _run(enable_pipeline: bool = True):
+            def _run():
                 if cfg.search_algo == "mcmc":
                     s = mcmc_search(self.ff, num_devices)
                 else:
-                    s = unity_search(self.ff, num_devices,
-                                     enable_pipeline=enable_pipeline)
+                    s = unity_search(self.ff, num_devices)
                 # same pre-publish provenance stamp as FFModel.compile's
                 # search path: a store entry restored on another host
                 # must carry the catalog identity its rewrite trace was
@@ -293,36 +328,15 @@ class TrainingSupervisor:
                 self.ff._stamp_catalog(s)
                 return s
 
-            cached = self._np_strategies.get(num_devices)
-            if cached is not None:
-                # a previous loss at this survivor count already paid
-                # the pipeline-excluded re-search; reuse it instead of
-                # re-paying two searches in the recovery path
-                return cached
+            # pipeline winners restore fine since checkpoint.py learned
+            # the per-op <-> __pipeline__ stacked layout mapping
+            # (_adapt_saved_layout), so the former pipeline-exclusion
+            # re-run is gone: whatever the search picks, reshard-restore
+            # carries the trained state onto it
             strategy = cached_search(self.ff, num_devices, _run)
-            if getattr(strategy, "pipeline", None):
-                # the carried state is restored from a PER-OP-keyed
-                # checkpoint; reshard-restore cannot map it onto the
-                # GPipe stacked weight layout mid-run (ROADMAP
-                # pre-existing bug) — re-search with pipeline
-                # candidates off.  Not published to the store (the
-                # entry for this key legitimately IS the pipeline
-                # winner for a fresh compile) but memoized in-process
-                # so repeated losses don't re-pay the double search.
-                self.counters["re_search_pipeline_excluded"] += 1
-                self.log.info(
-                    "elastic re-search for %d devices chose a pipeline "
-                    "strategy; excluding pipeline candidates (carried "
-                    "state cannot reshard onto the stacked layout)",
-                    num_devices,
-                )
-                strategy = _run(enable_pipeline=False)
-                self._np_strategies[num_devices] = strategy
-            elif (getattr(strategy, "search_stats", None) or {}).get(
+            if (getattr(strategy, "search_stats", None) or {}).get(
                 "store_hit"
             ):
-                # counted only when the hit is actually USED (a
-                # discarded pipeline hit is not a fast path)
                 self.counters["re_search_store_hits"] += 1
             return strategy
         from ..strategy import data_parallel_strategy
@@ -402,10 +416,54 @@ class TrainingSupervisor:
                 break
         return installed
 
+    def _preempt_rendezvous(self, step: int) -> int:
+        """Agree with the run's other workers on ONE emergency step
+        (blob-store preemption barrier, max of posts).  The run loop
+        keeps stepping a lagging host FORWARD to the returned step
+        before the emergency save, so every host commits the SAME
+        state.  Without a remote tier (or on any barrier failure) the
+        host's own step stands."""
+        if self.offloader is None:
+            return step
+        from ..distributed import preemption_barrier
+
+        try:
+            import jax
+
+            if self._run_id_defaulted and jax.process_count() > 1:
+                # the default run_id is the checkpoint dir's basename:
+                # hosts with differing per-host paths would rendezvous
+                # under DIFFERENT prefixes and each poll a quorum of one
+                self.log.warning(
+                    "preemption-barrier run_id defaulted to %r from the "
+                    "checkpoint directory — pass TrainingSupervisor("
+                    "run_id=...) with one fleet-wide value if per-host "
+                    "paths differ", self.run_id,
+                )
+            agreed = int(preemption_barrier(
+                self.offloader.remote.blob, self.run_id, step,
+                timeout_s=self.barrier_timeout,
+                sleep=self.sleep,
+            ))
+        except Exception as e:  # noqa: BLE001 — never block the save
+            self.log.info("preemption barrier failed (%s); saving "
+                          "without cross-host agreement", e)
+            return step
+        if agreed != step:
+            self.log.info(
+                "preemption barrier agreed on step %d (this host is at "
+                "%d): running forward to it before the emergency save",
+                agreed, step,
+            )
+        return agreed
+
     def _emergency_stop(self, step: int) -> None:
         """The preemption deadline is unknown — synchronously write one
         final checkpoint at this step boundary, drain the async writer,
-        and leave the directory restorable."""
+        and leave the directory restorable.  With a remote tier
+        configured the step was already barrier-agreed by the run loop
+        (_preempt_rendezvous); the emergency step is force-mirrored
+        regardless of cadence."""
         registry = registry_of(self.ff)
         with tracer_of(self.ff).span("emergency_checkpoint", cat="resilience",
                                      step=step, reason=self._preempt):
@@ -414,6 +472,11 @@ class TrainingSupervisor:
             # race it on the step dir / LATEST pointer
             self._drain_writer()
             self._save_checkpoint_survivable(step, wait=True)
+        if self.offloader is not None and hasattr(self.manager,
+                                                  "offload_step"):
+            # the last checkpoint before the host disappears is exactly
+            # the one the remote tier exists for
+            self.manager.offload_step(step)
         self.counters["emergency_saves"] += 1
         if registry is not None:
             registry.counter("resilience/ckpt_emergency_saves").inc()
@@ -445,8 +508,18 @@ class TrainingSupervisor:
         loss_by_step: Dict[int, float] = {}
         step = 0
         restarts = 0
+        preempt_target: Optional[int] = None
         self._preempt = None
-        if resume and self.manager.latest_step() is not None:
+        if self.offloader is not None:
+            # stale rendezvous posts from the incarnation this run is
+            # resuming FROM must never satisfy a future quorum
+            from ..distributed import clear_preemption_barrier
+
+            clear_preemption_barrier(self.offloader.remote.blob,
+                                     self.run_id)
+        if resume and self.manager.any_restorable():
+            # any_restorable consults BOTH tiers: a fresh host with an
+            # empty directory resumes from the remote mirror
             step = int(self.manager.restore(ff))
             self.log.info("resumed from checkpoint step %d", step)
         else:
@@ -455,7 +528,13 @@ class TrainingSupervisor:
         try:
             while step < num_steps:
                 if self._preempt is not None:
-                    break
+                    # rendezvous ONCE, then keep stepping until this
+                    # host reaches the fleet-agreed emergency step (the
+                    # max posted — laggards run forward, nobody rewinds)
+                    if preempt_target is None:
+                        preempt_target = self._preempt_rendezvous(step)
+                    if step >= preempt_target:
+                        break
                 try:
                     self.fault_plan.check_step(step)
                     inputs, labels = self._batch(
@@ -505,14 +584,24 @@ class TrainingSupervisor:
                 # AFTER the loop, not at its top: a signal during the
                 # final step must still get its boundary checkpoint —
                 # report.preempted promises a restorable directory
+                if preempt_target is None:
+                    # the signal landed during the final step, so the
+                    # loop exited before the top-of-loop rendezvous
+                    # ran.  Post anyway: peers block on num_hosts posts
+                    # and would otherwise stall to the deadline and
+                    # commit a divergent step.  This host completed
+                    # every step, so the agreed max cannot exceed it.
+                    self._preempt_rendezvous(step)
                 self._emergency_stop(step)
         finally:
             for sig, handler in displaced.items():
                 signal.signal(sig, handler)
             # every exit path — clean, preempted, budget-exhausted —
-            # waits out the async writer: queued saves must land (or
-            # be counted failed) before the process can go away
+            # waits out the async writer AND the remote mirror: queued
+            # saves/uploads must land (or be counted failed/abandoned)
+            # before the process can go away
             self._drain_writer()
+            self._drain_offloader()
         # same "supervisor: k=v ..." log line as before, now also folded
         # into the run's metrics registry (-> run_telemetry.jsonl)
         tel = getattr(self.ff, "telemetry", None)
@@ -523,10 +612,16 @@ class TrainingSupervisor:
         )
         if tel is not None and tel.enabled:
             tel.flush()
+        # the report carries the mirror's counters too (offload_*) —
+        # they already live in the registry as real Counters, so they
+        # ride the report dict only, not the gauge fold above
+        counters = dict(self.counters)
+        if self.offloader is not None:
+            counters.update(self.offloader.counters)
         return SupervisorReport(
             final_step=step,
             losses=[loss_by_step[s] for s in sorted(loss_by_step)],
-            counters=dict(self.counters),
+            counters=counters,
             preempted=self._preempt,
         )
 

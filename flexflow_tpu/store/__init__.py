@@ -33,7 +33,22 @@ from .key import (
     simulator_version,
     store_key_for,
 )
-from .store import MANIFEST_VERSION, StoreVerifyError, StrategyStore
+from .blobstore import (
+    BlobNotFound,
+    BlobPreconditionFailed,
+    BlobStore,
+    BlobStoreError,
+    BlobUnavailableError,
+    FaultyBlobStore,
+    LocalBlobStore,
+    blobstore_from_uri,
+)
+from .store import (
+    MANIFEST_VERSION,
+    RemoteStrategyMirror,
+    StoreVerifyError,
+    StrategyStore,
+)
 
 #: env var naming a shared store root for every process in a fleet
 #: (per-run --strategy-store overrides it; --no-strategy-store opts out)
@@ -55,12 +70,29 @@ def resolve_store_dir(cfg) -> Optional[str]:
 def store_from_config(cfg, registry=None) -> Optional[StrategyStore]:
     """The run's StrategyStore, or None when disabled/unusable.  An
     unwritable root degrades to store-off with a log line — persistence
-    is an accelerator, never a crash source."""
+    is an accelerator, never a crash source.  FFConfig.remote_store
+    attaches the fleet mirror (docs/STORE.md "Fleet mirror"): lookups
+    consult local -> remote and publishes mirror through, sharing the
+    checkpoint offload tier's blob root under its `strategies/`
+    prefix."""
     root = resolve_store_dir(cfg)
     if root is None:
         return None
+    remote = None
+    uri = getattr(cfg, "remote_store", None)
+    if uri and str(uri).strip().lower() != "none":
+        try:
+            from .blobstore import blobstore_from_uri
+            from .store import RemoteStrategyMirror
+
+            remote = RemoteStrategyMirror(blobstore_from_uri(uri))
+        except (OSError, ValueError, NotImplementedError) as e:
+            store_logger.info(
+                "fleet mirror %r unusable (%s); continuing with the "
+                "local store only", uri, e,
+            )
     try:
-        return StrategyStore(root, registry=registry)
+        return StrategyStore(root, registry=registry, remote=remote)
     except OSError as e:
         store_logger.info(
             "strategy store root %s unusable (%s); continuing without "
@@ -160,9 +192,18 @@ def cached_search(model, num_devices: int,
 __all__ = [
     "MANIFEST_VERSION",
     "STORE_DIR_ENV",
+    "BlobNotFound",
+    "BlobPreconditionFailed",
+    "BlobStore",
+    "BlobStoreError",
+    "BlobUnavailableError",
+    "FaultyBlobStore",
+    "LocalBlobStore",
+    "RemoteStrategyMirror",
     "StoreKey",
     "StoreVerifyError",
     "StrategyStore",
+    "blobstore_from_uri",
     "cached_search",
     "enable_compilation_cache",
     "graph_signature",

@@ -36,6 +36,12 @@ from typing import Dict, List, Optional, Tuple
 from ..checkpoint import _fsync_dir, _write_json_fsync
 from ..logger import store_logger
 from ..strategy import Strategy
+from .blobstore import (
+    BlobNotFound,
+    BlobStore,
+    BlobStoreError,
+    rmtree_blob_prefix,
+)
 from .key import StoreKey, strategy_sha256
 
 MANIFEST_VERSION = 1
@@ -59,12 +65,121 @@ def _json_safe(obj):
         return str(obj)
 
 
-class StrategyStore:
-    """Durable strategy artifacts keyed by StoreKey digests."""
+class RemoteStrategyMirror:
+    """Fleet mirror of the strategy store on a BlobStore (docs/STORE.md
+    "Fleet mirror").
 
-    def __init__(self, root: str, registry=None):
+    Remote layout mirrors the local one: `strategies/<digest>/
+    {manifest.json,strategy.json}`.  Reads verify the same invariants
+    the local store does (manifest version, key digest, strategy
+    sha256) and treat anything torn as a miss — a sha-mismatched pair
+    is quarantined so the next publish repairs it.  Writes put
+    strategy.json first, manifest.json last, and honor the best-cost
+    upgrade policy against the REMOTE incumbent (strictly lower
+    searched_cost replaces; everything else is first-write-wins).  The
+    pair-write is lock-free like the local store: a concurrent push of
+    the same key can tear the pair, which the next fetch detects and
+    the next push repairs."""
+
+    def __init__(self, blob: BlobStore, prefix: str = "strategies/"):
+        if prefix and not prefix.endswith("/"):
+            prefix += "/"
+        self.blob = blob
+        self.prefix = prefix
+
+    def _entry_prefix(self, digest: str) -> str:
+        return f"{self.prefix}{digest}/"
+
+    def fetch(self, digest: str):
+        """(manifest dict, strategy.json text) for a verified remote
+        entry, or None — unreadable/foreign-schema entries miss without
+        deletion, genuinely torn pairs are quarantined."""
+        prefix = self._entry_prefix(digest)
+        try:
+            manifest = json.loads(self.blob.get(prefix + "manifest.json"))
+        except BlobNotFound:
+            return None
+        except (BlobStoreError, ValueError) as e:
+            store_logger.info(
+                "remote store entry %s unreadable (%s: %s): treating as "
+                "a miss", digest[:16], type(e).__name__, e,
+            )
+            return None
+        if manifest.get("manifest_version") != MANIFEST_VERSION:
+            return None  # a newer reader's entry: never delete on a maybe
+        try:
+            text = self.blob.get(prefix + "strategy.json").decode("utf-8")
+        except BlobNotFound:
+            # writes land strategy.json BEFORE manifest.json, so a
+            # manifest without its strategy is never mid-publish — it's
+            # a quarantine that raced a concurrent push.  Left in place,
+            # push()'s first-write-wins would honor the orphan manifest
+            # forever; delete it so the next publish repairs the entry.
+            store_logger.info(
+                "remote store entry %s has a manifest but no strategy: "
+                "quarantined, treating as a miss", digest[:16],
+            )
+            try:
+                rmtree_blob_prefix(self.blob, prefix)
+            except BlobStoreError:
+                pass
+            return None
+        except (BlobStoreError, UnicodeDecodeError) as e:
+            store_logger.info(
+                "remote store entry %s unreadable (%s: %s): treating as "
+                "a miss", digest[:16], type(e).__name__, e,
+            )
+            return None
+        if (manifest.get("key_digest") != digest
+                or strategy_sha256(text) != manifest.get("strategy_sha256")):
+            store_logger.info(
+                "remote store entry %s torn/mismatched: quarantined, "
+                "treating as a miss", digest[:16],
+            )
+            try:
+                rmtree_blob_prefix(self.blob, prefix)
+            except BlobStoreError:
+                pass
+            return None
+        return manifest, text
+
+    def push(self, digest: str, manifest: Dict, text: str) -> bool:
+        """Publish-through one locally-verified entry; returns True when
+        the remote entry was (re)written.  First-write-wins against the
+        remote incumbent, except a strictly better searched_cost."""
+        prefix = self._entry_prefix(digest)
+        existing = None
+        try:
+            existing = json.loads(self.blob.get(prefix + "manifest.json"))
+        except BlobNotFound:
+            pass
+        except (BlobStoreError, ValueError):
+            existing = None  # unreadable incumbent: repair it
+        if existing is not None:
+            new_cost = manifest.get("searched_cost")
+            old_cost = existing.get("searched_cost")
+            if not (new_cost is not None and old_cost is not None
+                    and float(new_cost) < float(old_cost)):
+                return False
+        self.blob.put(prefix + "strategy.json", text.encode("utf-8"))
+        self.blob.put(prefix + "manifest.json",
+                      json.dumps(manifest).encode("utf-8"))
+        return True
+
+
+class StrategyStore:
+    """Durable strategy artifacts keyed by StoreKey digests.
+
+    `remote` (a RemoteStrategyMirror) adds the fleet tier: lookups
+    consult local -> remote (a remote hit is verified, then
+    materialized as a normal local entry so the NEXT lookup is local),
+    and successful publishes mirror through, so a brand-new host warms
+    from the fleet store before its first compile."""
+
+    def __init__(self, root: str, registry=None, remote=None):
         self.root = os.path.abspath(root)
         self.registry = registry
+        self.remote = remote
         os.makedirs(self.strategies_dir, exist_ok=True)
 
     @property
@@ -91,11 +206,64 @@ class StrategyStore:
 
     # -- lookup ---------------------------------------------------------
     def lookup(self, key: StoreKey) -> Optional[Strategy]:
-        """Strategy for `key`, or None.  A hit carries the manifest's
-        provenance as strategy.search_stats with store_hit=True — the
-        compile path surfaces it exactly like a fresh search's stats.
-        Corrupt entries are quarantined (removed) so the caller's
-        post-search publish can repair them."""
+        """Strategy for `key`, or None — consulting local THEN the
+        fleet mirror.  A hit carries the manifest's provenance as
+        strategy.search_stats with store_hit=True (remote hits add
+        store_remote_hit=True); a verified remote hit is materialized
+        as a local entry so later lookups never leave the host.
+        Corrupt local entries are quarantined (removed) so the
+        caller's post-search publish can repair them."""
+        strategy = self._lookup_local(key)
+        if strategy is not None or self.remote is None:
+            return strategy
+        return self._lookup_remote(key)
+
+    def _lookup_remote(self, key: StoreKey) -> Optional[Strategy]:
+        digest = key.digest
+        try:
+            fetched = self.remote.fetch(digest)
+        except Exception as e:  # noqa: BLE001 — mirror failures never crash
+            self._count("remote_errors")
+            store_logger.info(
+                "fleet mirror lookup failed for %s (%s: %s)",
+                digest[:16], type(e).__name__, e,
+            )
+            return None
+        if fetched is None:
+            return None
+        manifest, text = fetched
+        try:
+            strategy = Strategy.from_json(text)
+        except Exception as e:  # noqa: BLE001 — verified sha, odd schema
+            self._count("remote_errors")
+            store_logger.info(
+                "fleet mirror entry %s unparseable (%s)", digest[:16], e,
+            )
+            return None
+        self._count("remote_hits")
+        store_logger.info(
+            "fleet mirror hit %s: strategy materialized locally",
+            digest[:16],
+        )
+        # materialize through the normal verify-then-publish write so
+        # the next lookup is local; mirror=False — it came FROM remote
+        self.publish(
+            key, strategy,
+            searched_cost=manifest.get("searched_cost"),
+            search_stats=manifest.get("search_stats"),
+            created_at=manifest.get("created_at"),
+            overwrite=True, mirror=False,
+        )
+        stats = dict(manifest.get("search_stats") or {})
+        stats["store_hit"] = True
+        stats["store_remote_hit"] = True
+        stats["store_key"] = digest
+        strategy.search_stats = stats
+        if manifest.get("searched_cost") is not None:
+            strategy.search_cost = manifest["searched_cost"]
+        return strategy
+
+    def _lookup_local(self, key: StoreKey) -> Optional[Strategy]:
         t0 = time.perf_counter()
         digest = key.digest
         d = self._entry_dir(digest)
@@ -186,11 +354,14 @@ class StrategyStore:
         search_stats: Optional[Dict] = None,
         created_at: Optional[float] = None,
         overwrite: bool = False,
+        mirror: bool = True,
     ) -> bool:
         """Write-verify-rename one entry; returns True when the entry
         was (re)written, False when an existing entry was kept
         (first-write-wins) or the write failed survivably.  created_at
-        is caller-supplied provenance (seconds since epoch).
+        is caller-supplied provenance (seconds since epoch).  A
+        successful write publishes THROUGH to the fleet mirror when one
+        is configured (mirror=False marks entries that came from it).
 
         Best-cost upgrade policy: a publish carrying a STRICTLY better
         (lower) `searched_cost` than the existing entry's replaces it —
@@ -271,6 +442,18 @@ class StrategyStore:
         self._count("publishes")
         if upgrading:  # counted only once the replacement actually landed
             self._count("best_cost_upgrades")
+        if mirror and self.remote is not None:
+            try:
+                if self.remote.push(digest, manifest, text):
+                    self._count("remote_publishes")
+            except Exception as e:  # noqa: BLE001 — the mirror is an
+                # accelerator for OTHER hosts; its failure never
+                # un-publishes the verified local entry
+                self._count("remote_errors")
+                store_logger.info(
+                    "fleet mirror publish failed for %s (%s: %s); local "
+                    "entry intact", digest[:16], type(e).__name__, e,
+                )
         return True
 
     def _upgrades_cost(self, entry_dir: str,

@@ -237,6 +237,43 @@ def test_durability_cli_flags_parse():
     assert base.preempt_grace is True
 
 
+def test_offload_cli_flags_parse():
+    cfg = FFConfig.from_args([
+        "--remote-store", "file:///fleet/ckpt", "--offload-every", "4",
+        "--remote-keep", "5",
+    ])
+    assert cfg.remote_store == "file:///fleet/ckpt"
+    assert cfg.offload_every == 4
+    assert cfg.remote_keep == 5
+    # defaults: no remote tier, mirror every verified save, keep 3
+    base = FFConfig.from_args([])
+    assert base.remote_store is None
+    assert base.offload_every == 1
+    assert base.remote_keep == 3
+    # explicit opt-out (the --no-strategy-store pattern)
+    off = FFConfig.from_args(["--no-remote-store"])
+    assert off.remote_store == "none"
+    from flexflow_tpu.resilience.offload import offloader_from_config
+
+    assert offloader_from_config(off) is None
+    assert offloader_from_config(base) is None
+
+
+def test_offload_config_validated():
+    with pytest.raises(ValueError):
+        FFConfig(offload_every=0)
+    with pytest.raises(ValueError):
+        FFConfig(remote_keep=0)
+    with pytest.raises(ValueError):
+        FFConfig(barrier_timeout=0.0)
+
+
+def test_barrier_timeout_flag_parses():
+    cfg = FFConfig.from_args(["--barrier-timeout", "5.5"])
+    assert cfg.barrier_timeout == 5.5
+    assert FFConfig.from_args([]).barrier_timeout == 30.0
+
+
 def test_serving_cli_flags_parse():
     cfg = FFConfig.from_args([
         "--serving-mode", "static", "--kv-page-size", "8",

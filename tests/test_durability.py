@@ -541,3 +541,113 @@ def test_ckpt_spans_and_counters(devices8, tmp_path):
     assert hist.count == 2 and hist.sum > 0
     assert reg.gauge("resilience/ckpt_queue_depth").value == 0  # drained
     mgr.close()
+
+
+# -- pipeline <-> per-op restore layout mapping (ISSUE 9 satellite) ------
+
+def _blocky_model(devices, strategy=None, seed=0, momentum=0.9):
+    """4 identical dense blocks + head: the repeated-block graph the
+    pipeline plan stacks, compiled per-op or under a pp strategy."""
+    cfg = FFConfig(batch_size=16, num_devices=len(devices), seed=seed)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 8], name="x")
+    t = x
+    for i in range(4):
+        t = ff.dense(t, 8, activation=ActiMode.RELU, name=f"blk{i}")
+    t = ff.dense(t, 4, name="head")
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05, momentum=momentum),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy=strategy, devices=devices, seed=seed)
+    return ff
+
+
+def _pp_strategy(dp, pp, M):
+    from flexflow_tpu.strategy import Strategy
+
+    axes = {"data": dp, "pipe": pp} if dp > 1 else {"pipe": pp}
+    s = Strategy(
+        mesh_axes=axes,
+        pipeline={"degree": pp, "num_microbatches": M, "axis": "pipe",
+                  "dp_axis": "data" if dp > 1 else None},
+    )
+    if dp > 1:
+        s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
+    return s
+
+
+def test_restore_per_op_checkpoint_onto_pipeline(devices8, tmp_path):
+    """A checkpoint saved under a per-op strategy restores onto a
+    `__pipeline__`-stacked executor: restore maps the weight AND
+    momentum-slot trees through _adapt_weight_layout (the mapping that
+    lets elastic re-search pick pipeline winners mid-run)."""
+    xs, ys = _data()
+    ff = _blocky_model(devices8)
+    for i in range(2):
+        ff.train_step({"x": xs[i * 16:(i + 1) * 16]}, ys[i * 16:(i + 1) * 16])
+    w_saved = ff.get_weights()
+    import jax
+
+    v_saved = jax.tree.map(np.asarray, ff._opt_state)["v"]
+    mgr = LocalCheckpointManager(str(tmp_path / "c"))
+    mgr.save(ff, step=2, wait=True)
+
+    pp = _blocky_model(devices8[:4], strategy=_pp_strategy(2, 2, 4))
+    assert "__pipeline__" in pp._weights
+    step = LocalCheckpointManager(str(tmp_path / "c")).restore(pp, step=2)
+    assert step == 2
+    w_pp = pp.get_weights()
+    v_pp = jax.tree.map(np.asarray, pp._opt_state)["v"]
+    for k in range(4):
+        for name in ("kernel", "bias"):
+            np.testing.assert_array_equal(
+                w_pp["__pipeline__"][f"0.{name}"][k], w_saved[f"blk{k}"][name]
+            )
+            np.testing.assert_array_equal(
+                v_pp["__pipeline__"][f"0.{name}"][k], v_saved[f"blk{k}"][name]
+            )
+    np.testing.assert_array_equal(w_pp["head"]["kernel"],
+                                  w_saved["head"]["kernel"])
+
+
+def test_restore_pipeline_checkpoint_onto_per_op(devices8, tmp_path):
+    """The reverse mapping: a checkpoint saved under a pipeline
+    strategy restores onto a freshly compiled per-op executor."""
+    xs, ys = _data()
+    pp = _blocky_model(devices8[:4], strategy=_pp_strategy(2, 2, 4))
+    for i in range(2):
+        pp.train_step({"x": xs[i * 16:(i + 1) * 16]}, ys[i * 16:(i + 1) * 16])
+    w_saved = pp.get_weights()
+    mgr = LocalCheckpointManager(str(tmp_path / "c"))
+    mgr.save(pp, step=2, wait=True)
+
+    ff = _blocky_model(devices8)
+    assert "__pipeline__" not in ff._weights
+    step = LocalCheckpointManager(str(tmp_path / "c")).restore(ff, step=2)
+    assert step == 2
+    w = ff.get_weights()
+    for k in range(4):
+        for name in ("kernel", "bias"):
+            np.testing.assert_array_equal(
+                w[f"blk{k}"][name], w_saved["__pipeline__"][f"0.{name}"][k]
+            )
+
+
+def test_manifest_missing_leaf_is_unverifiable(devices8, tmp_path):
+    """A manifest listing FEWER leaves than state.npz must fail
+    verification — uncovered bytes would otherwise restore with no
+    integrity check at all."""
+    ff = _model(devices8)
+    mgr = LocalCheckpointManager(str(tmp_path))
+    mgr.save(ff, step=1, wait=True)
+    mpath = os.path.join(str(tmp_path), "step_00000001", "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    dropped = sorted(manifest["leaves"])[0]
+    del manifest["leaves"][dropped]
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    from flexflow_tpu.checkpoint import CheckpointVerifyError
+
+    with pytest.raises(CheckpointVerifyError, match="missing from the"):
+        mgr.restore(ff, step=1)

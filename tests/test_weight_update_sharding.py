@@ -137,6 +137,29 @@ def test_opt_state_sharded_one_over_dp(devices8):
     assert shard * 4 < shard_rep  # >= 4x shrink on the 8-way mesh
 
 
+def test_scalar_slot_replicated_without_wus(devices8):
+    """Adam's scalar t is mesh-replicated even with weight-update
+    sharding OFF: an eagerly created scalar is committed to one device,
+    and a checkpoint restore that commits to the live sharding (the
+    remote-mirror materialize path does) would wedge the multi-device
+    step with mixed device sets."""
+    from jax.sharding import NamedSharding
+
+    ff = _model(devices8, wus=False, opt=AdamOptimizer(alpha=0.01))
+    t = ff._opt_state["t"]
+    assert isinstance(t.sharding, NamedSharding)
+    assert len(t.sharding.device_set) == len(devices8)
+    # a committed round-trip through the live shardings must still step
+    import jax
+
+    ff._opt_state = jax.tree.map(
+        lambda x: jax.device_put(np.asarray(x), x.sharding), ff._opt_state
+    )
+    xs = np.random.RandomState(0).randn(16, 32).astype(np.float32)
+    ys = np.zeros(16, dtype=np.int32)
+    ff.fit(xs, ys, epochs=1)
+
+
 def test_unshardable_leaves_fall_back_per_leaf():
     """A dim that doesn't divide by the wus axis keeps its strategy
     sharding (replicated update for that leaf only)."""

@@ -102,8 +102,8 @@ def summarize(records: List[Dict]) -> str:
 
     rows = []
     for name, rec in sorted(metrics.items()):
-        if not name.startswith("store/"):
-            continue
+        if not name.startswith("store/") or name.startswith("store/remote_"):
+            continue  # remote_* renders under Durability
         short = name.split("/", 1)[1]
         if rec.get("kind") == "histogram":
             # lookup latency: render the streaming summary
@@ -121,8 +121,29 @@ def summarize(records: List[Dict]) -> str:
         (name.split("/", 1)[1], rec.get("value", 0.0))
         for name, rec in sorted(metrics.items())
         if name.startswith("resilience/")
+        and not name.startswith("resilience/offload_")
     ]
     out.append(_section("Resilience", rows))
+
+    # the durable offload tier (docs/RESILIENCE.md "Durable offload &
+    # host-loss recovery"): upload/verify/degradation counters from the
+    # checkpoint mirror plus the strategy store's fleet-mirror traffic
+    rows = []
+    for name, rec in sorted(metrics.items()):
+        if not (name.startswith("resilience/offload_")
+                or name.startswith("store/remote_")):
+            continue
+        short = name.split("/", 1)[1]
+        if rec.get("kind") == "histogram":
+            rows.append((
+                short,
+                f"n={rec.get('count', 0)} mean={_fmt(rec.get('mean', 0.0))} "
+                f"min={_fmt(rec.get('min', 0.0))} "
+                f"max={_fmt(rec.get('max', 0.0))}",
+            ))
+        else:
+            rows.append((short, rec.get("value", 0.0)))
+    out.append(_section("Durability", rows))
 
     rows = []
     for name, rec in sorted(metrics.items()):
