@@ -534,6 +534,114 @@ def bench_weight_update(on_tpu):
     return out
 
 
+def bench_zero_ladder(dev, on_tpu):
+    """ZeRO-ladder leg (manifest v14): stages 0-3 through the REAL
+    executor — per stage, the same dp-mesh Adam MLP is compiled with
+    --zero-stage and the leg times GraphExecutor's wrapped update pass
+    (the exact reduce-scatter / 1-over-dp-shard update / all-gather
+    wiring fit runs) and records grad-buffer, master-weight-resident,
+    and opt-state bytes/device from the actual NamedShardings.  On
+    dp=1 every stage coincides (update-pass regression tracker); on
+    multi-device captures grad bytes fall ~1/dp at stage >= 2 and
+    weight-resident bytes at stage 3."""
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.fftype import ActiMode
+    from flexflow_tpu.optimizer import AdamOptimizer
+    from flexflow_tpu.strategy import data_parallel_strategy
+
+    leg = MANIFEST["legs"]["zero_ladder"]
+    if on_tpu:
+        in_dim, hidden, layers = leg["input_dim"], leg["hidden"], leg["layers"]
+        classes, batch, iters = leg["classes"], leg["batch"], leg["iters"]
+    else:
+        in_dim, hidden, layers, classes, batch, iters = 128, 256, 2, 64, 8, 3
+
+    devs = jax.devices()
+    dp = len(devs)
+    out = {
+        "workload": f"Adam MLP {layers}L h{hidden}, dp={dp}, "
+                    f"executor update pass at --zero-stage 0..3",
+        "dp": dp,
+        "stages": {},
+    }
+
+    def tree_mb(shardings, leaves):
+        """Per-device MB of `leaves` laid out per the sharding tree."""
+        b = 0
+        for op_name, entry in shardings.items():
+            for wname, sh in entry.items():
+                leaf = leaves[op_name][wname]
+                b += int(np.prod(sh.shard_shape(leaf.shape))
+                         * leaf.dtype.itemsize)
+            # noqa: E501 — exact shard-shape sums, no estimate
+        return round(b / 2**20, 3)
+
+    for stage in (0, 1, 2, 3):
+        cfg = FFConfig(batch_size=batch, num_devices=dp, zero_stage=stage)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([batch, in_dim], name="x")
+        t = x
+        for _ in range(layers):
+            t = ff.dense(t, hidden, activation=ActiMode.RELU)
+        t = ff.dense(t, classes)
+        ff.softmax(t)
+        ff.compile(
+            optimizer=AdamOptimizer(alpha=1e-3),
+            loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+            strategy=data_parallel_strategy(dp),
+            devices=devs,
+        )
+        ex = ff.executor
+        grad_sh = ex.grad_shardings()
+        grads = jax.tree.map(
+            lambda v, s: jax.device_put(np.asarray(v) * 1e-3, s),
+            ff._weights, grad_sh,
+        )
+        update_fn = ex._make_update_fn(ff.optimizer)
+        jstep = jax.jit(update_fn, donate_argnums=(0, 2))
+        weights, state = jstep(ff._weights, grads, ff._opt_state)
+        jax.block_until_ready(jax.tree.leaves(weights)[0])
+
+        def window():
+            nonlocal weights, state
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                weights, state = jstep(weights, grads, state)
+            jax.block_until_ready(jax.tree.leaves(weights)[0])
+            return (time.perf_counter() - t0) / iters
+
+        dt = min(window() for _ in range(MANIFEST["timing"]["windows"]))
+        slot_b = sum(
+            int(np.prod(leaf.sharding.shard_shape(leaf.shape))
+                * leaf.dtype.itemsize)
+            for sub in state.values() if isinstance(sub, dict)
+            for entry in sub.values() for leaf in entry.values()
+        )
+        out["stages"][f"zero{stage}"] = {
+            "update_ms": round(dt * 1e3, 3),
+            "grad_mb_per_device": tree_mb(grad_sh, ff._weights),
+            "weight_resident_mb_per_device": tree_mb(
+                ex.master_weight_shardings(), ff._weights
+            ),
+            "opt_state_mb_per_device": round(slot_b / 2**20, 3),
+            "fallback_leaves": len(ex.zero_fallback_leaves()),
+        }
+    s1 = out["stages"]["zero1"]
+    s2, s3 = out["stages"]["zero2"], out["stages"]["zero3"]
+    if s1["grad_mb_per_device"] > 0:
+        out["grad_shrink_stage2"] = round(
+            s1["grad_mb_per_device"] / max(s2["grad_mb_per_device"], 1e-9), 2
+        )
+    if s1["weight_resident_mb_per_device"] > 0:
+        out["weight_shrink_stage3"] = round(
+            s1["weight_resident_mb_per_device"]
+            / max(s3["weight_resident_mb_per_device"], 1e-9), 2
+        )
+    return out
+
+
 def bench_checkpoint(dev, on_tpu):
     """Checkpoint-stall microbench (manifest v9): the step-boundary
     stall of a full-train-state save under the durability layer
@@ -1188,6 +1296,8 @@ def main():
     gc.collect()
     wu = bench_weight_update(on_tpu)
     gc.collect()
+    ladder = bench_zero_ladder(dev, on_tpu)
+    gc.collect()
     ckpt = bench_checkpoint(dev, on_tpu)
     gc.collect()
     serving = bench_serving(dev, on_tpu)
@@ -1215,6 +1325,7 @@ def main():
         "legs": {"bert_base": bert, "resnet50": resnet,
                  "bert_long_context": bert_long, "dlrm": dlrm,
                  "moe_dispatch": moe, "weight_update": wu,
+                 "zero_ladder": ladder,
                  "checkpoint": ckpt, "serving": serving,
                  "serving_resilience": serving_resilience,
                  "cold_start": cold_start, "host_loss": host_loss},

@@ -87,7 +87,7 @@ class CheckpointCompatibilityError(RuntimeError):
         meta = meta or {}
         context = (
             f" (saved with num_devices={meta.get('num_devices')}, "
-            f"weight_update_sharding={meta.get('weight_update_sharding')}, "
+            f"zero_stage={meta.get('zero_stage')}, "
             f"wus_axis={meta.get('wus_axis')})" if meta else ""
         )
         shown = "; ".join(self.mismatches[:8])
@@ -106,10 +106,17 @@ def _meta(ff, step: int) -> Dict[str, Any]:
         "strategy": ff.strategy.to_json() if ff.strategy is not None else None,
         "batch_size": ff.config.batch_size,
         "num_devices": ff.config.num_devices,
-        # ZeRO-1 layout marker: restore reshards slot leaves onto the
-        # CURRENT executor's shardings either way (sharded<->replicated
-        # and elastic meshes both round-trip); recorded so tooling can
-        # see which layout produced the artifact
+        # ZeRO ladder layout marker: restore reshards every leaf onto
+        # the CURRENT executor's shardings either way (any stage <->
+        # any stage — incl. stage-3 scattered master weights — and
+        # elastic meshes all round-trip, since leaves are saved as
+        # GLOBAL arrays); recorded so tooling can see which layout
+        # produced the artifact.  zero_stage is the EFFECTIVE stage
+        # the executor ran (search-chosen stages included).
+        "zero_stage": int(
+            getattr(getattr(ff, "executor", None), "zero_stage",
+                    getattr(ff.config, "zero_stage", 0)) or 0
+        ),
         "weight_update_sharding": bool(
             getattr(ff.config, "weight_update_sharding", False)
         ),

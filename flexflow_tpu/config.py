@@ -131,15 +131,29 @@ class FFConfig:
     simulator_segment_size: int = 16777216
 
     # -- execution
-    # cross-replica weight-update sharding (ZeRO-1, Xu et al.
-    # arXiv:2004.13336): reduce-scatter gradients along `wus_axis`,
-    # keep optimizer slots and run the update on the 1/N shard, then
-    # all-gather the updated weights back to their strategy sharding.
-    # Numerically equivalent to the replicated update; saves the
-    # redundant per-replica update compute and (slots-1)/N of the
-    # optimizer-state HBM.  The simulator models the sharded update
-    # (sim/simulator.py weight_update_sharding) so searches score
-    # candidates with the real update cost.
+    # ZeRO ladder stage (docs/PERF.md "The ZeRO ladder"; ZeRO-1 is
+    # Xu et al. arXiv:2004.13336, stages 2-3 are Rajbhandari et al.
+    # arXiv:1910.02054):
+    #   0 = replicated update (every replica runs the full optimizer
+    #       pass and keeps full grads/slots/master weights);
+    #   1 = sharded update: reduce-scatter grads along `wus_axis`, run
+    #       the update on the 1/N shard where the slots permanently
+    #       live, all-gather the updated weights back (slot HBM / N);
+    #   2 = stage 1 + gradients stay reduce-scattered THROUGH the
+    #       update — the per-device gradient buffer is the 1/N shard
+    #       (grad HBM / N);
+    #   3 = stage 2 + master weights live permanently sharded along
+    #       `wus_axis` with just-in-time per-layer all-gather on use
+    #       and double-buffered prefetch (FSDP: weight-resident
+    #       HBM / N, per-layer all-gather traffic).
+    # Every stage is numerically equivalent to stage 0 and is a costed
+    # simulator mode (sim/simulator.py zero_stage); with
+    # --memory-search the searches CHOOSE the stage per model
+    # (pcg/mcmc.py search_stage_candidates).
+    zero_stage: int = 0
+    # DEPRECATED alias for zero_stage=1 (the pre-ladder knob): True
+    # maps to stage 1 in __post_init__; after init it always mirrors
+    # `zero_stage >= 1` so existing consumers keep working.
     weight_update_sharding: bool = False
     wus_axis: str = "data"  # mesh axis the update shards over
     # reference --fusion (apply_fusion model.cc:2495): fold trailing
@@ -307,6 +321,17 @@ class FFConfig:
             raise ValueError(
                 f"barrier_timeout must be > 0, got {self.barrier_timeout}"
             )
+        if self.zero_stage not in (0, 1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be one of (0, 1, 2, 3), "
+                f"got {self.zero_stage!r}"
+            )
+        # deprecation shim: the pre-ladder --weight-update-sharding
+        # flag is exactly stage 1; after normalization the bool always
+        # mirrors the stage so old consumers stay correct
+        if self.weight_update_sharding and self.zero_stage == 0:
+            self.zero_stage = 1
+        self.weight_update_sharding = self.zero_stage >= 1
         if not self.wus_axis:
             raise ValueError("wus_axis must be a non-empty mesh axis name")
         if self.compilation_cache is not None and not str(
@@ -398,6 +423,12 @@ class FFConfig:
         p.add_argument("--machine-model-version", type=int, default=0)
         p.add_argument("--machine-model-file", type=str, default=None)
         p.add_argument("--simulator-segment-size", type=int, default=16777216)
+        # default None so an EXPLICIT --zero-stage 0 is distinguishable
+        # from the default: the explicit stage wins over the deprecated
+        # flag below (including 0), the shim only fills the default
+        p.add_argument("--zero-stage", dest="zero_stage", type=int,
+                       default=None, choices=(0, 1, 2, 3))
+        # deprecated: equivalent to --zero-stage 1 (shim in __post_init__)
         p.add_argument("--weight-update-sharding", dest="weight_update_sharding",
                        action="store_true")
         p.add_argument("--wus-axis", dest="wus_axis", type=str, default="data")
@@ -496,7 +527,10 @@ class FFConfig:
             machine_model_version=args.machine_model_version,
             machine_model_file=args.machine_model_file,
             simulator_segment_size=args.simulator_segment_size,
-            weight_update_sharding=args.weight_update_sharding,
+            zero_stage=(args.zero_stage if args.zero_stage is not None
+                        else (1 if args.weight_update_sharding else 0)),
+            weight_update_sharding=(args.weight_update_sharding
+                                    if args.zero_stage is None else False),
             wus_axis=args.wus_axis,
             perform_fusion=args.fusion,
             remat=args.remat,

@@ -90,6 +90,7 @@ class GraphExecutor:
         compute_dtype=None,
         pipeline_plan=None,
         wus_axis: Optional[str] = None,
+        zero_stage: int = 0,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -107,11 +108,24 @@ class GraphExecutor:
         self.order = graph.topo_order()
         self.sink = graph.sink_op()
         self._use_constraints = mesh.devices.size > 1
-        # cross-replica weight-update sharding (ZeRO-1, parallel/zero.py):
-        # active only when the axis exists on the mesh with size > 1
+        # ZeRO ladder (parallel/zero.py, docs/PERF.md): the wus axis is
+        # active only when it exists on the mesh with size > 1; without
+        # it every stage collapses to 0 (the replicated update).
+        #   stage 1: sharded update (grads reduce-scattered at the
+        #            update, slots resident on the 1/N shard);
+        #   stage 2: the gradient buffer itself is constrained to the
+        #            scattered layout out of backward — grad HBM / N;
+        #   stage 3: master weights live permanently scattered with
+        #            just-in-time per-layer all-gather on use and
+        #            double-buffered prefetch (no post-update gather).
         mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         self.wus_axis = (
             wus_axis if wus_axis and mesh_sizes.get(wus_axis, 1) > 1 else None
+        )
+        # a live wus axis with stage 0 means a pre-ladder caller passed
+        # only wus_axis: that contract WAS ZeRO-1
+        self.zero_stage = (
+            max(1, int(zero_stage)) if self.wus_axis is not None else 0
         )
         for op in self.order:
             op._mesh = mesh  # ops with shard_map lowerings (ring attention)
@@ -142,6 +156,12 @@ class GraphExecutor:
             op._data_layout = (
                 "nhwc" if self._op_layout.get(op.guid) == "nhwc" else "nchw"
             )
+        # ZeRO-3 just-in-time gather targets (op -> weight -> strategy
+        # sharding); None below stage 3, so the weight-read hot path
+        # pays one None check when the ladder is off or low
+        self._z3_gather = (
+            self._z3_gather_map() if self.zero_stage >= 3 else None
+        )
 
     def _build_remat_plan(self):
         """[(ops, in_guids, out_guids, pure)] per segment.  Impure
@@ -256,6 +276,72 @@ class GraphExecutor:
 
         return self._weight_sharding_tree(make)
 
+    def master_weight_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
+        """Resident layout of the master weight tree: the strategy
+        shardings below stage 3; the ZeRO-3 scattered (wus) layout at
+        stage 3 — per-op entries only, since the pipeline-stacked
+        `__pipeline__` weights are already 1/S per device on the pipe
+        axis and the GPipe region consumes them whole."""
+        if self.zero_stage < 3:
+            return self.weight_shardings()
+        out = self.wus_shardings()
+        if "__pipeline__" in out:
+            out["__pipeline__"] = self.weight_shardings()["__pipeline__"]
+        return out
+
+    def grad_shardings(self) -> Dict[str, Dict[str, NamedSharding]]:
+        """Layout the backward gradients are constrained to: the
+        scattered (wus) layout at ZeRO stage >= 2 — per-device grad HBM
+        drops by 1/N and the grads feed the 1/N-shard update directly —
+        else each weight's strategy sharding."""
+        if self.zero_stage >= 2:
+            return self.wus_shardings()
+        return self.weight_shardings()
+
+    def _wus_layout_diff(
+        self,
+    ) -> Tuple[Dict[str, Dict[str, NamedSharding]], List[str]]:
+        """One strat-vs-wus tree walk classifying every trainable leaf.
+        Returns (gather_map, fallback_names): leaves whose wus layout
+        differs from the strategy layout live scattered — the stage-3
+        gather map, op name -> {weight name: strategy NamedSharding},
+        per-op entries only since the pp-stacked `__pipeline__` weights
+        are consumed whole by the GPipe region — while leaves where
+        shard_update_spec kept the strategy spec exactly fell back to
+        the replicated update ('op.weight' names, `__pipeline__`
+        included: those participate in the sharded update like any
+        other leaf)."""
+        strat = self.weight_shardings()
+        wus = self.wus_shardings()
+        gather: Dict[str, Dict[str, NamedSharding]] = {}
+        fallback: List[str] = []
+        for op_name, entry in strat.items():
+            need = {}
+            for wname, sh in entry.items():
+                if wus[op_name][wname] == sh:
+                    fallback.append(f"{op_name}.{wname}")
+                elif op_name != "__pipeline__":
+                    need[wname] = sh
+            if need:
+                gather[op_name] = need
+        return gather, fallback
+
+    def zero_fallback_leaves(self) -> List[str]:
+        """'op.weight' names whose update falls back to the replicated
+        path while update sharding is active (no free logical dim
+        evenly divisible by the wus axis, or the axis already shards
+        the leaf) — the observability face of parallel/zero.py's
+        silent per-leaf fallback.  Empty when the ladder is off."""
+        if self.wus_axis is None:
+            return []
+        return self._wus_layout_diff()[1]
+
+    def _z3_gather_map(self) -> Dict[str, Dict[str, NamedSharding]]:
+        """Stage-3 leaves that actually live scattered (fallback leaves
+        are absent — they're already resident at their strategy
+        sharding and need no gather)."""
+        return self._wus_layout_diff()[0]
+
     def shard_opt_state(self, opt_state):
         """device_put the optimizer's weight-mirroring slot trees (SGD
         v, Adam m/v) onto the ZeRO-1 update layout — 1/N per-device HBM
@@ -312,8 +398,10 @@ class GraphExecutor:
 
     # -- weight init -----------------------------------------------------
     def init_weights(self, seed: int = 0):
-        """Initialize weight + state pytrees, sharded via out_shardings."""
-        w_shardings = self.weight_shardings()
+        """Initialize weight + state pytrees, sharded via out_shardings
+        (stage 3 initializes master weights directly onto their
+        scattered resident layout)."""
+        w_shardings = self.master_weight_shardings()
         s_shardings = self.state_shardings()
 
         def build():
@@ -404,6 +492,12 @@ class GraphExecutor:
             "training": training,
             "rng": rng,
             "to_compute": to_compute,
+            # ZeRO-3 gathered-weight memo: flat path only.  Under remat
+            # it stays None so gathers are emitted INSIDE checkpointed
+            # segments — jax.checkpoint then re-gathers in backward
+            # instead of saving full gathered copies as residuals (the
+            # FSDP memory contract; see docs/PERF.md).
+            "z3_cache": None,
         }
         if self._remat_plan is not None and training:
             for seg, in_guids, out_guids, pure in self._remat_plan:
@@ -423,7 +517,26 @@ class GraphExecutor:
                 )
                 env.update(zip(out_guids, outs))
         else:
+            z3_next = None
+            if self._z3_gather is not None:
+                # explicit double-buffered prefetch: gather op k+1's
+                # scattered weights BEFORE op k's compute is traced, so
+                # XLA's scheduler can overlap the all-gather of the
+                # next layer with the current layer's work (this
+                # replaces the post-update whole-tree all-gather that
+                # stages 1/2 pay)
+                state_ctx["z3_cache"] = {}
+                gatherable = [
+                    o for o in self.order if o.name in self._z3_gather
+                ]
+                z3_next = {
+                    a.guid: b for a, b in zip(gatherable, gatherable[1:])
+                }
+                if gatherable:
+                    self._z3_prefetch(gatherable[0], state_ctx)
             for op in self.order:
+                if z3_next is not None and op.guid in z3_next:
+                    self._z3_prefetch(z3_next[op.guid], state_ctx)
                 self._exec_op(op, env, state_ctx)
         out = env[self.sink.outputs[0].guid]
         from .pcg.layout import NHWC, TO_NCHW_PERM
@@ -433,6 +546,34 @@ class GraphExecutor:
         if self.compute_dtype is not None and jnp.issubdtype(out.dtype, jnp.floating):
             out = out.astype(jnp.float32)  # loss/metrics in full precision
         return out, new_state, aux_losses, env
+
+    def _z3_fetch(self, op_name: str, wname: str, w, ctx: Dict):
+        """One trainable weight as the compute copy: below stage 3 the
+        resident value IS the compute copy; at stage 3 a scattered leaf
+        is constrained to its strategy sharding (XLA SPMD emits the
+        just-in-time per-layer all-gather), memoized per trace through
+        ctx['z3_cache'] so the prefetch and the use share one gather."""
+        if self._z3_gather is None:
+            return w
+        sh = self._z3_gather.get(op_name, {}).get(wname)
+        if sh is None:
+            return w  # fallback leaf: already resident at strategy layout
+        cache = ctx.get("z3_cache")
+        if cache is not None:
+            hit = cache.get((op_name, wname))
+            if hit is not None:
+                return hit
+        g = jax.lax.with_sharding_constraint(w, sh)
+        if cache is not None:
+            cache[(op_name, wname)] = g
+        return g
+
+    def _z3_prefetch(self, op: Op, ctx: Dict):
+        """Populate the gather memo for all of `op`'s scattered weights
+        (emits their all-gathers at the CURRENT trace point)."""
+        entry = ctx["weights"].get(op.name, {})
+        for wname in self._z3_gather.get(op.name, {}):
+            self._z3_fetch(op.name, wname, entry[wname], ctx)
 
     def _exec_op(self, op: Op, env: Dict[int, jax.Array], ctx: Dict):
         """Execute one PCG op into env — the shared body of the flat
@@ -484,7 +625,10 @@ class GraphExecutor:
         ws: List[jax.Array] = []
         for i, spec in enumerate(op.weight_specs):
             src = ctx["weights"] if i < nt else ctx["state"]
-            ws.append(to_compute(src[op.name][spec.name]))
+            w = src[op.name][spec.name]
+            if i < nt and self._z3_gather is not None:
+                w = self._z3_fetch(op.name, spec.name, w, ctx)
+            ws.append(to_compute(w))
         op_rng = None
         if ctx["rng"] is not None:
             op_rng = jax.random.fold_in(ctx["rng"], op.guid)
@@ -566,19 +710,21 @@ class GraphExecutor:
 
     # -- train step ------------------------------------------------------
     def _make_update_fn(self, opt: Optimizer):
-        """opt.update, wrapped for cross-replica weight-update sharding
-        when a wus axis is active (ZeRO-1, arXiv:2004.13336):
+        """opt.update, wrapped for the ZeRO ladder when a wus axis is
+        active (stage 1: arXiv:2004.13336; stages 2/3: arXiv:1910.02054):
         constraining the grads to the update layout turns the backward
         psum into a reduce-scatter, the update then runs on the 1/N
         shard (where the slots permanently live), and constraining the
-        result back to the strategy sharding emits the weight
-        all-gather.  Numerically the replicated update — all-reduce ==
-        reduce-scatter + all-gather — with 1/N of the update compute
-        and slot HBM per device."""
+        result back to the OUTPUT layout emits the weight all-gather —
+        the strategy sharding at stages 1/2, or the scattered master
+        layout at stage 3, where no post-update gather happens at all
+        (forward re-gathers per layer instead).  Numerically the
+        replicated update — all-reduce == reduce-scatter + all-gather —
+        with 1/N of the update compute and slot HBM per device."""
         if self.wus_axis is None:
             return opt.update
         wus = self.wus_shardings()
-        strat = self.weight_shardings()
+        out_sh = self.master_weight_shardings()
 
         def constrain(tree, sh):
             return jax.tree.map(
@@ -589,7 +735,7 @@ class GraphExecutor:
             grads = constrain(grads, wus)
             shard_w = constrain(weights, wus)
             new_w, new_state = opt.update(shard_w, grads, state)
-            new_w = constrain(new_w, strat)
+            new_w = constrain(new_w, out_sh)
             new_state = {
                 k: constrain(sub, wus) if isinstance(sub, dict) else sub
                 for k, sub in new_state.items()
@@ -603,6 +749,7 @@ class GraphExecutor:
         loss_obj = self.loss
         opt = self.optimizer
         update_fn = self._make_update_fn(opt)
+        grad_sh = self.grad_shardings() if self.zero_stage >= 2 else None
         lrep = self.label_replication
 
         # replay-mode (_load_cached) ops are excluded: the reference's
@@ -647,6 +794,14 @@ class GraphExecutor:
             (loss_val, (logits, new_state, taps)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(weights)
+            if grad_sh is not None:
+                # ZeRO-2+: the gradient buffer is reduce-scattered AT
+                # PRODUCTION and stays scattered through the update —
+                # per-device grad HBM drops by 1/N, and no pre-update
+                # gather ever materializes the full tree
+                grads = jax.tree.map(
+                    jax.lax.with_sharding_constraint, grads, grad_sh
+                )
             new_w, new_opt_state = update_fn(weights, grads, opt_state)
             m = metrics.compute(logits, labels)
             m["loss"] = loss_val

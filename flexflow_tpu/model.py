@@ -17,6 +17,7 @@ Execution differences from the reference, by design (SURVEY §7):
 """
 from __future__ import annotations
 
+import logging
 import math
 import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
@@ -115,6 +116,8 @@ from .strategy import (
     data_parallel_strategy,
 )
 from .tensor import ParallelTensor, ParallelTensorShape
+
+_log = logging.getLogger("flexflow_tpu.model")
 
 
 def device_put_like(saved, current):
@@ -780,6 +783,13 @@ class FFModel:
             pipeline_plan = plan_pipeline(
                 self.operators, strategy.pipeline, strategy.mesh_axes
             )
+        # effective ZeRO stage: search-chosen (riding the strategy, so
+        # store-restored winners replay their stage) over the config
+        # knob (docs/PERF.md "The ZeRO ladder")
+        zero_stage = (
+            strategy.zero_stage if strategy.zero_stage is not None
+            else cfg.zero_stage
+        )
         self.executor = GraphExecutor(
             self.operators,
             self.mesh,
@@ -793,10 +803,31 @@ class FFModel:
             ),
             remat=cfg.remat,
             pipeline_plan=pipeline_plan,
-            wus_axis=(
-                cfg.wus_axis if cfg.weight_update_sharding else None
-            ),
+            wus_axis=(cfg.wus_axis if zero_stage >= 1 else None),
+            zero_stage=zero_stage,
         )
+        # per-leaf fallback observability: parallel/zero.py falls back
+        # to the replicated update leaf-by-leaf — count it instead of
+        # staying silent (the count also rides search_stats)
+        if self.executor.zero_stage >= 1:
+            fallback = self.executor.zero_fallback_leaves()
+            if fallback:
+                _log.warning(
+                    "zero_stage=%d: %d weight leaf(s) fall back to the "
+                    "replicated update (no free dim divisible by the "
+                    "%r axis): %s",
+                    self.executor.zero_stage, len(fallback),
+                    cfg.wus_axis, ", ".join(fallback[:8]) + (
+                        f", ... {len(fallback) - 8} more"
+                        if len(fallback) > 8 else ""
+                    ),
+                )
+            tel.metrics.counter("parallel/zero_fallback_leaves").inc(
+                len(fallback)
+            )
+            stats = getattr(strategy, "search_stats", None)
+            if isinstance(stats, dict):
+                stats["zero_fallback_leaves"] = len(fallback)
         # score hooks live on the FRONTEND ops (the user's handles);
         # strategy application clones the compiled PCG's op objects
         self._cache_ops = [
@@ -1390,7 +1421,9 @@ class FFModel:
 
     def set_weights(self, weights: Dict[str, Dict[str, np.ndarray]]):
         weights = self._adapt_weight_layout(weights)
-        shardings = self.executor.weight_shardings()
+        # master layout: the strategy shardings below ZeRO stage 3,
+        # the scattered resident layout at stage 3
+        shardings = self.executor.master_weight_shardings()
         self._weights = jax.tree.map(
             lambda v, s: jax.device_put(jnp.asarray(v), s), weights, shardings
         )

@@ -1,28 +1,40 @@
-"""Cross-replica weight-update sharding (ZeRO-1) spec helpers.
+"""ZeRO ladder spec helpers (the scattered update/resident layout).
 
-Reference: "Automatic Cross-Replica Sharding of Weight Update in
-Data-Parallel Training" (Xu et al., arXiv:2004.13336).  On data-parallel
+References: "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (Xu et al., arXiv:2004.13336 — stage 1) and
+"ZeRO: Memory Optimizations Toward Training Trillion Parameter Models"
+(Rajbhandari et al., arXiv:1910.02054 — stages 2/3).  On data-parallel
 legs every replica redundantly runs the full optimizer update and keeps
-a full replicated copy of the slots (Adam m/v).  The sharded update
-instead:
+full replicated copies of grads, slots (Adam m/v) and master weights.
+The ladder sheds them rung by rung, all expressed through ONE scattered
+layout (this module's spec arithmetic):
 
-  * reduce-scatters the gradient over the replica (wus) axis,
-  * updates a 1/N shard of the weight + slots (slots live sharded
-    permanently — 1/N per-device HBM),
-  * all-gathers the updated weights back to their strategy sharding.
+  * stage 1 — reduce-scatter the gradient over the replica (wus) axis,
+    update a 1/N shard of the weight + slots (slots live scattered
+    permanently — 1/N per-device HBM), all-gather the updated weights
+    back to their strategy sharding;
+  * stage 2 — the gradient BUFFER also lives scattered through the
+    update (grad HBM / N; executor.grad_shardings);
+  * stage 3 — master weights live scattered too (weight-resident
+    HBM / N; executor.master_weight_shardings), gathered
+    just-in-time per layer on use with double-buffered prefetch — the
+    post-update all-gather disappears.
 
-Total ring bytes equal the all-reduce the replicated path pays
-(all-reduce == reduce-scatter + all-gather), but the update compute and
-the slot memory shrink by 1/N.  The executor expresses all of it with
-`with_sharding_constraint` re-specs around `opt.update` — XLA SPMD then
-emits the reduce-scatter/all-gather pair — so the update body itself
+At stage 1 total ring bytes equal the all-reduce the replicated path
+pays (all-reduce == reduce-scatter + all-gather); stage 3 trades extra
+per-layer gather traffic for the resident-memory drop — the simulator
+costs every rung so the search picks the trade-off per model
+(sim/simulator.py zero_stage).  The executor expresses all of it with
+`with_sharding_constraint` re-specs — XLA SPMD then emits the
+reduce-scatter/all-gather collectives — so the update body itself
 stays the plain functional optimizer.
 
 This module owns the spec arithmetic: given a weight's strategy
 PartitionSpec, fold the wus axis into its first free, evenly-divisible
 logical dim.  Weights with no such dim (a 10-way bias on an 8-way axis)
 keep their strategy sharding and fall back to the replicated update —
-per leaf, not per model.
+per leaf, not per model (counted and logged:
+executor.zero_fallback_leaves -> parallel/zero_fallback_leaves).
 """
 from __future__ import annotations
 

@@ -68,13 +68,16 @@ def _shard_map(strategy: Strategy) -> Dict[str, Tuple[int, int, int, int]]:
 def strategy_signature(strategy: Strategy) -> Tuple:
     """Canonical memo key.  mesh_axes keeps its insertion ORDER (axis
     order steers how assign_axes factors degrees onto axes of equal
-    size); shard_configs and edge_ops are order-normalized."""
+    size); shard_configs and edge_ops are order-normalized.  The ZeRO
+    stage is part of the key: the same sharding costed at different
+    rungs of the ladder is a different candidate."""
     return (
         tuple(strategy.mesh_axes.items()),
         tuple(sorted(_shard_map(strategy).items())),
         _freeze(strategy.edge_ops),
         _freeze(strategy.rewrites),
         _freeze(strategy.pipeline),
+        getattr(strategy, "zero_stage", None),
     )
 
 
@@ -283,16 +286,21 @@ class IncrementalEvaluator:
             order = graph.topo_order()
             self.stats.full_evals += 1
         mesh_axes = strategy.mesh_axes
+        # the strategy's search-chosen ZeRO stage overrides the
+        # simulator default per evaluation; the applied graph does not
+        # depend on the stage, so delta bases stay valid across stages
+        # (OpTerms are cached per stage)
+        stage = getattr(strategy, "zero_stage", None)
         if self.training and not self.sim.remat:
             memory_fn = lambda: self.sim.memory_from_terms(  # noqa: E731
-                order, mesh_axes, self.training
+                order, mesh_axes, self.training, zero_stage=stage
             )
         else:
             memory_fn = lambda: self.sim.per_device_memory(  # noqa: E731
-                graph, self.training, mesh_axes=mesh_axes
+                graph, self.training, mesh_axes=mesh_axes, zero_stage=stage
             )
         res = self.sim.simulate_ops(order, mesh_axes, training=self.training,
-                                    memory_fn=memory_fn)
+                                    memory_fn=memory_fn, zero_stage=stage)
         res.ops = order  # applied op sequence, for callers needing shapes
         self._base = _AppliedState(
             mesh_items=tuple(mesh_axes.items()),

@@ -30,6 +30,18 @@ from .evaluator import IncrementalEvaluator
 from .graph import Graph
 
 
+def search_stage_candidates(cfg) -> Tuple[int, ...]:
+    """ZeRO ladder stages a search may choose (docs/PERF.md).  Pinned
+    to cfg.zero_stage unless the memory-aware search is on — then every
+    stage >= the configured floor competes, so memory-pressured models
+    land on 2/3 (grad- and weight-resident HBM / dp at the price of
+    per-layer all-gather traffic) while unconstrained ones keep 0/1.
+    Shared by the MCMC and Unity searches."""
+    if not cfg.memory_search:
+        return (cfg.zero_stage,)
+    return tuple(s for s in (0, 1, 2, 3) if s >= cfg.zero_stage)
+
+
 def _factorizations(n: int, allow_expert: bool = True) -> List[Tuple[int, int, int]]:
     """(data, model, expert) triples with product n.  allow_expert=False
     drops ep>1 triples — the single source of the 'expert axis only with
@@ -97,6 +109,7 @@ class MCMCSearch:
         continue_chance: float = 0.7,
         use_eval_cache: bool = True,
         registry=None,
+        zero_stages: Optional[Tuple[int, ...]] = None,
     ):
         # obs.metrics.MetricsRegistry (or None): final counters also
         # land in run telemetry, not just the log line
@@ -128,6 +141,11 @@ class MCMCSearch:
         self.propagate = propagate
         self.propagation_chance = propagation_chance
         self.continue_chance = continue_chance
+        # ZeRO ladder stages the chain may move between.  A singleton
+        # fixes the stage (no stage moves; candidates are stamped with
+        # it); None also disables moves but leaves candidates at
+        # zero_stage=None, costing under the simulator's own stage.
+        self.zero_stages = tuple(zero_stages) if zero_stages else None
         self.candidates = find_candidates(graph)
         has_experts = any(c.kind == "expert" for c in self.candidates)
         self.factorizations = _factorizations(
@@ -149,8 +167,10 @@ class MCMCSearch:
         return axes
 
     def _build(self, dp: int, tp: int, ep: int,
-               flags: Dict[str, bool]) -> Strategy:
-        s = Strategy(mesh_axes=self._mesh_axes(dp, tp, ep))
+               flags: Dict[str, bool],
+               zero_stage: Optional[int] = None) -> Strategy:
+        s = Strategy(mesh_axes=self._mesh_axes(dp, tp, ep),
+                     zero_stage=zero_stage)
         if dp > 1:
             s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
         # Megatron column->row pairing: a channel(tp)-sharded linear
@@ -210,15 +230,28 @@ class MCMCSearch:
     def optimize(self) -> Strategy:
         dp, tp, ep = self.n, 1, 1
         flags: Dict[str, bool] = {}
-        current = self._build(dp, tp, ep, flags)
+        # stage moves only when the ladder is actually searchable; the
+        # chain starts at the ladder's floor (the configured stage)
+        stage_moves = (
+            self.zero_stages
+            if self.zero_stages and len(self.zero_stages) > 1 else None
+        )
+        stage = self.zero_stages[0] if self.zero_stages else None
+        current = self._build(dp, tp, ep, flags, stage)
         current_cost = self.evaluate(current)
         best, best_cost = current, current_cost
         self.best_iteration = -1  # evals needed to reach the winner
-        state = (dp, tp, ep, dict(flags))
+        state = (dp, tp, ep, dict(flags), stage)
         for it in range(self.budget):
             ndp, ntp, nep, nflags = state[0], state[1], state[2], dict(state[3])
+            nstage = state[4]
             move = self.rng.random()
-            if move < 0.25 or not self.candidates:
+            if stage_moves is not None and move < 0.15:
+                # ZeRO-stage move: re-rung the ladder (the candidate's
+                # sharding is unchanged, so the evaluator re-sums
+                # cached OpTerms under the new stage — a cheap move)
+                nstage = self.rng.choice(stage_moves)
+            elif move < 0.25 or not self.candidates:
                 ndp, ntp, nep = self.rng.choice(self.factorizations)
             elif (self.propagate
                   and move < 0.25 + 0.75 * self.propagation_chance):
@@ -244,10 +277,11 @@ class MCMCSearch:
             else:
                 c = self.rng.choice(self.candidates)
                 nflags[c.name] = not nflags.get(c.name, False)
-            if (ndp, ntp, nep) == state[:3] and nflags == state[3]:
+            if ((ndp, ntp, nep) == state[:3] and nflags == state[3]
+                    and nstage == state[4]):
                 continue  # no-op move (e.g. propagate with no peers to
                 # change): don't burn a simulator eval on it
-            cand = self._build(ndp, ntp, nep, nflags)
+            cand = self._build(ndp, ntp, nep, nflags, nstage)
             cost = self.evaluate(cand)
             self.history.append((it, cost))
             if cost < current_cost or (
@@ -256,7 +290,7 @@ class MCMCSearch:
                 < math.exp(-self.alpha * (cost - current_cost) / max(1e-12, current_cost))
             ):
                 current, current_cost = cand, cost
-                state = (ndp, ntp, nep, nflags)
+                state = (ndp, ntp, nep, nflags, nstage)
                 if cost < best_cost:
                     best, best_cost = cand, cost
                     self.best_iteration = it
@@ -299,7 +333,7 @@ def make_search_simulator(cfg, machine, cost_model):
         **kw,
         parameter_sync=_sync_mode(cfg.parameter_sync),
         remat=cfg.remat,
-        weight_update_sharding=cfg.weight_update_sharding,
+        zero_stage=cfg.zero_stage,
         wus_axis=cfg.wus_axis,
     )
 
@@ -333,11 +367,13 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
         registry=getattr(
             getattr(model, "telemetry", None), "metrics", None
         ),
+        zero_stages=search_stage_candidates(cfg),
     )
     best = search.optimize()
-    # surface the update-sharding mode candidates were scored under
-    best.search_stats["weight_update_sharding"] = bool(
-        cfg.weight_update_sharding
-    )
+    # surface the ZeRO stage the winner was scored under (and the
+    # legacy bool it subsumes)
+    chosen = best.zero_stage if best.zero_stage is not None else cfg.zero_stage
+    best.search_stats["zero_stage"] = int(chosen)
+    best.search_stats["weight_update_sharding"] = chosen >= 1
     cost_model.save_persistent()
     return best

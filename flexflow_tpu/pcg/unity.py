@@ -45,9 +45,10 @@ from ..ops.op import Op, ShapeError, ShardConfig
 from ..parallel.machine import assign_axes
 from ..strategy import _PARAM_CLASSES, Strategy, apply_strategy, assign_views
 from ..tensor import ParallelTensor, ParallelTensorShape
+from ..sim.simulator import Z3_PREFETCH_OVERLAP
 from .evaluator import IncrementalEvaluator
 from .graph import Graph
-from .mcmc import _factorizations
+from .mcmc import _factorizations, search_stage_candidates
 from .substitution import (
     GraphXfer,
     XferChoice,
@@ -105,6 +106,8 @@ class UnitySearch:
         eval_cache: bool = True,
         weight_update_sharding: bool = False,
         wus_axis: str = "data",
+        zero_stage: Optional[int] = None,
+        zero_stages: Optional[Sequence[int]] = None,
         registry=None,
         enable_pipeline: bool = True,
     ):
@@ -152,7 +155,20 @@ class UnitySearch:
         from ..sim.simulator import Simulator
 
         self.remat = remat
-        self.weight_update_sharding = weight_update_sharding
+        # ZeRO ladder: zero_stage is the BASE stage the DP costs every
+        # segment under; zero_stages (when longer than one) are the
+        # rungs each collected candidate is additionally re-scored at
+        # through the memoized evaluator (_stage_variants), so the
+        # search — not the user — picks the memory/comm trade-off.
+        # weight_update_sharding=True is the deprecated stage-1 alias.
+        self.zero_stage = (
+            int(zero_stage) if zero_stage is not None
+            else (1 if weight_update_sharding else 0)
+        )
+        self.zero_stages = (
+            tuple(zero_stages) if zero_stages else (self.zero_stage,)
+        )
+        self.weight_update_sharding = self.zero_stage >= 1
         self.wus_axis = wus_axis
         self._sim = Simulator(machine, cost_model,
                               overlap_fraction=overlap_fraction,
@@ -161,7 +177,7 @@ class UnitySearch:
                               parameter_sync=parameter_sync,
                               remat=remat,
                               compute_scale=compute_scale,
-                              weight_update_sharding=weight_update_sharding,
+                              zero_stage=self.zero_stage,
                               wus_axis=wus_axis)
         # memoized whole-strategy evaluator per (possibly rewritten)
         # graph variant: the sp/sample candidate families and the
@@ -220,7 +236,9 @@ class UnitySearch:
                 k = out_rep // max(1, in_rep)
                 c = self._comm_time("allreduce", op.outputs[0].shape.shard_bytes(), k)
                 comm += 2.0 * c if training else c
+        gather = 0.0
         mem = 0
+        stage = self.zero_stage
         for w in op.weights:
             rep = w.shape.replica_degree
             sb = w.shape.shard_bytes()
@@ -232,25 +250,33 @@ class UnitySearch:
             g = self._sim.wus_group(w) if w.create_gradients else 1
             if training and rep > 1 and w.create_gradients:
                 if g > 1:
-                    # reduce-scatter + weight all-gather (the gather
-                    # takes the generic comm credit, like
-                    # Simulator.simulate_ops)
-                    s, x = self._sim.weight_update_comm(sb, g)
+                    # reduce-scatter + the stage's gathers (the
+                    # post-update gather takes the generic comm credit
+                    # like Simulator.simulate_ops; the stage-3
+                    # per-layer gathers take the prefetch credit)
+                    s, x, gx = self._sim.weight_update_comm(sb, g)
                     sync += s
                     comm += x
+                    gather += gx
                 else:
                     sync += self._sim.sync_time(sb, rep)
             if not training:
                 mem += sb
             elif g > 1:
-                # ZeRO-1 slots: 1/g per device; master + grad whole
-                mem += sb * 2 + self.optimizer_slots * (sb // g)
+                # ZeRO ladder residency: slots 1/g (stage 1+), grads
+                # 1/g (stage 2+), master 1/g (stage 3; the 2-layer
+                # gather window is charged by the authoritative
+                # evaluator, not per-op here)
+                master = sb // g if stage >= 3 else sb
+                grads = sb // g if stage >= 2 else sb
+                mem += master + grads + self.optimizer_slots * (sb // g)
             else:
                 mem += sb * (2 + self.optimizer_slots)
         for o in op.outputs:
             mem += o.shape.shard_bytes()
         time = (t + comm * (1.0 - self.overlap)
-                + sync * (1.0 - self.sync_overlap))
+                + sync * (1.0 - self.sync_overlap)
+                + gather * (1.0 - Z3_PREFETCH_OVERLAP))
         return time, mem
 
     def _realizable(self, shapes, mesh_axes: Dict[str, int]) -> bool:
@@ -775,13 +801,56 @@ class UnitySearch:
         agg["op_cost_hits"] = getattr(self.cost_model, "cost_hits", 0)
         return agg
 
+    def _stage_variants(self, strategy: Strategy, time: float, mem: int,
+                        lam: float) -> List[Tuple[Strategy, float]]:
+        """The candidate scored at every allowed ZeRO stage:
+        [(strategy', obj)].  The base stage keeps the caller's analytic
+        (time, mem); other rungs correct them by the memoized
+        evaluator's stage delta (the applied graph is stage-invariant,
+        so the delta is exactly the ladder's update/residency terms).
+        Ascending stage order + strict objective comparison downstream
+        keep ties on the LOWEST stage."""
+        out = [(strategy, self._objective(time, mem, lam))]
+        extra = [s for s in self.zero_stages if s != self.zero_stage]
+        if not extra:
+            return out
+        base = self._evaluator().evaluate(strategy)
+        if base is None:
+            return out
+        bt, bm = base.total_time, base.per_device_memory
+        for s in sorted(extra):
+            cand = dataclasses.replace(strategy, zero_stage=s)
+            res = self._evaluator().evaluate(cand)
+            if res is None:
+                continue
+            out.append((cand, self._objective(
+                time + res.total_time - bt,
+                mem + res.per_device_memory - bm, lam,
+            )))
+        return out
+
     def _optimize_graph(self, lam: float, collector: List[Tuple]):
         """Append every valid (obj, strategy, graph) for the CURRENT
-        self.graph to collector (mesh factorizations, sp, pp)."""
+        self.graph to collector (mesh factorizations, sp, pp) — each
+        non-pipeline candidate expanded across the allowed ZeRO
+        stages."""
         from ..logger import search_logger as slog
 
         has_moe = any(op.op_type == OperatorType.GROUP_BY for op in self.graph.ops)
         best_obj = math.inf
+
+        def collect(strategy, time, mem, label):
+            nonlocal best_obj
+            for cand, obj in self._stage_variants(strategy, time, mem, lam):
+                slog.debug(
+                    "candidate %s%s: obj=%.3g%s", label,
+                    (f" zero{cand.zero_stage}"
+                     if cand.zero_stage is not None else ""),
+                    obj, " *best*" if obj < best_obj else "",
+                )
+                best_obj = min(best_obj, obj)
+                collector.append((obj, cand, self.graph))
+
         for dp, tp, ep in _factorizations(self.n, allow_expert=has_moe):
             for mesh_axes in self._mesh_variants(dp, tp, ep):
                 if tp > 1 and not self._options_by_op(mesh_axes):
@@ -798,21 +867,14 @@ class UnitySearch:
                 # repeat passes validate revisited candidates by lookup
                 if self._evaluator().evaluate(strategy) is None:
                     continue
-                obj = self._objective(time, mem, lam)
-                slog.debug(
-                    "candidate %s: time=%.3gms mem=%.1fMB obj=%.3g%s",
-                    mesh_axes, time * 1e3, mem / 2**20, obj,
-                    " *best*" if obj < best_obj else "",
-                )
-                best_obj = min(best_obj, obj)
-                collector.append((obj, strategy, self.graph))
-        for strategy, obj, label in self._sp_candidates(lam):
-            slog.debug(
-                "candidate %s: obj=%.3g%s", label, obj,
-                " *best*" if obj < best_obj else "",
-            )
-            best_obj = min(best_obj, obj)
-            collector.append((obj, strategy, self.graph))
+                collect(strategy, time, mem,
+                        f"{mesh_axes} time={time * 1e3:.3g}ms "
+                        f"mem={mem / 2**20:.1f}MB")
+        for strategy, time, mem, label in self._sp_candidates():
+            collect(strategy, time, mem, label)
+        # pipeline candidates stay on the base stage: their memory
+        # model scales block terms by 1/S, which the evaluator's stage
+        # delta cannot see (docs/SEARCH.md)
         for strategy, obj, label in self._pp_candidates(lam):
             slog.debug(
                 "candidate %s: obj=%.3g%s", label, obj,
@@ -820,13 +882,8 @@ class UnitySearch:
             )
             best_obj = min(best_obj, obj)
             collector.append((obj, strategy, self.graph))
-        for strategy, obj, label in self._sample_candidates(lam):
-            slog.debug(
-                "candidate %s: obj=%.3g%s", label, obj,
-                " *best*" if obj < best_obj else "",
-            )
-            best_obj = min(best_obj, obj)
-            collector.append((obj, strategy, self.graph))
+        for strategy, time, mem, label in self._sample_candidates():
+            collect(strategy, time, mem, label)
 
     def _event_objective(
         self, strategy: Strategy, graph: Graph, lam: float
@@ -879,9 +936,29 @@ class UnitySearch:
                 def op_scale(op, _g=block_guids, _s=S):  # noqa: E731
                     return 1.0 / _s if op.guid in _g else 1.0
 
+            # the event simulator models none of the ladder's stage
+            # terms (sharded update, opt_xfer, per-layer gather_xfer),
+            # while the memory below IS stage-aware — uncorrected, the
+            # highest stage of a mesh would always win the rerank (same
+            # event time, less memory).  Correct the makespan with the
+            # analytic stage delta from the memoized evaluator, the
+            # same delta _stage_variants priced the candidate with.
+            if (strategy.zero_stage is not None
+                    and strategy.zero_stage != self.zero_stage):
+                prev = self.graph
+                try:
+                    self._set_graph(graph)
+                    rb = self._evaluator().evaluate(dataclasses.replace(
+                        strategy, zero_stage=self.zero_stage))
+                    rs = self._evaluator().evaluate(strategy)
+                finally:
+                    self._set_graph(prev)
+                if rb is not None and rs is not None:
+                    time += rs.total_time - rb.total_time
             mem = self._sim.per_device_memory(g, training=True,
                                               op_scale=op_scale,
-                                              mesh_axes=strategy.mesh_axes)
+                                              mesh_axes=strategy.mesh_axes,
+                                              zero_stage=strategy.zero_stage)
             return self._objective(time, mem, lam)
         except Exception as e:  # noqa: BLE001
             slog.debug(
@@ -918,13 +995,15 @@ class UnitySearch:
             # contention-aware makespan (reference: candidates are
             # ultimately judged by simulate_runtime, not the analytic
             # estimators)
-            # distinct meshes only — pp candidates differing solely in
-            # microbatch count would otherwise crowd the top-K
+            # distinct (mesh, zero stage) only — pp candidates
+            # differing solely in microbatch count would otherwise
+            # crowd the top-K, while stage variants of one mesh are
+            # genuinely different memory/comm trade-offs
             seen_keys = set()
             top: List[Tuple] = []
             for c in collector:
                 key = (tuple(sorted(c[1].mesh_axes.items())),
-                       c[1].pipeline is None)
+                       c[1].pipeline is None, c[1].zero_stage)
                 if key in seen_keys:
                     continue
                 seen_keys.add(key)
@@ -970,7 +1049,7 @@ class UnitySearch:
             obj *= 1.0 + (mem / self.memory_budget - 1.0)
         return obj
 
-    def _sp_candidates(self, lam: float):
+    def _sp_candidates(self):
         """Sequence-parallel (context-parallel) candidates: dp x sp
         meshes where activations are seq-sharded and attention lowers to
         ring attention over ICI (parallel/ring_attention.py) — the
@@ -1025,10 +1104,9 @@ class UnitySearch:
                 ring += 3.0 * self._comm_time("allgather", kv_bytes, sp)
             time = res.total_time + ring * (1.0 - self.overlap)
             mem = res.per_device_memory
-            obj = self._objective(time, mem, lam)
-            yield s, obj, f"dp={dp} sp={sp} (ring attention)"
+            yield s, time, mem, f"dp={dp} sp={sp} (ring attention)"
 
-    def _sample_candidates(self, lam: float):
+    def _sample_candidates(self):
         """Sample parallelism (reference --enable-sample-parallel,
         config.h:134: partition along non-batch sample dims): shard
         inputs' dim 1 (sequence rows / flattened spatial) over a
@@ -1067,8 +1145,8 @@ class UnitySearch:
             res = self._evaluator().evaluate(s)
             if res is None:
                 continue
-            obj = self._objective(res.total_time, res.per_device_memory, lam)
-            yield s, obj, f"dp={dp} sample={sp} (sample parallel)"
+            yield (s, res.total_time, res.per_device_memory,
+                   f"dp={dp} sample={sp} (sample parallel)")
 
     def _pp_candidates(self, lam: float):
         """Pipeline-parallel candidates: dp x pp meshes over the graph's
@@ -1219,13 +1297,18 @@ class UnitySearch:
         g = apply_strategy(base, strategy)
         assign_views(g, strategy.mesh_axes)
         # mirror the cost simulator's gating exactly (parameter_sync
-        # included) so the memory the lambda search constrains is the
-        # memory the time model believes in
+        # and the candidate's own ZeRO stage included) so the memory
+        # the lambda search constrains is the memory the time model
+        # believes in
         sim = Simulator(self.machine, self.cost_model,
                         optimizer_slots=self.optimizer_slots,
                         remat=self.remat,
                         parameter_sync=self.parameter_sync,
-                        weight_update_sharding=self.weight_update_sharding,
+                        zero_stage=(
+                            strategy.zero_stage
+                            if strategy.zero_stage is not None
+                            else self.zero_stage
+                        ),
                         wus_axis=self.wus_axis)
         op_scale = None
         if strategy.pipeline:
@@ -1306,7 +1389,8 @@ def unity_optimize(model, num_devices: int,
         rewrite_depth=cfg.rewrite_depth,
         rewrite_max_variants=cfg.rewrite_max_variants,
         eval_cache=cfg.search_eval_cache,
-        weight_update_sharding=cfg.weight_update_sharding,
+        zero_stage=cfg.zero_stage,
+        zero_stages=search_stage_candidates(cfg),
         wus_axis=cfg.wus_axis,
         registry=getattr(
             getattr(model, "telemetry", None), "metrics", None
@@ -1319,8 +1403,9 @@ def unity_optimize(model, num_devices: int,
         from ..strategy import data_parallel_strategy
 
         return data_parallel_strategy(num_devices)
-    # surface the update-sharding mode candidates were scored under
-    best.search_stats["weight_update_sharding"] = bool(
-        cfg.weight_update_sharding
-    )
+    # surface the ZeRO stage the winner was scored under (and the
+    # legacy bool it subsumes)
+    chosen = best.zero_stage if best.zero_stage is not None else cfg.zero_stage
+    best.search_stats["zero_stage"] = int(chosen)
+    best.search_stats["weight_update_sharding"] = chosen >= 1
     return best

@@ -99,11 +99,20 @@ class OpTerms:
     xfer: float = 0.0         # parallel-op resharding collective
     partial: float = 0.0      # fwd partial-sum all-reduce (undoubled)
     grad_sync: float = 0.0    # gradient sync over weight replica axes
-    #                           (all-reduce; reduce-scatter under wus)
+    #                           (all-reduce; reduce-scatter at stage >= 1)
     opt_numel: float = 0.0    # master-precision elements the update touches
     #                           (already /rep under the sharded update)
-    opt_xfer: float = 0.0     # post-update weight all-gather (wus only)
-    mem_weights: int = 0      # per-device weight shard bytes
+    opt_xfer: float = 0.0     # post-update weight all-gather (stage 1/2)
+    gather_xfer: float = 0.0  # ZeRO-3 per-layer weight all-gathers
+    #                           (fwd + bwd re-gather; prefetch-credited)
+    mem_weights: int = 0      # per-device weight shard bytes (compute copy)
+    mem_master: int = 0       # per-device master-resident weight bytes
+    #                           (== mem_weights below stage 3; /group at 3)
+    mem_grad: int = 0         # per-device gradient buffer bytes
+    #                           (== mem_weights below stage 2; /group at 2+)
+    mem_gather: int = 0       # stage-3 gathered weight copy bytes for THIS
+    #                           op (double-buffer window: 2x the max rides
+    #                           the memory total)
     mem_opt: int = 0          # per-device bytes ONE optimizer slot costs
     #                           (== mem_weights replicated; grad weights
     #                           /rep under the sharded update)
@@ -121,7 +130,22 @@ _KERNEL_OVERHEAD = 2e-6  # per-op dispatch/fusion overhead (XLA fuses, small)
 #: re-search under the new one instead of replaying stale rankings.
 #: (The learned cost model, arXiv:2008.01040, will ride this same
 #: constant: model retrain => version bump => fleet-wide invalidation.)
-COST_MODEL_VERSION = 1
+#: v2: the ZeRO ladder — OpTerms grew mem_master/mem_grad/mem_gather/
+#: gather_xfer and the memory/update accounting became zero_stage-aware,
+#: so stage-blind v1 rankings must re-search.  A tier-1 guard test pins
+#: the OpTerms field set to this number (tests/test_zero_ladder.py):
+#: changing the decomposition without bumping here fails CI.
+COST_MODEL_VERSION = 2
+
+#: overlap credit for the ZeRO-3 per-layer weight all-gathers: the
+#: executor double-buffers (layer k+1's gather issues before layer k's
+#: compute), but the gathers sit on the layer-boundary critical path, so
+#: they hide WORSE than generic resharding collectives.  This replaces
+#: the generic overlap_fraction credit for what used to be opt_xfer:
+#: 2 gathers/step at (1 - 0.5) exposed always costs more than stage 1's
+#: single post-update gather at the generic credit, which is what keeps
+#: unconstrained searches on stages <= 1.
+Z3_PREFETCH_OVERLAP = 0.5
 
 # backward/forward cost ratio per op class (replaces the old flat 2x:
 # conv/matmul backward really is two same-size contractions, but an
@@ -380,6 +404,7 @@ class Simulator:
         compute_scale: float = 1.0,
         weight_update_sharding: bool = False,
         wus_axis: str = "data",
+        zero_stage: Optional[int] = None,
     ):
         self.machine = machine
         self.cost_model = cost_model or OpCostModel(machine)
@@ -401,13 +426,23 @@ class Simulator:
         # flat 2*size/BW, reference default_estimate_sync_cost
         # simulator.cc:786-813 + ParameterSyncType::PS optimizer.h:47)
         self.parameter_sync = parameter_sync
-        # cross-replica weight-update sharding (ZeRO-1, executor
-        # --weight-update-sharding): the grad sync becomes a
-        # reduce-scatter, the update touches numel/rep elements, a
-        # post-update weight all-gather is charged, and optimizer-slot
-        # memory shrinks by 1/rep per grad weight.  Fixed per Simulator
-        # (like parameter_sync), so OpTerms cache keys are unaffected.
-        self.weight_update_sharding = weight_update_sharding
+        # ZeRO ladder stage (docs/PERF.md "The ZeRO ladder").  This is
+        # the simulator's DEFAULT stage; every stage-sensitive method
+        # also takes a per-call zero_stage override (keyed into the
+        # OpTerms cache) so one simulator can cost all four rungs of
+        # the ladder for the searches:
+        #   1: grad reduce-scatter, update numel/rep, post-update
+        #      weight all-gather, slot memory /rep;
+        #   2: + gradient-resident bytes /rep;
+        #   3: + master-weight-resident bytes /rep, per-layer weight
+        #      all-gathers (fwd + bwd) instead of the post-update one.
+        # weight_update_sharding=True is the deprecated alias for
+        # stage 1; the bool attribute mirrors `zero_stage >= 1`.
+        self.zero_stage = (
+            int(zero_stage) if zero_stage is not None
+            else (1 if weight_update_sharding else 0)
+        )
+        self.weight_update_sharding = self.zero_stage >= 1
         # the ONE mesh axis the executor shards the update over
         # (FFConfig.wus_axis); wus_group() resolves each weight's
         # actual sharding group from it
@@ -518,7 +553,13 @@ class Simulator:
             return 2.0 * lat + 2.0 * size / bw
         return self._collective_time("allreduce", size, rep)
 
-    def wus_group(self, w, mesh_axes: Optional[Dict[str, int]] = None) -> int:
+    def _stage(self, zero_stage: Optional[int]) -> int:
+        """Effective ZeRO stage for one call: the per-call override
+        (searches costing the ladder), else the simulator default."""
+        return self.zero_stage if zero_stage is None else int(zero_stage)
+
+    def wus_group(self, w, mesh_axes: Optional[Dict[str, int]] = None,
+                  zero_stage: Optional[int] = None) -> int:
         """The group size this weight's update actually shards over —
         the executor-fidelity mirror of parallel/zero.py.  1 means the
         leaf keeps the replicated update (wus off, a mesh without the
@@ -536,7 +577,7 @@ class Simulator:
         Callers without mesh context (unity's per-op DP stage) fall
         back to the replica degree — exact on pure-dp meshes, and the
         authoritative evaluation always re-scores with mesh_axes."""
-        if not self.weight_update_sharding or self.parameter_sync == "none":
+        if self._stage(zero_stage) < 1 or self.parameter_sync == "none":
             return 1
         if mesh_axes is None:
             n = w.shape.replica_degree
@@ -561,23 +602,35 @@ class Simulator:
             return 1
         return n
 
-    def weight_update_comm(self, size: int, rep: int) -> Tuple[float, float]:
-        """One weight's (grad-sync, post-update-all-gather) times.
+    def weight_update_comm(self, size: int, rep: int,
+                           zero_stage: Optional[int] = None
+                           ) -> Tuple[float, float, float]:
+        """One weight's (grad-sync, post-update-all-gather, per-layer
+        gather) times under the effective ZeRO stage.
 
-        Replicated update: ring all-reduce of the grad (sync_time), no
-        gather.  Sharded update (ZeRO-1): reduce-scatter the grad +
+        Replicated update (stage 0): ring all-reduce of the grad
+        (sync_time), no gathers.  Stages 1/2: reduce-scatter the grad +
         all-gather the updated weight — the same ring bytes as the
         all-reduce, split around an update that now touches only
-        numel/rep elements.  parameter_sync "none" keeps replicas
-        unsynced, which the sharded update cannot express — it stays on
-        the replicated path."""
-        if not self.weight_update_sharding or self.parameter_sync == "none":
-            return self.sync_time(size, rep), 0.0
+        numel/rep elements (stage 2 differs from 1 in MEMORY only: the
+        grad buffer stays scattered).  Stage 3: the post-update gather
+        disappears — weights stay resident-scattered — and instead the
+        step pays TWO per-layer all-gathers (forward use + backward
+        re-gather), credited with the double-buffered-prefetch overlap
+        (Z3_PREFETCH_OVERLAP), not the generic one.  parameter_sync
+        "none" keeps replicas unsynced, which the sharded update cannot
+        express — it stays on the replicated path."""
+        stage = self._stage(zero_stage)
+        if stage < 1 or self.parameter_sync == "none":
+            return self.sync_time(size, rep), 0.0, 0.0
         if self.parameter_sync == "ps":
             sync = self.sync_time(size, rep)  # flat 2*size/BW grad leg
         else:
             sync = self._collective_time("reducescatter", size, rep)
-        return sync, self._collective_time("allgather", size, rep)
+        gather = self._collective_time("allgather", size, rep)
+        if stage >= 3:
+            return sync, 0.0, 2.0 * gather
+        return sync, gather, 0.0
 
     def grad_sync_cost(self, graph: Graph, mesh_axes: Dict[str, int]) -> float:
         """Gradient sync over each weight's replica axes (SPMD's psum in
@@ -593,7 +646,8 @@ class Simulator:
 
     # -- per-op contribution terms (delta-sim decomposition) -------------
     def op_terms(self, op: Op, mesh_axes: Dict[str, int],
-                 training: bool = True, skip_compute: bool = False) -> OpTerms:
+                 training: bool = True, skip_compute: bool = False,
+                 zero_stage: Optional[int] = None) -> OpTerms:
         """All of `op`'s additive contributions to simulate(), cached by
         (node_key, mesh signature, training).  node_key already encodes
         params + ShardConfig + input parallel shapes, so a strategy move
@@ -607,15 +661,21 @@ class Simulator:
         # axes are distinct mesh configurations and must not alias one
         # cache entry (strategy_signature keeps order for the same
         # reason)
+        stage = self._stage(zero_stage)
+        # stage only shapes the weight-update terms, so weightless ops
+        # are stage-invariant — key them at a single rung so a stage
+        # sweep doesn't recompute their compute/xfer terms per stage
         key = (op.node_key(), tuple(mesh_axes.items()), training,
-               skip_compute)
+               skip_compute, stage if op.weights else 0)
         hit = self._term_cache.get(key)
         if hit is not None:
             self.term_hits += 1
             return hit
         self.term_misses += 1
-        compute = xfer = partial = grad_sync = opt_numel = opt_xfer = 0.0
-        mem_weights = mem_opt = mem_residual = mem_transient = 0
+        compute = xfer = partial = grad_sync = opt_numel = 0.0
+        opt_xfer = gather_xfer = 0.0
+        mem_weights = mem_master = mem_grad = mem_gather = 0
+        mem_opt = mem_residual = mem_transient = 0
         if op.op_type != OperatorType.INPUT:
             if op.is_parallel_op():
                 xfer = self.xfer_cost(op, mesh_axes)
@@ -630,14 +690,16 @@ class Simulator:
             sb = w.shape.shard_bytes()
             mem_weights += sb
             opt_sb = sb
+            master_sb = grad_sb = sb
             if w.create_gradients:
                 numel = sb / max(
                     1, np.dtype(w.shape.dtype.np_dtype).itemsize
                 )
                 rep = w.shape.replica_degree
-                g = self.wus_group(w, mesh_axes)
+                g = self.wus_group(w, mesh_axes, zero_stage=stage)
                 if g > 1:
-                    s, x = self.weight_update_comm(sb, g)
+                    s, x, gx = self.weight_update_comm(sb, g,
+                                                       zero_stage=stage)
                     grad_sync += s
                     if (rep > g and rep % g == 0
                             and self.parameter_sync == "allreduce"):
@@ -645,16 +707,29 @@ class Simulator:
                         # all-reduces, on the scattered shard
                         grad_sync += self.sync_time(sb // g, rep // g)
                     opt_xfer += x
+                    gather_xfer += gx
                     # the update runs on the 1/g shard; slots live
                     # there permanently
                     numel /= g
                     opt_sb = sb // g
+                    if stage >= 2:
+                        # ZeRO-2: the grad buffer stays reduce-scattered
+                        # through the update — 1/g resident per device
+                        grad_sb = sb // g
+                    if stage >= 3:
+                        # ZeRO-3/FSDP: master lives scattered; the
+                        # gathered compute copy is transient (the
+                        # double-buffer window rides mem_gather)
+                        master_sb = sb // g
+                        mem_gather += sb
                 elif rep > 1:
-                    # replicated update (wus off, or this leaf falls
+                    # replicated update (stage 0, or this leaf falls
                     # back per parallel/zero.py)
                     grad_sync += self.sync_time(sb, rep)
                 opt_numel += numel
             mem_opt += opt_sb
+            mem_master += master_sb
+            mem_grad += grad_sb
         for t in op.outputs:
             b = t.shape.shard_bytes()
             if op.op_type in self._FUSED_ACT_TYPES:
@@ -664,31 +739,46 @@ class Simulator:
         terms = OpTerms(
             compute=compute, xfer=xfer, partial=partial,
             grad_sync=grad_sync, opt_numel=opt_numel, opt_xfer=opt_xfer,
-            mem_weights=mem_weights, mem_opt=mem_opt,
+            gather_xfer=gather_xfer,
+            mem_weights=mem_weights, mem_master=mem_master,
+            mem_grad=mem_grad, mem_gather=mem_gather, mem_opt=mem_opt,
             mem_residual=mem_residual, mem_transient=mem_transient,
         )
         self._term_cache[key] = terms
         return terms
 
     def memory_from_terms(self, ops: Sequence[Op], mesh_axes: Dict[str, int],
-                          training: bool = True) -> int:
+                          training: bool = True,
+                          zero_stage: Optional[int] = None) -> int:
         """per_device_memory re-aggregated from cached OpTerms — exact
         for the training non-remat accounting (weights + residual sum +
         transient max; all integer bytes, so order-independent).  The
         remat and inference liveness models need whole-graph structure
-        and keep using per_device_memory()."""
-        weights = opt = residuals = transient = 0
+        and keep using per_device_memory().
+
+        Training weight accounting follows the ZeRO ladder: master
+        resident (mem_master: /g at stage 3) + gradient buffer
+        (mem_grad: /g at stage 2+) + slot bytes (mem_opt: /g at 1+) +
+        the stage-3 double-buffered gather window (2x the largest op's
+        gathered weight copies).  At stages 0/1 this is bit-identical
+        to the pre-ladder weights*2 + slots*opt formula."""
+        compute_copy = master = grads = opt = residuals = transient = 0
+        gather_peak = 0
         for op in ops:
-            terms = self.op_terms(op, mesh_axes, training)
-            weights += terms.mem_weights
+            terms = self.op_terms(op, mesh_axes, training,
+                                  zero_stage=zero_stage)
+            compute_copy += terms.mem_weights
+            master += terms.mem_master
+            grads += terms.mem_grad
             opt += terms.mem_opt
             residuals += terms.mem_residual
             transient = max(transient, terms.mem_transient)
+            gather_peak = max(gather_peak, terms.mem_gather)
         if training:
-            # master + grads replicated either way; slot bytes follow
-            # mem_opt (== mem_weights replicated, /rep under wus, so the
-            # replicated total is bit-identical to weights*(2+slots))
-            weights = weights * 2 + self.optimizer_slots * opt
+            weights = (master + grads + self.optimizer_slots * opt
+                       + 2 * gather_peak)
+        else:
+            weights = compute_copy
         return int(weights + residuals + transient)
 
     # -- memory ----------------------------------------------------------
@@ -703,7 +793,8 @@ class Simulator:
 
     def per_device_memory(self, graph: Graph, training: bool = True,
                           op_scale=None, remat: Optional[bool] = None,
-                          mesh_axes: Optional[Dict[str, int]] = None) -> int:
+                          mesh_axes: Optional[Dict[str, int]] = None,
+                          zero_stage: Optional[int] = None) -> int:
         """Peak per-device bytes: weights (+grads+optimizer slots when
         training) plus LIVE activations, not the sum of every tensor
         ever produced (the r02 model summed all of them, so
@@ -722,6 +813,7 @@ class Simulator:
         strategies pass 1/num_stages for block ops — each device holds
         only its stage's weights/activations)."""
         remat = self.remat if remat is None else remat
+        stage = self._stage(zero_stage)
         scale = (lambda op: op_scale(op)) if op_scale is not None \
             else (lambda op: 1.0)
         weights = sum(
@@ -729,18 +821,31 @@ class Simulator:
             for op in graph.ops for w in op.weights
         )
         if training:
-            if self.weight_update_sharding and self.parameter_sync != "none":
-                # ZeRO-1: slots of grad-bearing replicated weights live
-                # on their 1/group shard; master + grads stay whole;
-                # unshardable leaves fall back to full slots
-                opt = sum(
-                    w.shape.shard_bytes()
-                    // (self.wus_group(w, mesh_axes)
-                        if w.create_gradients else 1)
-                    * scale(op)
-                    for op in graph.ops for w in op.weights
-                )
-                weights = weights * 2 + self.optimizer_slots * opt
+            if stage >= 1 and self.parameter_sync != "none":
+                # ZeRO ladder: slots of grad-bearing replicated weights
+                # live on their 1/group shard (stage 1+); the gradient
+                # buffer joins them at stage 2+ and the master weights
+                # at stage 3 (plus the 2-layer gathered-copy window);
+                # unshardable leaves fall back whole at every rung
+                master = grads = opt = 0.0
+                gather_peak = 0.0
+                for op in graph.ops:
+                    op_gather = 0.0
+                    for w in op.weights:
+                        sb = w.shape.shard_bytes()
+                        sc = scale(op)
+                        g = (self.wus_group(w, mesh_axes, zero_stage=stage)
+                             if w.create_gradients else 1)
+                        opt += (sb // g) * sc
+                        grads += (sb // g if stage >= 2 else sb) * sc
+                        if g > 1 and stage >= 3:
+                            master += (sb // g) * sc
+                            op_gather += sb * sc
+                        else:
+                            master += sb * sc
+                    gather_peak = max(gather_peak, op_gather)
+                weights = (master + grads + self.optimizer_slots * opt
+                           + 2 * gather_peak)
             else:
                 # master copy + grads + optimizer slots
                 weights *= (2 + self.optimizer_slots)
@@ -812,20 +917,21 @@ class Simulator:
         return acts + worst_internal
 
     def optimizer_update_cost(self, graph: Graph,
-                              mesh_axes: Optional[Dict[str, int]] = None
-                              ) -> float:
+                              mesh_axes: Optional[Dict[str, int]] = None,
+                              zero_stage: Optional[int] = None) -> float:
         """Weight-update pass: read master weight + grad, write weight,
         touch each optimizer slot — pure HBM traffic in f32 (master
-        precision), one fused kernel under jit.  Under weight-update
-        sharding the pass touches only each replicated weight's 1/group
-        shard (arXiv:2004.13336)."""
+        precision), one fused kernel under jit.  At ZeRO stage >= 1 the
+        pass touches only each replicated weight's 1/group shard
+        (arXiv:2004.13336); stages 2/3 change residency, not the pass."""
         numel = 0.0
         for op in graph.ops:
             for w in op.weights:
                 if w.create_gradients:
                     sb = w.shape.shard_bytes()
                     n = sb / max(1, np.dtype(w.shape.dtype.np_dtype).itemsize)
-                    numel += n / self.wus_group(w, mesh_axes)
+                    numel += n / self.wus_group(w, mesh_axes,
+                                                zero_stage=zero_stage)
         bytes_moved = numel * 4.0 * (3 + self.optimizer_slots)
         return bytes_moved / self.machine.device().hbm_bandwidth
 
@@ -836,6 +942,7 @@ class Simulator:
         mesh_axes: Dict[str, int],
         training: bool = True,
         segment_costs: Optional[Sequence[Tuple[Sequence[int], float]]] = None,
+        zero_stage: Optional[int] = None,
     ) -> SimResult:
         """segment_costs: [(member op guids, fwd+bwd seconds)] from
         profiler.measure_segment_costs — ops inside a measured region
@@ -851,15 +958,16 @@ class Simulator:
         topo = graph.topo_order()
         if training and not self.remat:
             memory_fn = lambda: self.memory_from_terms(  # noqa: E731
-                topo, mesh_axes, training
+                topo, mesh_axes, training, zero_stage=zero_stage
             )
         else:
             memory_fn = lambda: self.per_device_memory(  # noqa: E731
-                graph, training, mesh_axes=mesh_axes
+                graph, training, mesh_axes=mesh_axes, zero_stage=zero_stage
             )
         return self.simulate_ops(
             topo, mesh_axes, training=training, measured_ops=measured_ops,
             seg_cost_total=seg_cost_total, memory_fn=memory_fn,
+            zero_stage=zero_stage,
         )
 
     def simulate_ops(
@@ -870,6 +978,7 @@ class Simulator:
         measured_ops: Optional[Dict[int, float]] = None,
         seg_cost_total: float = 0.0,
         memory_fn: Optional[Callable[[], int]] = None,
+        zero_stage: Optional[int] = None,
     ) -> SimResult:
         """Aggregate cached per-op terms over `ops` (a topo-ordered op
         sequence).  The ONE aggregation path shared by full and delta
@@ -884,16 +993,19 @@ class Simulator:
         sync = 0.0
         opt_numel = 0.0
         opt_xfer = 0.0
+        gather_xfer = 0.0
         breakdown: Dict[str, float] = {}
         for op in ops:
             if op.op_type == OperatorType.INPUT:
                 continue
             terms = self.op_terms(op, mesh_axes, training,
-                                  skip_compute=op.guid in measured_ops)
+                                  skip_compute=op.guid in measured_ops,
+                                  zero_stage=zero_stage)
             if training:
                 sync += terms.grad_sync
                 opt_numel += terms.opt_numel
                 opt_xfer += terms.opt_xfer
+                gather_xfer += terms.gather_xfer
             if op.is_parallel_op():
                 comm += terms.xfer
                 breakdown[op.name] = terms.xfer
@@ -915,13 +1027,18 @@ class Simulator:
         # XLA overlaps collectives with independent compute; gradient
         # sync gets its own credit when backward/update overlap is
         # modeled (--search-overlap-backward-update).  The sharded
-        # update's weight all-gather (opt_xfer) overlaps the NEXT
-        # step's forward the way other collectives overlap compute, so
-        # it takes the standard credit, not the backward-sync one.
+        # update's weight all-gather (opt_xfer, stages 1/2) overlaps
+        # the NEXT step's forward the way other collectives overlap
+        # compute, so it takes the standard credit, not the
+        # backward-sync one.  The ZeRO-3 per-layer gathers
+        # (gather_xfer) take the EXPLICIT double-buffered-prefetch
+        # credit instead — they sit on layer-boundary critical paths
+        # and hide worse than generic resharding.
         effective_comm = (
             comm * (1.0 - self.overlap_fraction)
             + sync * (1.0 - self.sync_overlap_fraction)
             + opt_xfer * (1.0 - self.overlap_fraction)
+            + gather_xfer * (1.0 - Z3_PREFETCH_OVERLAP)
         )
         compute = compute + analytic_compute * self.compute_scale
         total = compute + effective_comm

@@ -152,6 +152,10 @@ def simulator_version(cfg) -> Dict:
             "rewrite_depth": int(cfg.rewrite_depth),
             "rewrite_max_variants": int(cfg.rewrite_max_variants),
             "remat": bool(cfg.remat),
+            # the ZeRO ladder stage shapes what the search returns
+            # (stage rides the winning strategy); the legacy bool stays
+            # in the key for operator-facing manifest readability
+            "zero_stage": int(getattr(cfg, "zero_stage", 0)),
             "weight_update_sharding": bool(cfg.weight_update_sharding),
             "wus_axis": cfg.wus_axis,
             "seed": int(cfg.seed),

@@ -82,6 +82,11 @@ class Strategy:
     # INDICES, so the replaying host must load byte-identical rules or
     # fail loudly (rewrite.rules_for_replay checks this)
     catalog: Optional[Dict] = None
+    # search-chosen ZeRO ladder stage (0-3, docs/PERF.md); None means
+    # "not chosen by the search" — the executor falls back to
+    # FFConfig.zero_stage.  Rides the strategy so a store-restored or
+    # imported winner replays with the stage it was costed under.
+    zero_stage: Optional[int] = None
 
     # -- serialization ---------------------------------------------------
     def to_json(self) -> str:
@@ -95,6 +100,7 @@ class Strategy:
                 "rewrites": [list(r) for r in self.rewrites],
                 "pipeline": self.pipeline,
                 "catalog": self.catalog,
+                "zero_stage": self.zero_stage,
             },
             indent=2,
         )
@@ -114,6 +120,7 @@ class Strategy:
             rewrites=[list(r) for r in d.get("rewrites", [])],
             pipeline=d.get("pipeline"),
             catalog=d.get("catalog"),
+            zero_stage=d.get("zero_stage"),
         )
 
     def save(self, path: str):

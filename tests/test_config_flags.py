@@ -81,16 +81,16 @@ def test_sample_parallel_candidates_and_training(devices8):
     search = UnitySearch(ff.layers, 8, machine, OpCostModel(machine),
                          enable_sample_parallel=True,
                          rewrite_max_variants=1)
-    cands = list(search._sample_candidates(0.0))
+    cands = list(search._sample_candidates())
     assert cands, "sample-parallel candidates missing"
-    meshes = [s.mesh_axes for s, _, _ in cands]
+    meshes = [s.mesh_axes for s, _, _, _ in cands]
     assert any("sample" in m for m in meshes)
     # disabled flag -> no candidates
     search_off = UnitySearch(ff.layers, 8, machine, OpCostModel(machine),
                              rewrite_max_variants=1)
-    assert not list(search_off._sample_candidates(0.0))
+    assert not list(search_off._sample_candidates())
     # one of them trains end to end on the CPU mesh
-    s = next(s for s, _, _ in cands if s.total_devices == 8)
+    s = next(s for s, _, _, _ in cands if s.total_devices == 8)
     ff.compile(optimizer=SGDOptimizer(lr=0.01), strategy=s,
                devices=devices8[:8])
     xx = np.random.randn(8, 16, 32).astype(np.float32)

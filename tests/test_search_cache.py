@@ -54,21 +54,21 @@ def _random_strategies(graph, n_moves=60, seed=7):
     return out
 
 
-@pytest.mark.parametrize("wus", [False, True],
-                         ids=["replicated-update", "sharded-update"])
+@pytest.mark.parametrize("stage", [0, 1, 2, 3],
+                         ids=["zero0", "zero1", "zero2", "zero3"])
 @pytest.mark.parametrize("build", [_transformer, _moe],
                          ids=["transformer", "moe"])
-def test_delta_eval_matches_full_eval_bit_for_bit(build, wus):
+def test_delta_eval_matches_full_eval_bit_for_bit(build, stage):
     """delta_eval(state) == full_eval(state), exactly, for every state
     of a random move sequence — including the lazy memory term.  Runs
-    under both optimizer-cost models (replicated and ZeRO-1 sharded
-    update, ISSUE 3) since they produce different OpTerms."""
+    at every rung of the ZeRO ladder (ISSUE 3 shipped stage 1, ISSUE 10
+    stages 2/3) since each stage produces different OpTerms."""
     graph = build().layers
     ev_delta = IncrementalEvaluator(
-        graph, Simulator(_machine(), weight_update_sharding=wus),
+        graph, Simulator(_machine(), zero_stage=stage),
         use_cache=True)
     ev_full = IncrementalEvaluator(
-        graph, Simulator(_machine(), weight_update_sharding=wus),
+        graph, Simulator(_machine(), zero_stage=stage),
         use_cache=False)
     legal = 0
     for s in _random_strategies(graph):
@@ -91,6 +91,34 @@ def test_delta_eval_matches_full_eval_bit_for_bit(build, wus):
     st = ev_delta.stats
     assert st.memo_hits + st.full_evals + st.delta_evals + \
         st.illegal_evals == st.evals
+
+
+def test_delta_eval_matches_full_eval_with_strategy_stage():
+    """A strategy-carried zero_stage (how unity's stage variants and
+    store-restored winners cost themselves) overrides the simulator
+    default, stays delta == full bit-for-bit, and is part of the memo
+    key — stage variants of one sharding never alias."""
+    import dataclasses
+
+    graph = _transformer().layers
+    ev_d = IncrementalEvaluator(graph, Simulator(_machine()), use_cache=True)
+    ev_f = IncrementalEvaluator(graph, Simulator(_machine()), use_cache=False)
+    for s in _random_strategies(graph, n_moves=12):
+        for stage in (None, 0, 1, 2, 3):
+            c = dataclasses.replace(s, zero_stage=stage)
+            rd, rf = ev_d.evaluate(c), ev_f.evaluate(c)
+            assert (rd is None) == (rf is None)
+            if rd is None:
+                continue
+            assert rd.total_time == rf.total_time
+            assert rd.sync_time == rf.sync_time
+            assert rd.per_device_memory == rf.per_device_memory
+    base = data_parallel_strategy(8)
+    sigs = {
+        strategy_signature(dataclasses.replace(base, zero_stage=s))
+        for s in (None, 0, 1, 2, 3)
+    }
+    assert len(sigs) == 5
 
 
 def test_memo_hit_on_revisited_strategy():

@@ -21,11 +21,13 @@ from flexflow_tpu.fftype import ActiMode
 from flexflow_tpu.optimizer import AdamOptimizer, SGDOptimizer
 
 
-def _model(devices, wus, opt, seed=0, num_devices=None):
+def _model(devices, wus=False, opt=None, seed=0, num_devices=None,
+           stage=None):
     cfg = FFConfig(
         batch_size=16,
         num_devices=num_devices or len(devices),
         weight_update_sharding=wus,
+        zero_stage=stage if stage is not None else 0,
         seed=seed,
     )
     ff = FFModel(cfg)
@@ -395,3 +397,182 @@ def test_config_cli_flags():
     assert FFConfig.from_args([]).weight_update_sharding is False
     with pytest.raises(ValueError):
         FFConfig(wus_axis="")
+
+
+def test_zero_stage_cli_and_deprecation_shim():
+    """--zero-stage is the unified ladder knob; the pre-ladder
+    --weight-update-sharding flag is a deprecation shim for stage 1 and
+    the bool always mirrors `zero_stage >= 1` after init."""
+    cfg = FFConfig.from_args(["--zero-stage", "2"])
+    assert cfg.zero_stage == 2 and cfg.weight_update_sharding is True
+    assert FFConfig.from_args([]).zero_stage == 0
+    # deprecated flag maps to stage 1
+    cfg = FFConfig.from_args(["--weight-update-sharding"])
+    assert cfg.zero_stage == 1
+    # an explicit stage wins over the shim
+    cfg = FFConfig.from_args(["--weight-update-sharding", "--zero-stage", "3"])
+    assert cfg.zero_stage == 3 and cfg.weight_update_sharding is True
+    assert FFConfig(zero_stage=3).weight_update_sharding is True
+    assert FFConfig(zero_stage=0).weight_update_sharding is False
+    assert FFConfig(weight_update_sharding=True).zero_stage == 1
+    with pytest.raises(ValueError):
+        FFConfig(zero_stage=4)
+    with pytest.raises(ValueError):
+        FFConfig(zero_stage=-1)
+
+
+# -- the ZeRO ladder: stages 2/3 (arXiv:1910.02054) ----------------------
+
+def _axes_of(spec):
+    return [a for e in spec if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))]
+
+
+def _tree_shard_bytes(shardings, leaves):
+    """(per-device, total) bytes of `leaves` laid out per `shardings`
+    (both {op: {weight: _}} trees)."""
+    shard = total = 0
+    for op_name, entry in shardings.items():
+        for wname, sh in entry.items():
+            leaf = leaves[op_name][wname]
+            shard += int(
+                np.prod(sh.shard_shape(leaf.shape)) * leaf.dtype.itemsize
+            )
+            total += int(np.prod(leaf.shape) * leaf.dtype.itemsize)
+    return shard, total
+
+
+@pytest.mark.parametrize(
+    "make_opt",
+    [
+        lambda: SGDOptimizer(lr=0.05, momentum=0.9),
+        lambda: AdamOptimizer(alpha=0.01),
+    ],
+    ids=["sgd_momentum", "adam"],
+)
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_ladder_stage_matches_replicated(devices8, make_opt, stage):
+    """Every rung of the ladder trains to the stage-0 weights, slots
+    AND per-epoch loss trajectory on the same data: the ladder changes
+    residency and collectives, never numerics."""
+    import jax
+
+    xs, ys = _data()
+    ff0 = _model(devices8, opt=make_opt())
+    ffs = _model(devices8, opt=make_opt(), stage=stage)
+    h0 = ff0.fit(xs, ys, epochs=2, verbose=False)
+    hs = ffs.fit(xs, ys, epochs=2, verbose=False)
+    np.testing.assert_allclose(
+        [pm.sparse_cce_loss for pm in h0],
+        [pm.sparse_cce_loss for pm in hs],
+        rtol=2e-5,
+    )
+    _assert_trees_close(ff0.get_weights(), ffs.get_weights())
+    _assert_trees_close(
+        jax.tree.map(np.asarray, ff0._opt_state),
+        jax.tree.map(np.asarray, ffs._opt_state),
+    )
+
+
+def test_stage2_grad_buffer_scattered(devices8):
+    """At stage >= 2 the gradient buffer the step carries is the
+    scattered (wus) layout — per-device grad bytes drop by ~1/dp, and
+    no pre-update gather of the grads exists (they feed the 1/dp-shard
+    update directly).  Below stage 2 grads keep the strategy layout."""
+    from jax.sharding import NamedSharding
+
+    dp = 8
+    ff = _model(devices8, opt=AdamOptimizer(alpha=0.01), stage=2)
+    gsh = ff.executor.grad_shardings()
+    for op_name, entry in gsh.items():
+        for wname, sh in entry.items():
+            assert isinstance(sh, NamedSharding)
+    # the three kernels all scatter along the wus axis
+    for op in ("dense_0", "dense_1", "dense_2"):
+        assert "data" in _axes_of(gsh[op]["kernel"].spec), op
+    shard, total = _tree_shard_bytes(gsh, ff._weights)
+    assert shard <= total // dp + total // 20, (shard, total)
+    # stages 0/1 keep the strategy (replicated) grad layout
+    for s in (0, 1):
+        ffl = _model(devices8, opt=AdamOptimizer(alpha=0.01), stage=s)
+        assert ffl.executor.grad_shardings() == \
+            ffl.executor.weight_shardings()
+    # and the scattered-grad step still trains
+    xs, ys = _data(32)
+    m = ff.train_step({"x": xs[:16]}, ys[:16])
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_stage3_master_weights_resident_scattered(devices8):
+    """At stage 3 master weights LIVE scattered along the wus axis —
+    weight-resident bytes drop by ~1/dp per device (asserted via the
+    NamedShardings the weights actually carry) — and they stay
+    scattered after an update step (no post-update gather-back)."""
+    from jax.sharding import NamedSharding
+
+    dp = 8
+    ff = _model(devices8, opt=AdamOptimizer(alpha=0.01), stage=3)
+    shard = total = 0
+    for op_name, entry in ff._weights.items():
+        for wname, leaf in entry.items():
+            assert isinstance(leaf.sharding, NamedSharding)
+            shard += int(
+                np.prod(leaf.sharding.shard_shape(leaf.shape))
+                * leaf.dtype.itemsize
+            )
+            total += int(np.prod(leaf.shape) * leaf.dtype.itemsize)
+    assert shard <= total // dp + total // 20, (shard, total)
+    for op in ("dense_0", "dense_1", "dense_2"):
+        assert "data" in _axes_of(ff._weights[op]["kernel"].sharding.spec)
+    # below stage 3 the resident layout is the strategy sharding
+    ff1 = _model(devices8, opt=AdamOptimizer(alpha=0.01), stage=1)
+    assert ff1.executor.master_weight_shardings() == \
+        ff1.executor.weight_shardings()
+    assert _axes_of(ff1._weights["dense_0"]["kernel"].sharding.spec) == []
+    # a step keeps the scattered residency (the update emits no gather)
+    xs, ys = _data(32)
+    m = ff.train_step({"x": xs[:16]}, ys[:16])
+    assert np.isfinite(float(m["loss"]))
+    assert "data" in _axes_of(ff._weights["dense_0"]["kernel"].sharding.spec)
+    # get_weights still surfaces full global arrays
+    w = ff.get_weights()
+    assert w["dense_0"]["kernel"].shape == (32, 64)
+
+
+def test_stage3_checkpoint_elastic_reshard(devices8, tmp_path):
+    """A stage-3 run's scattered master weights round-trip through a
+    checkpoint, including the 8 -> 4 elastic reshard and a cross-stage
+    restore into a stage-0 model."""
+    import jax
+
+    from flexflow_tpu.checkpoint import LocalCheckpointManager
+
+    xs, ys = _data()
+    ff = _model(devices8, opt=AdamOptimizer(alpha=0.01), stage=3)
+    ff.fit(xs, ys, epochs=1, verbose=False)
+    saved_w = ff.get_weights()
+    saved_opt = jax.tree.map(np.asarray, ff._opt_state)
+
+    mgr = LocalCheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(ff, step=1)
+    assert mgr.restore_meta()["zero_stage"] == 3
+
+    # elastic: 8 -> 4 survivors, still stage 3
+    ff4 = _model(devices8[:4], opt=AdamOptimizer(alpha=0.01), stage=3,
+                 seed=7)
+    assert mgr.restore(ff4) == 1
+    _assert_trees_close(ff4.get_weights(), saved_w, rtol=0, atol=0)
+    _assert_trees_close(
+        jax.tree.map(np.asarray, ff4._opt_state), saved_opt, rtol=0, atol=0
+    )
+    # master weights resident-scattered on the survivor mesh (1/4 now)
+    k4 = ff4._weights["dense_0"]["kernel"]
+    assert "data" in _axes_of(k4.sharding.spec)
+    ff4.fit(xs, ys, epochs=1, verbose=False)  # keeps training
+
+    # cross-stage: the same artifact restores into a stage-0 model
+    # (leaves are saved as GLOBAL arrays; restore reshards onto the
+    # current executor's layouts)
+    ff0 = _model(devices8[:4], opt=AdamOptimizer(alpha=0.01), seed=9)
+    assert mgr.restore(ff0) == 1
+    _assert_trees_close(ff0.get_weights(), saved_w, rtol=0, atol=0)

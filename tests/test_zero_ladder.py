@@ -1,0 +1,307 @@
+"""The ZeRO ladder as a search-costed strategy dimension (ISSUE 10).
+
+Pins four contracts:
+
+  * the OpTerms decomposition is version-locked: changing the field set
+    without bumping sim.simulator.COST_MODEL_VERSION fails here, so
+    stale strategy-store entries always invalidate fleet-wide;
+  * the simulator's ladder economics — per-device memory strictly falls
+    rung over rung (slots /dp at 1, grads /dp at 2, master weights /dp
+    at 3) while stage 3 pays per-layer all-gather traffic on top of the
+    time-identical stages 1/2;
+  * the searches CHOOSE the stage: a memory-constrained config lands on
+    stage >= 2, the unconstrained config stays at stage <= 1, and the
+    choice rides strategy.zero_stage / search_stats;
+  * per-leaf replicated-update fallback is counted and surfaced, not
+    silent.
+"""
+import dataclasses
+import hashlib
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.models.transformer import build_transformer
+from flexflow_tpu.optimizer import AdamOptimizer, SGDOptimizer
+from flexflow_tpu.pcg.evaluator import IncrementalEvaluator
+from flexflow_tpu.pcg.mcmc import MCMCSearch, search_stage_candidates
+from flexflow_tpu.pcg.unity import UnitySearch
+from flexflow_tpu.sim.machine_model import TpuPodModel
+from flexflow_tpu.sim.simulator import (
+    COST_MODEL_VERSION,
+    OpCostModel,
+    OpTerms,
+    Simulator,
+)
+from flexflow_tpu.strategy import data_parallel_strategy
+
+
+# -- cost-model version guard (CI satellite) -----------------------------
+
+#: sha256 prefix of OpTerms' comma-joined field names, pinned per
+#: COST_MODEL_VERSION.  Changing the per-op decomposition re-prices
+#: every stored strategy, so the version MUST bump in the same change —
+#: that's what invalidates stale store entries fleet-wide (store/key.py
+#: embeds the version in every strategy key).
+_OPTERMS_DIGEST_BY_VERSION = {
+    # v2: the ZeRO ladder — mem_master/mem_grad/mem_gather/gather_xfer
+    2: "361bfd29c5f8ec36",
+}
+
+
+def test_opterms_field_set_pinned_to_cost_model_version():
+    fields = ",".join(f.name for f in dataclasses.fields(OpTerms))
+    digest = hashlib.sha256(fields.encode()).hexdigest()[:16]
+    assert COST_MODEL_VERSION in _OPTERMS_DIGEST_BY_VERSION, (
+        f"COST_MODEL_VERSION={COST_MODEL_VERSION} has no pinned OpTerms "
+        "digest — add it here IN THE SAME CHANGE that bumps the version"
+    )
+    assert digest == _OPTERMS_DIGEST_BY_VERSION[COST_MODEL_VERSION], (
+        f"OpTerms fields changed ({fields}) without bumping "
+        f"COST_MODEL_VERSION (= {COST_MODEL_VERSION}): stored strategies "
+        "ranked under the old decomposition would replay stale.  Bump the "
+        "version and pin the new digest "
+        f"{digest!r} in _OPTERMS_DIGEST_BY_VERSION."
+    )
+
+
+def test_store_key_invalidates_on_stage_change():
+    """The strategy-store key sees the configured stage (a stage-blind
+    key would replay a stage-0 winner into a stage-3 fleet)."""
+    from flexflow_tpu.store.key import simulator_version
+
+    v0 = simulator_version(FFConfig(zero_stage=0))
+    v2 = simulator_version(FFConfig(zero_stage=2))
+    assert v0 != v2
+    assert v0["search"]["zero_stage"] == 0
+    assert v2["search"]["zero_stage"] == 2
+    assert v0["cost_model_version"] == COST_MODEL_VERSION >= 2
+
+
+# -- simulator ladder economics ------------------------------------------
+
+def _transformer_graph(batch=16):
+    ff = FFModel(FFConfig())
+    build_transformer(ff, batch_size=batch, seq_length=16, hidden_size=32,
+                      num_layers=2, num_heads=4)
+    return ff.layers
+
+
+def _dp8_result(graph, stage):
+    machine = TpuPodModel(topology=(8,))
+    ev = IncrementalEvaluator(graph, Simulator(machine, zero_stage=stage))
+    return ev.evaluate(data_parallel_strategy(8))
+
+
+def test_ladder_memory_falls_and_stage3_pays_gathers():
+    """Per-device memory strictly falls up the ladder; stages 1 and 2
+    are time-identical (stage 2 is a residency change only), stage 1
+    beats stage 0 on time (numel/dp update), and stage 3 trades the
+    post-update gather for costlier per-layer gathers — which is what
+    keeps unconstrained searches on stages <= 1."""
+    graph = _transformer_graph()
+    r = {s: _dp8_result(graph, s) for s in (0, 1, 2, 3)}
+    assert all(v is not None for v in r.values())
+    mem = {s: v.per_device_memory for s, v in r.items()}
+    assert mem[0] > mem[1] > mem[2] > mem[3], mem
+    assert r[1].total_time < r[0].total_time
+    assert r[2].total_time == r[1].total_time
+    assert r[3].total_time > r[2].total_time
+    # the grad reduce-scatter replaces the all-reduce at stage >= 1
+    assert r[1].sync_time < r[0].sync_time
+    assert r[3].sync_time == r[1].sync_time
+
+
+def test_stage_override_beats_simulator_default():
+    """A strategy-carried stage overrides the simulator's own: costing
+    the ladder never needs a second Simulator."""
+    graph = _transformer_graph()
+    machine = TpuPodModel(topology=(8,))
+    ev = IncrementalEvaluator(graph, Simulator(machine, zero_stage=0))
+    s3 = dataclasses.replace(data_parallel_strategy(8), zero_stage=3)
+    base = ev.evaluate(data_parallel_strategy(8))
+    over = ev.evaluate(s3)
+    ref = _dp8_result(graph, 3)
+    assert over.per_device_memory == ref.per_device_memory
+    assert over.total_time == ref.total_time
+    assert over.per_device_memory < base.per_device_memory
+
+
+# -- the search chooses the stage ----------------------------------------
+
+def _mlp(batch=16):
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor([batch, 32], name="x")
+    t = ff.dense(x, 64, activation=ActiMode.RELU)
+    t = ff.dense(t, 64, activation=ActiMode.RELU)
+    t = ff.dense(t, 8)
+    ff.softmax(t)
+    return ff
+
+
+def test_search_stage_candidates_gating():
+    """The ladder opens to the search only under --memory-search; the
+    configured stage is always the floor."""
+    assert search_stage_candidates(FFConfig(zero_stage=0)) == (0,)
+    assert search_stage_candidates(FFConfig(zero_stage=3)) == (3,)
+    cfg = FFConfig(zero_stage=0, memory_search=True)
+    assert search_stage_candidates(cfg) == (0, 1, 2, 3)
+    cfg = FFConfig(zero_stage=2, memory_search=True)
+    assert search_stage_candidates(cfg) == (2, 3)
+
+
+def _dp_only(monkeypatch):
+    """Pin the mesh enumeration to pure-dp so the ZeRO stage is the only
+    memory lever — the deterministic face of the ladder decision."""
+    import flexflow_tpu.pcg.unity as unity_mod
+
+    monkeypatch.setattr(
+        unity_mod, "_factorizations",
+        lambda n, allow_expert=True: [(n, 1, 1)],
+    )
+
+
+def test_unity_chooses_high_stage_under_memory_pressure(monkeypatch):
+    """With a per-device budget between the stage-1 and stage-2
+    footprints of the dp-8 mesh, unity's lambda search must climb the
+    ladder (stage >= 2); without a budget it stays at stage <= 1
+    because stage 3's gather traffic costs time."""
+    _dp_only(monkeypatch)
+    graph = _mlp().layers
+    machine = TpuPodModel(topology=(8,))
+
+    def search(budget):
+        return UnitySearch(
+            graph, 8, machine, OpCostModel(machine),
+            zero_stage=0, zero_stages=(0, 1, 2, 3),
+            memory_budget=budget, enable_pipeline=False,
+        )
+
+    free = search(None).optimize()
+    assert free is not None
+    assert (free.zero_stage or 0) <= 1
+
+    mems = {
+        s: _dp8_result_for(graph, machine, s).per_device_memory
+        for s in (1, 2)
+    }
+    assert mems[2] < mems[1]
+    budget = (mems[1] + mems[2]) // 2
+    tight = search(budget).optimize_with_memory()
+    assert tight is not None
+    assert tight.zero_stage >= 2
+    sim = Simulator(machine, zero_stage=tight.zero_stage)
+    ev = IncrementalEvaluator(graph, sim)
+    assert ev.evaluate(tight).per_device_memory <= budget
+
+
+def _dp8_result_for(graph, machine, stage):
+    ev = IncrementalEvaluator(graph, Simulator(machine, zero_stage=stage))
+    return ev.evaluate(data_parallel_strategy(8))
+
+
+def test_mcmc_chooses_high_stage_under_memory_pressure():
+    """The MCMC chain's stage move lands memory-pressured models on
+    stage >= 2 (budget between the stage-1 and stage-2 dp-8
+    footprints); the winner records the stage in search_stats."""
+    graph = _mlp().layers
+    machine = TpuPodModel(topology=(8,))
+    mems = {
+        s: _dp8_result_for(graph, machine, s).per_device_memory
+        for s in (1, 2)
+    }
+    budget = (mems[1] + mems[2]) // 2
+    search = MCMCSearch(
+        graph, 8, lambda: Simulator(machine), budget=60, seed=0,
+        zero_stages=(0, 1, 2, 3), memory_budget=budget,
+    )
+    search.factorizations = [(8, 1, 1)]  # dp-only: the stage decides
+    best = search.optimize()
+    assert best.zero_stage is not None and best.zero_stage >= 2
+    assert search.evaluator.evaluate(best).per_device_memory <= budget
+
+
+def test_compile_surfaces_stage_in_search_stats(devices8):
+    """End to end through FFModel.compile: the searched winner records
+    the stage it was costed under for both search algorithms."""
+    for algo in ("mcmc", "unity"):
+        cfg = FFConfig(batch_size=16, num_devices=8, search_budget=8,
+                       search_algo=algo, search_calibrate=False,
+                       zero_stage=2)
+        ff = FFModel(cfg)
+        x = ff.create_tensor([16, 32], name="x")
+        t = ff.dense(x, 64, activation=ActiMode.RELU)
+        t = ff.dense(t, 8)
+        ff.softmax(t)
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   devices=devices8)
+        assert ff.strategy.search_stats["zero_stage"] == 2
+        assert ff.strategy.search_stats["weight_update_sharding"] is True
+        assert ff.executor.zero_stage == 2 or ff.executor.wus_axis is None
+
+
+# -- per-leaf fallback observability -------------------------------------
+
+def test_fallback_leaves_counted_and_surfaced(devices8):
+    """A leaf with no free dim divisible by the wus axis falls back to
+    the replicated update — counted into obs metrics and search_stats
+    instead of silently."""
+    cfg = FFConfig(batch_size=16, num_devices=8, zero_stage=1)
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 32], name="x")
+    t = ff.dense(x, 7)  # kernel (32, 7) shards dim 0; bias (7,) cannot
+    ff.softmax(t)
+    s = data_parallel_strategy(8)
+    s.search_stats = {}
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy=s, devices=devices8)
+    assert ff.executor.zero_fallback_leaves() == ["dense_0.bias"]
+    assert s.search_stats["zero_fallback_leaves"] == 1
+    assert ff.telemetry.metrics.counter(
+        "parallel/zero_fallback_leaves"
+    ).value == 1
+    # the ladder off -> no fallback bookkeeping at all
+    ff0 = FFModel(FFConfig(batch_size=16, num_devices=8, zero_stage=0))
+    x0 = ff0.create_tensor([16, 32], name="x")
+    ff0.softmax(ff0.dense(x0, 7))
+    ff0.compile(optimizer=SGDOptimizer(lr=0.05),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                strategy=data_parallel_strategy(8), devices=devices8)
+    assert ff0.executor.zero_fallback_leaves() == []
+    assert ff0.telemetry.metrics.counter(
+        "parallel/zero_fallback_leaves"
+    ).value == 0
+
+
+def test_zero3_loss_matches_adamw_with_fallback_leaf(devices8):
+    """Stage 3 with a fallback leaf in the tree (the 7-wide bias stays
+    resident + replicated) still matches stage 0 numerics."""
+    cfg3 = FFConfig(batch_size=16, num_devices=8, zero_stage=3)
+    cfg0 = FFConfig(batch_size=16, num_devices=8, zero_stage=0)
+
+    def build(cfg):
+        ff = FFModel(cfg)
+        x = ff.create_tensor([16, 32], name="x")
+        t = ff.dense(x, 64, activation=ActiMode.RELU)
+        t = ff.dense(t, 7)
+        ff.softmax(t)
+        ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   strategy=data_parallel_strategy(8), devices=devices8,
+                   seed=0)
+        return ff
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 32).astype(np.float32)
+    ys = rng.randint(0, 7, 64).astype(np.int32)
+    ff3, ff0 = build(cfg3), build(cfg0)
+    h3 = ff3.fit(xs, ys, epochs=2, verbose=False)
+    h0 = ff0.fit(xs, ys, epochs=2, verbose=False)
+    np.testing.assert_allclose(
+        [pm.sparse_cce_loss for pm in h3],
+        [pm.sparse_cce_loss for pm in h0], rtol=2e-5,
+    )
