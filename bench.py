@@ -642,6 +642,36 @@ def bench_zero_ladder(dev, on_tpu):
     return out
 
 
+def _fsck_verdict(local_dir=None, remote_uri=None):
+    """Post-bench verification (manifest v15): run the offline
+    two-tier checkpoint verifier (tools/checkpoint_fsck.py) over the
+    dirs a leg just produced, BEFORE they are cleaned up — a bench
+    that published a corrupt checkpoint should say so in its own
+    numbers, not pass silently."""
+    from tools.checkpoint_fsck import fsck_local, fsck_remote
+
+    out = {}
+    problems = []
+    if local_dir is not None:
+        rep = fsck_local(local_dir)
+        step_problems = [p for s in rep["steps"].values()
+                         for p in s["problems"]]
+        problems += rep["problems"] + step_problems
+        out["local_steps_verified"] = sum(
+            1 for s in rep["steps"].values() if s["ok"])
+    if remote_uri is not None:
+        rep = fsck_remote(remote_uri)
+        step_problems = [p for s in rep.get("steps", {}).values()
+                         for p in s["problems"]]
+        problems += rep.get("problems", []) + step_problems
+        out["remote_steps_verified"] = sum(
+            1 for s in rep.get("steps", {}).values() if s["ok"])
+    out["ok"] = not problems
+    if problems:
+        out["problems"] = problems[:5]
+    return out
+
+
 def bench_checkpoint(dev, on_tpu):
     """Checkpoint-stall microbench (manifest v9): the step-boundary
     stall of a full-train-state save under the durability layer
@@ -706,6 +736,8 @@ def bench_checkpoint(dev, on_tpu):
         with open(os.path.join(mgr._path(step), "manifest.json")) as f:
             total_bytes = json.load(f)["total_bytes"]
         mgr.close()
+        fsck = _fsck_verdict(local_dir=tmpdir)
+        assert fsck["ok"], fsck
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
@@ -723,6 +755,7 @@ def bench_checkpoint(dev, on_tpu):
         "flush_ms": round(flush * 1e3, 3),
         # serialize+fsync+verify+publish throughput of the background writer
         "write_mb_per_s": round(total_bytes / 2**20 / max(flush, 1e-9), 1),
+        "fsck": fsck,
     }
 
 
@@ -961,6 +994,12 @@ def bench_host_loss(dev, on_tpu):
         cold_s = time.perf_counter() - t0
         assert cold_report.final_step == 1
 
+        # post-bench verification: both tiers the drill produced must
+        # fsck clean (every manifest crc, LATEST/REMOTE_LATEST intact)
+        fsck = _fsck_verdict(local_dir=roots["ck_fresh"],
+                             remote_uri=roots["blob"])
+        assert fsck["ok"], fsck
+
         return {
             "workload": (
                 f"{layers}L h{hidden} MLP, {steps} supervised steps, "
@@ -983,6 +1022,7 @@ def bench_host_loss(dev, on_tpu):
                 "cold_start_time_to_first_step_s": round(cold_s, 3),
                 "progress_kept_steps": steps,
             },
+            "fsck": fsck,
         }
     finally:
         for path in roots.values():
@@ -1239,6 +1279,184 @@ def bench_serving_resilience(dev, on_tpu):
     }
 
 
+def bench_autoscale(dev, on_tpu):
+    """Autoscaling-front leg (manifest v15): a SEEDED square-wave
+    burst trace against a ServingFront that starts at min_replicas
+    with a ServingAutoscaler attached (serving/autoscaler.py).  The
+    burst must scale the fleet UP (replicas spawned through the warm
+    from_trained factory) and the post-burst calm must DRAIN it back
+    down gracefully — in-flight slots run to completion, so
+    requeued_requests stays 0 and a post-run token-identity audit
+    (greedy re-generation of every completion on the settled fleet)
+    must match byte-for-byte.  Availability acceptance is >= 0.99.
+    The autoscaler tick history carries the replica-count timeline;
+    TTFT records bucket into a per-second p99 timeline."""
+    import time as _time
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+    from flexflow_tpu.obs.metrics import MetricsRegistry
+    from flexflow_tpu.serving import ServingAutoscaler, ServingFront
+    from flexflow_tpu.serving.loadgen import run_loadgen, sample_workload
+
+    leg = MANIFEST["legs"]["autoscale"]
+    if on_tpu:
+        vocab, max_seq = leg["vocab"], leg["max_seq"]
+        hidden, layers, heads = leg["hidden"], leg["layers"], leg["heads"]
+        inter, slots = leg["intermediate"], leg["slots"]
+        page, n_req = leg["kv_page_size"], leg["requests"]
+        calm_rps, burst = leg["calm_rps"], leg["burst_factor"]
+        period_s = leg["period_s"]
+        plen_range = tuple(leg["prompt_len_range"])
+        mnt_range = tuple(leg["max_new_range"])
+    else:
+        vocab, max_seq = 64, 64
+        hidden, layers, heads, inter = 128, 2, 4, 256
+        slots, page, n_req = 4, 8, 96
+        # the burst must OUTRUN one replica's measured service rate on
+        # CPU (~100-150 req/s at these lengths) or nothing scales
+        calm_rps, burst, period_s = 40.0, 12.0, 0.5
+        plen_range, mnt_range = (2, 8), (8, 24)
+    min_r, max_r = leg["min_replicas"], leg["max_replicas"]
+
+    cfg = FFConfig(batch_size=slots, num_devices=1,
+                   serving_slots=slots, kv_page_size=page,
+                   serving_replicas=min_r,
+                   serving_min_replicas=min_r,
+                   serving_max_replicas=max_r)
+    ff = FFModel(cfg)
+    build_gpt(ff, batch_size=slots, seq_length=max_seq,
+              hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=inter, vocab_size=vocab)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (slots, max_seq)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                          (slots, max_seq)).copy()
+    ff.train_step({"input": ids, "positions": pos}, ids)  # real weights
+
+    reg = MetricsRegistry()
+    front = ServingFront.from_trained(ff, num_replicas=min_r,
+                                      devices=[dev], registry=reg)
+    scaler = ServingAutoscaler(
+        front, min_r, max_r,
+        interval_s=leg["interval_s"], cooldown_s=leg["cooldown_s"],
+        queue_high=leg["queue_high"], queue_low=leg["queue_low"],
+        drain_timeout_s=leg["drain_timeout_s"], registry=reg,
+    )
+    try:
+        # warm the initial replica's decode compile before timing
+        warm = [front.generate_async([1, 2], 2) for _ in range(slots)]
+        for h in warm:
+            h.wait(300.0)
+        scaler.start()
+        wl_rng = np.random.RandomState(11)
+        workload = sample_workload(wl_rng, n_req, vocab,
+                                   prompt_len_range=plen_range,
+                                   max_new_range=mnt_range)
+        t0 = _time.monotonic()
+        report = run_loadgen(front, workload, calm_rps, seed=7,
+                             detail=True, record_tokens=True,
+                             arrival="square", burst_factor=burst,
+                             period_s=period_s)
+        def fleet_size():
+            with front._cv:
+                return len(front.replicas)
+
+        # a scale-up decided near the end of the trace may still be
+        # compiling (add_replica appends AFTER the build) — wait for
+        # it to land before judging the drain-down
+        # list() snapshot: the loop thread is still appending ticks
+        max_fleet = max((e["replicas"] for e in list(scaler.history)),
+                        default=min_r)
+        # wait on the PEAK fleet, not the current one: when the trace
+        # outlasts both the scale-up and the drain-down, the fleet is
+        # already back at min_r and a current-size check would spin to
+        # the full deadline
+        spin_deadline = _time.monotonic() + 120.0
+        while (_time.monotonic() < spin_deadline
+               and (scaler._spawning
+                    or (scaler.scale_ups > 0 and max_fleet <= min_r))):
+            _time.sleep(0.05)
+            max_fleet = max(max_fleet, fleet_size())
+        # post-burst calm: the loop must drain back to min_replicas
+        drain_deadline = _time.monotonic() + 120.0
+        while _time.monotonic() < drain_deadline:
+            max_fleet = max(max_fleet, fleet_size())
+            if fleet_size() <= min_r and scaler._draining is None:
+                break
+            _time.sleep(0.05)
+        scaler.stop()
+        final_fleet = fleet_size()
+        # token-identity audit: greedy decode is deterministic, so
+        # every completion re-generated on the settled fleet must be
+        # byte-identical — a drain that disturbed an in-flight slot
+        # (or a requeue that lost prefix state) would show here
+        records = report.pop("records", [])
+        audited = mismatches = 0
+        for r in records:
+            if not r.get("ok") or "tokens" not in r:
+                continue
+            p, mnt = workload[r["idx"]]
+            audited += 1
+            if front.generate(p, mnt, timeout=120.0) != r["tokens"]:
+                mismatches += 1
+        availability = report["completed"] / max(report["requests"], 1)
+        # p99-TTFT timeline: 1s submit-time buckets over the run
+        buckets = {}
+        for r in records:
+            if r.get("ok") and "ttft_s" in r:
+                buckets.setdefault(int(r["submit_s"]), []).append(
+                    r["ttft_s"])
+        ttft_timeline = [
+            {"t_s": t, "n": len(v),
+             "p99_ms": round(float(np.percentile(v, 99)) * 1e3, 2)}
+            for t, v in sorted(buckets.items())
+        ]
+        # replica-count timeline from the autoscaler's tick history
+        # (downsampled: keep every entry where the fleet size changed,
+        # plus scale decisions)
+        timeline = []
+        last = None
+        for e in scaler.history:
+            if e["replicas"] != last or e["action"] != "hold":
+                timeline.append({"t_s": round(e["t"] - t0, 2),
+                                 "replicas": e["replicas"],
+                                 "action": e["action"]})
+                last = e["replicas"]
+        return {
+            "workload": (
+                f"{n_req} reqs, square-wave {calm_rps}->"
+                f"{calm_rps * burst} rps every {period_s}s, fleet "
+                f"[{min_r}, {max_r}] starting at {min_r}"
+            ),
+            "availability": round(availability, 4),
+            "completed": report["completed"],
+            "submitted": report["requests"],
+            "scale_ups": scaler.scale_ups,
+            "scale_downs": scaler.scale_downs,
+            "forced_retires": scaler.forced_retires,
+            "max_fleet": max_fleet,
+            "final_fleet": final_fleet,
+            "scaled_up_on_burst": bool(scaler.scale_ups >= 1),
+            "drained_down_after": bool(final_fleet == min_r
+                                       and scaler.scale_downs >= 1),
+            "requeued_requests": front.requeued_requests,
+            "token_identity": {
+                "audited": audited,
+                "mismatches": mismatches,
+                "identical": bool(audited > 0 and mismatches == 0),
+            },
+            "replica_timeline": timeline,
+            "ttft_p99_timeline_ms": ttft_timeline,
+            "tokens_per_s": report.get("tokens_per_s", 0.0),
+        }
+    finally:
+        front.close()
+
+
 def _outage_line(reason: str):
     # tunnel/backend outage: emit a diagnostic JSON line instead of a
     # stacktrace/hang so the capture records WHY there are no numbers
@@ -1304,6 +1522,8 @@ def main():
     gc.collect()
     serving_resilience = bench_serving_resilience(dev, on_tpu)
     gc.collect()
+    autoscale = bench_autoscale(dev, on_tpu)
+    gc.collect()
     cold_start = bench_cold_start(dev, on_tpu)
     gc.collect()
     host_loss = bench_host_loss(dev, on_tpu)
@@ -1328,6 +1548,7 @@ def main():
                  "zero_ladder": ladder,
                  "checkpoint": ckpt, "serving": serving,
                  "serving_resilience": serving_resilience,
+                 "autoscale": autoscale,
                  "cold_start": cold_start, "host_loss": host_loss},
     }
     print(json.dumps(result))

@@ -20,6 +20,7 @@ Run: python serve_gpt.py [-e STEPS] [-b BATCH]
 """
 import argparse
 import json
+import signal
 import threading
 import urllib.request
 
@@ -61,6 +62,7 @@ def main():
         m = ff.train_step({"input": ids, "positions": pos}, labels)
     print(f"trained {args.steps} steps, loss={float(m['loss']):.3f}")
 
+    grace_displaced = {}
     if serving_cfg.serving_mode == "continuous":
         page = serving_cfg.kv_page_size
         if S % page:  # the demo model's position table is small
@@ -82,6 +84,19 @@ def main():
         ff.config.request_retry_limit = \
             serving_cfg.request_retry_limit
         batcher = ServingFront.from_trained(ff)
+        # SIGTERM/SIGINT drain instead of kill for ANY front — the
+        # grace machinery lives in ServingFront, not the autoscaler
+        grace_displaced = batcher.install_grace_handlers(
+            deadline_s=serving_cfg.serving_drain_timeout)
+        if serving_cfg.serving_max_replicas > 0:
+            # --serving-max-replicas N turns the fleet size into a
+            # controlled variable (docs/SERVING.md "Autoscaling &
+            # drain lifecycle"): scale-up on load, graceful drain
+            # when calm
+            from flexflow_tpu.serving import ServingAutoscaler
+
+            ServingAutoscaler.from_config(
+                batcher, serving_cfg).start()
     else:
         engine = GenerationEngine(ff, batch_size=b)
         batcher = GenerationBatcher(engine, flush_timeout_s=0.02)
@@ -114,6 +129,9 @@ def main():
           f"{stats['batches_run']} p95={stats['latency']['p95_ms']}ms")
     server.shutdown()
     batcher.close()
+    for signum, handler in grace_displaced.items():
+        if handler is not None:  # Ctrl-C kills again post-close
+            signal.signal(signum, handler)
 
 
 if __name__ == "__main__":

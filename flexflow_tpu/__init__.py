@@ -7,7 +7,25 @@ runtime + mapper; Pallas kernels replace custom CUDA; ICI/DCN
 collectives replace NCCL; and the Unity/MCMC strategy search drives a
 TPU-pod machine model.  See SURVEY.md at the repo root.
 """
-from .checkpoint import (
+import os as _os
+
+import jax as _jax
+
+# Sharding-invariant RNG: with the legacy (non-partitionable) threefry,
+# GSPMD-partitioning a `jax.random` draw CHANGES the values it
+# produces, so a weight initialized onto a sharded layout differs from
+# the same seed initialized replicated — a tensor-parallel model would
+# genuinely train different weights than its single-device twin
+# (tests/test_parallelism.py caught this).  The partitionable
+# implementation makes every draw a pure function of (key, shape)
+# regardless of how XLA partitions it; it is also the jax default
+# going forward.  NOTE this is a process-global flag and changes the
+# values unrelated `jax.random` draws produce in the host application;
+# an explicit JAX_THREEFRY_PARTITIONABLE env setting wins over us.
+if "JAX_THREEFRY_PARTITIONABLE" not in _os.environ:
+    _jax.config.update("jax_threefry_partitionable", True)
+
+from .checkpoint import (  # noqa: E402
     CheckpointCompatibilityError,
     CheckpointManager,
     CheckpointVerifyError,

@@ -244,6 +244,21 @@ class FFConfig:
     serving_step_timeout: float = 0.0  # decode-step watchdog deadline, s
     serving_max_restarts: int = 3      # per-replica restart budget
     request_retry_limit: int = 2       # requeues before a 503 retriable
+    # SLO-driven autoscaling (serving/autoscaler.py, docs/SERVING.md
+    # "Autoscaling & drain lifecycle"): the fleet sizes itself between
+    # [min, max] from the queue-depth / p99-TTFT / KV-occupancy gauges;
+    # scale-down DRAINS (graceful, token-identical) instead of killing.
+    # max = 0 leaves autoscaling off (static --serving-replicas fleet).
+    serving_min_replicas: int = 1
+    serving_max_replicas: int = 0
+    autoscale_interval: float = 1.0    # control-loop tick period, s
+    autoscale_cooldown: float = 5.0    # hold-off after any scale action
+    serving_slo_ttft: float = 0.0      # p99 TTFT target, s (0 = ignore)
+    serving_drain_timeout: float = 30.0  # wedged-drain force bound, s
+    # overload admission control: shed at admission when predicted TTFT
+    # (backlog / measured service rate) exceeds this many seconds
+    # (0 = off; per-request deadline_s overrides)
+    admission_deadline_s: float = 0.0
 
     def __post_init__(self):
         if self.serving_mode not in SERVING_MODES:
@@ -282,6 +297,43 @@ class FFConfig:
             raise ValueError(
                 f"request_retry_limit must be >= 0, "
                 f"got {self.request_retry_limit}"
+            )
+        if self.serving_min_replicas < 1:
+            raise ValueError(
+                f"serving_min_replicas must be >= 1, "
+                f"got {self.serving_min_replicas}"
+            )
+        if (self.serving_max_replicas != 0
+                and self.serving_max_replicas < self.serving_min_replicas):
+            raise ValueError(
+                f"serving_max_replicas ({self.serving_max_replicas}) must "
+                f"be 0 (autoscaling off) or >= serving_min_replicas "
+                f"({self.serving_min_replicas})"
+            )
+        if self.autoscale_interval <= 0:
+            raise ValueError(
+                f"autoscale_interval must be > 0, "
+                f"got {self.autoscale_interval}"
+            )
+        if self.autoscale_cooldown < 0:
+            raise ValueError(
+                f"autoscale_cooldown must be >= 0, "
+                f"got {self.autoscale_cooldown}"
+            )
+        if self.serving_slo_ttft < 0:
+            raise ValueError(
+                f"serving_slo_ttft must be >= 0 (0 = ignore), "
+                f"got {self.serving_slo_ttft}"
+            )
+        if self.serving_drain_timeout <= 0:
+            raise ValueError(
+                f"serving_drain_timeout must be > 0, "
+                f"got {self.serving_drain_timeout}"
+            )
+        if self.admission_deadline_s < 0:
+            raise ValueError(
+                f"admission_deadline_s must be >= 0 (0 = off), "
+                f"got {self.admission_deadline_s}"
             )
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(
@@ -497,6 +549,24 @@ class FFConfig:
                        dest="serving_max_restarts", type=int, default=3)
         p.add_argument("--request-retry-limit",
                        dest="request_retry_limit", type=int, default=2)
+        p.add_argument("--serving-min-replicas",
+                       dest="serving_min_replicas", type=int, default=1)
+        p.add_argument("--serving-max-replicas",
+                       dest="serving_max_replicas", type=int, default=0)
+        p.add_argument("--autoscale-interval",
+                       dest="autoscale_interval", type=float,
+                       default=1.0)
+        p.add_argument("--autoscale-cooldown",
+                       dest="autoscale_cooldown", type=float,
+                       default=5.0)
+        p.add_argument("--serving-slo-ttft", dest="serving_slo_ttft",
+                       type=float, default=0.0)
+        p.add_argument("--serving-drain-timeout",
+                       dest="serving_drain_timeout", type=float,
+                       default=30.0)
+        p.add_argument("--admission-deadline",
+                       dest="admission_deadline_s", type=float,
+                       default=0.0)
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -567,6 +637,13 @@ class FFConfig:
             serving_step_timeout=args.serving_step_timeout,
             serving_max_restarts=args.serving_max_restarts,
             request_retry_limit=args.request_retry_limit,
+            serving_min_replicas=args.serving_min_replicas,
+            serving_max_replicas=args.serving_max_replicas,
+            autoscale_interval=args.autoscale_interval,
+            autoscale_cooldown=args.autoscale_cooldown,
+            serving_slo_ttft=args.serving_slo_ttft,
+            serving_drain_timeout=args.serving_drain_timeout,
+            admission_deadline_s=args.admission_deadline_s,
         )
 
 

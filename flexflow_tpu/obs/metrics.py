@@ -127,6 +127,13 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
+    def remove(self, name: str) -> None:
+        """Drop a metric whose subject is gone (e.g. a retired serving
+        replica's per-id gauge) — per-entity names minted from
+        monotonically increasing ids would otherwise accumulate
+        without bound in a long-lived process."""
+        self._metrics.pop(name, None)
+
     # -- bulk folds ------------------------------------------------------
     def fold_counters(self, group: str, mapping: Dict) -> None:
         """Snapshot a flat counters dict (search_stats, supervisor

@@ -1,17 +1,29 @@
-"""Sort-based MoE dispatch (the standard TPU trick; SURVEY hard-part 3).
+"""Cumsum-based MoE dispatch (the standard TPU trick; SURVEY hard-part 3).
 
 The reference's group_by/aggregate are data-dependent CUDA
 scatter/gather kernels (group_by.cu:1-206, aggregate.cu).  The dense
 one-hot formulation (`_dispatch_mask` in moe.py) is numerically
 identical but costs O(b·k·n·cap·d) MXU work.  This module computes the
-same capacity-bounded assignment with a stable sort + rank-in-group
-scan — O(bk·log bk) on XLA:TPU's bitonic sort — and moves rows with
-one scatter-add (dispatch) / gather (combine), each O(bk·d).
+same capacity-bounded assignment with a one-hot cumsum rank — the
+GShard/Switch position-in-expert scan, O(bk·n) on integers only — and
+moves rows with one scatter-add (dispatch) / gather (combine), each
+O(bk·d).
 
 Priority semantics match `_dispatch_mask` exactly: tokens are served in
 flattened (sample-major, slot-minor) order; ranks past `capacity` are
-dropped.  Integer sort indices carry no gradient, matching the one-hot
+dropped.  Integer rank indices carry no gradient, matching the one-hot
 path (gradients flow through the moved rows only).
+
+Why cumsum and not sort: an earlier revision ranked tokens with a
+stable argsort + segment scan + unscatter.  That chain is numerically
+identical per device, but under GSPMD with the batch dim sharded
+(data-parallel serving/training meshes) XLA's partitioner produced
+wrong ranks for the fused sort->scan->scatter pattern on jax 0.4.x —
+the expert-parallel parity test caught live routing corruption.  The
+cumsum formulation partitions correctly (verified sharded-vs-single
+bit-parity in tests/test_parallelism.py::test_moe_expert_parallel) and
+lowers to the same O(bk·n) integer work XLA emits for the GShard
+dispatch einsum's position computation.
 """
 from __future__ import annotations
 
@@ -22,7 +34,7 @@ import jax.numpy as jnp
 
 
 def dispatch_indices(
-    assign: jax.Array, capacity: int
+    assign: jax.Array, capacity: int, n: int
 ) -> Tuple[jax.Array, jax.Array]:
     """[b, k] int expert ids -> (slot [bk], keep [bk]).
 
@@ -31,18 +43,9 @@ def dispatch_indices(
     i.e. the same priority order as the reference's cumsum scatter.
     """
     flat = assign.reshape(-1).astype(jnp.int32)
-    bk = flat.shape[0]
-    idx = jnp.arange(bk, dtype=jnp.int32)
-    order = jnp.argsort(flat, stable=True)  # groups tokens by expert
-    sorted_e = flat[order]
-    is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), sorted_e[1:] != sorted_e[:-1]]
-    )
-    group_start = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(is_start, idx, 0)
-    )
-    rank_sorted = idx - group_start
-    rank = jnp.zeros(bk, jnp.int32).at[order].set(rank_sorted)
+    onehot = jax.nn.one_hot(flat, n, dtype=jnp.int32)  # [bk, n]
+    pos = jnp.cumsum(onehot, axis=0) - 1  # running count per expert
+    rank = jnp.sum(pos * onehot, axis=-1)  # [bk] rank within expert
     keep = rank < capacity
     slot = flat * capacity + jnp.minimum(rank, capacity - 1)
     return slot, keep
@@ -55,7 +58,7 @@ def sort_group_by(
     inputs (dropped tokens contribute zero rows)."""
     b, k = assign.shape
     d = data.shape[1]
-    slot, keep = dispatch_indices(assign, capacity)
+    slot, keep = dispatch_indices(assign, capacity, n)
     rows = jnp.repeat(data, k, axis=0)  # row i serves flat token i
     contrib = rows * keep[:, None].astype(data.dtype)
     out = jnp.zeros((n * capacity, d), data.dtype).at[slot].add(contrib)
@@ -67,6 +70,7 @@ def sort_combine(
 ) -> Tuple[jax.Array, jax.Array]:
     """[n, cap, e] expert outputs -> per-(token, slot) rows [bk, e]
     (zero for dropped tokens), plus keep [bk]."""
-    slot, keep = dispatch_indices(assign, capacity)
+    n = expert_out.shape[0]
+    slot, keep = dispatch_indices(assign, capacity, n)
     flat_out = expert_out.reshape(-1, expert_out.shape[-1])
     return flat_out[slot] * keep[:, None].astype(expert_out.dtype), keep

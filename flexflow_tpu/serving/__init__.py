@@ -20,7 +20,17 @@ share device time and HBM instead of padding to the batch max.
 primitives (fault injection, decode-step watchdog, budget-capped
 restarts) — behind one admission queue: replica deaths requeue
 in-flight requests onto survivors instead of failing the service.
+
+`ServingAutoscaler` (docs/SERVING.md "Autoscaling & drain lifecycle")
+makes the fleet size itself a measured, controlled variable: a control
+loop over the front's queue-depth / p99-TTFT / KV-occupancy gauges
+spawns replicas under load (warm through the strategy store) and
+gracefully DRAINS the least-loaded one when calm — in-flight work runs
+to completion token-identically before the engine retires and frees
+its KV pool — with hysteresis bands, a cooldown, and
+min/max-replica bounds so the loop cannot flap.
 """
+from .autoscaler import ServingAutoscaler
 from .batcher import DynamicBatcher
 from .engine import InferenceEngine
 from .front import FrontRequest, ServiceUnavailable, ServingFront
@@ -34,4 +44,4 @@ __all__ = ["InferenceEngine", "DynamicBatcher", "GenerationEngine",
            "GenerationBatcher", "ContinuousScheduler",
            "PagedKVDecodeModel", "KVPool", "serve_http",
            "ServingFront", "ServingReplica", "SupervisedDecodeModel",
-           "FrontRequest", "ServiceUnavailable"]
+           "FrontRequest", "ServiceUnavailable", "ServingAutoscaler"]

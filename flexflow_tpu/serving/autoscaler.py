@@ -1,0 +1,398 @@
+"""SLO-driven autoscaling of the replicated serving front.
+
+The paper's thesis is that placement decisions should be measured and
+costed, not hardcoded; the serving fleet treats its replica count the
+same way — a controlled variable driven by the load signals the front
+already emits (PR 8), not a static ``--serving-replicas`` knob:
+
+  * **queue depth per live replica** — the admission backlog the
+    dispatcher hasn't placed yet, normalized by fleet size;
+  * **windowed p99 TTFT** — the user-facing SLO, from the front's
+    rolling TTFT window;
+  * **KV-pool occupancy** — the capacity signal: a fleet whose pools
+    run full queues at admission even when TTFT still looks fine.
+
+Control discipline (the loop must not flap):
+
+  * **hysteresis bands**: scale-up and scale-down thresholds are
+    separated (`queue_high` vs `queue_low`, SLO breach vs comfortable
+    margin), so a signal oscillating around one threshold cannot
+    bounce the fleet;
+  * **cooldown**: after any action the loop holds for `cooldown_s`
+    before deciding again — a freshly spawned replica needs time to
+    absorb load before the signals mean anything;
+  * **bounds**: `min_replicas <= fleet <= max_replicas`, the
+    ``--serving-min/max-replicas`` contract;
+  * **one transition at a time**: while a drain or spin-up is in
+    flight, the loop only watches (and bounds a wedged drain with
+    `drain_timeout_s` -> `force_retire`, which requeues the stragglers
+    onto survivors).
+
+Scale-up spawns through the front's `model_factory` — warm via the
+strategy store (docs/STORE.md), so spin-up is compile-cache-bounded,
+not search-bounded.  Scale-down picks the least-loaded live replica
+and DRAINS it (READY -> DRAINING -> RETIRED, serving/replica.py): the
+dispatcher stops routing to it, in-flight slots run to completion
+token-identically, then the engine retires and frees its KV pool.
+
+Metrics (obs.metrics, docs/OBSERVABILITY.md "serving/autoscaler_*"):
+current/target replica gauges, scale_up/scale_down/hold counters, a
+decision event per action, and the drain-duration histogram the
+replica emits.  /v2/stats surfaces `stats()` as the "autoscaler"
+block.  docs/SERVING.md "Autoscaling & drain lifecycle".
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..logger import resilience_logger
+
+
+class ServingAutoscaler:
+    """Control loop over a ServingFront's load gauges.
+
+    Deterministic core: `observe()` -> signals, `decide(signals)` ->
+    (action, reason), `tick()` -> one observe/decide/act cycle.  Tests
+    drive `tick()` directly with a fake `time_fn`; production calls
+    `start()` for the daemon-thread loop at `interval_s`.
+    """
+
+    def __init__(
+        self,
+        front,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        *,
+        interval_s: float = 1.0,
+        cooldown_s: float = 5.0,
+        queue_high: float = 4.0,
+        queue_low: float = 0.5,
+        slo_ttft_s: float = 0.0,
+        kv_high: float = 0.9,
+        drain_timeout_s: float = 30.0,
+        history: int = 256,
+        registry=None,
+        time_fn: Callable[[], float] = time.monotonic,
+        logger=resilience_logger,
+    ):
+        if min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) must be >= "
+                f"min_replicas ({min_replicas})")
+        if queue_low >= queue_high:
+            raise ValueError(
+                f"hysteresis band inverted: queue_low ({queue_low}) "
+                f"must be < queue_high ({queue_high})")
+        if interval_s <= 0:
+            raise ValueError(
+                f"interval_s must be > 0, got {interval_s}")
+        if drain_timeout_s <= 0:
+            raise ValueError(
+                f"drain_timeout_s must be > 0, got {drain_timeout_s}")
+        self.front = front
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.interval_s = float(interval_s)
+        self.cooldown_s = float(cooldown_s)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.slo_ttft_s = float(slo_ttft_s)
+        self.kv_high = float(kv_high)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.registry = registry if registry is not None \
+            else front.registry
+        self.time_fn = time_fn
+        self.log = logger
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.forced_retires = 0
+        self.ticks = 0
+        self.last_action_t: Optional[float] = None
+        self.last_decision: Optional[Dict] = None
+        self.history: "deque[Dict]" = deque(maxlen=history)
+        self._draining = None  # replica with a drain in flight
+        self._spawning = False  # a scale-up build (compile) in flight
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        front.autoscaler = self  # /v2/stats picks up the block
+
+    @classmethod
+    def from_config(cls, front, cfg, **kw) -> "ServingAutoscaler":
+        """Bounds + pacing from the FFConfig serving knobs
+        (--serving-min/max-replicas, --autoscale-interval,
+        --autoscale-cooldown, --serving-slo-ttft,
+        --serving-drain-timeout).  serving_max_replicas=0 means
+        autoscaling is OFF (the documented static-fleet contract) —
+        building a scaler anyway would drain a --serving-replicas N
+        fleet down to min_replicas, so refuse loudly."""
+        if cfg.serving_max_replicas <= 0:
+            raise ValueError(
+                "autoscaling is off (serving_max_replicas=0): set "
+                "--serving-max-replicas >= --serving-min-replicas to "
+                "enable, or don't build a ServingAutoscaler")
+        kw.setdefault("interval_s", cfg.autoscale_interval)
+        kw.setdefault("cooldown_s", cfg.autoscale_cooldown)
+        kw.setdefault("slo_ttft_s", cfg.serving_slo_ttft)
+        kw.setdefault("drain_timeout_s", cfg.serving_drain_timeout)
+        return cls(front, cfg.serving_min_replicas,
+                   cfg.serving_max_replicas, **kw)
+
+    # -- signals ---------------------------------------------------------
+    def observe(self) -> Dict:
+        """One sample of the control inputs, from gauges the front and
+        schedulers already maintain — observing never blocks decode."""
+        front = self.front
+        with front._cv:
+            replicas = list(front.replicas)
+            queue_depth = len(front._admission)
+        live = [r for r in replicas if r.alive]
+        draining = [r for r in replicas if r.state == "draining"]
+        # restarting replicas come back live after their rebuild, so
+        # they count against max_replicas (permanently-dead ones hold
+        # no engine and never return — they don't)
+        restarting = [r for r in replicas if r.state == "restarting"]
+        outstanding = sum(r.outstanding for r in live)
+        occ = 0.0
+        for r in live:
+            sched = r.scheduler
+            if sched is not None:
+                try:
+                    occ = max(occ, sched.pool.occupancy())
+                except Exception:  # noqa: BLE001 — a dying replica's
+                    pass           # pool must not kill the loop
+        ttft = front.ttft_stats()  # percentile_summary keys, in ms
+        return {
+            "t": self.time_fn(),
+            "live": len(live),
+            "draining": len(draining),
+            "restarting": len(restarting),
+            "fleet": len(replicas),
+            "queue_depth": queue_depth,
+            "outstanding": outstanding,
+            "queue_per_replica": queue_depth / max(len(live), 1),
+            "p99_ttft_s": (ttft.get("p99_ms", 0.0) or 0.0) / 1e3,
+            "kv_occupancy": occ,
+        }
+
+    # -- policy ----------------------------------------------------------
+    def decide(self, s: Dict) -> tuple:
+        """(action, reason) for one signal sample.  Pure policy — no
+        side effects, directly unit-testable."""
+        if self._draining is not None:
+            return "hold", "drain in flight"
+        if (self.last_action_t is not None
+                and s["t"] - self.last_action_t < self.cooldown_s):
+            return "hold", "cooldown"
+        if s["live"] == 0:
+            # replica supervision (restarts) owns total outages; the
+            # autoscaler only sizes a serving fleet
+            return "hold", "no live replicas"
+        committed = s["live"] + s["draining"] + s.get("restarting", 0)
+        if committed < self.min_replicas:
+            # a permanently-dead replica leaves the fleet below its
+            # contracted floor with NO load signal to restore it —
+            # min_replicas is a bound, not a suggestion
+            return "up", (f"fleet {committed} < "
+                          f"min_replicas={self.min_replicas}")
+        # the TTFT window is count-based (last N completions), so with
+        # NO traffic it never refreshes: a past burst's p99 would pin
+        # an idle fleet at max forever (and block its drain).  Gate the
+        # TTFT signal on actual load — an idle fleet breaches no SLO.
+        busy = s["queue_depth"] + s["outstanding"] > 0
+        up_reasons = []
+        if s["queue_per_replica"] > self.queue_high:
+            up_reasons.append(
+                f"queue/replica {s['queue_per_replica']:.1f} > "
+                f"{self.queue_high:.1f}")
+        if (self.slo_ttft_s > 0 and busy
+                and s["p99_ttft_s"] > self.slo_ttft_s):
+            up_reasons.append(
+                f"p99 TTFT {s['p99_ttft_s'] * 1e3:.0f}ms > SLO "
+                f"{self.slo_ttft_s * 1e3:.0f}ms")
+        if s["kv_occupancy"] > self.kv_high:
+            up_reasons.append(
+                f"KV occupancy {s['kv_occupancy']:.2f} > "
+                f"{self.kv_high:.2f}")
+        if up_reasons:
+            if committed >= self.max_replicas:
+                return "hold", (f"at max_replicas={self.max_replicas} "
+                                f"({'; '.join(up_reasons)})")
+            return "up", "; ".join(up_reasons)
+        # scale-down wants EVERY signal comfortable (hysteresis: the
+        # down band sits well below the up band)
+        calm = (
+            s["queue_per_replica"] < self.queue_low
+            and (self.slo_ttft_s <= 0 or not busy
+                 or s["p99_ttft_s"] < 0.5 * self.slo_ttft_s)
+            and s["kv_occupancy"] < 0.5 * self.kv_high
+        )
+        if calm and s["live"] > self.min_replicas:
+            return "down", (
+                f"queue/replica {s['queue_per_replica']:.1f} < "
+                f"{self.queue_low:.1f} and SLO margin ample")
+        return "hold", "within bands"
+
+    # -- actuation -------------------------------------------------------
+    def _pick_drain_target(self):
+        """Least-loaded live replica — the cheapest one to retire."""
+        live = self.front._live()
+        if len(live) <= self.min_replicas:
+            return None
+        return min(live, key=lambda r: r.outstanding)
+
+    def _record(self, action: str, reason: str, s: Dict) -> None:
+        entry = {
+            "t": s["t"],
+            "action": action,
+            "reason": reason,
+            "replicas": s["fleet"],
+            "live": s["live"],
+            "queue_depth": s["queue_depth"],
+            "p99_ttft_s": round(s["p99_ttft_s"], 4),
+            "kv_occupancy": round(s["kv_occupancy"], 4),
+        }
+        self.history.append(entry)
+        if action != "hold":
+            self.last_decision = entry
+            self.last_action_t = s["t"]
+            self.log.info("autoscaler %s (fleet %d): %s",
+                          action, s["fleet"], reason)
+        if self.registry is not None:
+            reg = self.registry
+            reg.gauge("serving/autoscaler_replicas").set(s["fleet"])
+            # the target this TICK decided — not target_replicas(),
+            # which would re-run decide() AFTER last_action_t/_draining
+            # were updated and always report the pre-action size
+            cur = (s["live"] + s["draining"]
+                   + s.get("restarting", 0))
+            reg.gauge("serving/autoscaler_target").set(
+                self._target_for(action, cur))
+            reg.counter(f"serving/autoscaler_{action}").inc()
+            if action != "hold":
+                reg.event("serving/autoscaler_decision", **entry)
+
+    def _target_for(self, action: str, cur: int) -> int:
+        if action == "up":
+            return min(cur + 1, self.max_replicas)
+        if action == "down":
+            return max(cur - 1, self.min_replicas)
+        return max(min(cur, self.max_replicas), self.min_replicas)
+
+    def target_replicas(self, s: Optional[Dict] = None) -> int:
+        """The fleet size the policy is steering toward right now."""
+        if s is None:
+            s = self.observe()
+        action, _ = self.decide(s)
+        cur = s["live"] + s["draining"] + s.get("restarting", 0)
+        return self._target_for(action, cur)
+
+    def tick(self) -> Dict:
+        """One control cycle: observe -> decide -> act.  Returns the
+        history entry (action + signals) for this cycle."""
+        self.ticks += 1
+        self._sweep_drain()
+        s = self.observe()
+        action, reason = self.decide(s)
+        if action == "up":
+            self._spawning = True  # visible while the build compiles
+            try:
+                self.front.add_replica()
+                self.scale_ups += 1
+            except Exception as e:  # noqa: BLE001 — a failed spawn
+                action, reason = "hold", f"spawn failed: {e}"
+                # _record only logs non-hold actions and only they set
+                # the cooldown: without both, a persistent build
+                # failure retries a full compile every tick, silently
+                self.log.info("autoscaler scale-up failed: %s", e)
+                self.last_action_t = s["t"]
+                if self.registry is not None:
+                    self.registry.counter(
+                        "serving/autoscaler_spawn_failed").inc()
+            finally:
+                self._spawning = False
+        elif action == "down":
+            target = self._pick_drain_target()
+            if target is not None and self.front.drain_replica(target):
+                self._draining = (target, s["t"])
+                self.scale_downs += 1
+            else:
+                action, reason = "hold", "no drainable replica"
+        self._record(action, reason, s)
+        return self.history[-1]
+
+    def _sweep_drain(self) -> None:
+        """Resolve an in-flight drain: done, or wedged past the
+        deadline -> bounded force_retire (in-flight requests requeue
+        onto survivors through the front's settle hooks)."""
+        if self._draining is None:
+            return
+        replica, t0 = self._draining
+        if replica.state in ("retired", "dead", "closed"):
+            self._draining = None
+            return
+        if self.time_fn() - t0 > self.drain_timeout_s:
+            self.log.info(
+                "autoscaler: drain of replica %d wedged past %.1fs — "
+                "forcing retirement", replica.replica_id,
+                self.drain_timeout_s)
+            self.forced_retires += 1
+            if self.registry is not None:
+                self.registry.counter(
+                    "serving/autoscaler_forced_retire").inc()
+            replica.force_retire()
+            self._draining = None
+
+    # -- loop ------------------------------------------------------------
+    def start(self) -> "ServingAutoscaler":
+        """Run tick() every interval_s on a daemon thread."""
+        if self._thread is not None:
+            return self
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serving-autoscaler")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the control loop must
+                # outlive any single bad cycle (a dying replica's race
+                # is the replica supervisor's problem, not ours)
+                self.log.exception("autoscaler tick failed")
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+    # -- surfaces --------------------------------------------------------
+    def stats(self) -> Dict:
+        """The /v2/stats "autoscaler" block."""
+        with self.front._cv:
+            current = len(self.front.replicas)
+        # single read: the loop thread clears _draining concurrently
+        draining = self._draining
+        return {
+            "current_replicas": current,
+            "target_replicas": self.target_replicas(),
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "forced_retires": self.forced_retires,
+            "ticks": self.ticks,
+            "drain_in_flight": (draining[0].replica_id
+                                if draining is not None else None),
+            "spawn_in_flight": self._spawning,
+            "last_decision": self.last_decision,
+        }

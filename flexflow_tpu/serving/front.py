@@ -38,6 +38,7 @@ obs.metrics registry.  docs/SERVING.md "Replicated front".
 """
 from __future__ import annotations
 
+import signal
 import threading
 import time
 from collections import deque
@@ -67,9 +68,11 @@ class FrontRequest:
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "event",
                  "result", "error", "t_submit", "t_first_token",
-                 "t_done", "n_generated", "retries")
+                 "t_done", "n_generated", "retries",
+                 "queue_depth_at_admit", "deadline_s")
 
-    def __init__(self, prompt, max_new_tokens, temperature):
+    def __init__(self, prompt, max_new_tokens, temperature,
+                 deadline_s: Optional[float] = None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -81,6 +84,8 @@ class FrontRequest:
         self.t_done: Optional[float] = None
         self.n_generated = 0
         self.retries = 0  # requeues consumed (replica deaths/faults)
+        self.queue_depth_at_admit = 0  # front backlog seen at admission
+        self.deadline_s = deadline_s   # TTFT SLO for admission control
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.event.wait(timeout):
@@ -117,6 +122,8 @@ class ServingFront:
         latency_window: int = 1024,
         close_timeout_s: float = 5.0,
         shed_retry_after_s: float = 1.0,
+        admission_deadline_s: float = 0.0,
+        rate_staleness_s: float = 30.0,
         sleep: Callable[[float], None] = time.sleep,
         logger=resilience_logger,
     ):
@@ -130,40 +137,76 @@ class ServingFront:
         self.registry = registry
         self.request_retry_limit = int(request_retry_limit)
         self.shed_retry_after_s = float(shed_retry_after_s)
+        self.admission_deadline_s = float(admission_deadline_s)
+        self.rate_staleness_s = float(rate_staleness_s)
         self.log = logger
         self._cv = threading.Condition()
         self._admission: "deque[FrontRequest]" = deque()
         self._closed = False
+        self._terminating = False
         self.requests_done = 0
         self.shed_requests = 0
+        self.admission_shed = 0   # overload-control sheds (deadline)
         self.requeued_requests = 0
         self._latencies = deque(maxlen=latency_window)
         self._ttfts = deque(maxlen=latency_window)
         self._lat_lock = threading.Lock()
+        # completion timestamps for the measured service rate (drain
+        # rate): Retry-After and predicted-TTFT admission control both
+        # read it instead of a constant.  _done_busy marks, per
+        # completion, whether the admission queue was non-empty at
+        # that moment — only those samples witness CAPACITY (an
+        # uncontended completion merely tracks the arrival rate)
+        self._done_times = deque(maxlen=256)
+        self._done_busy = deque(maxlen=256)
+        # the autoscaler attaches itself here (serving/autoscaler.py);
+        # /v2/stats surfaces its block when present
+        self.autoscaler = None
+        # bounded retirement history: a long-lived autoscaled front
+        # cycles replicas indefinitely, so keep the last few for
+        # /v2/stats and fold the rest into aggregate counters
+        self.retired: List[ServingReplica] = []
+        self.retired_keep = 16
+        self._retired_dropped = 0
+        self._retired_folded = {"batches_run": 0, "tokens_generated": 0}
+        self._model_factory = model_factory
         plans = fault_plans or {}
+        self._replica_kw = dict(
+            eos_id=eos_id, registry=registry, seed=seed,
+            step_timeout=step_timeout, max_restarts=max_restarts,
+            retry_backoff=retry_backoff,
+            close_timeout_s=close_timeout_s, sleep=sleep, logger=logger,
+        )
         self.replicas: List[ServingReplica] = [
-            ServingReplica(
-                i, model_factory,
-                eos_id=eos_id, registry=registry,
-                seed=seed,
-                step_timeout=step_timeout,
-                retry=RetryPolicy(max_restarts=max_restarts,
-                                  base_backoff=retry_backoff, seed=seed + i),
-                fault_plan=plans.get(i),
-                close_timeout_s=close_timeout_s,
-                sleep=sleep,
-                logger=logger,
-            )
+            self._build_replica(i, fault_plan=plans.get(i))
             for i in range(num_replicas)
         ]
+        self._next_replica_id = num_replicas
         self.max_seq = self.replicas[0].scheduler.model.max_seq
-        for r in self.replicas:
-            r.on_state_change = self._on_replica_state
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="serving-front-dispatch",
         )
         self._dispatcher.start()
+
+    def _build_replica(self, replica_id: int,
+                       fault_plan=None) -> ServingReplica:
+        kw = self._replica_kw
+        r = ServingReplica(
+            replica_id, self._model_factory,
+            eos_id=kw["eos_id"], registry=kw["registry"],
+            seed=kw["seed"],
+            step_timeout=kw["step_timeout"],
+            retry=RetryPolicy(max_restarts=kw["max_restarts"],
+                              base_backoff=kw["retry_backoff"],
+                              seed=kw["seed"] + replica_id),
+            fault_plan=fault_plan,
+            close_timeout_s=kw["close_timeout_s"],
+            sleep=kw["sleep"],
+            logger=kw["logger"],
+        )
+        r.on_state_change = self._on_replica_state
+        return r
 
     @classmethod
     def from_trained(cls, ff_train, num_replicas: Optional[int] = None,
@@ -198,6 +241,8 @@ class ServingFront:
         kw.setdefault("max_restarts", cfg.serving_max_restarts)
         kw.setdefault("request_retry_limit", cfg.request_retry_limit)
         kw.setdefault("seed", cfg.seed)
+        kw.setdefault("admission_deadline_s",
+                      getattr(cfg, "admission_deadline_s", 0.0))
         return cls(
             factory,
             cfg.serving_replicas if num_replicas is None else num_replicas,
@@ -214,23 +259,173 @@ class ServingFront:
         return [r for r in self.replicas if r.alive]
 
     def _all_permanently_dead(self) -> bool:
-        return all(r.state == "dead" for r in self.replicas)
+        # vacuous truth on an empty fleet would mislabel terminate()'s
+        # residue (all replicas retired) as "restart budgets exhausted"
+        return bool(self.replicas) and all(
+            r.state == "dead" for r in self.replicas)
+
+    # -- fleet lifecycle (autoscaler / SIGTERM grace) --------------------
+    def add_replica(self) -> ServingReplica:
+        """Scale-up: build one more supervised replica (the compile is
+        warm through the strategy store whenever any replica has paid
+        it — docs/STORE.md) and put it in the dispatcher's rotation."""
+        if self._closed or self._terminating:
+            raise RuntimeError("ServingFront is closing")
+        with self._cv:
+            rid = self._next_replica_id
+            self._next_replica_id += 1
+        replica = self._build_replica(rid)  # compile OUTSIDE the lock
+        with self._cv:
+            # close()/terminate() may have swept the fleet while we
+            # were compiling; appending now would leak a live engine
+            # nobody ever closes
+            if self._closed or self._terminating:
+                aborted = True
+            else:
+                aborted = False
+                self.replicas.append(replica)
+                self._cv.notify_all()
+        if aborted:
+            replica.close()
+            raise RuntimeError("ServingFront is closing")
+        if self.registry is not None:
+            self.registry.counter("serving/replicas_added").inc()
+        self.log.info("serving front: replica %d added (fleet %d)",
+                      rid, len(self.replicas))
+        return replica
+
+    def drain_replica(self, replica: ServingReplica) -> bool:
+        """Scale-down: READY -> DRAINING.  The dispatcher stops routing
+        to it immediately (state leaves \"live\"); in-flight slots run
+        to completion token-identically; on retirement the replica
+        leaves `replicas` for `retired` and its KV pool is freed."""
+        return replica.drain(on_retired=self._on_replica_retired)
+
+    def _on_replica_retired(self, replica: ServingReplica) -> None:
+        dropped = []
+        with self._cv:
+            if replica in self.replicas:
+                self.replicas.remove(replica)
+                self.retired.append(replica)
+                while len(self.retired) > self.retired_keep:
+                    old = self.retired.pop(0)
+                    st = old.stats()
+                    self._retired_dropped += 1
+                    for k in self._retired_folded:
+                        self._retired_folded[k] += int(st.get(k, 0))
+                    dropped.append(old)
+            self._cv.notify_all()
+        for old in dropped:
+            old.close(0.1)  # outside the lock: close joins a thread
+        if self.registry is not None:
+            # replica ids are monotonic — the per-id gauge would
+            # otherwise accumulate one dead name per scale cycle
+            self.registry.remove(
+                f"serving/replica/{replica.replica_id}/queue_depth")
+        self.log.info("serving front: replica %d retired (fleet %d)",
+                      replica.replica_id, len(self.replicas))
+
+    # -- measured service rate -------------------------------------------
+    def service_rate(self) -> Optional[float]:
+        """Measured completions/s over the recent window; None until
+        two completions have landed, and None again once the newest
+        completion is older than `rate_staleness_s` — after an idle
+        gap the old span measures ARRIVALS, not capacity, and a stale
+        near-zero rate would shed traffic an idle fleet could trivially
+        serve.  This is the drain rate Retry-After and predicted-TTFT
+        admission control are computed from."""
+        with self._lat_lock:
+            ts = list(self._done_times)
+        if len(ts) < 2:
+            return None
+        if time.monotonic() - ts[-1] > self.rate_staleness_s:
+            return None
+        span = ts[-1] - ts[0]
+        if span <= 0:
+            return None
+        return (len(ts) - 1) / span
+
+    def _capacity_rate(self) -> Optional[float]:
+        """Completions/s over the TRAILING RUN of completions that all
+        landed with a non-empty admission queue — i.e. while the fleet
+        was saturated, so the span witnesses CAPACITY.  Anything less
+        (a whole-window rate, even one gated on a few busy samples)
+        is contaminated by calm stretches where completions pace
+        arrivals, and shedding on an arrival rate would condemn the
+        first burst after every quiet period.  None until the run has
+        3 members; an uncontended completion resets it (the queue
+        drained — no longer saturated, and with an empty queue the
+        shed path is off anyway)."""
+        with self._lat_lock:
+            ts = list(self._done_times)
+            flags = list(self._done_busy)
+        run = 0
+        for b in reversed(flags):
+            if not b:
+                break
+            run += 1
+        if run < 3:
+            return None
+        ts = ts[-run:]
+        if time.monotonic() - ts[-1] > self.rate_staleness_s:
+            return None
+        span = ts[-1] - ts[0]
+        if span <= 0:
+            return None
+        return (run - 1) / span
+
+    def _predict_wait_s(self, depth: int) -> Optional[float]:
+        """Predicted time for `depth` queued requests to clear at the
+        measured service rate (None with no measurements yet)."""
+        rate = self.service_rate()
+        if rate is None or rate <= 0:
+            return None
+        return depth / rate
+
+    def _retry_after(self, depth: Optional[int] = None) -> float:
+        """Retry-After from the measured drain rate: how long until the
+        current backlog clears.  Falls back to the constructor constant
+        before any completion has been measured."""
+        if depth is None:
+            with self._cv:
+                depth = len(self._admission) + sum(
+                    r.outstanding for r in self.replicas)
+        predicted = self._predict_wait_s(max(depth, 1))
+        if predicted is None:
+            return self.shed_retry_after_s
+        return min(max(predicted, self.shed_retry_after_s), 120.0)
 
     # -- client API ------------------------------------------------------
     def generate_async(self, prompt, max_new_tokens: int = 16,
-                       temperature: float = 0.0) -> FrontRequest:
+                       temperature: float = 0.0,
+                       deadline_s: Optional[float] = None) -> FrontRequest:
         if self._closed:
             raise RuntimeError("ServingFront is closed")
         # validate at admission (the batcher convention: a bad request
         # fails alone, synchronously, as a client error)
-        req = FrontRequest(prompt, max_new_tokens, temperature)
+        req = FrontRequest(prompt, max_new_tokens, temperature,
+                           deadline_s=deadline_s)
         if not 1 <= len(req.prompt) < self.max_seq:
             raise ValueError(
                 f"prompt length {len(req.prompt)} outside "
                 f"[1, {self.max_seq})")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {deadline_s}")
         with self._cv:
+            if self._terminating:
+                # SIGTERM grace: the front is draining — redirect new
+                # load with a Retry-After from the measured drain rate
+                self.shed_requests += 1
+                if self.registry is not None:
+                    self.registry.counter("serving/shed_requests").inc()
+                raise ServiceUnavailable(
+                    "serving front is terminating",
+                    retry_after_s=self._retry_after(
+                        len(self._admission) + 1),
+                )
             if not self._live():
                 # all replicas down: shed instead of queueing against
                 # a service that may never come back
@@ -241,6 +436,40 @@ class ServingFront:
                     "all serving replicas are down",
                     retry_after_s=self.shed_retry_after_s,
                 )
+            depth = len(self._admission)
+            backlog = depth + sum(r.outstanding for r in self.replicas)
+            # overload admission control: a request whose PREDICTED
+            # TTFT (backlog ahead of it / measured service rate)
+            # already exceeds its deadline would only time out inside
+            # the queue — shed it NOW so the front degrades to a
+            # bounded-latency subset under sustained overload
+            slo = (deadline_s if deadline_s is not None
+                   else self.admission_deadline_s)
+            # only predict when there is an actual FRONT backlog: with
+            # an empty admission queue the request dispatches at once
+            # and its TTFT is service time, not backlog/rate — the
+            # measured rate is arrival-limited and would over-predict
+            if slo and slo > 0 and depth > 0:
+                # capacity-gated rate, NOT the general service rate:
+                # Retry-After may hint from an arrival-paced window,
+                # but shedding on one would be wrong
+                rate = self._capacity_rate()
+                predicted = (None if rate is None or rate <= 0
+                             else (backlog + 1) / rate)
+                if predicted is not None and predicted > slo:
+                    self.admission_shed += 1
+                    if self.registry is not None:
+                        self.registry.counter(
+                            "serving/admission_shed").inc()
+                    raise ServiceUnavailable(
+                        f"predicted TTFT {predicted:.2f}s exceeds the "
+                        f"{slo:.2f}s deadline (backlog {backlog} at "
+                        "the measured service rate)",
+                        retry_after_s=min(max(
+                            predicted - slo, self.shed_retry_after_s),
+                            120.0),
+                    )
+            req.queue_depth_at_admit = depth
             self._admission.append(req)
             self._cv.notify_all()
         return req
@@ -306,11 +535,25 @@ class ServingFront:
                 self._fail(req, e)
             except Exception:
                 # the replica died between pick and submit: back to the
-                # queue head (dispatch never started — no retry spent)
+                # queue head (dispatch never started — no retry spent).
+                # Mid-terminate the residue sweep may already have run,
+                # so requeueing would strand the request until close()
+                # fails it NON-retriably — settle it 503 instead, as
+                # the terminate contract promises.
+                shed_req = None
                 with self._cv:
                     replica.outstanding -= 1
                     self._observe_depth(replica)
-                    self._admission.appendleft(req)
+                    if self._terminating or self._closed:
+                        shed_req = req
+                    else:
+                        self._admission.appendleft(req)
+                if shed_req is not None:
+                    self._fail(shed_req, ServiceUnavailable(
+                        "serving front terminated before this request "
+                        "was dispatched",
+                        retry_after_s=self._retry_after(),
+                    ))
 
     def _observe_depth(self, replica: ServingReplica) -> None:
         if self.registry is not None:
@@ -332,6 +575,10 @@ class ServingFront:
             self._latencies.append(req.t_done - req.t_submit)
             if req.t_first_token is not None:
                 self._ttfts.append(req.t_first_token - req.t_submit)
+            self._done_times.append(req.t_done)  # service-rate window
+            # relaxed read of the deque (no _cv inside _lat_lock —
+            # lock order is _cv -> _lat_lock): a heuristic flag
+            self._done_busy.append(bool(self._admission))
             # settles arrive from every replica's worker thread; the
             # += below is not atomic, so it rides the same lock
             self.requests_done += 1
@@ -352,6 +599,15 @@ class ServingFront:
             return
         if isinstance(err, ValueError):
             self._fail(req, err)  # unservable as posed, retry won't help
+            return
+        if self._terminating:
+            # force-closed past the drain deadline: the contract is
+            # 503 + Retry-After, never a silent drop or a requeue into
+            # a dispatcher that is going away
+            self._fail(req, ServiceUnavailable(
+                "serving front is terminating",
+                retry_after_s=self._retry_after(1),
+            ))
             return
         if self._closed:
             self._fail(req, RuntimeError("ServingFront is closed"))
@@ -386,11 +642,17 @@ class ServingFront:
 
     @property
     def batches_run(self) -> int:
-        return sum(r.stats()["batches_run"] for r in self.replicas)
+        with self._cv:
+            fleet = list(self.replicas) + list(self.retired)
+            folded = self._retired_folded["batches_run"]
+        return folded + sum(r.stats()["batches_run"] for r in fleet)
 
     @property
     def tokens_generated(self) -> int:
-        return sum(r.stats()["tokens_generated"] for r in self.replicas)
+        with self._cv:
+            fleet = list(self.replicas) + list(self.retired)
+            folded = self._retired_folded["tokens_generated"]
+        return folded + sum(r.stats()["tokens_generated"] for r in fleet)
 
     def latency_stats(self) -> Dict[str, float]:
         from .batcher import latency_percentiles
@@ -403,63 +665,217 @@ class ServingFront:
         return latency_percentiles(self._ttfts, self._lat_lock)
 
     def health(self) -> Dict:
-        """ok = every replica live; degraded = some down, still
-        serving; down = nothing live (server.py rides this to HTTP
-        200/200/503)."""
-        live = len(self._live())
-        n = len(self.replicas)
+        """ok = every fleet member live or intentionally draining;
+        degraded = a replica is restarting/dead but something still
+        serves; down = nothing live (server.py rides this to HTTP
+        200/200/503).  A DRAINING replica is an intentional,
+        autoscaler-driven exit — it finishes its in-flight work but
+        takes nothing new, and does NOT degrade the front."""
+        with self._cv:
+            replicas = list(self.replicas)
+            retired = len(self.retired) + self._retired_dropped
+        live = sum(1 for r in replicas if r.alive)
+        draining = sum(1 for r in replicas if r.state == "draining")
+        broken = sum(1 for r in replicas
+                     if r.state in ("restarting", "dead"))
         if self._closed or live == 0:
             status = "down"
-        elif live == n:
-            status = "ok"
-        else:
+        elif broken:
             status = "degraded"
+        else:
+            status = "ok"
         return {
             "status": status,
             "replicas_live": live,
+            "replicas_draining": draining,
+            "replicas_retired": retired,
+            "terminating": self._terminating,
             "replicas": [
                 {"id": r.replica_id, "state": r.state,
                  "restarts": r.restarts, "deaths": r.deaths}
-                for r in self.replicas
+                for r in replicas
             ],
         }
+
+    @property
+    def admission_depth(self) -> int:
+        """Front-queue depth alone (excludes dispatched in-flight)."""
+        with self._cv:
+            return len(self._admission)
 
     def stats(self) -> Dict:
         with self._cv:
             queued = len(self._admission)
             replicas = [r.stats() for r in self.replicas]
+            retired = [r.stats() for r in self.retired]
+            retired_n = len(self.retired) + self._retired_dropped
+            folded = dict(self._retired_folded)
         if self.registry is not None:
             self.registry.gauge("serving/replicas_live").set(
                 len(self._live()))
-        return {
+        rate = self.service_rate()
+        out = {
             "mode": "replicated",
             "replicas_live": len(self._live()),
+            "replicas_draining": sum(1 for r in replicas
+                                     if r["state"] == "draining"),
+            "replicas_retired": retired_n,
             "queue_depth": queued + sum(r["outstanding"]
                                         for r in replicas),
             "requests_done": self.requests_done,
             "requeued_requests": self.requeued_requests,
             "shed_requests": self.shed_requests,
-            "tokens_generated": sum(r["tokens_generated"]
-                                    for r in replicas),
-            "steps": sum(r["batches_run"] for r in replicas),
+            "admission_shed": self.admission_shed,
+            "service_rate_rps": (round(rate, 3)
+                                 if rate is not None else None),
+            "tokens_generated": (folded["tokens_generated"]
+                                 + sum(r["tokens_generated"]
+                                       for r in replicas)
+                                 + sum(r["tokens_generated"]
+                                       for r in retired)),
+            "steps": (folded["batches_run"]
+                      + sum(r["batches_run"] for r in replicas)
+                      + sum(r["batches_run"] for r in retired)),
             "ttft": self.ttft_stats(),
             "latency": self.latency_stats(),
             "replicas": replicas,
         }
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
+        return out
 
     # -- shutdown --------------------------------------------------------
+    def terminate(self, deadline_s: float = 30.0) -> Dict:
+        """SIGTERM grace, the serving-side twin of the training
+        supervisor's preemption grace (docs/RESILIENCE.md): stop
+        admitting (new submissions shed with 503 + Retry-After from the
+        measured drain rate), drain every replica under `deadline_s` —
+        in-flight and already-queued requests run to completion — then
+        shed the residue and close.  No admitted request is ever
+        silently dropped: each one either completes or settles with a
+        retriable ServiceUnavailable.
+
+        Returns a report: completed/shed counts, drained replicas,
+        whether the deadline was met, and the elapsed time."""
+        t0 = time.monotonic()
+        with self._cv:
+            if self._closed or self._terminating:
+                return {"already_terminating": True}
+            self._terminating = True
+            done_before = self.requests_done
+            self._cv.notify_all()
+        if self.registry is not None:
+            self.registry.counter("serving/terminations").inc()
+        self.log.info("serving front terminating: draining %d replicas "
+                      "under %.1fs", len(self.replicas), deadline_s)
+        deadline = t0 + deadline_s
+        # phase 1: the dispatcher keeps handing QUEUED requests to the
+        # still-live replicas — draining them now would strand the
+        # backlog, so wait for the queue to empty (or the deadline)
+        with self._cv:
+            while (time.monotonic() < deadline and self._admission
+                   and self._live()):
+                self._cv.wait(min(
+                    0.05, max(0.001, deadline - time.monotonic())))
+            replicas = list(self.replicas)
+        # phase 2: nothing left to dispatch (or out of time) — drain
+        # every replica; in-flight slots run to completion
+        for r in replicas:
+            r.drain(on_retired=self._on_replica_retired)
+        while time.monotonic() < deadline:
+            with self._cv:
+                # a replica mid-rebuild at the snapshot above refused
+                # its drain() and comes back "live" after — catch it
+                late_live = [r for r in self.replicas
+                             if r.state == "live"]
+            for r in late_live:  # outside the lock: drain fans into
+                r.drain(on_retired=self._on_replica_retired)  # the sched
+            with self._cv:
+                settled = all(
+                    r.state in ("retired", "dead", "closed")
+                    for r in self.replicas)
+                if not self._admission and settled:
+                    break
+                self._cv.wait(min(
+                    0.05, max(0.001, deadline - time.monotonic())))
+        with self._cv:
+            residue = list(self._admission)
+            self._admission.clear()
+        # residue past the deadline: 503 + Retry-After from the
+        # measured drain rate — the client knows when to come back
+        shed = 0
+        for req in residue:
+            self._fail(req, ServiceUnavailable(
+                "serving front terminated before this request was "
+                "dispatched",
+                retry_after_s=self._retry_after(len(residue)),
+            ))
+            shed += 1
+        deadline_met = not residue and time.monotonic() <= deadline
+        # bounded close sweeps up wedged DRAINING replicas; their
+        # in-flight requests settle as 503s through _on_settle's
+        # terminating branch
+        self.close(timeout_s=max(0.1, deadline - time.monotonic()))
+        report = {
+            "duration_s": round(time.monotonic() - t0, 3),
+            "deadline_s": deadline_s,
+            "deadline_met": deadline_met,
+            "completed_during_drain": self.requests_done - done_before,
+            "shed": shed,
+            "replicas_retired": len(self.retired) + self._retired_dropped,
+        }
+        self.log.info("serving front terminated: %s", report)
+        return report
+
+    def install_grace_handlers(self, deadline_s: float = 30.0) -> Dict:
+        """SIGTERM/SIGINT -> graceful terminate() on a daemon thread
+        (the supervisor's preemption-grace pattern on the serving
+        side).  Main-thread only; returns the displaced handlers so an
+        embedding process can restore them."""
+        if threading.current_thread() is not threading.main_thread():
+            return {}
+        installed = {}
+
+        def _on_signal(signum, frame):
+            self.log.info(
+                "%s received: graceful serving drain under %.1fs",
+                signal.Signals(signum).name, deadline_s)
+            threading.Thread(
+                target=self.terminate, args=(deadline_s,),
+                daemon=True, name="serving-front-terminate",
+            ).start()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                installed[sig] = signal.signal(sig, _on_signal)
+            except (ValueError, OSError):  # exotic embeddings
+                break
+        return installed
+
     def close(self, timeout_s: Optional[float] = None):
-        """Stop dispatching, close every replica (each close is
-        BOUNDED — a wedged decode step cannot hang front shutdown),
-        and fail whatever is still queued, promptly."""
+        """Stop dispatching, close every replica, and fail whatever is
+        still queued, promptly.  An explicit `timeout_s` is a TOTAL
+        budget shared by the whole fleet (terminate()'s deadline
+        contract — N wedged replicas must not each get the full
+        bound); None lets each replica use its own close_timeout_s."""
         with self._cv:
             if self._closed:
                 return
             self._closed = True
             self._cv.notify_all()
+        scaler = self.autoscaler
+        if scaler is not None:
+            scaler.stop()
         self._dispatcher.join(timeout=2.0)
-        for r in self.replicas:
-            r.close(timeout_s)
+        with self._cv:
+            # retired replicas released their threads at _retire();
+            # sweeping them too makes close() the backstop either way
+            replicas = list(self.replicas) + list(self.retired)
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        for r in replicas:
+            r.close(None if deadline is None
+                    else max(0.05, deadline - time.monotonic()))
         err = RuntimeError("ServingFront is closed")
         with self._cv:
             while self._admission:

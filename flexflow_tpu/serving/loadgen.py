@@ -59,29 +59,88 @@ def sample_workload(rng: np.random.RandomState, n_requests: int,
     return reqs
 
 
+def arrival_gaps(rng: np.random.RandomState, n: int, rate_rps: float,
+                 pattern: str = "poisson", *,
+                 ramp_to: Optional[float] = None,
+                 burst_factor: float = 4.0,
+                 period_s: float = 2.0) -> np.ndarray:
+    """Seeded, replayable inter-arrival gaps for `n` requests — the
+    same (seed, pattern, params) always yields the same trace, so a
+    bench run and its baseline see identical load.
+
+    Patterns (all open-loop: arrivals are exponential around a
+    time-varying rate, scheduled by the clock, never by completions):
+
+      * ``poisson`` — constant `rate_rps` (the PR 6 default);
+      * ``ramp`` — rate climbs linearly from `rate_rps` to `ramp_to`
+        (default 4x) across the trace: the steady-growth shape that
+        should trigger exactly one scale-up wave, no flapping;
+      * ``square`` — square-wave bursts: rate alternates between
+        `rate_rps` and `rate_rps * burst_factor` every `period_s`
+        seconds of generated load, the surge/calm cycle an autoscaler
+        must follow up AND back down.
+    """
+    if n <= 0:
+        return np.zeros(0)
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if pattern == "poisson":
+        return rng.exponential(1.0 / rate_rps, size=n)
+    if pattern == "ramp":
+        hi = float(ramp_to) if ramp_to is not None else 4.0 * rate_rps
+        rates = np.linspace(rate_rps, hi, n)
+        return rng.exponential(1.0, size=n) / rates
+    if pattern == "square":
+        if burst_factor <= 0:
+            raise ValueError(
+                f"burst_factor must be > 0, got {burst_factor}")
+        if period_s <= 0:
+            raise ValueError(f"period_s must be > 0, got {period_s}")
+        gaps = np.empty(n)
+        t = 0.0
+        for i in range(n):
+            phase = int(t / period_s) % 2  # 0 = calm, 1 = burst
+            rate = rate_rps * (burst_factor if phase else 1.0)
+            gaps[i] = rng.exponential(1.0 / rate)
+            t += gaps[i]
+        return gaps
+    raise ValueError(
+        f"arrival pattern must be poisson | ramp | square, "
+        f"got {pattern!r}")
+
+
 def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
                 temperature: float = 0.0, timeout_s: float = 120.0,
                 on_submit: Optional[Callable] = None,
-                detail: bool = False) -> Dict:
-    """Fire `requests` [(prompt, max_new_tokens), ...] at Poisson
-    arrivals of `rate_rps`, wait for completion, report SLOs.
+                detail: bool = False, record_tokens: bool = False,
+                arrival: str = "poisson",
+                ramp_to: Optional[float] = None,
+                burst_factor: float = 4.0,
+                period_s: float = 2.0) -> Dict:
+    """Fire `requests` [(prompt, max_new_tokens), ...] at seeded
+    open-loop arrivals (`arrival` = poisson | ramp | square, see
+    arrival_gaps), wait for completion, report SLOs.
 
     Failed/timed-out requests are counted, excluded from latency
     summaries, and never crash the run (the server keeps them going;
     the loadgen just stops waiting).
 
     detail=True adds per-request `records` (submit_s relative to the
-    run start, ok, ttft_s, done_s) covering failures too — the
-    serving_resilience bench leg buckets these around a fault window."""
+    run start, ok, ttft_s, done_s, and queue_depth_at_admit when the
+    handle carries it — the front stamps its backlog at admission)
+    covering failures too — the serving_resilience and autoscale bench
+    legs bucket these around fault/burst windows."""
     rng = np.random.RandomState(seed)
-    gaps = rng.exponential(1.0 / rate_rps, size=len(requests))
+    gaps = arrival_gaps(rng, len(requests), rate_rps, arrival,
+                        ramp_to=ramp_to, burst_factor=burst_factor,
+                        period_s=period_s)
     t0 = time.monotonic()
     next_at = t0
     handles = []
     results = []
     records = []
     failures = 0
-    for (prompt, mnt), gap in zip(requests, gaps):
+    for idx, ((prompt, mnt), gap) in enumerate(zip(requests, gaps)):
         next_at += gap
         delay = next_at - time.monotonic()
         if delay > 0:
@@ -95,24 +154,29 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
             # firing at the clock
             failures += 1
             records.append({
+                "idx": idx,
                 "submit_s": round(time.monotonic() - t0, 4),
                 "ok": False, "rejected": True,
             })
             continue
-        handles.append((h, len(prompt), mnt))
+        handles.append((h, idx, len(prompt), mnt))
         if on_submit is not None:
             on_submit(h)
     # ONE deadline across all waits (the server.py /v2/generate
     # convention): a wedged engine costs ~timeout_s total, not
     # timeout_s per outstanding handle
     wait_deadline = time.monotonic() + timeout_s
-    for h, plen, mnt in handles:
+    for h, idx, plen, mnt in handles:
+        depth = getattr(h, "queue_depth_at_admit", None)
         try:
             toks = h.wait(max(0.0, wait_deadline - time.monotonic()))
         except Exception:
             failures += 1
-            records.append({"submit_s": round(h.t_submit - t0, 4),
-                            "ok": False})
+            rec = {"idx": idx, "submit_s": round(h.t_submit - t0, 4),
+                   "ok": False}
+            if depth is not None:
+                rec["queue_depth_at_admit"] = depth
+            records.append(rec)
             continue
         # every handle flavor stamps t_submit at generate_async time —
         # the loadgen's submit clock.  t_done/t_first_token exist only
@@ -130,11 +194,19 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
             "n_generated": n_gen,
             "gen_s": t_done - t_first,
         })
-        records.append({"submit_s": round(t_submit - t0, 4), "ok": True,
-                        "ttft_s": round(t_first - t_submit, 4),
-                        "done_s": round(t_done - t0, 4)})
+        rec = {"idx": idx, "submit_s": round(t_submit - t0, 4),
+               "ok": True, "ttft_s": round(t_first - t_submit, 4),
+               "done_s": round(t_done - t0, 4)}
+        if depth is not None:
+            rec["queue_depth_at_admit"] = depth
+        if record_tokens:
+            # token-identity audits (the autoscale leg proves zero
+            # requests were corrupted by a drain) need the completions
+            rec["tokens"] = [int(t) for t in toks]
+        records.append(rec)
     report = {
         "offered_rps": rate_rps,
+        "arrival": arrival,
         "requests": len(requests),
         "completed": len(results),
         "failures": failures,

@@ -98,10 +98,14 @@ class ServingReplica:
     list and recompiles, which consults the strategy store's
     degraded-mesh key before paying a search (docs/STORE.md).
 
-    States: ``live`` (serving), ``restarting`` (death observed, rebuild
-    pending/underway), ``dead`` (restart budget exhausted — permanent),
-    ``closed``.  `on_state_change` (set by the front) fires on every
-    transition so the dispatcher never polls.
+    States: ``live`` (serving — READY), ``restarting`` (death observed,
+    rebuild pending/underway), ``draining`` (autoscaler scale-down or
+    SIGTERM grace: no new dispatches, in-flight slots run to
+    completion), ``retired`` (drain finished — the engine and its KV
+    pool are released, permanently out of the fleet), ``dead`` (restart
+    budget exhausted — permanent), ``closed``.  `on_state_change` (set
+    by the front) fires on every transition so the dispatcher never
+    polls.
     """
 
     def __init__(
@@ -146,6 +150,12 @@ class ServingReplica:
         self._survivors: Optional[int] = None
         self._death_evt = threading.Event()
         self._closed = False
+        self._draining = False
+        self._retire_guard = threading.Lock()
+        self._retire_done = False
+        self._on_retired: Optional[Callable] = None
+        self.drain_started_t: Optional[float] = None
+        self.retired_t: Optional[float] = None
         self._build()
         self._set_state("live")
         self._supervisor = threading.Thread(
@@ -162,6 +172,8 @@ class ServingReplica:
     def _set_state(self, state: str) -> None:
         if self._closed and state != "closed":
             return  # a rebuild that raced close() must not resurrect us
+        if self.state == "retired" and state not in ("closed",):
+            return  # retirement is permanent — no resurrection
         self.state = state
         if state == "live":
             self.last_live_t = time.monotonic()
@@ -204,7 +216,10 @@ class ServingReplica:
         self.deaths += 1
         self._count("replica_deaths")
         self.log.info("serving replica %d died: %s", self.replica_id, exc)
-        self._set_state("restarting")
+        if not self._draining:
+            # a DRAINING replica was leaving anyway: stay in draining
+            # (the supervisor retires it instead of rebuilding)
+            self._set_state("restarting")
         self._death_evt.set()
 
     def _fold_carried(self) -> None:
@@ -223,6 +238,12 @@ class ServingReplica:
             self._death_evt.wait()
             self._death_evt.clear()
             if self._closed:
+                return
+            if self._draining:
+                # death observed while leaving the fleet: the front
+                # already requeued the stranded in-flight requests —
+                # retire instead of paying a rebuild nobody wants
+                self._retire()
                 return
             self._fold_carried()
             self.scheduler = None
@@ -275,6 +296,74 @@ class ServingReplica:
         else:
             self.log.info("serving replica %d restarted (restart %d)",
                           self.replica_id, self.restarts)
+
+    # -- drain lifecycle (autoscaler scale-down / SIGTERM grace) ---------
+    def drain(self, on_retired: Optional[Callable] = None) -> bool:
+        """READY -> DRAINING: stop taking new work, let in-flight slots
+        run to completion (token-identical — decode is undisturbed),
+        then retire and release the engine + KV pool.  Returns False if
+        the replica is not currently live (nothing to drain).
+
+        `on_retired(replica)` fires exactly once when the drain
+        completes — including when a fault kills the draining engine
+        (in-flight requests are requeued by the front; a leaving
+        replica is never rebuilt)."""
+        sched = self.scheduler
+        if self.state != "live" or sched is None or self._closed:
+            return False
+        self._draining = True
+        self._on_retired = on_retired
+        self.drain_started_t = time.monotonic()
+        self._count("replica_drains")
+        self.log.info("serving replica %d draining", self.replica_id)
+        self._set_state("draining")  # dispatcher stops routing here
+        sched.drain(on_drained=self._retire)
+        return True
+
+    def _retire(self) -> None:
+        """DRAINING -> RETIRED: release the engine (the KV pool goes
+        with it) and notify the front.  Idempotent under CONCURRENT
+        callers — a clean drain completion, a death-while-draining,
+        and a force_retire may all arrive, from different threads;
+        exactly one runs the body (else _fold_carried double-counts
+        and on_retired fires twice)."""
+        with self._retire_guard:
+            if self._retire_done or self.state == "retired":
+                return
+            self._retire_done = True
+        self._fold_carried()
+        self.scheduler = None  # drops the pool: KV blocks are freed
+        self.retired_t = time.monotonic()
+        if self.drain_started_t is not None and self.registry is not None:
+            self.registry.histogram("serving/drain_ms").observe(
+                (self.retired_t - self.drain_started_t) * 1e3)
+        self._count("replica_retired")
+        self.log.info("serving replica %d retired", self.replica_id)
+        self._set_state("retired")
+        hook = self._on_retired
+        self._on_retired = None
+        if hook is not None:
+            try:
+                hook(self)
+            except Exception:  # noqa: BLE001 — never kill the worker
+                pass           # or supervisor retiring us
+        # retirement is the replica's end of life: release the parked
+        # supervisor thread too.  front.close() only sweeps fleet
+        # members, so without this every clean scale-down would leave
+        # one daemon thread blocked on _death_evt until process exit.
+        self._closed = True
+        self._death_evt.set()
+
+    def force_retire(self, timeout_s: Optional[float] = None) -> None:
+        """Bounded end of a wedged drain: close the engine (in-flight
+        requests fail and the front requeues them onto survivors),
+        then retire.  The autoscaler calls this when a drain outlives
+        its deadline."""
+        sched = self.scheduler
+        if sched is not None:
+            sched.close(timeout_s if timeout_s is not None
+                        else self.close_timeout_s)
+        self._retire()
 
     # -- front-facing ----------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature, on_done):

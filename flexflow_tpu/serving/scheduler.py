@@ -223,6 +223,12 @@ class ContinuousScheduler:
         # fired (with the exception) when the worker dies on a fault —
         # NOT on a clean close.  The replica supervisor's death signal.
         self._on_death = on_death
+        # graceful drain (autoscaler scale-down / SIGTERM grace): set by
+        # drain(); new submissions are refused, everything already
+        # accepted runs to completion, then the worker exits cleanly
+        # and fires _on_drained exactly once
+        self._draining = False
+        self._on_drained = None
         self.batches_run = 0       # decode steps executed
         self.requests_done = 0
         self.tokens_generated = 0
@@ -254,6 +260,10 @@ class ContinuousScheduler:
                        on_done=None) -> _PendingSeq:
         if self._stop.is_set():
             raise RuntimeError("ContinuousScheduler is closed")
+        if self._draining:
+            # the drain cutoff: everything accepted BEFORE drain() runs
+            # to completion; nothing new boards a leaving engine
+            raise RuntimeError("ContinuousScheduler is draining")
         # validate HERE so a bad request fails alone (the batcher
         # convention); continuous mode has no same-temperature
         # restriction — sampling is host-side per row.  on_done rides
@@ -277,6 +287,26 @@ class ContinuousScheduler:
     def worker_alive(self) -> bool:
         return self._worker.is_alive()
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, on_drained=None) -> None:
+        """Stop ACCEPTING and run everything already accepted to
+        completion (decode proceeds undisturbed — completions are
+        token-identical to an engine that was never drained).  When the
+        last live sequence retires and the arrival queue is empty, the
+        worker exits cleanly and fires `on_drained` exactly once; the
+        engine then refuses submissions like a closed one.
+
+        Unlike close(), drain() never fails an in-flight request.  A
+        wedged drain is still bounded by close(timeout_s=), which
+        overrides it."""
+        if self._stop.is_set() or self._draining:
+            return
+        self._on_drained = on_drained
+        self._draining = True
+
     def latency_stats(self) -> Dict[str, float]:
         from .batcher import latency_percentiles
 
@@ -292,6 +322,7 @@ class ContinuousScheduler:
         seq_tokens = {s.seq_id: s.pos for s in live}
         return {
             "mode": "continuous",
+            "draining": self._draining,
             "steps": self.batches_run,
             "requests_done": self.requests_done,
             "tokens_generated": self.tokens_generated,
@@ -444,6 +475,13 @@ class ContinuousScheduler:
             self._decode_loop()
         except Exception as e:  # scheduler bug / pool invariant breach
             err = fatal = e
+        drained = (fatal is None and self._draining
+                   and not self._stop.is_set())
+        if drained:
+            # clean drain completion: flip the closed flag so late
+            # submissions refuse, then notify AFTER the residual drain
+            # below settles any racer that slipped into the queue
+            self._stop.set()
         if fatal is not None:
             # the engine is dead for NEW submissions too: flip the
             # closed flag and notify the supervisor BEFORE failing the
@@ -458,12 +496,24 @@ class ContinuousScheduler:
                 except Exception:  # noqa: BLE001 — the worker is
                     pass           # exiting; never mask the drain
         self._drain(err)
+        if drained and self._on_drained is not None:
+            try:
+                self._on_drained()
+            except Exception:  # noqa: BLE001 — the worker is exiting;
+                pass           # a retire hook must never mask that
 
     def _decode_loop(self):
         page = self.pool.page_size
         while not self._stop.is_set():
             self._admit()
             if all(s is None for s in self._slots):
+                if (self._draining and not self._waiting
+                        and self._queue.empty()):
+                    # drain complete: nothing live, nothing queued —
+                    # exit cleanly (a submit that raced past the
+                    # drain() cutoff sits in _queue and was admitted
+                    # above, so it is NOT abandoned here)
+                    return
                 # idle: park on the arrival queue instead of spinning
                 try:
                     self._waiting.append(self._queue.get(timeout=0.05))

@@ -6,7 +6,11 @@ POST /v2/generate  {"prompt": [ids...]} or {"prompts": [[ids...], ...]},
                    optional "max_new_tokens" (int), "temperature"
                    (float), "timeout_s" (float, default 120; an
                    expired wait returns HTTP 503 — the request still
-                   completes server-side)
+                   completes server-side), "deadline_s" (float: TTFT
+                   SLO for a ServingFront's overload admission
+                   control — a request whose predicted TTFT already
+                   exceeds it is shed with 503 + Retry-After instead
+                   of timing out inside the queue)
                    -> {"tokens": [[ids...], ...]}   (requires a
                    GenerationBatcher or ContinuousScheduler via
                    serve_http(generator=...))
@@ -18,16 +22,23 @@ GET  /v2/health    -> {"status": "ok"|"degraded", "requests": N}
                    backend too.  A ServingFront generator aggregates
                    per-replica liveness instead: ok (all live, 200),
                    degraded (some live — still serving, 200), down
-                   (none live, 503), with a "replicas" detail list)
+                   (none live, 503), with a "replicas" detail list.
+                   A replica mid-scale-down reports state "draining"
+                   plus top-level replicas_draining/replicas_retired
+                   counts — an INTENTIONAL exit that does not degrade
+                   the front)
 GET  /v2/stats     -> batch/request counters + latency percentiles
                    (+ a "continuous" block when the generator is a
                    ContinuousScheduler: queue depth, KV pool
                    occupancy/fragmentation, TTFT percentiles; a
                    ServingFront adds a per-replica block under
-                   "replicas")
+                   "replicas" and, when an autoscaler is attached, an
+                   "autoscaler" block: current/target replicas,
+                   min/max bounds, last scale decision + reason)
 
 Shed/exhausted-retry requests (front.ServiceUnavailable) return 503
-with a Retry-After header.
+with a Retry-After header computed from the front's MEASURED drain
+rate (how long the current backlog takes to clear), not a constant.
 """
 from __future__ import annotations
 
@@ -145,8 +156,18 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
                     if timeout <= 0:
                         raise ValueError(
                             f"timeout_s must be > 0, got {timeout}")
+                    # per-request TTFT deadline for the front's
+                    # overload admission control: a request the
+                    # backlog already condemns to miss it is shed NOW
+                    # (503 + Retry-After), not timed out in the queue
+                    deadline = req.get("deadline_s")
+                    kw = {}
+                    if (deadline is not None
+                            and hasattr(generator,
+                                        "admission_deadline_s")):
+                        kw["deadline_s"] = float(deadline)
                     handles = [
-                        generator.generate_async(p, mnt, temp)
+                        generator.generate_async(p, mnt, temp, **kw)
                         for p in prompts
                     ]  # rows of one POST coalesce into one scan
                     # ONE deadline for the whole request: sequential

@@ -1,0 +1,986 @@
+"""SLO-driven autoscaling of the serving front (serving/autoscaler.py)
+plus the replica drain lifecycle (READY -> DRAINING -> RETIRED) it
+rides on: policy hysteresis/cooldown/bounds as pure unit tests, real
+scale-up/scale-down against the fake step model, drain races (late
+submit, wedged DRAINING replica, death-while-draining), token identity
+of requests completed on a draining engine, overload admission
+control, SIGTERM-grace terminate(), and the HTTP surfaces
+(/v2/health draining state, /v2/stats autoscaler block)."""
+import json
+import os
+import signal
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.obs.metrics import MetricsRegistry
+from flexflow_tpu.serving import (
+    ContinuousScheduler,
+    ServiceUnavailable,
+    ServingAutoscaler,
+    ServingFront,
+)
+from flexflow_tpu.serving.server import serve_http
+
+V = 16
+NO_SLEEP = lambda s: None  # noqa: E731
+
+
+class FakeStepModel:
+    """Deterministic PagedKVDecodeModel stand-in: next token is
+    (input + 1) % vocab as one-hot logits — greedy expectations are
+    closed-form, so drain TOKEN-IDENTITY is directly checkable."""
+
+    def __init__(self, batch_slots=2, max_seq=32, page_size=4,
+                 delay_s=0.0):
+        self.batch_slots = batch_slots
+        self.max_seq = max_seq
+        self.page_size = page_size
+        self.max_blocks_per_seq = max_seq // page_size
+        self.num_blocks = 1 + batch_slots * self.max_blocks_per_seq
+        self.vocab = V
+        self.delay_s = delay_s
+        self.steps = 0
+
+    def reset(self):
+        pass
+
+    def step(self, tokens, seq_lens, block_tables):
+        self.steps += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        logits = np.zeros((self.batch_slots, V), np.float32)
+        nxt = (np.asarray(tokens) + 1) % V
+        logits[np.arange(self.batch_slots), nxt] = 1.0
+        return logits
+
+
+def expected(prompt, mnt):
+    out = list(prompt)
+    t = prompt[-1]
+    for _ in range(mnt):
+        t = (t + 1) % V
+        out.append(t)
+    return out
+
+
+def factory(replica_id, survivors=None):
+    return FakeStepModel()
+
+
+def _wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def make_scaler(**kw):
+    """Autoscaler around a minimal fake front — enough for the PURE
+    policy surface (decide/target_replicas), which never touches the
+    front."""
+    front = types.SimpleNamespace(registry=None)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    return ServingAutoscaler(front, **kw)
+
+
+def sig(**kw):
+    s = {"t": 100.0, "live": 2, "draining": 0, "restarting": 0,
+         "fleet": 2,
+         "queue_depth": 0, "outstanding": 0, "queue_per_replica": 0.0,
+         "p99_ttft_s": 0.0, "kv_occupancy": 0.0}
+    s.update(kw)
+    return s
+
+
+# -- policy: pure decide() unit tests -----------------------------------
+
+def test_policy_holds_within_bands():
+    sc = make_scaler()
+    action, reason = sc.decide(sig(queue_per_replica=1.0))
+    assert action == "hold" and "within bands" in reason
+
+
+def test_policy_scales_up_on_queue_breach():
+    sc = make_scaler(queue_high=4.0)
+    action, reason = sc.decide(sig(queue_per_replica=5.0))
+    assert action == "up" and "queue/replica" in reason
+
+
+def test_policy_scales_up_on_slo_breach():
+    sc = make_scaler(slo_ttft_s=0.5)
+    action, reason = sc.decide(sig(p99_ttft_s=0.9, outstanding=1))
+    assert action == "up" and "TTFT" in reason
+
+
+def test_policy_stale_ttft_cannot_pin_idle_fleet():
+    """The TTFT window is count-based: after a burst ends, no new
+    completions refresh it.  An IDLE fleet (no queue, nothing
+    outstanding) must neither scale up on the stale p99 nor be blocked
+    from draining by it."""
+    sc = make_scaler(slo_ttft_s=0.5)
+    stale = sig(p99_ttft_s=9.9)  # way past SLO, but queue=outstanding=0
+    action, _ = sc.decide(stale)
+    assert action == "down"  # calm + above min -> drains despite p99
+    action, _ = sc.decide(sig(p99_ttft_s=9.9, live=1, fleet=1))
+    assert action == "hold"  # at min: nothing to drain, never "up"
+
+
+def test_policy_scales_up_on_kv_pressure():
+    sc = make_scaler(kv_high=0.9)
+    action, reason = sc.decide(sig(kv_occupancy=0.95))
+    assert action == "up" and "KV occupancy" in reason
+
+
+def test_policy_hysteresis_band_between_up_and_down():
+    """Signals BETWEEN the bands (above queue_low, below queue_high)
+    hold — an oscillation around either threshold can't flap the
+    fleet."""
+    sc = make_scaler(queue_low=0.5, queue_high=4.0)
+    for q in (0.6, 1.0, 2.0, 3.9):
+        action, _ = sc.decide(sig(queue_per_replica=q))
+        assert action == "hold", q
+    assert sc.decide(sig(queue_per_replica=0.1))[0] == "down"
+    assert sc.decide(sig(queue_per_replica=4.1))[0] == "up"
+
+
+def test_policy_down_requires_every_signal_calm():
+    sc = make_scaler(slo_ttft_s=1.0)
+    # queue calm but TTFT at 80% of SLO under live traffic: not
+    # comfortable -> hold
+    action, _ = sc.decide(sig(queue_per_replica=0.0, p99_ttft_s=0.8,
+                              outstanding=1))
+    assert action == "hold"
+    action, _ = sc.decide(sig(queue_per_replica=0.0, p99_ttft_s=0.1,
+                              outstanding=1))
+    assert action == "down"
+
+
+def test_policy_respects_bounds():
+    sc = make_scaler(min_replicas=2, max_replicas=3)
+    # at max: an up signal holds (with the reason naming the bound)
+    action, reason = sc.decide(
+        sig(live=3, fleet=3, queue_per_replica=10.0))
+    assert action == "hold" and "max_replicas" in reason
+    # at min: calm holds
+    action, _ = sc.decide(sig(live=2, fleet=2, queue_per_replica=0.0))
+    assert action == "hold"
+
+
+def test_policy_restores_min_replicas_after_permanent_death():
+    """A permanently-dead replica leaves the fleet below its
+    contracted floor with no load signal to grow it back: the policy
+    must scale up on the bound itself, not wait for queue pressure."""
+    sc = make_scaler(min_replicas=2, max_replicas=4)
+    action, reason = sc.decide(sig(live=1, fleet=2))  # calm traffic
+    assert action == "up" and "min_replicas" in reason
+    # a restarting replica is coming back on its own: no spawn
+    action, _ = sc.decide(sig(live=1, restarting=1, fleet=2))
+    assert action == "hold"
+
+
+def test_policy_max_counts_restarting_replicas():
+    """A restarting replica returns live after its rebuild: scaling up
+    past it would grow the fleet to max_replicas+1 live engines with
+    no corrective path (the calm condition never holds under the load
+    that drove the up signal)."""
+    sc = make_scaler(min_replicas=1, max_replicas=2)
+    action, reason = sc.decide(
+        sig(live=1, restarting=1, fleet=2, queue_per_replica=10.0))
+    assert action == "hold" and "max_replicas" in reason
+    # a permanently-dead replica holds no engine and never returns —
+    # it must NOT consume headroom (restarting=0 excludes it)
+    action, _ = sc.decide(
+        sig(live=1, restarting=0, fleet=2, queue_per_replica=10.0))
+    assert action == "up"
+
+
+def test_policy_cooldown_and_drain_in_flight_hold():
+    sc = make_scaler(cooldown_s=5.0)
+    sc.last_action_t = 98.0  # 2s ago at t=100
+    action, reason = sc.decide(sig(queue_per_replica=10.0))
+    assert action == "hold" and reason == "cooldown"
+    sc.last_action_t = None
+    sc._draining = (object(), 0.0)
+    action, reason = sc.decide(sig(queue_per_replica=10.0))
+    assert action == "hold" and reason == "drain in flight"
+
+
+def test_policy_zero_live_is_supervisions_problem():
+    sc = make_scaler()
+    action, reason = sc.decide(
+        sig(live=0, fleet=2, queue_per_replica=50.0))
+    assert action == "hold" and "no live replicas" in reason
+
+
+def test_scaler_validates_construction():
+    with pytest.raises(ValueError, match="min_replicas"):
+        make_scaler(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        make_scaler(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="hysteresis"):
+        make_scaler(queue_low=4.0, queue_high=4.0)
+    with pytest.raises(ValueError, match="interval_s"):
+        make_scaler(interval_s=0)
+    with pytest.raises(ValueError, match="drain_timeout_s"):
+        make_scaler(drain_timeout_s=0)
+
+
+# -- scale-up / scale-down against the real front -----------------------
+
+def test_scale_up_on_backlog_and_new_replica_serves():
+    tm = [0.0]
+    reg = MetricsRegistry()
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.01),
+        num_replicas=1, registry=reg, sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 3, cooldown_s=5.0,
+                           time_fn=lambda: tm[0], registry=reg)
+    try:
+        hs = [front.generate_async([1 + i], 6) for i in range(12)]
+        entry = sc.tick()
+        assert entry["action"] == "up"
+        assert len(front.replicas) == 2
+        assert sc.scale_ups == 1
+        # cooldown: an immediate second tick holds even under backlog
+        tm[0] += 1.0
+        assert sc.tick()["action"] == "hold"
+        for h, i in zip(hs, range(12)):
+            assert h.wait(30.0) == expected([1 + i], 6)
+        # both replicas served
+        st = front.stats()
+        assert all(r["batches_run"] > 0 for r in st["replicas"])
+        assert reg.counter("serving/replicas_added").value == 1
+        assert reg.counter("serving/autoscaler_up").value == 1
+    finally:
+        front.close()
+
+
+def test_scale_down_drains_least_loaded_and_retires():
+    tm = [0.0]
+    front = ServingFront(factory, num_replicas=2, sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 4, cooldown_s=0.0,
+                           time_fn=lambda: tm[0])
+    try:
+        assert front.generate([1, 2], 4, timeout=30.0) == \
+            expected([1, 2], 4)
+        entry = sc.tick()
+        assert entry["action"] == "down"
+        assert sc.scale_downs == 1
+        assert _wait_for(lambda: len(front.replicas) == 1)
+        assert len(front.retired) == 1
+        assert front.retired[0].state == "retired"
+        # the retired engine released its scheduler (and KV pool)
+        assert front.retired[0].scheduler is None
+        # the survivor still serves
+        assert front.generate([7], 3, timeout=30.0) == expected([7], 3)
+        # at min_replicas now: calm no longer drains
+        tm[0] += 10.0
+        sc._sweep_drain()
+        assert sc.tick()["action"] == "hold"
+        assert len(front.replicas) == 1
+    finally:
+        front.close()
+
+
+def test_drain_completes_inflight_token_identical():
+    """Scale-down drain with requests mid-generation: the dispatcher
+    stops routing to the draining replica, its in-flight slots run to
+    completion TOKEN-IDENTICALLY (closed-form greedy check), nothing
+    is requeued or lost."""
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.01),
+        num_replicas=2, sleep=NO_SLEEP)
+    try:
+        reqs = [([1 + i], 12) for i in range(4)]
+        hs = [front.generate_async(p, m) for p, m in reqs]
+        # wait until work is actually in flight, then drain the busier
+        # replica mid-generation
+        assert _wait_for(
+            lambda: any(r.outstanding for r in front.replicas))
+        target = max(front.replicas, key=lambda r: r.outstanding)
+        assert front.drain_replica(target)
+        assert target.state in ("draining", "retired")
+        for h, (p, m) in zip(hs, reqs):
+            assert h.wait(30.0) == expected(p, m)  # token-identical
+        assert front.requeued_requests == 0  # graceful, not requeue
+        assert _wait_for(lambda: target.state == "retired")
+        assert front.health()["replicas_retired"] == 1
+    finally:
+        front.close()
+
+
+def test_retired_replica_releases_supervisor_thread():
+    """A cleanly drained replica must not park its supervisor thread
+    on _death_evt until process exit — front.close() only sweeps fleet
+    members, so each scale-down would otherwise leak one daemon
+    thread."""
+    front = ServingFront(factory, num_replicas=2, sleep=NO_SLEEP)
+    try:
+        r = front.replicas[0]
+        assert front.drain_replica(r)
+        assert _wait_for(lambda: r.state == "retired")
+        assert _wait_for(lambda: not r._supervisor.is_alive())
+    finally:
+        front.close()
+
+
+def test_retired_history_bounded_counters_preserved():
+    """front.retired is a bounded window: a long-lived autoscaled
+    front cycles replicas indefinitely, and an unbounded list grows
+    stats() cost and memory forever.  Dropped replicas must keep
+    counting in the aggregates."""
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    front.retired_keep = 2
+    try:
+        for i in range(4):
+            r = front.add_replica()
+            assert front.generate([1 + i], 3, timeout=30.0) == \
+                expected([1 + i], 3)
+            assert front.drain_replica(r)
+            assert _wait_for(lambda: r.state in ("retired", "closed"))
+        assert _wait_for(lambda: len(front.retired) <= 2)
+        stats = front.stats()
+        assert stats["replicas_retired"] == 4  # dropped still counted
+        assert front.health()["replicas_retired"] == 4
+        # work done on since-dropped replicas stays in the aggregates
+        assert stats["tokens_generated"] == front.tokens_generated
+        assert front.tokens_generated >= 4 * 3
+    finally:
+        front.close()
+
+
+def test_add_replica_aborts_when_close_races_build():
+    """close() sweeping the fleet while add_replica is mid-compile:
+    the late append must be refused and the fresh engine closed, not
+    leaked into a closed front."""
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    orig = front._build_replica
+    built = []
+
+    def build_then_lose_race(rid, fault_plan=None):
+        r = orig(rid, fault_plan=fault_plan)
+        built.append(r)
+        front.close()  # the fleet sweep happens while we "compiled"
+        return r
+
+    front._build_replica = build_then_lose_race
+    with pytest.raises(RuntimeError, match="closing"):
+        front.add_replica()
+    (replica,) = built
+    assert replica.state == "closed"
+    assert replica.scheduler is None
+    assert replica not in front.replicas
+
+
+def test_drain_refuses_nonlive_and_double_drain():
+    front = ServingFront(factory, num_replicas=2, sleep=NO_SLEEP)
+    try:
+        r = front.replicas[0]
+        assert front.drain_replica(r) is True
+        # a second drain of the same replica is a no-op refusal
+        assert front.drain_replica(r) is False
+        assert _wait_for(lambda: r.state == "retired")
+        assert front.drain_replica(r) is False
+    finally:
+        front.close()
+
+
+# -- drain races --------------------------------------------------------
+
+def test_sched_drain_races_late_submit():
+    """A submit racing drain() either refuses synchronously (the
+    caller requeues elsewhere) or is accepted and runs to full
+    token-identical completion — never accepted-then-dropped."""
+    for trial in range(5):
+        sched = ContinuousScheduler(FakeStepModel(batch_slots=2))
+        drained = threading.Event()
+        accepted = []
+        refused = []
+
+        def submitter(i):
+            try:
+                h = sched.generate_async([1 + i], 6)
+                accepted.append((h, [1 + i], 6))
+            except RuntimeError:
+                refused.append(i)
+
+        threads = [threading.Thread(target=submitter, args=(i,))
+                   for i in range(4)]
+        for i, t in enumerate(threads):
+            if i == 2:  # flip the drain mid-burst
+                sched.drain(on_drained=drained.set)
+            t.start()
+        for t in threads:
+            t.join()
+        # everything ACCEPTED completes token-identically; the drain
+        # still finishes (the worker exits once the queue is empty)
+        for h, p, m in accepted:
+            assert h.wait(30.0) == expected(p, m)
+        assert drained.wait(10.0)
+        assert not sched.worker_alive
+        # post-drain the engine refuses like a closed one
+        with pytest.raises(RuntimeError):
+            sched.generate_async([1], 2)
+        assert len(accepted) + len(refused) == 4
+        sched.close()
+
+
+def test_front_close_bounded_with_wedged_draining_replica():
+    """close(timeout_s=) with a replica wedged in DRAINING (its decode
+    step blocks forever): shutdown stays bounded."""
+
+    def wedged_factory(replica_id, survivors=None):
+        return FakeStepModel(delay_s=30.0)
+
+    front = ServingFront(wedged_factory, num_replicas=2,
+                         sleep=NO_SLEEP, close_timeout_s=0.5)
+    h = front.generate_async([1, 2], 4)
+    time.sleep(0.2)  # let a step wedge
+    target = max(front.replicas, key=lambda r: r.outstanding)
+    front.drain_replica(target)
+    assert target.state == "draining"  # wedged: drain can't finish
+    t0 = time.monotonic()
+    front.close(timeout_s=0.5)
+    assert time.monotonic() - t0 < 10.0
+    with pytest.raises(Exception):
+        h.wait(1.0)
+
+
+def test_autoscaler_force_retires_wedged_drain():
+    """A drain that outlives drain_timeout_s is force-retired: the
+    engine closes (bounded), the in-flight request requeues onto the
+    survivor, and the fleet shrinks anyway."""
+    tm = [0.0]
+
+    def mixed_factory(replica_id, survivors=None):
+        # replica 0 wedges mid-step; later builds are healthy
+        return FakeStepModel(delay_s=20.0 if replica_id == 0 else 0.0)
+
+    front = ServingFront(mixed_factory, num_replicas=2, sleep=NO_SLEEP,
+                         close_timeout_s=0.2, retry_backoff=0.0)
+    sc = ServingAutoscaler(front, 1, 4, cooldown_s=0.0,
+                           drain_timeout_s=5.0, time_fn=lambda: tm[0])
+    try:
+        h = front.generate_async([1, 2], 4)
+        assert _wait_for(
+            lambda: front.replicas[0].outstanding > 0)
+        wedged = front.replicas[0]
+        assert front.drain_replica(wedged)
+        sc._draining = (wedged, tm[0])
+        tm[0] += 10.0  # past the drain deadline
+        sc.tick()
+        assert sc.forced_retires == 1
+        assert _wait_for(lambda: wedged.state in ("retired", "closed"))
+        # the stranded request completed on the survivor,
+        # token-identically
+        assert h.wait(30.0) == expected([1, 2], 4)
+        assert front.requeued_requests >= 1
+    finally:
+        front.close()
+
+
+def test_death_while_draining_retires_instead_of_rebuilding():
+    """A fault killing a DRAINING engine must not resurrect it: the
+    front requeues the in-flight strand onto survivors and the
+    replica retires."""
+    from flexflow_tpu.resilience.faults import Fault, FaultKind, FaultPlan
+
+    plan = FaultPlan([Fault(step=3, kind=FaultKind.HUNG_STEP)])
+    front = ServingFront(factory, num_replicas=2, sleep=NO_SLEEP,
+                         retry_backoff=0.0, fault_plans={0: plan})
+    try:
+        assert _wait_for(lambda: all(r.state == "live"
+                                     for r in front.replicas))
+        victim = front.replicas[0]
+        hs = [front.generate_async([1 + i], 8) for i in range(4)]
+        front.drain_replica(victim)
+        for h, i in zip(hs, range(4)):
+            assert h.wait(30.0) == expected([1 + i], 8)
+        assert _wait_for(
+            lambda: victim.state in ("draining", "retired"))
+        assert _wait_for(lambda: victim.state == "retired", 15.0)
+        assert victim.restarts == 0  # never rebuilt
+    finally:
+        front.close()
+
+
+# -- overload admission control -----------------------------------------
+
+def _prime_service_rate(front, n=3):
+    for i in range(n):
+        front.generate([1 + i], 2, timeout=30.0)
+    assert front.service_rate() is not None
+
+
+def test_admission_control_sheds_predicted_ttft_breach():
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.05),
+        num_replicas=1, sleep=NO_SLEEP)
+    try:
+        _prime_service_rate(front)
+        # build a DEEP backlog and let several completions land under
+        # pressure (the capacity gate wants a trailing run of >= 3
+        # busy samples), then ask for an impossible deadline
+        done0 = front.requests_done
+        hs = [front.generate_async([1 + i], 8) for i in range(12)]
+        assert _wait_for(lambda: front.requests_done >= done0 + 4)
+        assert front.admission_depth > 0  # still queued behind slots
+        with pytest.raises(ServiceUnavailable) as ei:
+            front.generate_async([9], 4, deadline_s=1e-4)
+        assert "predicted TTFT" in str(ei.value)
+        assert ei.value.retry_after_s > 0
+        assert front.admission_shed == 1
+        # no deadline -> still admitted under the same backlog
+        h = front.generate_async([9], 4)
+        for hh, i in zip(hs, range(12)):
+            assert hh.wait(30.0) == expected([1 + i], 8)
+        assert h.wait(30.0) == expected([9], 4)
+        assert front.stats()["admission_shed"] == 1
+    finally:
+        front.close()
+
+
+def test_admission_deadline_needs_measured_rate():
+    """Before any completion there is no measured service rate —
+    admission control must NOT shed on a guess."""
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP,
+                         admission_deadline_s=0.001)
+    try:
+        assert front.service_rate() is None
+        h = front.generate_async([1, 2], 4)  # admitted, not shed
+        assert h.wait(30.0) == expected([1, 2], 4)
+        assert front.admission_shed == 0
+    finally:
+        front.close()
+
+
+def test_admission_never_sheds_on_arrival_paced_rate():
+    """Steady calm traffic (completions pacing arrivals, queue empty
+    throughout) must not arm admission control: the measured window
+    says ~N rps but that is the LOAD, not what the fleet could do —
+    the first burst after a calm stretch must be admitted, not
+    condemned on an arrival-paced rate."""
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.02),
+        num_replicas=1, sleep=NO_SLEEP)
+    try:
+        for i in range(6):  # sequential: queue empty at every settle
+            front.generate([1 + i], 2, timeout=30.0)
+        assert front.service_rate() is not None  # measured: arrivals
+        assert front._capacity_rate() is None    # ...not capacity
+        # burst: momentary backlog + tight deadline -> still admitted
+        # (no capacity measurement to shed on; completions are slow
+        # enough that none lands before the deadline submit)
+        hs = [front.generate_async([1 + i], 4) for i in range(4)]
+        h = front.generate_async([9], 3, deadline_s=1e-3)
+        assert h.wait(30.0) == expected([9], 3)
+        assert front.admission_shed == 0
+        for hh, i in zip(hs, range(4)):
+            assert hh.wait(30.0) == expected([1 + i], 4)
+    finally:
+        front.close()
+
+
+def test_admission_never_sheds_an_empty_queue():
+    """With no FRONT backlog the request dispatches immediately — the
+    measured rate is arrival-limited and must not condemn it."""
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.02),
+        num_replicas=1, sleep=NO_SLEEP)
+    try:
+        _prime_service_rate(front)  # slow-ish measured rate
+        # empty queue + tiny deadline: admitted, completes fine
+        h = front.generate_async([1, 2], 3, deadline_s=1e-4)
+        assert h.wait(30.0) == expected([1, 2], 3)
+        assert front.admission_shed == 0
+    finally:
+        front.close()
+
+
+def test_service_rate_goes_stale_after_idle_gap():
+    """After an idle gap the old completion span measures arrivals,
+    not capacity: service_rate() must return None (and admission
+    control must not shed) instead of a near-zero stale rate."""
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP,
+                         rate_staleness_s=0.05)
+    try:
+        _prime_service_rate(front)
+        time.sleep(0.15)  # idle past the staleness window
+        assert front.service_rate() is None
+        hs = [front.generate_async([1 + i], 6) for i in range(4)]
+        h = front.generate_async([9], 3, deadline_s=1e-4)
+        assert h.wait(30.0) == expected([9], 3)  # admitted, not shed
+        assert front.admission_shed == 0
+        for hh, i in zip(hs, range(4)):
+            assert hh.wait(30.0) == expected([1 + i], 6)
+    finally:
+        front.close()
+
+
+def test_admission_deadline_validation():
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    try:
+        with pytest.raises(ValueError, match="deadline_s"):
+            front.generate_async([1], 2, deadline_s=-1.0)
+    finally:
+        front.close()
+
+
+# -- SIGTERM grace ------------------------------------------------------
+
+def test_terminate_drains_under_load_no_silent_drops():
+    """terminate() during active load: every admitted request either
+    completes token-identically or settles 503-retriable with a
+    Retry-After — none hangs, none silently drops."""
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.005),
+        num_replicas=2, sleep=NO_SLEEP)
+    reqs = [([1 + i], 8) for i in range(10)]
+    hs = [front.generate_async(p, m) for p, m in reqs]
+    report = front.terminate(deadline_s=30.0)
+    completed = failed = 0
+    for h, (p, m) in zip(hs, reqs):
+        try:
+            assert h.wait(5.0) == expected(p, m)
+            completed += 1
+        except ServiceUnavailable as e:
+            assert e.retry_after_s > 0
+            failed += 1
+    assert completed + failed == len(reqs)
+    assert report["deadline_met"]
+    assert report["completed_during_drain"] == completed
+    assert completed == len(reqs)  # generous deadline: all complete
+    # new submissions shed 503 (the front is gone)
+    with pytest.raises((ServiceUnavailable, RuntimeError)):
+        front.generate_async([1], 2)
+
+
+def test_terminate_tight_deadline_sheds_residue_with_retry_after():
+    """A deadline too tight for the backlog: the residue is shed as
+    503 + Retry-After (measured drain rate), nothing hangs past the
+    deadline, and the report says deadline_met=False."""
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.2),
+        num_replicas=1, sleep=NO_SLEEP, close_timeout_s=0.3)
+    reqs = [([1 + i], 10) for i in range(8)]
+    hs = [front.generate_async(p, m) for p, m in reqs]
+    t0 = time.monotonic()
+    report = front.terminate(deadline_s=1.0)
+    assert time.monotonic() - t0 < 15.0  # bounded
+    outcomes = []
+    for h, (p, m) in zip(hs, reqs):
+        try:
+            assert h.wait(5.0) == expected(p, m)
+            outcomes.append("ok")
+        except ServiceUnavailable as e:
+            assert e.retry_after_s > 0
+            outcomes.append("shed")
+        except RuntimeError:
+            outcomes.append("closed")
+    assert len(outcomes) == len(reqs)  # every handle SETTLED
+    assert "shed" in outcomes  # the tight deadline shed something
+    assert report["shed"] > 0
+
+
+def test_terminate_drains_replica_that_returns_live_mid_drain():
+    """A replica mid-rebuild when terminate() snapshots the fleet
+    refuses its drain() and comes back 'live' afterwards: the settle
+    loop must drain it too, not spin to the full deadline."""
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    r = front.replicas[0]
+    r.state = "restarting"  # mid-rebuild at terminate time
+    threading.Timer(0.15, lambda: setattr(r, "state", "live")).start()
+    t0 = time.monotonic()
+    report = front.terminate(deadline_s=20.0)
+    assert report["deadline_met"] is True
+    assert time.monotonic() - t0 < 10.0  # settled, not deadline-bound
+    assert r.state in ("retired", "dead", "closed")
+
+
+def test_spawn_failure_logged_and_cooled_down():
+    """A persistent replica-build failure must not be retried with a
+    full compile every tick: the failed attempt starts the cooldown
+    (and is logged + counted)."""
+    tm = [100.0]
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    reg = MetricsRegistry()
+    sc = ServingAutoscaler(front, 1, 4, cooldown_s=5.0,
+                           registry=reg, time_fn=lambda: tm[0])
+    try:
+        front.add_replica = lambda: (_ for _ in ()).throw(
+            RuntimeError("device OOM"))
+        sc.observe = lambda: sig(t=tm[0], live=1, fleet=1,
+                                 queue_per_replica=10.0)
+        entry = sc.tick()
+        assert entry["action"] == "hold"
+        assert "spawn failed" in entry["reason"]
+        assert reg.counter(
+            "serving/autoscaler_spawn_failed").value == 1
+        tm[0] += 1.0  # within cooldown: no new build attempt
+        assert sc.tick()["reason"] == "cooldown"
+        tm[0] += 10.0  # past cooldown: the policy may try again
+        assert "spawn failed" in sc.tick()["reason"]
+    finally:
+        front.close()
+
+
+def test_terminate_sheds_new_submissions_while_draining():
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.05),
+        num_replicas=1, sleep=NO_SLEEP)
+    _prime_service_rate(front)
+    hs = [front.generate_async([1 + i], 10) for i in range(4)]
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(front.terminate(deadline_s=20.0)))
+    t.start()
+    assert _wait_for(lambda: front._terminating)
+    with pytest.raises(ServiceUnavailable) as ei:
+        front.generate_async([9], 2)
+    assert "terminating" in str(ei.value)
+    # Retry-After rides the MEASURED drain rate (>= the floor)
+    assert ei.value.retry_after_s >= front.shed_retry_after_s
+    for h, i in zip(hs, range(4)):
+        assert h.wait(30.0) == expected([1 + i], 10)
+    t.join(timeout=30.0)
+    assert done and done[0]["deadline_met"]
+
+
+@pytest.mark.skipif(
+    threading.current_thread() is not threading.main_thread(),
+    reason="signal delivery needs the main thread")
+def test_sigterm_triggers_graceful_drain():
+    """A real SIGTERM mid-load: the installed handler drains the front
+    under the deadline — admitted requests complete, the process isn't
+    killed, and the displaced handler is restored after."""
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.005),
+        num_replicas=1, sleep=NO_SLEEP)
+    installed = front.install_grace_handlers(deadline_s=20.0)
+    assert signal.SIGTERM in installed
+    try:
+        hs = [front.generate_async([1 + i], 6) for i in range(4)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        for h, i in zip(hs, range(4)):
+            assert h.wait(30.0) == expected([1 + i], 6)
+        assert _wait_for(lambda: front._closed, 20.0)
+    finally:
+        for sig_num, old in installed.items():
+            signal.signal(sig_num, old)
+        front.close()
+
+
+# -- observation + surfaces ---------------------------------------------
+
+def test_observe_reads_front_gauges():
+    front = ServingFront(factory, num_replicas=2, sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 4)
+    try:
+        s = sc.observe()
+        assert s["live"] == 2 and s["fleet"] == 2
+        assert s["queue_depth"] == 0
+        assert s["queue_per_replica"] == 0.0
+        assert 0.0 <= s["kv_occupancy"] <= 1.0
+    finally:
+        front.close()
+
+
+def test_stats_block_and_history():
+    tm = [0.0]
+    front = ServingFront(factory, num_replicas=2, sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 4, cooldown_s=0.0,
+                           time_fn=lambda: tm[0])
+    try:
+        sc.tick()  # calm 2-replica fleet -> down
+        st = front.stats()["autoscaler"]
+        assert st["min_replicas"] == 1 and st["max_replicas"] == 4
+        assert st["scale_downs"] == 1
+        assert st["last_decision"]["action"] == "down"
+        assert st["last_decision"]["reason"]
+        assert st["ticks"] == 1
+        assert len(sc.history) == 1
+    finally:
+        front.close()
+
+
+def test_health_reports_draining_then_retired():
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.02),
+        num_replicas=2, sleep=NO_SLEEP)
+    try:
+        h = front.generate_async([1, 2], 30)
+        assert _wait_for(
+            lambda: any(r.outstanding for r in front.replicas))
+        target = max(front.replicas, key=lambda r: r.outstanding)
+        front.drain_replica(target)
+        health = front.health()
+        # draining is INTENTIONAL: still "ok", not degraded
+        assert health["status"] == "ok"
+        assert health["replicas_draining"] == 1
+        assert any(r["state"] == "draining"
+                   for r in health["replicas"])
+        assert h.wait(30.0) == expected([1, 2], 30)
+        assert _wait_for(
+            lambda: front.health()["replicas_retired"] == 1)
+        assert front.health()["status"] == "ok"
+    finally:
+        front.close()
+
+
+def test_http_health_draining_and_stats_autoscaler_block():
+    front = ServingFront(
+        lambda rid, survivors=None: FakeStepModel(delay_s=0.02),
+        num_replicas=2, sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 4)
+    server = serve_http(generator=front, port=0, block=False)
+    port = server.server_address[1]
+
+    def _get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return json.loads(r.read())
+
+    try:
+        h = front.generate_async([1, 2], 30)
+        assert _wait_for(
+            lambda: any(r.outstanding for r in front.replicas))
+        target = max(front.replicas, key=lambda r: r.outstanding)
+        front.drain_replica(target)
+        health = _get("/v2/health")
+        assert health["status"] == "ok"
+        assert health["replicas_draining"] == 1
+        stats = _get("/v2/stats")
+        blk = stats["continuous"]["autoscaler"]
+        assert blk["current_replicas"] == 2
+        assert blk["min_replicas"] == 1
+        assert blk["max_replicas"] == 4
+        assert h.wait(30.0) == expected([1, 2], 30)
+    finally:
+        server.shutdown()
+        front.close()
+
+
+def test_autoscaler_metrics_emitted():
+    tm = [0.0]
+    reg = MetricsRegistry()
+    front = ServingFront(factory, num_replicas=2, registry=reg,
+                         sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 4, cooldown_s=0.0,
+                           time_fn=lambda: tm[0], registry=reg)
+    try:
+        sc.tick()
+        assert _wait_for(lambda: len(front.retired) == 1)
+        names = set(reg._metrics)
+        assert "serving/autoscaler_replicas" in names
+        assert "serving/autoscaler_target" in names
+        assert "serving/autoscaler_down" in names
+        assert "serving/replica_drains" in names
+        assert "serving/replica_retired" in names
+        assert "serving/drain_ms" in names
+        # the retired replica's per-id gauge is dropped (ids are
+        # monotonic — dead names would otherwise accumulate forever)
+        rid = front.retired[0].replica_id
+        assert f"serving/replica/{rid}/queue_depth" not in names
+    finally:
+        front.close()
+
+
+# -- loop plumbing ------------------------------------------------------
+
+def test_start_stop_background_loop():
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 2, interval_s=0.02)
+    try:
+        sc.start()
+        assert _wait_for(lambda: sc.ticks >= 2)
+        sc.stop()
+        ticks = sc.ticks
+        time.sleep(0.1)
+        assert sc.ticks == ticks  # loop actually stopped
+    finally:
+        front.close()
+
+
+def test_front_close_stops_attached_autoscaler():
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 2, interval_s=0.02).start()
+    front.close()
+    assert sc._thread is None  # close() stopped the loop
+
+
+# -- config / CLI -------------------------------------------------------
+
+def test_autoscale_config_knobs_parse_and_validate():
+    cfg = FFConfig.from_args([
+        "--serving-min-replicas", "2", "--serving-max-replicas", "6",
+        "--autoscale-interval", "0.5", "--autoscale-cooldown", "3",
+        "--serving-slo-ttft", "0.25", "--serving-drain-timeout", "12",
+        "--admission-deadline", "2.5",
+    ])
+    assert cfg.serving_min_replicas == 2
+    assert cfg.serving_max_replicas == 6
+    assert cfg.autoscale_interval == 0.5
+    assert cfg.autoscale_cooldown == 3.0
+    assert cfg.serving_slo_ttft == 0.25
+    assert cfg.serving_drain_timeout == 12.0
+    assert cfg.admission_deadline_s == 2.5
+    # defaults: autoscaling OFF (max 0), admission control OFF
+    base = FFConfig.from_args([])
+    assert base.serving_max_replicas == 0
+    assert base.admission_deadline_s == 0.0
+
+    with pytest.raises(ValueError, match="serving_min_replicas"):
+        FFConfig(serving_min_replicas=0)
+    with pytest.raises(ValueError, match="serving_max_replicas"):
+        FFConfig(serving_min_replicas=3, serving_max_replicas=2)
+    with pytest.raises(ValueError, match="autoscale_interval"):
+        FFConfig(autoscale_interval=0)
+    with pytest.raises(ValueError, match="autoscale_cooldown"):
+        FFConfig(autoscale_cooldown=-1)
+    with pytest.raises(ValueError, match="serving_slo_ttft"):
+        FFConfig(serving_slo_ttft=-0.5)
+    with pytest.raises(ValueError, match="serving_drain_timeout"):
+        FFConfig(serving_drain_timeout=0)
+    with pytest.raises(ValueError, match="admission_deadline_s"):
+        FFConfig(admission_deadline_s=-1)
+
+
+def test_from_config_refuses_autoscaling_off():
+    """serving_max_replicas=0 is the documented 'autoscaling off'
+    contract — from_config must refuse instead of building a scaler
+    that would drain a static --serving-replicas fleet to min."""
+    cfg = FFConfig.from_args([])  # default: max 0
+    front = ServingFront(factory, num_replicas=2, sleep=NO_SLEEP)
+    try:
+        with pytest.raises(ValueError, match="autoscaling is off"):
+            ServingAutoscaler.from_config(front, cfg)
+    finally:
+        front.close()
+
+
+def test_from_config_wires_knobs():
+    cfg = FFConfig.from_args([
+        "--serving-min-replicas", "1", "--serving-max-replicas", "3",
+        "--autoscale-interval", "0.7", "--autoscale-cooldown", "2",
+        "--serving-slo-ttft", "0.4", "--serving-drain-timeout", "9",
+    ])
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    try:
+        sc = ServingAutoscaler.from_config(front, cfg)
+        assert sc.min_replicas == 1 and sc.max_replicas == 3
+        assert sc.interval_s == 0.7
+        assert sc.cooldown_s == 2.0
+        assert sc.slo_ttft_s == 0.4
+        assert sc.drain_timeout_s == 9.0
+    finally:
+        front.close()

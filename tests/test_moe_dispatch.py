@@ -55,7 +55,7 @@ def test_priority_order_is_flat_order():
     """With capacity 1, the FIRST flat (sample-major) token per expert
     wins — the reference's cumsum priority."""
     assign = jnp.asarray([[0], [0], [1], [0]])
-    slot, keep = dispatch_indices(assign, capacity=1)
+    slot, keep = dispatch_indices(assign, capacity=1, n=2)
     np.testing.assert_array_equal(np.asarray(keep), [True, False, True, False])
     assert int(slot[0]) == 0 and int(slot[2]) == 1
 
