@@ -642,6 +642,85 @@ def bench_zero_ladder(dev, on_tpu):
     return out
 
 
+def bench_multi_slice(dev, on_tpu):
+    """Multi-slice topology leg (manifest v16, docs/TOPOLOGY.md): the
+    same model searched on a flat 1x8 mesh vs a 2x4 slice hierarchy
+    with a simulated DCN ~20x slower than the effective ICI.  Reports
+    the predicted step time on each machine, the searched placement
+    (which mesh axis crosses the DCN boundary), whether the grad
+    reduction lowers hierarchically, and the per-tier predicted comm
+    bytes — asserting the searched strategy keeps the bulk of its
+    traffic intra-slice (dcn_bytes < ici_bytes)."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.fftype import ActiMode
+    from flexflow_tpu.pcg.evaluator import IncrementalEvaluator
+    from flexflow_tpu.pcg.unity import UnitySearch
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+    from flexflow_tpu.sim.simulator import OpCostModel, Simulator
+    from flexflow_tpu.topology.hierarchy import SliceHierarchy
+
+    leg = MANIFEST["legs"]["multi_slice"]
+    batch, hidden = leg["batch"], leg["hidden"]
+    slices, dcn_bw = leg["slices"], leg["dcn_bandwidth"]
+    n = leg["devices"]
+    per_slice = n // slices
+    print("bench[multi_slice]: searching flat vs hierarchy",
+          file=sys.stderr)
+
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor([batch, hidden], name="x")
+    t = ff.dense(x, hidden, activation=ActiMode.RELU)
+    t = ff.dense(t, hidden, activation=ActiMode.RELU)
+    t = ff.dense(t, 8)
+    ff.softmax(t)
+
+    out = {
+        "workload": f"{slices}x{per_slice} hierarchy vs 1x{n} flat, "
+                    f"MLP b{batch} h{hidden}, unity search "
+                    f"(simulator-driven; DCN {dcn_bw / 1e9:g} GB/s)",
+        "machines": {},
+    }
+    machines = {
+        "flat_1x8": TpuPodModel(topology=(n,)),
+        f"hier_{slices}x{per_slice}": SliceHierarchy(
+            topology=(per_slice,), slices=slices,
+            dcn_bw_per_host=dcn_bw, dcn_latency=leg["dcn_latency"],
+        ),
+    }
+    for name, machine in machines.items():
+        search = UnitySearch(ff.layers, n, machine, OpCostModel(machine),
+                             enable_pipeline=False)
+        best = search.optimize()
+        res = IncrementalEvaluator(ff.layers, Simulator(machine)).evaluate(
+            best
+        )
+        tiers = res.comm_tiers
+        entry = {
+            "mesh_axes": dict(best.mesh_axes),
+            "predicted_step_ms": round(res.total_time * 1e3, 4),
+            "placement": best.search_stats["placement"],
+            "hierarchical_reduction":
+                best.search_stats["hierarchical_reduction"],
+            "ici_comm_kb": round(tiers["ici_bytes"] / 1024.0, 2),
+            "dcn_comm_kb": round(tiers["dcn_bytes"] / 1024.0, 2),
+        }
+        out["machines"][name] = entry
+    hier = out["machines"][f"hier_{slices}x{per_slice}"]
+    flat = out["machines"]["flat_1x8"]
+    # the hierarchy-searched winner keeps the bulk of its comm on ICI
+    out["dp_traffic_intra_slice"] = bool(
+        hier["dcn_comm_kb"] < hier["ici_comm_kb"]
+    )
+    assert out["dp_traffic_intra_slice"], (
+        "hierarchy search left more predicted bytes on DCN than ICI: "
+        f"{hier}"
+    )
+    out["hier_vs_flat_predicted"] = round(
+        hier["predicted_step_ms"] / max(flat["predicted_step_ms"], 1e-9), 3
+    )
+    return out
+
+
 def _fsck_verdict(local_dir=None, remote_uri=None):
     """Post-bench verification (manifest v15): run the offline
     two-tier checkpoint verifier (tools/checkpoint_fsck.py) over the
@@ -1527,6 +1606,8 @@ def main():
     cold_start = bench_cold_start(dev, on_tpu)
     gc.collect()
     host_loss = bench_host_loss(dev, on_tpu)
+    gc.collect()
+    multi_slice = bench_multi_slice(dev, on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
@@ -1549,7 +1630,8 @@ def main():
                  "checkpoint": ckpt, "serving": serving,
                  "serving_resilience": serving_resilience,
                  "autoscale": autoscale,
-                 "cold_start": cold_start, "host_loss": host_loss},
+                 "cold_start": cold_start, "host_loss": host_loss,
+                 "multi_slice": multi_slice},
     }
     print(json.dumps(result))
 

@@ -126,6 +126,21 @@ class FFConfig:
     #    --simulator-segment-size)
     machine_model_version: int = 0
     machine_model_file: Optional[str] = None
+    # -- multi-slice topology (topology/, docs/TOPOLOGY.md): slices > 1
+    #    models a pod of identical slices — fast ICI inside each slice,
+    #    slow DCN between.  The machine model becomes a SliceHierarchy,
+    #    *placement* (which mesh axis spans the DCN boundary) becomes a
+    #    searched strategy dimension, and the executor lowers the
+    #    cross-slice grad reduction to the hierarchical form on a
+    #    two-level mesh.  1 slice (the default) is exactly the flat
+    #    pre-topology behavior — same costs, and the slice/DCN knobs
+    #    never enter a flat run's store key.
+    slices: int = 1
+    dcn_bandwidth: float = 25e9   # bytes/s per host across slices
+    dcn_latency: float = 10e-6    # seconds per cross-slice hop
+    # per-slice ICI torus shape, e.g. "4x4" or "2,2,2"; None = a 1-D
+    # ring of num_devices/slices chips
+    slice_topology: Optional[str] = None
     # bounds per-region search enumeration (its reference role: cap
     # per-segment simulation work); can only lower the built-in cap
     simulator_segment_size: int = 16777216
@@ -373,6 +388,20 @@ class FFConfig:
             raise ValueError(
                 f"barrier_timeout must be > 0, got {self.barrier_timeout}"
             )
+        if self.slices < 1:
+            raise ValueError(f"slices must be >= 1, got {self.slices}")
+        if self.dcn_bandwidth <= 0:
+            raise ValueError(
+                f"dcn_bandwidth must be > 0 bytes/s, got {self.dcn_bandwidth}"
+            )
+        if self.dcn_latency < 0:
+            raise ValueError(
+                f"dcn_latency must be >= 0 seconds, got {self.dcn_latency}"
+            )
+        if self.slice_topology is not None:
+            from .topology.hierarchy import parse_slice_topology
+
+            parse_slice_topology(self.slice_topology)  # raises on bad spec
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(
                 f"zero_stage must be one of (0, 1, 2, 3), "
@@ -475,6 +504,13 @@ class FFConfig:
         p.add_argument("--machine-model-version", type=int, default=0)
         p.add_argument("--machine-model-file", type=str, default=None)
         p.add_argument("--simulator-segment-size", type=int, default=16777216)
+        p.add_argument("--slices", dest="slices", type=int, default=1)
+        p.add_argument("--dcn-bandwidth", dest="dcn_bandwidth", type=float,
+                       default=25e9)
+        p.add_argument("--dcn-latency", dest="dcn_latency", type=float,
+                       default=10e-6)
+        p.add_argument("--slice-topology", dest="slice_topology", type=str,
+                       default=None)
         # default None so an EXPLICIT --zero-stage 0 is distinguishable
         # from the default: the explicit stage wins over the deprecated
         # flag below (including 0), the shim only fills the default
@@ -597,6 +633,10 @@ class FFConfig:
             machine_model_version=args.machine_model_version,
             machine_model_file=args.machine_model_file,
             simulator_segment_size=args.simulator_segment_size,
+            slices=args.slices,
+            dcn_bandwidth=args.dcn_bandwidth,
+            dcn_latency=args.dcn_latency,
+            slice_topology=args.slice_topology,
             zero_stage=(args.zero_stage if args.zero_stage is not None
                         else (1 if args.weight_update_sharding else 0)),
             weight_update_sharding=(args.weight_update_sharding

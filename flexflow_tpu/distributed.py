@@ -15,7 +15,6 @@ primitive on the path to pod-scale placement (docs/RESILIENCE.md
 """
 from __future__ import annotations
 
-import json
 import logging
 import os
 import time
@@ -136,8 +135,14 @@ def preemption_barrier(
     `barrier/<run_id>/` before training starts — see
     `clear_preemption_barrier` — and `run_id` must be unique per
     logical run on a shared blob root.
+
+    Implementation: one caller of the generalized cross-slice
+    rendezvous (topology/rendezvous.py post_and_agree — MAX reduction:
+    the newest state any host holds; laggards run deterministically
+    forward, never backward), under this barrier's legacy
+    `barrier/<run_id>/` key layout.
     """
-    from .store.blobstore import BlobStoreError
+    from .topology.rendezvous import post_and_agree
 
     if host_id is None or num_hosts is None:
         import jax
@@ -146,42 +151,12 @@ def preemption_barrier(
         num_hosts = jax.process_count() if num_hosts is None else num_hosts
     if num_hosts <= 1:
         return int(step)
-    prefix = f"barrier/{run_id}/"
-    key = f"{prefix}host_{host_id:05d}"
-    payload = json.dumps({"host": int(host_id), "step": int(step)}).encode()
-    try:
-        blob.put(key, payload)
-    except BlobStoreError as e:
-        _log.warning(
-            "preemption barrier post failed (%s); committing step %d "
-            "without cross-host agreement", e, step,
-        )
-        return int(step)
-    deadline = time.monotonic() + timeout_s
-    agreed = int(step)
-    while True:
-        posts = []
-        try:
-            for k in blob.list(prefix):
-                try:
-                    posts.append(int(json.loads(blob.get(k))["step"]))
-                except (BlobStoreError, ValueError, KeyError, TypeError):
-                    continue  # a peer's post mid-write: next poll sees it
-        except BlobStoreError:
-            posts = []
-        if posts:
-            # max: the newest state any host holds; laggards run
-            # forward to it (never backward — state can't rewind)
-            agreed = max(posts + [int(step)])
-        if len(posts) >= num_hosts:
-            return agreed
-        if time.monotonic() >= deadline:
-            _log.warning(
-                "preemption barrier timed out with %d/%d hosts posted; "
-                "committing step %d", len(posts), num_hosts, agreed,
-            )
-            return agreed
-        sleep(poll_s)
+    return post_and_agree(
+        blob, run_id, "preemption", int(step),
+        host_id=host_id, num_hosts=num_hosts, reduce=max,
+        timeout_s=timeout_s, poll_s=poll_s, sleep=sleep,
+        prefix=f"barrier/{run_id}/", field="step",
+    )
 
 
 def clear_preemption_barrier(blob, run_id: str) -> int:

@@ -91,6 +91,7 @@ class GraphExecutor:
         pipeline_plan=None,
         wus_axis: Optional[str] = None,
         zero_stage: int = 0,
+        hier_axis: Optional[str] = None,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -126,6 +127,23 @@ class GraphExecutor:
         # only wus_axis: that contract WAS ZeRO-1
         self.zero_stage = (
             max(1, int(zero_stage)) if self.wus_axis is not None else 0
+        )
+        # multi-slice hierarchical grad reduction (topology/,
+        # docs/TOPOLOGY.md): on a two-level mesh whose placement axis
+        # has an intra-slice remainder, `hier_axis` names that
+        # remainder.  With the ZeRO ladder off (no wus axis), the
+        # update wrapper still re-specs the grads through the scattered
+        # layout over it — XLA SPMD then lowers the cross-slice psum as
+        # reduce-scatter over ICI, all-reduce of the shard over DCN,
+        # all-gather over ICI — bit-identical to the flat all-reduce.
+        # With the ladder ON, the wus machinery over the (now
+        # intra-slice) wus axis already produces the hierarchical form,
+        # so hier_axis is only consulted when wus is inactive.
+        self.hier_axis = (
+            hier_axis
+            if hier_axis and mesh_sizes.get(hier_axis, 1) > 1
+            and self.wus_axis is None
+            else None
         )
         for op in self.order:
             op._mesh = mesh  # ops with shard_map lowerings (ring attention)
@@ -265,9 +283,17 @@ class GraphExecutor:
         dim keep their strategy sharding — they fall back to the
         replicated update individually.  Mirrors weight_shardings()'s
         pytree structure exactly (same underlying walk)."""
+        return self._scatter_shardings(self.wus_axis)
+
+    def _scatter_shardings(self, axis: str
+                           ) -> Dict[str, Dict[str, NamedSharding]]:
+        """Every trainable leaf's strategy sharding with `axis` folded
+        into its first free, evenly-divisible logical dim (the shared
+        parallel/zero.py axis-picking) — the wus layout when `axis` is
+        the wus axis, the hierarchical-reduction scatter layout when it
+        is the intra-slice remainder of a cross-slice placement."""
         from .parallel.zero import shard_update_spec
 
-        axis = self.wus_axis
         size = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[axis]
 
         def make(spec, shape):
@@ -722,7 +748,29 @@ class GraphExecutor:
         replicated update — all-reduce == reduce-scatter + all-gather —
         with 1/N of the update compute and slot HBM per device."""
         if self.wus_axis is None:
-            return opt.update
+            if self.hier_axis is None:
+                return opt.update
+            # multi-slice, ladder off: synthesize the HIERARCHICAL grad
+            # reduction alone.  Constraining the grads through the
+            # scattered layout over the intra-slice axis and straight
+            # back re-associates the cross-slice psum as
+            # RS(ICI) -> AR(DCN on the 1/N shard) -> AG(ICI); the
+            # update itself stays the plain replicated optimizer pass.
+            # Bit-identical to the flat all-reduce (the same
+            # re-association the ZeRO ladder's tests pin down).
+            scat = self._scatter_shardings(self.hier_axis)
+            out_sh = self.weight_shardings()
+
+            def hier_update(weights, grads, state):
+                grads = jax.tree.map(
+                    jax.lax.with_sharding_constraint, grads, scat
+                )
+                grads = jax.tree.map(
+                    jax.lax.with_sharding_constraint, grads, out_sh
+                )
+                return opt.update(weights, grads, state)
+
+            return hier_update
         wus = self.wus_shardings()
         out_sh = self.master_weight_shardings()
 

@@ -773,8 +773,77 @@ class FFModel:
         self.operators = cancel_all_inverse_parallel_ops(
             apply_strategy(compiled_frontend, strategy)
         )
-        assign_views(self.operators, strategy.mesh_axes)
-        self.mesh = make_mesh(strategy.mesh_axes, devices)
+        # multi-slice execution (topology/, docs/TOPOLOGY.md): lower the
+        # strategy's placement (which mesh axis spans the DCN boundary)
+        # to a two-level execution mesh — a leading slice dim plus the
+        # placement axis's intra-slice remainder — so the hierarchical
+        # grad-reduction re-specs can name the intra axis and the
+        # C-order device layout aligns axes with physical slices.
+        # Search-facing surfaces (strategy.mesh_axes, store keys,
+        # simulator costs) keep the UNEXPANDED axes; only view
+        # assignment and the jax Mesh see the expansion.
+        exec_axes = strategy.mesh_axes
+        hier_axis = None
+        if cfg.slices > 1 and not strategy.pipeline:
+            from .topology.hierarchy import (
+                SLICE_AXIS,
+                expand_mesh_axes,
+                legal_placements,
+                resolve_placement,
+            )
+
+            if num_devices % cfg.slices:
+                # a degraded mesh (elastic recompile on survivors) may
+                # not split into equal slices: execute flat rather
+                # than failing recovery
+                _log.warning(
+                    "%d devices do not split into %d slices; executing "
+                    "flat", num_devices, cfg.slices,
+                )
+            elif SLICE_AXIS in strategy.mesh_axes:
+                _log.warning(
+                    "mesh axis %r collides with the reserved slice "
+                    "axis; executing flat (placement-less)", SLICE_AXIS,
+                )
+            else:
+                placement = strategy.placement
+                if placement is not None and placement not in \
+                        legal_placements(strategy.mesh_axes, cfg.slices):
+                    # imported/exported strategies can carry a placement
+                    # from a different slice config: degrade to the
+                    # default like the simulator and MCMC do, never
+                    # crash compile over it
+                    _log.warning(
+                        "strategy placement %r is not legal for mesh %s "
+                        "with %d slices; using the default placement",
+                        placement, dict(strategy.mesh_axes), cfg.slices,
+                    )
+                    placement = None
+                if placement is None:
+                    placement = resolve_placement(
+                        strategy.mesh_axes, cfg.slices
+                    )
+                if placement is None:
+                    _log.warning(
+                        "no mesh axis of %s is divisible by %d slices; "
+                        "executing flat (cross-slice collectives "
+                        "unsynthesized)", dict(strategy.mesh_axes),
+                        cfg.slices,
+                    )
+                else:
+                    exec_axes, hier_axis = expand_mesh_axes(
+                        strategy.mesh_axes, cfg.slices, placement
+                    )
+                    _log.info(
+                        "multi-slice execution: placement=%s over %d "
+                        "slices, exec mesh %s%s", placement, cfg.slices,
+                        exec_axes,
+                        (f" (hierarchical reduction over {hier_axis!r})"
+                         if hier_axis else ""),
+                    )
+        self._exec_axes = exec_axes
+        assign_views(self.operators, exec_axes)
+        self.mesh = make_mesh(exec_axes, devices)
 
         pipeline_plan = None
         if strategy.pipeline:
@@ -805,6 +874,7 @@ class FFModel:
             pipeline_plan=pipeline_plan,
             wus_axis=(cfg.wus_axis if zero_stage >= 1 else None),
             zero_stage=zero_stage,
+            hier_axis=hier_axis,
         )
         # per-leaf fallback observability: parallel/zero.py falls back
         # to the replicated update leaf-by-leaf — count it instead of

@@ -37,6 +37,8 @@ def predicted_step(ff, segment_costs: Optional[
     return sim.simulate(
         ff.operators, ff.strategy.mesh_axes, training=True,
         segment_costs=segment_costs,
+        zero_stage=ff.strategy.zero_stage,
+        placement=getattr(ff.strategy, "placement", None),
     )
 
 
@@ -80,6 +82,16 @@ def fidelity_record(
         "calibrated": bool(segment_costs),
         "backend": str(ff.mesh.devices.flat[0].platform),
     }
+    # per-tier predicted comm split (topology subsystem): zero on flat
+    # meshes; on a multi-slice run this is the ICI-vs-DCN decomposition
+    # the placement search priced the winner with (docs/TOPOLOGY.md)
+    tiers = getattr(res, "comm_tiers", None)
+    if tiers:
+        record["predicted_ici_ms"] = round(tiers.get("ici_time", 0.0) * 1e3, 4)
+        record["predicted_dcn_ms"] = round(tiers.get("dcn_time", 0.0) * 1e3, 4)
+        record["predicted_ici_bytes"] = int(tiers.get("ici_bytes", 0.0))
+        record["predicted_dcn_bytes"] = int(tiers.get("dcn_bytes", 0.0))
+        record["placement"] = getattr(ff.strategy, "placement", None)
     if segment_costs:
         regions: List[Dict] = [
             {"ops": len(guids), "measured_ms": round(cost * 1e3, 4)}
@@ -119,4 +131,15 @@ def report_fidelity(ff, measured_step_s: float, steps_measured: int = 0,
             tel.metrics.gauge("fidelity/predicted_vs_measured").set(
                 record["predicted_vs_measured"]
             )
+        # per-tier comm-bytes telemetry (docs/TOPOLOGY.md): counters so
+        # multi-run drains accumulate total predicted traffic per tier
+        if "predicted_ici_bytes" in record:
+            tel.metrics.counter("comm/ici_bytes").inc(
+                record["predicted_ici_bytes"]
+            )
+            tel.metrics.counter("comm/dcn_bytes").inc(
+                record["predicted_dcn_bytes"]
+            )
+            tel.metrics.gauge("comm/ici_ms").set(record["predicted_ici_ms"])
+            tel.metrics.gauge("comm/dcn_ms").set(record["predicted_dcn_ms"])
     return record

@@ -78,6 +78,7 @@ def strategy_signature(strategy: Strategy) -> Tuple:
         _freeze(strategy.rewrites),
         _freeze(strategy.pipeline),
         getattr(strategy, "zero_stage", None),
+        getattr(strategy, "placement", None),
     )
 
 
@@ -286,21 +287,25 @@ class IncrementalEvaluator:
             order = graph.topo_order()
             self.stats.full_evals += 1
         mesh_axes = strategy.mesh_axes
-        # the strategy's search-chosen ZeRO stage overrides the
-        # simulator default per evaluation; the applied graph does not
-        # depend on the stage, so delta bases stay valid across stages
-        # (OpTerms are cached per stage)
+        # the strategy's search-chosen ZeRO stage and multi-slice
+        # placement override the simulator defaults per evaluation; the
+        # applied graph depends on neither, so delta bases stay valid
+        # across both (OpTerms are cached per stage AND placement)
         stage = getattr(strategy, "zero_stage", None)
+        placement = getattr(strategy, "placement", None)
         if self.training and not self.sim.remat:
             memory_fn = lambda: self.sim.memory_from_terms(  # noqa: E731
-                order, mesh_axes, self.training, zero_stage=stage
+                order, mesh_axes, self.training, zero_stage=stage,
+                placement=placement,
             )
         else:
             memory_fn = lambda: self.sim.per_device_memory(  # noqa: E731
-                graph, self.training, mesh_axes=mesh_axes, zero_stage=stage
+                graph, self.training, mesh_axes=mesh_axes, zero_stage=stage,
+                placement=placement,
             )
         res = self.sim.simulate_ops(order, mesh_axes, training=self.training,
-                                    memory_fn=memory_fn, zero_stage=stage)
+                                    memory_fn=memory_fn, zero_stage=stage,
+                                    placement=placement)
         res.ops = order  # applied op sequence, for callers needing shapes
         self._base = _AppliedState(
             mesh_items=tuple(mesh_axes.items()),

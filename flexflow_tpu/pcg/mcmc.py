@@ -146,6 +146,15 @@ class MCMCSearch:
         # it); None also disables moves but leaves candidates at
         # zero_stage=None, costing under the simulator's own stage.
         self.zero_stages = tuple(zero_stages) if zero_stages else None
+        # multi-slice hierarchy (topology/, docs/TOPOLOGY.md): when the
+        # machine is a SliceHierarchy the chain gains a PLACEMENT move —
+        # re-pick which mesh axis spans the DCN boundary.  Flat machines
+        # keep the exact pre-topology move distribution.
+        machine = self.simulator.machine
+        self.slices = max(1, int(getattr(machine, "slices", 1) or 1))
+        self._hier = (
+            self.slices > 1 and hasattr(machine, "collective_cost")
+        )
         self.candidates = find_candidates(graph)
         has_experts = any(c.kind == "expert" for c in self.candidates)
         self.factorizations = _factorizations(
@@ -168,9 +177,19 @@ class MCMCSearch:
 
     def _build(self, dp: int, tp: int, ep: int,
                flags: Dict[str, bool],
-               zero_stage: Optional[int] = None) -> Strategy:
-        s = Strategy(mesh_axes=self._mesh_axes(dp, tp, ep),
-                     zero_stage=zero_stage)
+               zero_stage: Optional[int] = None,
+               placement: Optional[str] = None) -> Strategy:
+        mesh_axes = self._mesh_axes(dp, tp, ep)
+        if placement is not None:
+            # a factorization move can strand the placement on an axis
+            # the new mesh lacks (or that the slices no longer divide):
+            # normalize to None = the shared resolve_placement default
+            from ..topology.hierarchy import legal_placements
+
+            if placement not in legal_placements(mesh_axes, self.slices):
+                placement = None
+        s = Strategy(mesh_axes=mesh_axes, zero_stage=zero_stage,
+                     placement=placement)
         if dp > 1:
             s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
         # Megatron column->row pairing: a channel(tp)-sharded linear
@@ -237,24 +256,41 @@ class MCMCSearch:
             if self.zero_stages and len(self.zero_stages) > 1 else None
         )
         stage = self.zero_stages[0] if self.zero_stages else None
-        current = self._build(dp, tp, ep, flags, stage)
+        placement = None  # the shared resolve_placement default
+        current = self._build(dp, tp, ep, flags, stage, placement)
         current_cost = self.evaluate(current)
         best, best_cost = current, current_cost
         self.best_iteration = -1  # evals needed to reach the winner
-        state = (dp, tp, ep, dict(flags), stage)
+        state = (dp, tp, ep, dict(flags), stage, placement)
         for it in range(self.budget):
             ndp, ntp, nep, nflags = state[0], state[1], state[2], dict(state[3])
-            nstage = state[4]
+            nstage, nplacement = state[4], state[5]
             move = self.rng.random()
-            if stage_moves is not None and move < 0.15:
+            # the placement move carves its window ABOVE the existing
+            # thresholds (off shifts them) so the stage/factorization
+            # move probabilities are unchanged on hierarchy machines —
+            # and flat machines keep the exact historical distribution
+            off = 0.12 if self._hier else 0.0
+            if self._hier and move < off:
+                # placement move: re-pick the mesh axis spanning the
+                # DCN boundary (sharding unchanged — the evaluator
+                # re-sums cached OpTerms under the new tiers, cheap
+                # like the stage move).  None = the default placement.
+                from ..topology.hierarchy import legal_placements
+
+                mesh = self._mesh_axes(ndp, ntp, nep)
+                nplacement = self.rng.choice(
+                    [None] + legal_placements(mesh, self.slices)
+                )
+            elif stage_moves is not None and move < off + 0.15:
                 # ZeRO-stage move: re-rung the ladder (the candidate's
                 # sharding is unchanged, so the evaluator re-sums
                 # cached OpTerms under the new stage — a cheap move)
                 nstage = self.rng.choice(stage_moves)
-            elif move < 0.25 or not self.candidates:
+            elif move < off + 0.25 or not self.candidates:
                 ndp, ntp, nep = self.rng.choice(self.factorizations)
             elif (self.propagate
-                  and move < 0.25 + 0.75 * self.propagation_chance):
+                  and move < off + 0.25 + 0.75 * self.propagation_chance):
                 # propagate move (reference FFModel::propagate,
                 # model.cc:3180-3258): spread a randomly selected op's
                 # CURRENT config to a walk of adoptable neighbors —
@@ -278,10 +314,10 @@ class MCMCSearch:
                 c = self.rng.choice(self.candidates)
                 nflags[c.name] = not nflags.get(c.name, False)
             if ((ndp, ntp, nep) == state[:3] and nflags == state[3]
-                    and nstage == state[4]):
+                    and nstage == state[4] and nplacement == state[5]):
                 continue  # no-op move (e.g. propagate with no peers to
                 # change): don't burn a simulator eval on it
-            cand = self._build(ndp, ntp, nep, nflags, nstage)
+            cand = self._build(ndp, ntp, nep, nflags, nstage, nplacement)
             cost = self.evaluate(cand)
             self.history.append((it, cost))
             if cost < current_cost or (
@@ -290,13 +326,22 @@ class MCMCSearch:
                 < math.exp(-self.alpha * (cost - current_cost) / max(1e-12, current_cost))
             ):
                 current, current_cost = cand, cost
-                state = (ndp, ntp, nep, nflags, nstage)
+                state = (ndp, ntp, nep, nflags, nstage, nplacement)
                 if cost < best_cost:
                     best, best_cost = cand, cost
                     self.best_iteration = it
         # search observability: counters ride on the returned strategy
         # so benchmarks and callers can track cache effectiveness
         best.search_stats = self.evaluator.stats.as_dict()
+        # the winner's multi-slice placement ("" on flat machines) and
+        # whether its grad reduction lowers hierarchically — gated on
+        # _hier: a slices>1 TpuPodModel that is NOT a SliceHierarchy
+        # never searched placements and must not claim one
+        from ..topology.hierarchy import placement_stats
+
+        best.search_stats.update(placement_stats(
+            best, self.slices if self._hier else 1
+        ))
         # underlying cache layers (term decomposition + op-cost cache)
         best.search_stats["term_hits"] = self.simulator.term_hits
         best.search_stats["term_misses"] = self.simulator.term_misses

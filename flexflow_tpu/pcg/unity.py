@@ -179,6 +179,15 @@ class UnitySearch:
                               compute_scale=compute_scale,
                               zero_stage=self.zero_stage,
                               wus_axis=wus_axis)
+        # multi-slice hierarchy (topology/, docs/TOPOLOGY.md): each
+        # collected candidate is additionally re-scored at every legal
+        # placement (which mesh axis spans the DCN boundary) through
+        # the memoized evaluator — the exact shape of the ZeRO-stage
+        # variants.  Flat machines skip the expansion entirely.
+        self.slices = max(1, int(getattr(machine, "slices", 1) or 1))
+        self._hier = (
+            self.slices > 1 and hasattr(machine, "collective_cost")
+        )
         # memoized whole-strategy evaluator per (possibly rewritten)
         # graph variant: the sp/sample candidate families and the
         # memory-aware lambda binary search revisit identical strategies
@@ -829,11 +838,42 @@ class UnitySearch:
             )))
         return out
 
+    def _placement_variants(self, strategy: Strategy, time: float,
+                            mem: int) -> List[Tuple[Strategy, float, int]]:
+        """The candidate re-scored at every legal multi-slice placement:
+        [(strategy', time', mem')].  The default placement keeps the
+        caller's analytic (time, mem); alternatives correct them by the
+        memoized evaluator's placement delta (the applied graph is
+        placement-invariant, so the delta is exactly the tier re-cost).
+        Flat machines return the candidate unchanged."""
+        out = [(strategy, time, mem)]
+        if not self._hier or strategy.pipeline:
+            return out
+        from ..topology.hierarchy import legal_placements, resolve_placement
+
+        legal = legal_placements(strategy.mesh_axes, self.slices)
+        default = resolve_placement(strategy.mesh_axes, self.slices)
+        extra = [p for p in legal if p != default]
+        if not extra:
+            return out
+        base = self._evaluator().evaluate(strategy)
+        if base is None:
+            return out
+        bt, bm = base.total_time, base.per_device_memory
+        for p in extra:
+            cand = dataclasses.replace(strategy, placement=p)
+            res = self._evaluator().evaluate(cand)
+            if res is None:
+                continue
+            out.append((cand, time + res.total_time - bt,
+                        mem + res.per_device_memory - bm))
+        return out
+
     def _optimize_graph(self, lam: float, collector: List[Tuple]):
         """Append every valid (obj, strategy, graph) for the CURRENT
         self.graph to collector (mesh factorizations, sp, pp) — each
         non-pipeline candidate expanded across the allowed ZeRO
-        stages."""
+        stages and (on hierarchy machines) the legal placements."""
         from ..logger import search_logger as slog
 
         has_moe = any(op.op_type == OperatorType.GROUP_BY for op in self.graph.ops)
@@ -841,15 +881,19 @@ class UnitySearch:
 
         def collect(strategy, time, mem, label):
             nonlocal best_obj
-            for cand, obj in self._stage_variants(strategy, time, mem, lam):
-                slog.debug(
-                    "candidate %s%s: obj=%.3g%s", label,
-                    (f" zero{cand.zero_stage}"
-                     if cand.zero_stage is not None else ""),
-                    obj, " *best*" if obj < best_obj else "",
-                )
-                best_obj = min(best_obj, obj)
-                collector.append((obj, cand, self.graph))
+            for pcand, pt, pm in self._placement_variants(strategy, time,
+                                                          mem):
+                for cand, obj in self._stage_variants(pcand, pt, pm, lam):
+                    slog.debug(
+                        "candidate %s%s%s: obj=%.3g%s", label,
+                        (f" zero{cand.zero_stage}"
+                         if cand.zero_stage is not None else ""),
+                        (f" place={cand.placement}"
+                         if cand.placement is not None else ""),
+                        obj, " *best*" if obj < best_obj else "",
+                    )
+                    best_obj = min(best_obj, obj)
+                    collector.append((obj, cand, self.graph))
 
         for dp, tp, ep in _factorizations(self.n, allow_expert=has_moe):
             for mesh_axes in self._mesh_variants(dp, tp, ep):
@@ -937,19 +981,23 @@ class UnitySearch:
                     return 1.0 / _s if op.guid in _g else 1.0
 
             # the event simulator models none of the ladder's stage
-            # terms (sharded update, opt_xfer, per-layer gather_xfer),
-            # while the memory below IS stage-aware — uncorrected, the
-            # highest stage of a mesh would always win the rerank (same
-            # event time, less memory).  Correct the makespan with the
-            # analytic stage delta from the memoized evaluator, the
-            # same delta _stage_variants priced the candidate with.
-            if (strategy.zero_stage is not None
-                    and strategy.zero_stage != self.zero_stage):
+            # terms (sharded update, opt_xfer, per-layer gather_xfer)
+            # nor the hierarchy's tiered comm, while the memory below
+            # IS stage/placement-aware — uncorrected, the highest stage
+            # of a mesh would always win the rerank (same event time,
+            # less memory).  Correct the makespan with the analytic
+            # stage+placement delta from the memoized evaluator, the
+            # same delta the variant expansions priced the candidate
+            # with.
+            if ((strategy.zero_stage is not None
+                    and strategy.zero_stage != self.zero_stage)
+                    or strategy.placement is not None):
                 prev = self.graph
                 try:
                     self._set_graph(graph)
                     rb = self._evaluator().evaluate(dataclasses.replace(
-                        strategy, zero_stage=self.zero_stage))
+                        strategy, zero_stage=self.zero_stage,
+                        placement=None))
                     rs = self._evaluator().evaluate(strategy)
                 finally:
                     self._set_graph(prev)
@@ -958,7 +1006,8 @@ class UnitySearch:
             mem = self._sim.per_device_memory(g, training=True,
                                               op_scale=op_scale,
                                               mesh_axes=strategy.mesh_axes,
-                                              zero_stage=strategy.zero_stage)
+                                              zero_stage=strategy.zero_stage,
+                                              placement=strategy.placement)
             return self._objective(time, mem, lam)
         except Exception as e:  # noqa: BLE001
             slog.debug(
@@ -995,15 +1044,16 @@ class UnitySearch:
             # contention-aware makespan (reference: candidates are
             # ultimately judged by simulate_runtime, not the analytic
             # estimators)
-            # distinct (mesh, zero stage) only — pp candidates
-            # differing solely in microbatch count would otherwise
-            # crowd the top-K, while stage variants of one mesh are
-            # genuinely different memory/comm trade-offs
+            # distinct (mesh, zero stage, placement) only — pp
+            # candidates differing solely in microbatch count would
+            # otherwise crowd the top-K, while stage/placement variants
+            # of one mesh are genuinely different memory/comm trade-offs
             seen_keys = set()
             top: List[Tuple] = []
             for c in collector:
                 key = (tuple(sorted(c[1].mesh_axes.items())),
-                       c[1].pipeline is None, c[1].zero_stage)
+                       c[1].pipeline is None, c[1].zero_stage,
+                       c[1].placement)
                 if key in seen_keys:
                     continue
                 seen_keys.add(key)
@@ -1030,8 +1080,16 @@ class UnitySearch:
         a registry wired they also land in run telemetry."""
         from ..logger import search_logger as slog
         from ..obs.metrics import emit_counters
+        from ..topology.hierarchy import placement_stats
 
         strategy.search_stats = self.eval_stats()
+        # the winner's multi-slice placement ("" on flat machines) and
+        # whether its grad reduction lowers hierarchically — gated on
+        # _hier: a slices>1 TpuPodModel that is NOT a SliceHierarchy
+        # never searched placements and must not claim one
+        strategy.search_stats.update(placement_stats(
+            strategy, self.slices if self._hier else 1
+        ))
         emit_counters(slog, "unity eval stats", strategy.search_stats,
                       registry=self.registry, group="search/unity")
         return strategy
@@ -1323,7 +1381,8 @@ class UnitySearch:
                 return 1.0 / _s if op.guid in _g else 1.0
 
         return sim.per_device_memory(g, training=True, op_scale=op_scale,
-                                     mesh_axes=strategy.mesh_axes)
+                                     mesh_axes=strategy.mesh_axes,
+                                     placement=strategy.placement)
 
 
 def _sync_mode(pst) -> str:

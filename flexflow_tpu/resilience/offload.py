@@ -46,7 +46,7 @@ import json
 import logging
 import re
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -75,6 +75,26 @@ _STEP_KEY_RE = re.compile(r"step_(\d{8})/manifest\.json$")
 
 class RemoteVerifyError(RuntimeError):
     """A mirrored step's remotely-read bytes do not match its manifest."""
+
+
+#: delta-mirror chain bound: after this many consecutive delta steps the
+#: next mirror re-uploads every leaf, so a restore never chases more
+#: than MAX_DELTA_CHAIN base fetches and prune's base-retention set
+#: stays small
+MAX_DELTA_CHAIN = 4
+
+
+class UploadReport:
+    """What upload_step actually moved: the per-leaf delta accounting
+    (docs/RESILIENCE.md "Delta mirror")."""
+
+    __slots__ = ("leaves_skipped", "bytes_uploaded", "manifest")
+
+    def __init__(self, leaves_skipped: int, bytes_uploaded: int,
+                 manifest: Dict):
+        self.leaves_skipped = leaves_skipped
+        self.bytes_uploaded = bytes_uploaded
+        self.manifest = manifest
 
 
 class RemoteCheckpointStore:
@@ -147,20 +167,86 @@ class RemoteCheckpointStore:
         )
 
     # -- upload / verify -------------------------------------------------
-    def upload_step(self, step: int, files: Dict[str, bytes]) -> None:
+    def _delta_files(self, step: int, files: Dict[str, bytes],
+                     base_step: int, base_manifest: Dict,
+                     ) -> Tuple[Dict[str, bytes], int]:
+        """Rewrite one step's upload payload as a per-leaf delta against
+        an already-mirrored base: leaves whose manifest crc32 matches
+        the base's are dropped from state.npz and annotated in the
+        manifest with {"base_step": N} — restore/verify resolve them
+        through the base (download_step reassembles the full npz).
+        Returns (files', leaves_skipped); returns the input unchanged
+        when nothing is skippable or the delta chain is at its bound."""
+        try:
+            manifest = json.loads(files["manifest.json"])
+            base_leaves = base_manifest.get("leaves", {})
+            base_depth = int(base_manifest.get("delta_depth", 0))
+        except (ValueError, TypeError, AttributeError):
+            return files, 0
+        if base_depth >= MAX_DELTA_CHAIN:
+            return files, 0  # re-anchor: full upload bounds the chain
+        leaves = manifest.get("leaves")
+        if not isinstance(leaves, dict):
+            return files, 0
+        unchanged = [
+            k for k, spec in leaves.items()
+            if isinstance(base_leaves.get(k), dict)
+            and base_leaves[k].get("crc32") == spec.get("crc32")
+        ]
+        if not unchanged:
+            return files, 0
+        try:
+            with np.load(io.BytesIO(files["state.npz"])) as data:
+                kept = {
+                    k: data[k] for k in data.files if k not in set(unchanged)
+                }
+        except Exception:  # torn local npz: upload as-is, verify catches it
+            return files, 0
+        for k in unchanged:
+            leaves[k] = dict(leaves[k])
+            # FLATTEN the chain: point at the step that actually HOLDS
+            # the bytes (the base's own base when the base is itself a
+            # delta for this leaf) — restore fetches exactly one extra
+            # step per leaf and prune's retention set stays at the
+            # anchor steps, not every intermediate delta
+            leaves[k]["base_step"] = int(
+                base_leaves[k].get("base_step", base_step)
+            )
+        manifest["delta_depth"] = base_depth + 1
+        buf = io.BytesIO()
+        np.savez(buf, **kept)
+        out = dict(files)
+        out["state.npz"] = buf.getvalue()
+        out["manifest.json"] = json.dumps(manifest).encode()
+        return out, len(unchanged)
+
+    def upload_step(self, step: int, files: Dict[str, bytes],
+                    base_step: Optional[int] = None,
+                    base_manifest: Optional[Dict] = None) -> UploadReport:
         """Mirror one verified local step: put data blobs, manifest
         last, then re-download and crc-verify before advancing
         REMOTE_LATEST.  A verification failure quarantines the remote
         step (deletes its blobs) and raises RemoteVerifyError — the
-        pointer never advances onto unverified bytes."""
+        pointer never advances onto unverified bytes.
+
+        `base_step`/`base_manifest` (the previously mirrored step, as
+        the offloader tracks it) turn the upload into a per-leaf DELTA:
+        leaves whose crc32 is unchanged since the base are not
+        re-uploaded — ZeRO-3-sized mirrors stop re-sending frozen
+        embeddings and unchanged buffers every cadence point."""
         missing = [n for n in STEP_FILES if n not in files]
         if missing:
             raise ValueError(f"upload_step missing files {missing}")
+        skipped = 0
+        if base_step is not None and base_manifest and base_step != step:
+            files, skipped = self._delta_files(
+                step, files, base_step, base_manifest
+            )
         prefix = self._step_prefix(step)
         for name in STEP_FILES:
             self.blob.put(prefix + name, files[name])
         try:
-            self.verify_step(step)
+            manifest = self.verify_step(step)
         except RemoteVerifyError:
             removed = rmtree_blob_prefix(self.blob, prefix)
             _log.warning(
@@ -169,6 +255,11 @@ class RemoteCheckpointStore:
             )
             raise
         self.advance_latest(step)
+        return UploadReport(
+            leaves_skipped=skipped,
+            bytes_uploaded=sum(len(b) for b in files.values()),
+            manifest=manifest,
+        )
 
     def verify_step(self, step: int) -> Dict:
         """Download one remote step and check every leaf against its
@@ -186,6 +277,7 @@ class RemoteCheckpointStore:
             raise RemoteVerifyError(
                 f"remote step {step} unreadable: {e}"
             ) from e
+        base_manifests: Dict[int, Dict] = {}
         try:
             with np.load(io.BytesIO(state)) as data:
                 leaves = manifest.get("leaves")
@@ -194,6 +286,35 @@ class RemoteCheckpointStore:
                         f"remote step {step}: manifest has no leaves"
                     )
                 for key, spec in leaves.items():
+                    base = spec.get("base_step")
+                    if base is not None:
+                        # delta leaf: its bytes live in the base step's
+                        # mirror — verify the base vouches for the SAME
+                        # crc (the base's own verify covered the bytes)
+                        base = int(base)
+                        bm = base_manifests.get(base)
+                        if bm is None:
+                            try:
+                                bm = json.loads(self.blob.get(
+                                    self._step_prefix(base)
+                                    + "manifest.json"
+                                ))
+                            except BlobUnavailableError:
+                                raise
+                            except (BlobStoreError, ValueError) as e:
+                                raise RemoteVerifyError(
+                                    f"remote step {step}: delta base "
+                                    f"{base} unreadable: {e}"
+                                ) from e
+                            base_manifests[base] = bm
+                        bspec = bm.get("leaves", {}).get(key)
+                        if (not isinstance(bspec, dict)
+                                or bspec.get("crc32") != spec["crc32"]):
+                            raise RemoteVerifyError(
+                                f"remote step {step}: delta leaf {key!r} "
+                                f"not vouched for by base step {base}"
+                            )
+                        continue
                     if key not in data.files:
                         raise RemoteVerifyError(
                             f"remote step {step}: leaf {key!r} in manifest "
@@ -217,6 +338,9 @@ class RemoteCheckpointStore:
                         )
         except RemoteVerifyError:
             raise
+        except BlobUnavailableError:
+            raise  # delta-base fetch blip: transient, NOT corruption —
+            # wrapping it would quarantine a perfectly good step
         except Exception as e:  # torn npz, zip errors, bad dtypes
             raise RemoteVerifyError(
                 f"remote step {step} undecodable: {e}"
@@ -225,22 +349,119 @@ class RemoteCheckpointStore:
 
     def download_step(self, step: int) -> Dict[str, bytes]:
         """The three step blobs as bytes (restore's materialize source);
-        raises BlobNotFound/BlobStoreError straight through."""
+        raises BlobNotFound/BlobStoreError straight through.
+
+        Delta mirrors are REASSEMBLED here: leaves the manifest marks
+        `base_step` are fetched from their base step's state.npz
+        (chasing chains through each base's own manifest), and the
+        returned payload is a SELF-CONTAINED full step — the local
+        materialize path writes ordinary, annotation-free files."""
         prefix = self._step_prefix(step)
-        return {name: self.blob.get(prefix + name) for name in STEP_FILES}
+        files = {name: self.blob.get(prefix + name) for name in STEP_FILES}
+        try:
+            manifest = json.loads(files["manifest.json"])
+            leaves = manifest.get("leaves", {})
+        except (ValueError, TypeError):
+            return files  # unparseable: hand back raw, restore verifies
+        if not any(
+            isinstance(s, dict) and s.get("base_step") is not None
+            for s in leaves.values()
+        ):
+            return files
+        with np.load(io.BytesIO(files["state.npz"])) as data:
+            arrays = {k: data[k] for k in data.files}
+        npz_cache: Dict[int, Dict[str, np.ndarray]] = {}
+        manifest_cache: Dict[int, Dict] = {int(step): manifest}
+
+        def _load_base(s: int):
+            if s not in npz_cache:
+                p = self._step_prefix(s)
+                with np.load(io.BytesIO(self.blob.get(p + "state.npz"))) as d:
+                    npz_cache[s] = {k: d[k] for k in d.files}
+                manifest_cache[s] = json.loads(
+                    self.blob.get(p + "manifest.json")
+                )
+            return npz_cache[s], manifest_cache[s]
+
+        for key, spec in leaves.items():
+            base = spec.get("base_step") if isinstance(spec, dict) else None
+            seen = set()
+            while base is not None:
+                if base in seen:  # defensive: a cyclic chain is corrupt
+                    raise BlobStoreError(
+                        f"delta chain cycle at step {base} leaf {key!r}"
+                    )
+                seen.add(base)
+                arrs, bman = _load_base(int(base))
+                if key in arrs:
+                    arrays[key] = arrs[key]
+                    base = None
+                else:
+                    bspec = bman.get("leaves", {}).get(key, {})
+                    base = bspec.get("base_step")
+                    if base is None:
+                        raise BlobStoreError(
+                            f"delta leaf {key!r} unresolvable from its "
+                            "base chain"
+                        )
+        for spec in leaves.values():
+            if isinstance(spec, dict):
+                spec.pop("base_step", None)
+        manifest.pop("delta_depth", None)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        files["state.npz"] = buf.getvalue()
+        files["manifest.json"] = json.dumps(manifest).encode()
+        return files
 
     def delete_step(self, step: int) -> int:
         return rmtree_blob_prefix(self.blob, self._step_prefix(step))
 
+    def _base_steps_of(self, step: int) -> List[int]:
+        """Base steps a (possibly delta) mirrored step references.
+        Store/parse failures PROPAGATE — treating an unreadable
+        manifest as 'no bases' would let prune delete a base a kept
+        delta still resolves leaves through (prune aborts instead)."""
+        try:
+            raw = self.blob.get(self._step_prefix(step) + "manifest.json")
+        except BlobNotFound:
+            return []  # dangling step: nothing it can reference
+        manifest = json.loads(raw)
+        return sorted({
+            int(s["base_step"])
+            for s in manifest.get("leaves", {}).values()
+            if isinstance(s, dict) and s.get("base_step") is not None
+        })
+
     def prune(self, keep: int) -> int:
         """Keep the `keep` newest mirrored steps; never delete the step
         REMOTE_LATEST names (the remote durability floor, mirroring the
-        local manager's never-prune-the-verified-step rule)."""
+        local manager's never-prune-the-verified-step rule) — NOR any
+        base step a kept delta mirror still resolves leaves through
+        (transitively: deleting a delta's base would orphan its
+        unre-uploaded leaves)."""
         steps = self.list_steps()
         keep_set = set(steps[-max(1, keep):])
         latest = self.read_latest()
         if latest is not None:
             keep_set.add(latest)
+        try:
+            frontier = list(keep_set)
+            while frontier:
+                nxt = []
+                for s in frontier:
+                    for b in self._base_steps_of(s):
+                        if b not in keep_set:
+                            keep_set.add(b)
+                            nxt.append(b)
+                frontier = nxt
+        except (BlobStoreError, ValueError, TypeError) as e:
+            # can't prove which bases are still referenced: deleting
+            # anything could orphan a kept delta's leaves — skip this
+            # prune round, the next cadence point retries
+            _log.warning("remote prune skipped: delta bases "
+                         "unresolvable (%s)", e)
+            return 0
         removed = 0
         for s in steps:
             if s not in keep_set:
@@ -293,6 +514,10 @@ class CheckpointOffloader:
         # last step that completed upload + remote verification (written
         # on the uploader thread; int read is atomic enough for dedupe)
         self._mirrored: Optional[int] = None
+        # ...and its REMOTE manifest — the delta-mirror base: the next
+        # upload skips leaves whose crc32 this manifest already vouches
+        # for (docs/RESILIENCE.md "Delta mirror")
+        self._mirrored_manifest: Optional[Dict] = None
         self.counters: Dict[str, float] = {
             "offload_uploads": 0,      # steps durably mirrored + verified
             "offload_failures": 0,     # uploads abandoned past the budget
@@ -301,6 +526,7 @@ class CheckpointOffloader:
             "offload_verify_failures": 0,  # remote crc misses (quarantined)
             "offload_unavailable": 0,  # degraded-to-local-only events
             "offload_bytes": 0,        # payload bytes durably uploaded
+            "offload_leaves_skipped": 0,  # delta-mirror leaves not re-sent
         }
 
     # -- metrics --------------------------------------------------------
@@ -353,14 +579,17 @@ class CheckpointOffloader:
             # (and double-counting) the identical payload
             return
         attempts = 0
-        nbytes = sum(len(b) for b in files.values())
         t0 = time.perf_counter()
         while True:
             try:
                 # injected uploader-path CheckpointWriteFault (payload
                 # target="remote"): fires once, then the retry succeeds
                 self.fault_plan.check_offload(step)
-                self.remote.upload_step(step, files)
+                report = self.remote.upload_step(
+                    step, files,
+                    base_step=self._mirrored,
+                    base_manifest=self._mirrored_manifest,
+                )
             except Exception as e:  # noqa: BLE001 — classified below
                 transient = isinstance(
                     e, (BlobUnavailableError, RemoteVerifyError,
@@ -393,7 +622,10 @@ class CheckpointOffloader:
                 continue
             break
         self._count("offload_uploads")
-        self._count("offload_bytes", nbytes)
+        self._count("offload_bytes", report.bytes_uploaded)
+        if report.leaves_skipped:
+            self._count("offload_leaves_skipped", report.leaves_skipped)
+        self._mirrored_manifest = report.manifest
         self._mirrored = step
         if self.registry is not None:
             self.registry.histogram("resilience/offload_upload_ms").observe(
@@ -457,10 +689,12 @@ def offloader_from_config(cfg, *, blob: Optional[BlobStore] = None,
 
 
 __all__ = [
+    "MAX_DELTA_CHAIN",
     "REMOTE_LATEST",
     "STEP_FILES",
     "CheckpointOffloader",
     "RemoteCheckpointStore",
     "RemoteVerifyError",
+    "UploadReport",
     "offloader_from_config",
 ]

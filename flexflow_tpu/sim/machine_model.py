@@ -282,7 +282,27 @@ class TpuPodModel(MachineModel):
 def make_machine_model(config, num_devices: int) -> MachineModel:
     """Build from FFConfig (--machine-model-version/-file parity).
     Device roofline auto-matches the live chip (cpu -> v5p defaults,
-    keeping hermetic tests deterministic)."""
+    keeping hermetic tests deterministic).  --slices > 1 selects the
+    multi-slice hierarchy (topology/hierarchy.py SliceHierarchy: ICI
+    inside each slice, DCN between) regardless of model version — the
+    hierarchy is what the searches must see; 1 slice is exactly the
+    flat pre-topology behavior."""
+    if getattr(config, "slices", 1) > 1:
+        # a degraded mesh (elastic re-search on survivors) may no
+        # longer split into equal slices — or match the configured
+        # per-slice topology's chip count: degrade to the flat model
+        # rather than failing recovery over a cost-model nicety
+        import logging
+
+        try:
+            from ..topology.hierarchy import hierarchy_from_config
+
+            return hierarchy_from_config(config, num_devices)
+        except ValueError as e:
+            logging.getLogger("flexflow_tpu.topology").warning(
+                "slice hierarchy unusable for %d devices (%s); falling "
+                "back to the flat machine model", num_devices, e,
+            )
     if config.machine_model_file:
         return TpuPodModel.from_file(config.machine_model_file)
     spec = detect_device_spec()

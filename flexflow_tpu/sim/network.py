@@ -92,6 +92,41 @@ def flat_degree_constrained(num_nodes: int, degree: int,
     return conn
 
 
+def multi_slice_torus(dims: Sequence[int], slices: int,
+                      dcn_links: int = 1) -> ConnectionMatrix:
+    """`slices` identical per-slice tori (the ICI fabric) joined by a
+    DCN tier: node i of every slice links to node i of every other
+    slice with `dcn_links` parallel links (each TPU host owns its own
+    DCN NIC, so the cross-slice fabric is host-to-host, not a single
+    uplink).  Node order is slice-major — node s*per_slice + i is chip
+    i of slice s — matching `SliceHierarchy`/`TpuPodModel` coords and
+    the C-order device layout `topology.expand_mesh_axes` produces.
+
+    This is the hierarchy's CONNECTIVITY/ROUTING view (hop structure:
+    per-hop ICI inside a slice, one cross-slice hop between same-index
+    chips).  A ConnectionMatrix carries link multiplicities only, and
+    `NetworkedMachineModel` prices every link at one bandwidth — it
+    CANNOT express the DCN tier being slower than ICI; per-tier
+    bandwidth/latency live in the analytic `topology.SliceHierarchy`
+    costs.  Pricing routed makespans on the real two-tier fabric needs
+    per-link bandwidths in the routed model (a ROADMAP follow-up)."""
+    if slices < 1:
+        raise ValueError(f"slices must be >= 1, got {slices}")
+    intra = torus(dims)
+    per_slice = intra.shape[0]
+    n = per_slice * slices
+    conn = np.zeros((n, n), np.int32)
+    for s in range(slices):
+        base = s * per_slice
+        conn[base:base + per_slice, base:base + per_slice] = intra
+    for i in range(per_slice):
+        for a in range(slices):
+            for b in range(slices):
+                if a != b:
+                    conn[a * per_slice + i, b * per_slice + i] = dcn_links
+    return conn
+
+
 def torus(dims: Sequence[int]) -> ConnectionMatrix:
     """N-D torus (ICI pod-slice shape, e.g. (4,4) or (4,4,4)): each node
     links to +/-1 neighbors per axis with wraparound; axes of size 2

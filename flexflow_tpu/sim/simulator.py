@@ -35,6 +35,7 @@ from ..fftype import OperatorType
 from ..ops.op import Op
 from ..pcg.graph import Graph
 from .machine_model import MachineModel, TpuPodModel
+from ..topology.comm import CommCost, ZERO_COST, ring_bytes
 
 
 @dataclasses.dataclass
@@ -76,6 +77,12 @@ class SimResult:
         self.breakdown = breakdown if breakdown is not None else {}
         self._memory = per_device_memory
         self._memory_fn = memory_fn
+        # per-tier comm split (topology subsystem): simulate_ops fills
+        # it from the OpTerms ici_/dcn_ fields; zero on flat meshes
+        self.comm_tiers: Dict[str, float] = {
+            "ici_time": 0.0, "dcn_time": 0.0,
+            "ici_bytes": 0.0, "dcn_bytes": 0.0,
+        }
 
     @property
     def per_device_memory(self) -> int:
@@ -105,6 +112,12 @@ class OpTerms:
     opt_xfer: float = 0.0     # post-update weight all-gather (stage 1/2)
     gather_xfer: float = 0.0  # ZeRO-3 per-layer weight all-gathers
     #                           (fwd + bwd re-gather; prefetch-credited)
+    ici_xfer: float = 0.0     # per-tier (uncredited) split of ALL the
+    dcn_xfer: float = 0.0     # op's comm seconds: intra-slice ICI vs
+    #                           inter-slice DCN (flat mesh = all ICI);
+    #                           grad/opt legs fold in only when training
+    ici_bytes: float = 0.0    # per-device ring bytes over each tier —
+    dcn_bytes: float = 0.0    # the comm/{ici,dcn}_bytes telemetry split
     mem_weights: int = 0      # per-device weight shard bytes (compute copy)
     mem_master: int = 0       # per-device master-resident weight bytes
     #                           (== mem_weights below stage 3; /group at 3)
@@ -135,7 +148,13 @@ _KERNEL_OVERHEAD = 2e-6  # per-op dispatch/fusion overhead (XLA fuses, small)
 #: so stage-blind v1 rankings must re-search.  A tier-1 guard test pins
 #: the OpTerms field set to this number (tests/test_zero_ladder.py):
 #: changing the decomposition without bumping here fails CI.
-COST_MODEL_VERSION = 2
+#: v3: the multi-slice topology subsystem (docs/TOPOLOGY.md) — OpTerms
+#: grew the ici_xfer/dcn_xfer/ici_bytes/dcn_bytes per-tier split, comm
+#: estimators became placement-aware (a collective crossing the slice
+#: boundary costs the hierarchical / DCN form), and the sharded-update
+#: group shrinks to the intra-slice remainder under a cross-slice
+#: placement — slice-blind v2 rankings must re-search.
+COST_MODEL_VERSION = 3
 
 #: overlap credit for the ZeRO-3 per-layer weight all-gathers: the
 #: executor double-buffers (layer k+1's gather issues before layer k's
@@ -405,6 +424,7 @@ class Simulator:
         weight_update_sharding: bool = False,
         wus_axis: str = "data",
         zero_stage: Optional[int] = None,
+        placement: Optional[str] = None,
     ):
         self.machine = machine
         self.cost_model = cost_model or OpCostModel(machine)
@@ -447,6 +467,17 @@ class Simulator:
         # (FFConfig.wus_axis); wus_group() resolves each weight's
         # actual sharding group from it
         self.wus_axis = wus_axis
+        # multi-slice hierarchy (topology/hierarchy.py): placement is
+        # the DEFAULT cross-slice mesh axis; every placement-sensitive
+        # method also takes a per-call override (keyed into the OpTerms
+        # cache) so one simulator costs every placement for the
+        # searches.  Single-slice machines ignore it entirely — the
+        # flat costs are bit-identical to the pre-topology model.
+        self.placement = placement
+        self._slices = max(1, int(getattr(machine, "slices", 1) or 1))
+        self._hier = (
+            self._slices > 1 and hasattr(machine, "collective_cost")
+        )
         # (node_key, mesh signature, training) -> OpTerms: per-op
         # contribution terms for the delta/memoized evaluator (the
         # machine and sync mode are fixed per Simulator)
@@ -458,6 +489,22 @@ class Simulator:
         self._fused_members: Dict[Tuple, List[Op]] = {}
 
     # -- comm costs ------------------------------------------------------
+    def _collective(self, kind: str, size: float, group_len: int,
+                    cross: bool = False):
+        """One collective as a topology.CommCost: the flat single-tier
+        estimate on ordinary machines (everything ICI), the
+        hierarchical / DCN synthesis on a SliceHierarchy when the
+        group spans the slice boundary (`cross`)."""
+        if group_len <= 1:
+            return ZERO_COST
+        if self._hier:
+            return self.machine.collective_cost(kind, size, group_len,
+                                                cross=cross)
+        return CommCost(
+            ici_time=self._collective_time(kind, size, group_len),
+            ici_bytes=ring_bytes(kind, size, group_len),
+        )
+
     def _collective_time(self, kind: str, size: int, group_len: int,
                          over_dcn: bool = False) -> float:
         m = self.machine
@@ -475,11 +522,85 @@ class Simulator:
             return m.allgather_time(size, group)
         return m.alltoall_time(size, group)
 
+    # -- placement / tier decisions (topology/hierarchy.py) --------------
+    def effective_placement(self, mesh_axes: Optional[Dict[str, int]],
+                            placement: Optional[str]) -> Optional[str]:
+        """The cross-slice mesh axis one evaluation costs under: the
+        per-call override (searches costing placements), else the
+        simulator default, else the shared resolve_placement default —
+        always validated against the mesh (an axis the slice count
+        cannot divide falls back to the default).  None on flat
+        machines, so every tier decision degrades to ICI."""
+        if not self._hier or not mesh_axes:
+            return None
+        from ..topology.hierarchy import resolve_placement
+
+        p = placement if placement is not None else self.placement
+        if p is not None:
+            n = mesh_axes.get(p, 0)
+            if n >= self._slices and n % self._slices == 0:
+                return p
+        return resolve_placement(mesh_axes, self._slices)
+
+    @staticmethod
+    def _view_axes(pt) -> frozenset:
+        view = getattr(pt, "machine_view", None)
+        if view is None:
+            return frozenset()
+        return frozenset(view.used_axes())
+
+    def _xfer_crosses(self, op: Op, eff_p: Optional[str]) -> bool:
+        """Does a parallel op's resharding collective ride the
+        cross-slice axis?  The moved degrees are the axes entering or
+        leaving between input and output views (best-effort: views are
+        assigned on the evaluator's applied graphs; viewless fallback
+        stays ICI)."""
+        if eff_p is None or not op.inputs or not op.outputs:
+            return False
+        return eff_p in (
+            self._view_axes(op.inputs[0]) ^ self._view_axes(op.outputs[0])
+        )
+
+    def _partial_crosses(self, op: Op, eff_p: Optional[str]) -> bool:
+        """Does a contraction partial-sum all-reduce span slices?  The
+        psum group rides the output's replica-dim axes."""
+        if eff_p is None or not op.outputs:
+            return False
+        view = getattr(op.outputs[0], "machine_view", None)
+        if view is None:
+            return False
+        for dim, axes in zip(op.outputs[0].shape.dims, view.axes):
+            if dim.is_replica_dim and eff_p in axes:
+                return True
+        return False
+
+    def _weight_rep_crosses(self, w, eff_p: Optional[str]) -> bool:
+        """Does this weight's gradient-sync replica group include the
+        cross-slice axis?  True unless the placement axis SHARDS the
+        weight (then its replicas all live inside one slice)."""
+        if eff_p is None:
+            return False
+        view = getattr(w, "machine_view", None)
+        if view is not None:
+            for dim, axes in zip(w.shape.dims, view.axes):
+                if not dim.is_replica_dim and eff_p in axes:
+                    return False
+        return True
+
     def xfer_cost(self, op: Op, mesh_axes: Dict[str, int]) -> float:
         """Cost of a parallel op's resharding collective (reference
-        estimate_xfer_cost per type, simulator.cc:622-767)."""
+        estimate_xfer_cost per type, simulator.cc:622-767).  Flat
+        (single-tier) estimate — op_terms costs the placement-aware
+        form through _xfer_cc."""
+        return self._xfer_cc(op, mesh_axes, cross=False).time
+
+    def _xfer_cc(self, op: Op, mesh_axes: Dict[str, int],
+                 cross: bool = False):
+        """The resharding collective as a per-tier CommCost; `cross`
+        routes it over the slice boundary on hierarchy machines."""
+        overhead = CommCost(ici_time=_KERNEL_OVERHEAD)
         if not op.is_parallel_op():
-            return 0.0
+            return ZERO_COST
         inp, out = op.inputs[0].shape, op.outputs[0].shape
         shard_bytes = out.shard_bytes()
         t = op.op_type
@@ -488,22 +609,25 @@ class Simulator:
             # coming from replicated, all-to-all otherwise
             degree = op.params.degree
             if inp.total_degree == 1 or inp.replica_degree >= degree:
-                return _KERNEL_OVERHEAD
-            return self._collective_time("alltoall", shard_bytes, degree)
+                return overhead
+            return self._collective("alltoall", shard_bytes, degree, cross)
         if t == OperatorType.COMBINE:
-            return self._collective_time(
-                "allgather", inp.shard_bytes() * op.params.degree, op.params.degree
+            return self._collective(
+                "allgather", inp.shard_bytes() * op.params.degree,
+                op.params.degree, cross,
             )
         if t == OperatorType.REPLICATE:
-            return self._collective_time(
-                "allgather", shard_bytes, op.params.degree
+            return self._collective(
+                "allgather", shard_bytes, op.params.degree, cross
             )
         if t == OperatorType.REDUCTION:
-            return self._collective_time(
-                "allreduce", shard_bytes, op.params.degree
+            return self._collective(
+                "allreduce", shard_bytes, op.params.degree, cross
             )
         if t == OperatorType.ALLTOALL:
-            return self._collective_time("alltoall", shard_bytes, op.params.degree)
+            return self._collective(
+                "alltoall", shard_bytes, op.params.degree, cross
+            )
         if t == OperatorType.FUSED_PARALLEL:
             # one boundary, but each fused member still moves its bytes
             # (reference estimate_xfer_cost on FusedParallelOp walks the
@@ -521,25 +645,29 @@ class Simulator:
                     members.append(sub)
                     shape = sub.outputs[0].shape
                 self._fused_members[key] = members
-            total = 0.0
+            total = ZERO_COST
             for sub in members:
-                total += self.xfer_cost(sub, mesh_axes)
-            return max(total, _KERNEL_OVERHEAD)
-        return _KERNEL_OVERHEAD
+                total = total + self._xfer_cc(sub, mesh_axes, cross)
+            return total if total.time > _KERNEL_OVERHEAD else overhead
+        return overhead
 
     def partial_sum_cost(self, op: Op, mesh_axes: Dict[str, int]) -> float:
         """An op whose output replica degree exceeds its inputs' implies
         a contraction-dim partial sum -> all-reduce inserted by SPMD."""
+        return self._partial_cc(op, mesh_axes, cross=False).time
+
+    def _partial_cc(self, op: Op, mesh_axes: Dict[str, int],
+                    cross: bool = False):
         if op.is_parallel_op() or not op.outputs:
-            return 0.0
+            return ZERO_COST
         out_rep = op.outputs[0].shape.replica_degree
         in_rep = max((t.shape.replica_degree for t in op.inputs), default=1)
         if out_rep > in_rep:
             k = out_rep // max(1, in_rep)
-            return self._collective_time(
-                "allreduce", op.outputs[0].shape.shard_bytes(), k
+            return self._collective(
+                "allreduce", op.outputs[0].shape.shard_bytes(), k, cross
             )
-        return 0.0
+        return ZERO_COST
 
     def sync_time(self, size: int, rep: int) -> float:
         """One weight's gradient sync under the configured
@@ -559,7 +687,8 @@ class Simulator:
         return self.zero_stage if zero_stage is None else int(zero_stage)
 
     def wus_group(self, w, mesh_axes: Optional[Dict[str, int]] = None,
-                  zero_stage: Optional[int] = None) -> int:
+                  zero_stage: Optional[int] = None,
+                  placement: Optional[str] = None) -> int:
         """The group size this weight's update actually shards over —
         the executor-fidelity mirror of parallel/zero.py.  1 means the
         leaf keeps the replicated update (wus off, a mesh without the
@@ -576,7 +705,14 @@ class Simulator:
         doesn't block) — and a free logical dim must divide evenly.
         Callers without mesh context (unity's per-op DP stage) fall
         back to the replica degree — exact on pure-dp meshes, and the
-        authoritative evaluation always re-scores with mesh_axes."""
+        authoritative evaluation always re-scores with mesh_axes.
+
+        `placement` (the effective cross-slice axis): when the wus axis
+        itself spans slices with an intra-slice remainder, the executor
+        scatters over THAT remainder only (the expanded mesh's reduced
+        axis, topology.expand_mesh_axes) — so the group shrinks to
+        n / slices and the inter-slice leg rides grad_sync as a DCN
+        all-reduce of the scattered shard."""
         if self._stage(zero_stage) < 1 or self.parameter_sync == "none":
             return 1
         if mesh_axes is None:
@@ -585,6 +721,9 @@ class Simulator:
                 return 1
         else:
             n = mesh_axes.get(self.wus_axis, 1)
+            if (placement == self.wus_axis and self._slices > 1
+                    and n > self._slices and n % self._slices == 0):
+                n //= self._slices
             if n <= 1:
                 return 1
             view = getattr(w, "machine_view", None)
@@ -620,17 +759,37 @@ class Simulator:
         (Z3_PREFETCH_OVERLAP), not the generic one.  parameter_sync
         "none" keeps replicas unsynced, which the sharded update cannot
         express — it stays on the replicated path."""
+        s, x, gx = self._weight_update_comm_cc(size, rep,
+                                               zero_stage=zero_stage)
+        return s.time, x.time, gx.time
+
+    def _weight_update_comm_cc(self, size: int, rep: int,
+                               zero_stage: Optional[int] = None,
+                               cross: bool = False):
+        """weight_update_comm as per-tier CommCosts: (grad leg,
+        post-update gather, stage-3 per-layer gathers).  `cross` routes
+        the group over the slice boundary — the placement axis exactly
+        equal to the slice count, where the scattered update's RS/AG
+        ride DCN whole (an intra-slice remainder instead shrinks the
+        group and keeps these legs on ICI; see wus_group)."""
         stage = self._stage(zero_stage)
         if stage < 1 or self.parameter_sync == "none":
-            return self.sync_time(size, rep), 0.0, 0.0
+            t = self.sync_time(size, rep)
+            sync = CommCost(ici_time=t, ici_bytes=(
+                2.0 * size if (t and self.parameter_sync == "ps")
+                else ring_bytes("allreduce", size, rep)
+            )) if t else ZERO_COST
+            return sync, ZERO_COST, ZERO_COST
         if self.parameter_sync == "ps":
-            sync = self.sync_time(size, rep)  # flat 2*size/BW grad leg
+            # flat 2*size/BW grad leg rides the ps link (single-tier)
+            sync = CommCost(ici_time=self.sync_time(size, rep),
+                            ici_bytes=2.0 * size)
         else:
-            sync = self._collective_time("reducescatter", size, rep)
-        gather = self._collective_time("allgather", size, rep)
+            sync = self._collective("reducescatter", size, rep, cross)
+        gather = self._collective("allgather", size, rep, cross)
         if stage >= 3:
-            return sync, 0.0, 2.0 * gather
-        return sync, gather, 0.0
+            return sync, ZERO_COST, gather + gather
+        return sync, gather, ZERO_COST
 
     def grad_sync_cost(self, graph: Graph, mesh_axes: Dict[str, int]) -> float:
         """Gradient sync over each weight's replica axes (SPMD's psum in
@@ -647,14 +806,21 @@ class Simulator:
     # -- per-op contribution terms (delta-sim decomposition) -------------
     def op_terms(self, op: Op, mesh_axes: Dict[str, int],
                  training: bool = True, skip_compute: bool = False,
-                 zero_stage: Optional[int] = None) -> OpTerms:
+                 zero_stage: Optional[int] = None,
+                 placement: Optional[str] = None) -> OpTerms:
         """All of `op`'s additive contributions to simulate(), cached by
         (node_key, mesh signature, training).  node_key already encodes
         params + ShardConfig + input parallel shapes, so a strategy move
         that leaves an op's config and input shapes unchanged reuses its
         terms across candidates.  skip_compute: the op's compute is
         covered by a measured segment — don't run (or cache-measure) the
-        per-op cost model for a term the aggregation will discard."""
+        per-op cost model for a term the aggregation will discard.
+
+        On a SliceHierarchy machine, `placement` (per-call override of
+        the simulator default) decides which mesh axis spans the DCN
+        boundary: collectives whose group rides it cost the
+        hierarchical / DCN synthesis, everything else stays on ICI, and
+        the ici_/dcn_ tier fields carry the split."""
         # mesh signature preserves INSERTION order (not sorted): views —
         # which wus_group reads — are assigned by assign_axes' axis-
         # declaration-order heuristic, so two orderings of equal-size
@@ -662,11 +828,12 @@ class Simulator:
         # cache entry (strategy_signature keeps order for the same
         # reason)
         stage = self._stage(zero_stage)
+        eff_p = self.effective_placement(mesh_axes, placement)
         # stage only shapes the weight-update terms, so weightless ops
         # are stage-invariant — key them at a single rung so a stage
         # sweep doesn't recompute their compute/xfer terms per stage
         key = (op.node_key(), tuple(mesh_axes.items()), training,
-               skip_compute, stage if op.weights else 0)
+               skip_compute, stage if op.weights else 0, eff_p)
         hit = self._term_cache.get(key)
         if hit is not None:
             self.term_hits += 1
@@ -674,13 +841,22 @@ class Simulator:
         self.term_misses += 1
         compute = xfer = partial = grad_sync = opt_numel = 0.0
         opt_xfer = gather_xfer = 0.0
+        tiers = ZERO_COST  # per-tier time/bytes over every comm term
         mem_weights = mem_master = mem_grad = mem_gather = 0
         mem_opt = mem_residual = mem_transient = 0
         if op.op_type != OperatorType.INPUT:
             if op.is_parallel_op():
-                xfer = self.xfer_cost(op, mesh_axes)
+                cc = self._xfer_cc(op, mesh_axes,
+                                   cross=self._xfer_crosses(op, eff_p))
+                xfer = cc.time
+                tiers = tiers + cc
             else:
-                partial = self.partial_sum_cost(op, mesh_axes)
+                cc = self._partial_cc(op, mesh_axes,
+                                      cross=self._partial_crosses(op, eff_p))
+                partial = cc.time
+                tiers = tiers + cc
+                if training:
+                    tiers = tiers + cc  # bwd mirror (simulate_ops's 2x)
                 if not skip_compute:
                     cm = self.cost_model.cost(op)
                     compute = cm.forward_time + (
@@ -696,18 +872,40 @@ class Simulator:
                     1, np.dtype(w.shape.dtype.np_dtype).itemsize
                 )
                 rep = w.shape.replica_degree
-                g = self.wus_group(w, mesh_axes, zero_stage=stage)
+                g = self.wus_group(w, mesh_axes, zero_stage=stage,
+                                   placement=eff_p)
                 if g > 1:
-                    s, x, gx = self.weight_update_comm(sb, g,
-                                                       zero_stage=stage)
-                    grad_sync += s
+                    # whole-axis crossing: the wus axis IS the slice dim
+                    # (no intra remainder), so the scattered update's
+                    # RS/AG ride DCN; with a remainder, wus_group shrank
+                    # g to it and these legs stay on ICI
+                    cross_whole = (
+                        eff_p is not None and eff_p == self.wus_axis
+                        and mesh_axes.get(self.wus_axis, 1) == self._slices
+                    )
+                    s_cc, x_cc, gx_cc = self._weight_update_comm_cc(
+                        sb, g, zero_stage=stage, cross=cross_whole
+                    )
+                    grad_sync += s_cc.time
+                    wcc = s_cc + x_cc + gx_cc
                     if (rep > g and rep % g == 0
                             and self.parameter_sync == "allreduce"):
-                        # tracked replication beyond the wus axis still
-                        # all-reduces, on the scattered shard
-                        grad_sync += self.sync_time(sb // g, rep // g)
-                    opt_xfer += x
-                    gather_xfer += gx
+                        # tracked replication beyond the (intra) wus
+                        # group still all-reduces on the scattered
+                        # shard — over DCN when the slice factor is in
+                        # that remainder (the hierarchical reduction's
+                        # inter-slice leg)
+                        rem_cc = self._collective(
+                            "allreduce", sb // g, rep // g,
+                            cross=(not cross_whole
+                                   and self._weight_rep_crosses(w, eff_p)),
+                        )
+                        grad_sync += rem_cc.time
+                        wcc = wcc + rem_cc
+                    opt_xfer += x_cc.time
+                    gather_xfer += gx_cc.time
+                    if training:
+                        tiers = tiers + wcc
                     # the update runs on the 1/g shard; slots live
                     # there permanently
                     numel /= g
@@ -724,8 +922,21 @@ class Simulator:
                         mem_gather += sb
                 elif rep > 1:
                     # replicated update (stage 0, or this leaf falls
-                    # back per parallel/zero.py)
-                    grad_sync += self.sync_time(sb, rep)
+                    # back per parallel/zero.py): hierarchical
+                    # all-reduce when the replica group spans slices
+                    if self.parameter_sync == "allreduce":
+                        rcc = self._collective(
+                            "allreduce", sb, rep,
+                            cross=self._weight_rep_crosses(w, eff_p),
+                        )
+                    else:
+                        t = self.sync_time(sb, rep)
+                        rcc = CommCost(
+                            ici_time=t, ici_bytes=2.0 * sb
+                        ) if t else ZERO_COST
+                    grad_sync += rcc.time
+                    if training:
+                        tiers = tiers + rcc
                 opt_numel += numel
             mem_opt += opt_sb
             mem_master += master_sb
@@ -740,6 +951,8 @@ class Simulator:
             compute=compute, xfer=xfer, partial=partial,
             grad_sync=grad_sync, opt_numel=opt_numel, opt_xfer=opt_xfer,
             gather_xfer=gather_xfer,
+            ici_xfer=tiers.ici_time, dcn_xfer=tiers.dcn_time,
+            ici_bytes=tiers.ici_bytes, dcn_bytes=tiers.dcn_bytes,
             mem_weights=mem_weights, mem_master=mem_master,
             mem_grad=mem_grad, mem_gather=mem_gather, mem_opt=mem_opt,
             mem_residual=mem_residual, mem_transient=mem_transient,
@@ -749,7 +962,8 @@ class Simulator:
 
     def memory_from_terms(self, ops: Sequence[Op], mesh_axes: Dict[str, int],
                           training: bool = True,
-                          zero_stage: Optional[int] = None) -> int:
+                          zero_stage: Optional[int] = None,
+                          placement: Optional[str] = None) -> int:
         """per_device_memory re-aggregated from cached OpTerms — exact
         for the training non-remat accounting (weights + residual sum +
         transient max; all integer bytes, so order-independent).  The
@@ -766,7 +980,8 @@ class Simulator:
         gather_peak = 0
         for op in ops:
             terms = self.op_terms(op, mesh_axes, training,
-                                  zero_stage=zero_stage)
+                                  zero_stage=zero_stage,
+                                  placement=placement)
             compute_copy += terms.mem_weights
             master += terms.mem_master
             grads += terms.mem_grad
@@ -794,7 +1009,8 @@ class Simulator:
     def per_device_memory(self, graph: Graph, training: bool = True,
                           op_scale=None, remat: Optional[bool] = None,
                           mesh_axes: Optional[Dict[str, int]] = None,
-                          zero_stage: Optional[int] = None) -> int:
+                          zero_stage: Optional[int] = None,
+                          placement: Optional[str] = None) -> int:
         """Peak per-device bytes: weights (+grads+optimizer slots when
         training) plus LIVE activations, not the sum of every tensor
         ever produced (the r02 model summed all of them, so
@@ -814,6 +1030,7 @@ class Simulator:
         only its stage's weights/activations)."""
         remat = self.remat if remat is None else remat
         stage = self._stage(zero_stage)
+        eff_p = self.effective_placement(mesh_axes, placement)
         scale = (lambda op: op_scale(op)) if op_scale is not None \
             else (lambda op: 1.0)
         weights = sum(
@@ -834,7 +1051,8 @@ class Simulator:
                     for w in op.weights:
                         sb = w.shape.shard_bytes()
                         sc = scale(op)
-                        g = (self.wus_group(w, mesh_axes, zero_stage=stage)
+                        g = (self.wus_group(w, mesh_axes, zero_stage=stage,
+                                            placement=eff_p)
                              if w.create_gradients else 1)
                         opt += (sb // g) * sc
                         grads += (sb // g if stage >= 2 else sb) * sc
@@ -918,20 +1136,23 @@ class Simulator:
 
     def optimizer_update_cost(self, graph: Graph,
                               mesh_axes: Optional[Dict[str, int]] = None,
-                              zero_stage: Optional[int] = None) -> float:
+                              zero_stage: Optional[int] = None,
+                              placement: Optional[str] = None) -> float:
         """Weight-update pass: read master weight + grad, write weight,
         touch each optimizer slot — pure HBM traffic in f32 (master
         precision), one fused kernel under jit.  At ZeRO stage >= 1 the
         pass touches only each replicated weight's 1/group shard
         (arXiv:2004.13336); stages 2/3 change residency, not the pass."""
         numel = 0.0
+        eff_p = self.effective_placement(mesh_axes, placement)
         for op in graph.ops:
             for w in op.weights:
                 if w.create_gradients:
                     sb = w.shape.shard_bytes()
                     n = sb / max(1, np.dtype(w.shape.dtype.np_dtype).itemsize)
                     numel += n / self.wus_group(w, mesh_axes,
-                                                zero_stage=zero_stage)
+                                                zero_stage=zero_stage,
+                                                placement=eff_p)
         bytes_moved = numel * 4.0 * (3 + self.optimizer_slots)
         return bytes_moved / self.machine.device().hbm_bandwidth
 
@@ -943,6 +1164,7 @@ class Simulator:
         training: bool = True,
         segment_costs: Optional[Sequence[Tuple[Sequence[int], float]]] = None,
         zero_stage: Optional[int] = None,
+        placement: Optional[str] = None,
     ) -> SimResult:
         """segment_costs: [(member op guids, fwd+bwd seconds)] from
         profiler.measure_segment_costs — ops inside a measured region
@@ -958,16 +1180,18 @@ class Simulator:
         topo = graph.topo_order()
         if training and not self.remat:
             memory_fn = lambda: self.memory_from_terms(  # noqa: E731
-                topo, mesh_axes, training, zero_stage=zero_stage
+                topo, mesh_axes, training, zero_stage=zero_stage,
+                placement=placement,
             )
         else:
             memory_fn = lambda: self.per_device_memory(  # noqa: E731
-                graph, training, mesh_axes=mesh_axes, zero_stage=zero_stage
+                graph, training, mesh_axes=mesh_axes, zero_stage=zero_stage,
+                placement=placement,
             )
         return self.simulate_ops(
             topo, mesh_axes, training=training, measured_ops=measured_ops,
             seg_cost_total=seg_cost_total, memory_fn=memory_fn,
-            zero_stage=zero_stage,
+            zero_stage=zero_stage, placement=placement,
         )
 
     def simulate_ops(
@@ -979,6 +1203,7 @@ class Simulator:
         seg_cost_total: float = 0.0,
         memory_fn: Optional[Callable[[], int]] = None,
         zero_stage: Optional[int] = None,
+        placement: Optional[str] = None,
     ) -> SimResult:
         """Aggregate cached per-op terms over `ops` (a topo-ordered op
         sequence).  The ONE aggregation path shared by full and delta
@@ -994,13 +1219,19 @@ class Simulator:
         opt_numel = 0.0
         opt_xfer = 0.0
         gather_xfer = 0.0
+        ici_time = dcn_time = ici_bytes = dcn_bytes = 0.0
         breakdown: Dict[str, float] = {}
         for op in ops:
             if op.op_type == OperatorType.INPUT:
                 continue
             terms = self.op_terms(op, mesh_axes, training,
                                   skip_compute=op.guid in measured_ops,
-                                  zero_stage=zero_stage)
+                                  zero_stage=zero_stage,
+                                  placement=placement)
+            ici_time += terms.ici_xfer
+            dcn_time += terms.dcn_xfer
+            ici_bytes += terms.ici_bytes
+            dcn_bytes += terms.dcn_bytes
             if training:
                 sync += terms.grad_sync
                 opt_numel += terms.opt_numel
@@ -1042,7 +1273,7 @@ class Simulator:
         )
         compute = compute + analytic_compute * self.compute_scale
         total = compute + effective_comm
-        return SimResult(
+        res = SimResult(
             total_time=total,
             compute_time=compute,
             comm_time=comm,
@@ -1050,3 +1281,10 @@ class Simulator:
             breakdown=breakdown,
             memory_fn=memory_fn,
         )
+        # uncredited per-tier split of every comm term this aggregation
+        # charged — the comm/{ici,dcn}_* telemetry + fidelity payload
+        res.comm_tiers = {
+            "ici_time": ici_time, "dcn_time": dcn_time,
+            "ici_bytes": ici_bytes, "dcn_bytes": dcn_bytes,
+        }
+        return res

@@ -78,7 +78,17 @@ def mesh_fingerprint(cfg, num_devices: int) -> Dict:
     """Identity of the hardware a strategy was placed onto: device
     count, node split, machine-model id (version + file digest), and
     the live backend kind (calibrated searches rank differently per
-    chip generation)."""
+    chip generation).
+
+    Hierarchy-aware (docs/TOPOLOGY.md): on a multi-slice run the slice
+    count, per-slice topology and per-tier DCN bandwidth/latency join
+    the fingerprint — a placement searched for 2 slices at one DCN
+    speed is wrong for 4 slices or a faster fabric, so those entries
+    must not alias.  Single-slice runs (the default) emit EXACTLY the
+    pre-topology fields: the slice/DCN knobs never split a flat key.
+    (The composed key still changes once per COST_MODEL_VERSION bump —
+    v3 shipped with this subsystem — which is the digest guard working
+    as designed: new cost semantics re-search once, fleet-wide.)"""
     platform, kind = "unknown", "unknown"
     try:
         import jax
@@ -87,7 +97,7 @@ def mesh_fingerprint(cfg, num_devices: int) -> Dict:
         platform, kind = d.platform, d.device_kind
     except Exception:
         pass
-    return {
+    out = {
         "num_devices": int(num_devices),
         "num_nodes": int(cfg.num_nodes),
         "machine_model_version": int(cfg.machine_model_version),
@@ -95,6 +105,14 @@ def mesh_fingerprint(cfg, num_devices: int) -> Dict:
         "platform": platform,
         "device_kind": kind,
     }
+    if int(getattr(cfg, "slices", 1)) > 1:
+        out["slices"] = int(cfg.slices)
+        out["slice_topology"] = (
+            str(cfg.slice_topology) if cfg.slice_topology else None
+        )
+        out["dcn_bandwidth"] = float(cfg.dcn_bandwidth)
+        out["dcn_latency"] = float(cfg.dcn_latency)
+    return out
 
 
 def _calibration_digest() -> str:

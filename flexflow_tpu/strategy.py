@@ -87,6 +87,12 @@ class Strategy:
     # FFConfig.zero_stage.  Rides the strategy so a store-restored or
     # imported winner replays with the stage it was costed under.
     zero_stage: Optional[int] = None
+    # search-chosen multi-slice placement (docs/TOPOLOGY.md): the mesh
+    # axis that spans the DCN boundary between slices.  None means "not
+    # chosen" — the executor/simulator fall back to the shared
+    # topology.resolve_placement default.  Meaningless (and ignored) on
+    # single-slice runs, so flat strategies serialize unchanged.
+    placement: Optional[str] = None
 
     # -- serialization ---------------------------------------------------
     def to_json(self) -> str:
@@ -101,6 +107,7 @@ class Strategy:
                 "pipeline": self.pipeline,
                 "catalog": self.catalog,
                 "zero_stage": self.zero_stage,
+                "placement": self.placement,
             },
             indent=2,
         )
@@ -121,6 +128,7 @@ class Strategy:
             pipeline=d.get("pipeline"),
             catalog=d.get("catalog"),
             zero_stage=d.get("zero_stage"),
+            placement=d.get("placement"),
         )
 
     def save(self, path: str):

@@ -1,0 +1,45 @@
+"""Multi-slice topology subsystem (docs/TOPOLOGY.md).
+
+Makes the slice hierarchy — fast ICI inside a slice, slow DCN between —
+a first-class, searchable dimension end to end:
+
+  * `hierarchy` — the `SliceHierarchy`/`PodModel` machine model with
+    two-level collective costs, plus the placement helpers
+    (`resolve_placement`, `legal_placements`, `expand_mesh_axes`) both
+    searches and the executor share;
+  * `rendezvous` — the cross-slice epoch/health rendezvous generalizing
+    PR 9's blob-store preemption barrier.
+"""
+from .hierarchy import (
+    SLICE_AXIS,
+    CommCost,
+    PodModel,
+    SliceHierarchy,
+    expand_mesh_axes,
+    hierarchy_from_config,
+    legal_placements,
+    parse_slice_topology,
+    resolve_placement,
+)
+from .rendezvous import (
+    clear_rendezvous,
+    epoch_rendezvous,
+    health_census,
+    post_and_agree,
+)
+
+__all__ = [
+    "SLICE_AXIS",
+    "CommCost",
+    "PodModel",
+    "SliceHierarchy",
+    "clear_rendezvous",
+    "epoch_rendezvous",
+    "expand_mesh_axes",
+    "health_census",
+    "hierarchy_from_config",
+    "legal_placements",
+    "parse_slice_topology",
+    "post_and_agree",
+    "resolve_placement",
+]

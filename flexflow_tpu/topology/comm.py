@@ -1,0 +1,48 @@
+"""Per-tier collective cost records (dependency-free).
+
+Split out of `hierarchy.py` so `sim/simulator.py` can import the record
+types at module level without a cycle: hierarchy.py imports the machine
+models from `sim.machine_model` (whose package __init__ pulls in the
+simulator), so anything the simulator needs at import time lives here.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class CommCost:
+    """One collective's cost, split by network tier."""
+
+    ici_time: float = 0.0
+    dcn_time: float = 0.0
+    ici_bytes: float = 0.0  # ring bytes moved per device over ICI
+    dcn_bytes: float = 0.0  # ring bytes moved per device over DCN
+
+    @property
+    def time(self) -> float:
+        return self.ici_time + self.dcn_time
+
+    def __add__(self, other: "CommCost") -> "CommCost":
+        return CommCost(
+            self.ici_time + other.ici_time,
+            self.dcn_time + other.dcn_time,
+            self.ici_bytes + other.ici_bytes,
+            self.dcn_bytes + other.dcn_bytes,
+        )
+
+
+ZERO_COST = CommCost()
+
+
+def ring_bytes(kind: str, size: float, n: int) -> float:
+    """Per-device bytes a ring collective moves (the bandwidth-term
+    bytes of the machine-model formulas)."""
+    if n <= 1:
+        return 0.0
+    if kind == "allreduce":
+        return 2.0 * (n - 1) / n * size
+    return (n - 1) / n * size  # allgather / reducescatter / alltoall
+
+
+__all__ = ["CommCost", "ZERO_COST", "ring_bytes"]

@@ -1,0 +1,341 @@
+"""Slice hierarchy: the two-level (ICI-within / DCN-between) machine model.
+
+Production TPU scale is pods of slices — a fast per-slice ICI torus and
+a much slower DCN fabric between slices.  "Synthesizing Optimal
+Parallelism Placement and Reduction Strategies on Hierarchical Systems"
+(arXiv:2110.10548) shows placement and reduction strategy must be
+searched *jointly* on such hierarchies; this module owns the model both
+searches and the executor share:
+
+  * `SliceHierarchy` — a `TpuPodModel` whose slice count is live: it
+    keeps every flat (single-tier) collective estimate of its parent
+    AND exposes the two-level costs — a hierarchical all-reduce is
+    intra-slice reduce-scatter over ICI, inter-slice all-reduce over
+    DCN on the scattered shard, intra-slice all-gather back;
+  * *placement* helpers — `resolve_placement` / `legal_placements` pick
+    which strategy mesh axis spans the DCN boundary (every other axis
+    stays inside a slice), `expand_mesh_axes` lowers that choice to the
+    execution mesh (the placement axis splits into a leading
+    `SLICE_AXIS` of size S and its intra-slice remainder, so XLA's
+    C-order device layout puts the slice dimension outermost and the
+    sharding-constraint re-specs in parallel/zero.py + the executor can
+    name the intra-slice axis).
+
+Every cost is returned as a `CommCost` carrying the per-tier (ICI vs
+DCN) time and ring-bytes split — the terms `sim/simulator.py` folds
+into `OpTerms.ici_xfer`/`dcn_xfer` and the `comm/*_bytes` telemetry.
+All times in seconds, sizes in bytes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..sim.machine_model import DeviceSpec, TpuPodModel, V5P_DEVICE
+from .comm import CommCost, ZERO_COST, ring_bytes
+
+#: reserved execution-mesh axis name for the inter-slice (DCN) dim.
+#: Strategies never generate it; model.compile refuses to expand a
+#: user mesh that already names it.
+SLICE_AXIS = "slice"
+
+
+class SliceHierarchy(TpuPodModel):
+    """ICI torus per slice + DCN between slices, with two-level
+    collective costs alongside the flat per-axis ones.
+
+    `topology` is ONE slice's per-axis chip counts; `slices` joins that
+    many identical slices over DCN.  Mesh axes inside a slice ride ICI;
+    the searched *placement* axis spans slices and its collectives cost
+    the hierarchical (or pure-DCN) form via `collective_cost`.
+    """
+
+    version = 3
+
+    def __init__(
+        self,
+        topology: Tuple[int, ...] = (4,),
+        slices: int = 2,
+        device: DeviceSpec = V5P_DEVICE,
+        ici_bw_per_link: float = 90e9,
+        ici_latency: float = 1e-6,
+        dcn_bw_per_host: float = 25e9,
+        dcn_latency: float = 10e-6,
+    ):
+        if slices < 1:
+            raise ValueError(f"slices must be >= 1, got {slices}")
+        super().__init__(
+            topology=topology,
+            device=device,
+            ici_bw_per_link=ici_bw_per_link,
+            ici_latency=ici_latency,
+            dcn_bw_per_host=dcn_bw_per_host,
+            dcn_latency=dcn_latency,
+            slices=slices,
+        )
+
+    # -- single-tier legs (flat API, tier explicit) ---------------------
+    def tier_collective(self, kind: str, size: float, n: int,
+                        over_dcn: bool = False) -> CommCost:
+        """One collective entirely on one tier, as a CommCost."""
+        if n <= 1:
+            return ZERO_COST
+        if kind == "allreduce":
+            t = self.axis_allreduce_time(size, n, over_dcn)
+        elif kind in ("allgather", "reducescatter"):
+            t = self.axis_allgather_time(size, n, over_dcn)
+        else:
+            t = self.axis_alltoall_time(size, n, over_dcn)
+        b = ring_bytes(kind, size, n)
+        if over_dcn:
+            return CommCost(dcn_time=t, dcn_bytes=b)
+        return CommCost(ici_time=t, ici_bytes=b)
+
+    # -- two-level collective costs -------------------------------------
+    def split_group(self, group_len: int) -> Tuple[int, int]:
+        """(intra, inter) factorization of a cross-slice group: the
+        inter leg is the slice count whenever it divides the group,
+        else the whole group degrades to pure DCN."""
+        s = self.slices
+        if s <= 1 or group_len <= 1:
+            return group_len, 1
+        if group_len % s == 0:
+            return group_len // s, s
+        return 1, group_len  # unfactorable: every hop may cross DCN
+
+    def hierarchical_allreduce_time(self, size: float, intra: int,
+                                    inter: int) -> float:
+        return self.hierarchical_cost("allreduce", size, intra, inter).time
+
+    def hierarchical_cost(self, kind: str, size: float, intra: int,
+                          inter: int) -> CommCost:
+        """Two-level synthesis of one collective over `intra * inter`
+        devices where the inter leg crosses DCN.
+
+        all-reduce:      RS over ICI -> AR of size/intra over DCN
+                         -> AG over ICI  (the reduction the executor
+                         synthesizes with sharding-constraint re-specs);
+        reduce-scatter:  RS over ICI -> RS of size/intra over DCN;
+        all-gather:      AG of size/intra over DCN -> AG over ICI;
+        all-to-all:      intra-slice exchange over ICI plus the
+                         cross-slice fraction (inter-1)/inter over DCN.
+        """
+        if intra <= 1:
+            return self.tier_collective(kind, size, inter, over_dcn=True)
+        if inter <= 1:
+            return self.tier_collective(kind, size, intra)
+        if kind == "allreduce":
+            return (
+                self.tier_collective("reducescatter", size, intra)
+                + self.tier_collective("allreduce", size / intra, inter,
+                                       over_dcn=True)
+                + self.tier_collective("allgather", size, intra)
+            )
+        if kind == "reducescatter":
+            return (
+                self.tier_collective("reducescatter", size, intra)
+                + self.tier_collective("reducescatter", size / intra,
+                                       inter, over_dcn=True)
+            )
+        if kind == "allgather":
+            return (
+                self.tier_collective("allgather", size / intra, inter,
+                                     over_dcn=True)
+                + self.tier_collective("allgather", size, intra)
+            )
+        # alltoall: each device exchanges (n-1)/n of size; the slices it
+        # does not share ICI with account for the (inter-1)/inter slab
+        cross = size * (inter - 1) / inter
+        return (
+            self.tier_collective("alltoall", size - cross, intra)
+            + self.tier_collective("alltoall", cross, inter, over_dcn=True)
+        )
+
+    def collective_cost(self, kind: str, size: float, group_len: int,
+                        cross: bool = False) -> CommCost:
+        """The cost the simulator charges one collective: flat ICI when
+        the group stays inside a slice, the hierarchical synthesis when
+        it spans the DCN boundary."""
+        if group_len <= 1:
+            return ZERO_COST
+        if not cross or self.slices <= 1:
+            return self.tier_collective(kind, size, group_len)
+        intra, inter = self.split_group(group_len)
+        return self.hierarchical_cost(kind, size, intra, inter)
+
+
+PodModel = SliceHierarchy  # the ISSUE's alias
+
+
+# ----------------------------------------------------------------------
+# placement: which strategy mesh axis spans the DCN boundary
+# ----------------------------------------------------------------------
+
+def legal_placements(mesh_axes: Dict[str, int], slices: int) -> List[str]:
+    """Axes a strategy may place across slices: size divisible by the
+    slice count (each slice then holds an equal 1/S of that axis)."""
+    if slices <= 1:
+        return []
+    return [
+        a for a, n in mesh_axes.items()
+        if n >= slices and n % slices == 0
+    ]
+
+
+def resolve_placement(mesh_axes: Dict[str, int],
+                      slices: int) -> Optional[str]:
+    """Default placement when a strategy carries none: the first legal
+    axis in declaration order (strategies declare the data axis first,
+    so the default keeps model/expert groups intra-slice — grad sync
+    crosses DCN once per step in hierarchical form, per-layer
+    collectives stay on ICI).  None when no axis can span the slices
+    (the run degrades to a flat, placement-less execution)."""
+    legal = legal_placements(mesh_axes, slices)
+    return legal[0] if legal else None
+
+
+def expand_mesh_axes(
+    mesh_axes: Dict[str, int], slices: int, placement: str,
+) -> Tuple[Dict[str, int], Optional[str]]:
+    """Lower a placement choice to the execution mesh.
+
+    Returns (exec_axes, intra_axis):
+
+      * placement axis larger than the slice count: a leading
+        `SLICE_AXIS` of size S is inserted and the placement axis keeps
+        its name at 1/S size — `intra_axis` names it, and the
+        reduction-synthesis re-specs (executor/parallel.zero) scatter
+        over it so the cross-slice reduction decomposes into
+        RS(ICI) -> AR(DCN) -> AG(ICI);
+      * placement axis exactly the slice count: the axis IS the slice
+        dim — it moves to the front (outermost in the C-order device
+        layout) and there is no intra remainder (`intra_axis` None).
+
+    The leading position is what aligns the axis with physical slices:
+    jax's C-order reshape varies the first axis slowest, so slice id ==
+    device_index // devices_per_slice.
+    """
+    size = mesh_axes.get(placement, 0)
+    if slices <= 1 or size < slices or size % slices:
+        raise ValueError(
+            f"placement {placement!r} (size {size}) cannot span "
+            f"{slices} slices"
+        )
+    if size == slices:
+        out = {placement: size}
+        out.update(
+            (k, v) for k, v in mesh_axes.items() if k != placement
+        )
+        return out, None
+    out = {SLICE_AXIS: slices}
+    for k, v in mesh_axes.items():
+        out[k] = v // slices if k == placement else v
+    return out, placement
+
+
+def placement_stats(strategy, slices: int) -> Dict[str, object]:
+    """The search_stats payload describing a winner's placement: the
+    effective cross-slice axis ("" on flat runs) and whether its grad
+    reduction lowers to the hierarchical form (an intra-slice remainder
+    exists) rather than a pure-DCN ring.  Pipeline winners report no
+    placement — model.compile executes them flat (unexpanded), so
+    claiming one would advertise a reduction never synthesized."""
+    if slices <= 1 or getattr(strategy, "pipeline", None):
+        return {"placement": "", "hierarchical_reduction": False}
+    eff = getattr(strategy, "placement", None)
+    if eff not in legal_placements(strategy.mesh_axes, slices):
+        eff = resolve_placement(strategy.mesh_axes, slices)
+    return {
+        "placement": eff or "",
+        "hierarchical_reduction": bool(
+            eff and strategy.mesh_axes.get(eff, 0) > slices
+        ),
+    }
+
+
+def hierarchy_from_config(cfg, num_devices: int) -> SliceHierarchy:
+    """Build the run's SliceHierarchy from FFConfig (--slices,
+    --slice-topology, --dcn-bandwidth, --dcn-latency).  The per-slice
+    topology defaults to a 1-D ring of num_devices/slices chips —
+    the multi-slice face of make_machine_model's flat default.
+
+    --machine-model-file still contributes: its device roofline and
+    per-link ICI bandwidth/latency describe ONE slice's fabric (the
+    cfg DCN knobs own the inter-slice tier), and its topology serves
+    as the per-slice default when --slice-topology is unset."""
+    from ..sim.machine_model import TpuPodModel, detect_device_spec
+
+    slices = max(1, int(cfg.slices))
+    if num_devices % slices:
+        raise ValueError(
+            f"{num_devices} devices do not split into {slices} equal "
+            "slices"
+        )
+    per_slice = num_devices // slices
+    device = None
+    ici_kw = {}
+    file_topo: Optional[Tuple[int, ...]] = None
+    if getattr(cfg, "machine_model_file", None):
+        base = TpuPodModel.from_file(cfg.machine_model_file)
+        device = base.device()
+        ici_kw = {"ici_bw_per_link": base.ici_bw,
+                  "ici_latency": base.ici_lat}
+        file_topo = base.topology
+    topo: Tuple[int, ...]
+    if cfg.slice_topology:
+        topo = parse_slice_topology(cfg.slice_topology)
+    elif file_topo is not None and _prod(file_topo) == per_slice:
+        topo = file_topo
+    else:
+        topo = (per_slice,)
+    if _prod(topo) != per_slice:
+        raise ValueError(
+            f"slice topology {topo} has {_prod(topo)} chips per slice "
+            f"but {num_devices} devices / {slices} slices = {per_slice}"
+        )
+    return SliceHierarchy(
+        topology=topo,
+        slices=slices,
+        device=device if device is not None else detect_device_spec(),
+        dcn_bw_per_host=float(cfg.dcn_bandwidth),
+        dcn_latency=float(cfg.dcn_latency),
+        **ici_kw,
+    )
+
+
+def _prod(dims: Tuple[int, ...]) -> int:
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def parse_slice_topology(spec: str) -> Tuple[int, ...]:
+    """'4x4' or '4,4' -> (4, 4); raises ValueError on anything else."""
+    parts = [p for p in str(spec).replace("x", ",").split(",") if p.strip()]
+    if not parts:
+        raise ValueError(f"empty slice topology {spec!r}")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        raise ValueError(
+            f"slice topology {spec!r} must be comma/x-separated ints"
+        ) from None
+    if any(d < 1 for d in dims):
+        raise ValueError(f"slice topology {spec!r} has non-positive dims")
+    return dims
+
+
+__all__ = [
+    "SLICE_AXIS",
+    "CommCost",
+    "ZERO_COST",
+    "PodModel",
+    "SliceHierarchy",
+    "expand_mesh_axes",
+    "hierarchy_from_config",
+    "legal_placements",
+    "parse_slice_topology",
+    "placement_stats",
+    "resolve_placement",
+    "ring_bytes",
+]

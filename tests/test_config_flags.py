@@ -411,3 +411,43 @@ def test_remat_matches_nonremat_numerics_and_inserts_checkpoint(devices8):
     assert ex._remat_plan is not None
     assert any(pure for _, _, _, pure in ex._remat_plan)
     assert ff_a.executor._remat_plan is None
+
+
+def test_topology_cli_flags_parse():
+    cfg = FFConfig.from_args([
+        "--slices", "2", "--dcn-bandwidth", "5e9",
+        "--dcn-latency", "2e-5", "--slice-topology", "2,2",
+    ])
+    assert cfg.slices == 2
+    assert cfg.dcn_bandwidth == pytest.approx(5e9)
+    assert cfg.dcn_latency == pytest.approx(2e-5)
+    assert cfg.slice_topology == "2,2"
+    # defaults: 1 slice = exactly the flat pre-topology behavior
+    d = FFConfig.from_args([])
+    assert d.slices == 1 and d.slice_topology is None
+    assert d.dcn_bandwidth == pytest.approx(25e9)
+    assert d.dcn_latency == pytest.approx(10e-6)
+
+
+def test_topology_config_validated():
+    with pytest.raises(ValueError):
+        FFConfig(slices=0)
+    with pytest.raises(ValueError):
+        FFConfig(dcn_bandwidth=0.0)
+    with pytest.raises(ValueError):
+        FFConfig(dcn_latency=-1e-6)
+    with pytest.raises(ValueError):
+        FFConfig(slice_topology="zero,4")
+    FFConfig(slices=2, slice_topology="4x4")  # valid hierarchy config
+
+
+def test_slices_selects_hierarchy_machine_model():
+    from flexflow_tpu.sim.machine_model import make_machine_model
+    from flexflow_tpu.topology.hierarchy import SliceHierarchy
+
+    m = make_machine_model(FFConfig(slices=2, dcn_bandwidth=3e9), 8)
+    assert isinstance(m, SliceHierarchy)
+    assert m.slices == 2 and m.dcn_bw == pytest.approx(3e9)
+    assert m.num_devices() == 8
+    flat = make_machine_model(FFConfig(), 8)
+    assert not isinstance(flat, SliceHierarchy)

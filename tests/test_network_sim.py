@@ -53,6 +53,20 @@ def test_torus_generator():
     assert (t3.sum(axis=1) == 3).all()
 
 
+def test_multi_slice_torus_generator():
+    from flexflow_tpu.sim.network import multi_slice_torus
+
+    conn = multi_slice_torus((2, 2), slices=3, dcn_links=2)
+    assert conn.shape == (12, 12) and _connected(conn)
+    # intra-slice blocks are the plain torus
+    assert (conn[:4, :4] == torus((2, 2))).all()
+    # chip i of slice a links chip i of slice b with dcn_links links
+    assert conn[0, 4] == 2 and conn[4, 8] == 2
+    # no cross-slice links between different chip indices
+    assert conn[0, 5] == 0
+    assert (conn == conn.T).all()
+
+
 def test_shortest_path_routing():
     # path graph 0-1-2-3
     conn = np.zeros((4, 4), np.int32)

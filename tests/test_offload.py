@@ -921,3 +921,171 @@ def test_telemetry_summary_renders_durability_section(devices8, tmp_path):
     assert "Durability" in out
     assert "offload_uploads" in out and "offload_bytes" in out
     assert "offload_upload_ms" in out
+
+
+# -- per-leaf delta mirror (ISSUE 12 satellite) ---------------------------
+
+def _multi_leaf_files(step, a_value, b_value):
+    leaves = {"['weights']['a']['k']": np.full(8, a_value, np.float32),
+              "['weights']['b']['k']": np.full(8, b_value, np.float32)}
+    buf = io.BytesIO()
+    np.savez(buf, **leaves)
+    manifest = {
+        "manifest_version": 1, "step": step,
+        "leaves": {
+            k: {
+                "crc32": zlib.crc32(
+                    np.ascontiguousarray(v).view(np.uint8).reshape(-1)
+                ),
+                "bytes": int(v.nbytes), "shape": [8], "dtype": "float32",
+            }
+            for k, v in leaves.items()
+        },
+    }
+    return {
+        "state.npz": buf.getvalue(),
+        "meta.json": json.dumps({"step": step}).encode(),
+        "manifest.json": json.dumps(manifest).encode(),
+    }
+
+
+def test_delta_mirror_skips_unchanged_leaves(tmp_path):
+    """The second upload drops the leaf whose crc matched the previous
+    mirrored step, annotates it in the remote manifest, and restore
+    reassembles the FULL step bit-identically."""
+    from flexflow_tpu.resilience.offload import RemoteCheckpointStore
+
+    r = RemoteCheckpointStore(LocalBlobStore(str(tmp_path)))
+    rep1 = r.upload_step(2, _multi_leaf_files(2, 1.0, 5.0))
+    assert rep1.leaves_skipped == 0
+    # leaf 'a' unchanged, leaf 'b' changed
+    rep2 = r.upload_step(4, _multi_leaf_files(4, 1.0, 7.0),
+                         base_step=2, base_manifest=rep1.manifest)
+    assert rep2.leaves_skipped == 1
+    assert rep2.bytes_uploaded < rep1.bytes_uploaded
+    # the remote state.npz really lacks the unchanged leaf
+    raw = r.blob.get(r._step_prefix(4) + "state.npz")
+    with np.load(io.BytesIO(raw)) as data:
+        assert list(data.files) == ["['weights']['b']['k']"]
+    # verify passes (base vouches for the delta leaf)...
+    man = r.verify_step(4)
+    assert man["leaves"]["['weights']['a']['k']"]["base_step"] == 2
+    # ...and download reassembles a SELF-CONTAINED full step
+    files = r.download_step(4)
+    with np.load(io.BytesIO(files["state.npz"])) as data:
+        np.testing.assert_array_equal(
+            data["['weights']['a']['k']"], np.full(8, 1.0, np.float32))
+        np.testing.assert_array_equal(
+            data["['weights']['b']['k']"], np.full(8, 7.0, np.float32))
+    out_man = json.loads(files["manifest.json"])
+    assert "base_step" not in out_man["leaves"]["['weights']['a']['k']"]
+
+
+def test_delta_mirror_prune_keeps_referenced_base(tmp_path):
+    """keep-last-1 pruning must NOT delete the base step a kept delta
+    still resolves its leaves through."""
+    from flexflow_tpu.resilience.offload import RemoteCheckpointStore
+
+    r = RemoteCheckpointStore(LocalBlobStore(str(tmp_path)))
+    rep1 = r.upload_step(2, _multi_leaf_files(2, 1.0, 5.0))
+    r.upload_step(4, _multi_leaf_files(4, 1.0, 7.0),
+                  base_step=2, base_manifest=rep1.manifest)
+    r.prune(keep=1)
+    assert r.list_steps() == [2, 4]  # base survives the prune
+    files = r.download_step(4)      # and the delta still reassembles
+    with np.load(io.BytesIO(files["state.npz"])) as data:
+        assert len(data.files) == 2
+
+
+def test_delta_chain_reanchors_at_bound(tmp_path):
+    """A delta chain re-uploads the full step once the bound is hit, so
+    restores never chase unbounded base chains."""
+    from flexflow_tpu.resilience.offload import (
+        MAX_DELTA_CHAIN, RemoteCheckpointStore,
+    )
+
+    r = RemoteCheckpointStore(LocalBlobStore(str(tmp_path)))
+    rep = r.upload_step(0, _multi_leaf_files(0, 1.0, 0.0))
+    step, deltas = 0, []
+    for i in range(1, MAX_DELTA_CHAIN + 3):
+        step = 2 * i
+        rep2 = r.upload_step(step, _multi_leaf_files(step, 1.0, float(i)),
+                             base_step=step - 2, base_manifest=rep.manifest)
+        deltas.append(rep2.leaves_skipped > 0)
+        rep = rep2
+    # MAX deltas, then one full re-anchor, then the chain restarts
+    assert deltas == [True] * MAX_DELTA_CHAIN + [False, True]
+    files = r.download_step(step)
+    with np.load(io.BytesIO(files["state.npz"])) as data:
+        assert len(data.files) == 2
+
+
+def test_offloader_counts_skipped_leaves(tmp_path):
+    """End to end through the offloader thread: the second cadence
+    upload skips the unchanged leaf and counts it."""
+    from flexflow_tpu.resilience.offload import (
+        CheckpointOffloader, RemoteCheckpointStore,
+    )
+
+    r = RemoteCheckpointStore(LocalBlobStore(str(tmp_path)))
+    off = CheckpointOffloader(r, every=1, keep=3, sleep=NO_SLEEP)
+    try:
+        off.maybe_submit(2, _multi_leaf_files(2, 1.0, 5.0))
+        off.drain()
+        off.maybe_submit(4, _multi_leaf_files(4, 1.0, 7.0))
+        off.drain()
+    finally:
+        off.close()
+    assert off.counters["offload_uploads"] == 2
+    assert off.counters["offload_leaves_skipped"] == 1
+    assert r.latest_verified_step() == 4
+
+
+def test_delta_mirror_prune_aborts_on_unreadable_manifest(tmp_path):
+    """A transient store fault while resolving a kept delta's bases
+    must SKIP the prune round, not delete the base (review finding:
+    deleting it would leave REMOTE_LATEST unrestorable)."""
+    from flexflow_tpu.resilience.offload import RemoteCheckpointStore
+    from flexflow_tpu.store.blobstore import BlobUnavailableError
+
+    blob = LocalBlobStore(str(tmp_path))
+    r = RemoteCheckpointStore(blob)
+    rep1 = r.upload_step(2, _multi_leaf_files(2, 1.0, 5.0))
+    r.upload_step(4, _multi_leaf_files(4, 1.0, 7.0),
+                  base_step=2, base_manifest=rep1.manifest)
+
+    real_get = blob.get
+
+    def flaky_get(key):
+        if key.endswith("step_00000004/manifest.json"):
+            raise BlobUnavailableError("store blip")
+        return real_get(key)
+
+    blob.get = flaky_get
+    try:
+        assert r.prune(keep=1) == 0  # aborted, nothing deleted
+    finally:
+        blob.get = real_get
+    assert r.list_steps() == [2, 4]
+    files = r.download_step(4)  # base intact: delta still reassembles
+    with np.load(io.BytesIO(files["state.npz"])) as data:
+        assert len(data.files) == 2
+
+
+def test_delta_chain_flattens_to_the_anchor_step(tmp_path):
+    """Delta annotations point at the step that HOLDS the bytes (the
+    anchor), not the immediately previous delta — one base fetch per
+    restore, and prune retains anchors only (review finding)."""
+    from flexflow_tpu.resilience.offload import RemoteCheckpointStore
+
+    r = RemoteCheckpointStore(LocalBlobStore(str(tmp_path)))
+    rep = r.upload_step(0, _multi_leaf_files(0, 1.0, 0.0))
+    for i in (1, 2, 3):
+        rep = r.upload_step(2 * i, _multi_leaf_files(2 * i, 1.0, float(i)),
+                            base_step=2 * (i - 1), base_manifest=rep.manifest)
+    man = json.loads(
+        r.blob.get(r._step_prefix(6) + "manifest.json")
+    )
+    # leaf 'a' unchanged since step 0: annotated straight to the anchor
+    assert man["leaves"]["['weights']['a']['k']"]["base_step"] == 0
+    assert r._base_steps_of(6) == [0]

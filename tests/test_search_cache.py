@@ -93,6 +93,51 @@ def test_delta_eval_matches_full_eval_bit_for_bit(build, stage):
         st.illegal_evals == st.evals
 
 
+@pytest.mark.parametrize("placement", [None, "data", "model"],
+                         ids=["pdefault", "pdata", "pmodel"])
+def test_delta_eval_matches_full_eval_over_placements(placement):
+    """ISSUE 12 satellite: the multi-slice placement dimension keeps
+    the delta_eval == full_eval invariant bit-for-bit — a strategy's
+    placement re-tiers every comm term through the cached OpTerms, and
+    both paths must sum identical terms in identical order on a
+    SliceHierarchy machine."""
+    import dataclasses
+
+    from flexflow_tpu.topology.hierarchy import SliceHierarchy
+
+    graph = _transformer().layers
+    machine = SliceHierarchy(topology=(4,), slices=2, dcn_bw_per_host=4e9)
+    ev_delta = IncrementalEvaluator(graph, Simulator(machine),
+                                    use_cache=True)
+    ev_full = IncrementalEvaluator(graph, Simulator(machine),
+                                   use_cache=False)
+    legal = 0
+    for s in _random_strategies(graph, n_moves=30):
+        if placement is not None and s.mesh_axes.get(placement, 0) % 2:
+            continue  # illegal placement for this mesh: skip the pin
+        c = dataclasses.replace(s, placement=placement)
+        rd = ev_delta.evaluate(c)
+        rf = ev_full.evaluate(c)
+        assert (rd is None) == (rf is None)
+        if rd is None:
+            continue
+        legal += 1
+        assert rd.total_time == rf.total_time
+        assert rd.comm_time == rf.comm_time
+        assert rd.sync_time == rf.sync_time
+        assert rd.per_device_memory == rf.per_device_memory
+        assert rd.comm_tiers == rf.comm_tiers
+    assert legal > 5
+    assert ev_delta.stats.memo_hits + ev_delta.stats.delta_evals > 0
+    # placements never alias in the memo
+    base = data_parallel_strategy(8)
+    sigs = {
+        strategy_signature(dataclasses.replace(base, placement=p))
+        for p in (None, "data")
+    }
+    assert len(sigs) == 2
+
+
 def test_delta_eval_matches_full_eval_with_strategy_stage():
     """A strategy-carried zero_stage (how unity's stage variants and
     store-restored winners cost themselves) overrides the simulator

@@ -1,0 +1,571 @@
+"""Multi-slice topology subsystem (ISSUE 12, docs/TOPOLOGY.md).
+
+Pins the tentpole contracts:
+
+  * the two-level machine model's hierarchical collective costs sit
+    strictly between the pure-ICI and pure-DCN bounds, and the
+    multi-slice torus generator routes cross-slice paths through one
+    DCN hop (hand-computed estimates);
+  * *placement* is a searched, costed strategy dimension: with 2
+    slices and a DCN >= 10x slower than ICI both searches keep the
+    tensor-parallel groups intra-slice (placement = the data axis) and
+    choose the hierarchical reduction, surfaced in
+    search_stats["placement"];
+  * the executor lowers the cross-slice grad reduction to the
+    hierarchical form on a two-level mesh, numerically equivalent to
+    the flat reduction on the same global mesh (the ZeRO-ladder
+    equivalence standard: float32 reduction-order noise only);
+  * store keys are hierarchy-aware without invalidating single-slice
+    entries: --slices 1 fingerprints carry NO slice fields and ignore
+    the DCN knobs;
+  * the cross-slice rendezvous generalizes the preemption barrier
+    (epoch agreement = MAX, health census).
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.pcg.evaluator import IncrementalEvaluator, strategy_signature
+from flexflow_tpu.pcg.mcmc import MCMCSearch
+from flexflow_tpu.pcg.unity import UnitySearch
+from flexflow_tpu.sim.simulator import OpCostModel, Simulator
+from flexflow_tpu.strategy import Strategy, data_parallel_strategy
+from flexflow_tpu.topology.hierarchy import (
+    SLICE_AXIS,
+    SliceHierarchy,
+    expand_mesh_axes,
+    hierarchy_from_config,
+    legal_placements,
+    parse_slice_topology,
+    resolve_placement,
+)
+
+
+def _hier(dcn_bw=4e9, dcn_lat=2e-6, slices=2, topo=(4,)):
+    return SliceHierarchy(topology=topo, slices=slices,
+                          dcn_bw_per_host=dcn_bw, dcn_latency=dcn_lat)
+
+
+# -- machine model -------------------------------------------------------
+
+def test_hierarchical_allreduce_between_pure_bounds():
+    """RS(ICI) -> AR(DCN on the shard) -> AG(ICI) must cost strictly
+    more than an all-ICI ring and strictly less than an all-DCN ring
+    whenever DCN is the slower tier."""
+    m = _hier()
+    size = 64 * 2**20
+    for intra, inter in [(4, 2), (2, 4), (8, 2)]:
+        n = intra * inter
+        ici = m.tier_collective("allreduce", size, n).time
+        dcn = m.tier_collective("allreduce", size, n, over_dcn=True).time
+        hier = m.hierarchical_allreduce_time(size, intra, inter)
+        assert ici < hier < dcn, (intra, inter, ici, hier, dcn)
+
+
+def test_hierarchical_cost_degenerates_at_trivial_legs():
+    m = _hier()
+    size = 1 << 20
+    # no intra remainder -> the pure DCN ring
+    assert m.hierarchical_cost("allreduce", size, 1, 2).time == \
+        m.tier_collective("allreduce", size, 2, over_dcn=True).time
+    # no inter leg -> the pure ICI ring
+    assert m.hierarchical_cost("allreduce", size, 4, 1).time == \
+        m.tier_collective("allreduce", size, 4).time
+
+
+def test_collective_cost_tier_split_accounting():
+    """The CommCost split carries the hierarchical decomposition: the
+    DCN leg moves only the scattered shard's ring bytes."""
+    m = _hier()
+    size = 8 * 2**20
+    cc = m.collective_cost("allreduce", size, 8, cross=True)  # (4, 2)
+    # DCN all-reduce of size/4 over 2: 2 * (1/2) * size/4
+    assert cc.dcn_bytes == pytest.approx(size / 4.0)
+    # ICI RS + AG of the full size over 4: 2 * (3/4) * size
+    assert cc.ici_bytes == pytest.approx(2 * 0.75 * size)
+    flat = m.collective_cost("allreduce", size, 8, cross=False)
+    assert flat.dcn_bytes == 0 and flat.dcn_time == 0
+    assert flat.time < cc.time  # the hierarchy pays for the DCN leg
+
+
+def test_split_group_and_unfactorable_fallback():
+    m = _hier(slices=2)
+    assert m.split_group(8) == (4, 2)
+    assert m.split_group(2) == (1, 2)
+    assert m.split_group(3) == (1, 3)  # unfactorable: pure DCN
+
+
+def test_multi_slice_torus_routing_hand_computed():
+    """Generator + routed p2p: intra-slice rides per-hop ICI links,
+    cross-slice exactly one DCN-tier hop between same-index chips."""
+    from flexflow_tpu.sim.network import (
+        NetworkedMachineModel, multi_slice_torus,
+    )
+
+    conn = multi_slice_torus((4,), slices=2)
+    assert conn.shape == (8, 8)
+    # chip 0 of slice 0 <-> chip 0 of slice 1 directly linked
+    assert conn[0, 4] == 1 and conn[4, 0] == 1
+    # no diagonal cross-slice shortcuts
+    assert conn[0, 5] == 0
+    m = NetworkedMachineModel(conn, link_bandwidth=1e9, link_latency=1e-6)
+    size = 1 << 20
+    # ring neighbors inside a slice: one hop
+    assert np.isclose(m.p2p_time(size, 0, 1), 1e-6 + size / 1e9)
+    # cross-slice same index: one (DCN) hop
+    assert np.isclose(m.p2p_time(size, 0, 4), 1e-6 + size / 1e9)
+    # cross-slice different index: DCN hop + intra hop
+    assert np.isclose(m.p2p_time(size, 0, 5), 2e-6 + size / 1e9)
+
+
+def test_flat_costs_unchanged_on_single_slice():
+    """A SliceHierarchy with cross=False and a plain TpuPodModel agree
+    exactly — slices=1 (and every intra-slice group) is the flat
+    pre-topology cost model."""
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+
+    flat = TpuPodModel(topology=(4,))
+    m = _hier(topo=(4,))
+    size = 3 << 20
+    for n in (2, 4):
+        assert m.collective_cost("allreduce", size, n).time == \
+            flat.axis_allreduce_time(size, n)
+        assert m.collective_cost("allgather", size, n).time == \
+            flat.axis_allgather_time(size, n)
+
+
+# -- placement helpers ---------------------------------------------------
+
+def test_placement_helpers():
+    axes = {"data": 4, "model": 2}
+    assert legal_placements(axes, 2) == ["data", "model"]
+    assert legal_placements(axes, 4) == ["data"]
+    assert legal_placements(axes, 3) == []
+    assert resolve_placement(axes, 2) == "data"
+    assert resolve_placement({"model": 3}, 2) is None
+    assert legal_placements(axes, 1) == []
+
+
+def test_expand_mesh_axes_splits_and_reorders():
+    # intra remainder: leading slice axis + reduced placement axis
+    exec_axes, hier = expand_mesh_axes({"data": 8}, 2, "data")
+    assert exec_axes == {SLICE_AXIS: 2, "data": 4}
+    assert hier == "data"
+    exec_axes, hier = expand_mesh_axes({"model": 2, "data": 4}, 2, "data")
+    assert list(exec_axes) == [SLICE_AXIS, "model", "data"]
+    assert exec_axes["data"] == 2 and hier == "data"
+    # placement axis exactly the slice count: moved first, no split
+    exec_axes, hier = expand_mesh_axes({"model": 4, "data": 2}, 2, "data")
+    assert list(exec_axes) == ["data", "model"]
+    assert exec_axes["data"] == 2 and hier is None
+    with pytest.raises(ValueError):
+        expand_mesh_axes({"data": 3}, 2, "data")
+
+
+def test_parse_slice_topology():
+    assert parse_slice_topology("4x4") == (4, 4)
+    assert parse_slice_topology("2,2,2") == (2, 2, 2)
+    for bad in ("", "axb", "0,4", "-1"):
+        with pytest.raises(ValueError):
+            parse_slice_topology(bad)
+
+
+def test_hierarchy_from_config_validates():
+    cfg = FFConfig(slices=2, slice_topology="2,2")
+    m = hierarchy_from_config(cfg, 8)
+    assert m.slices == 2 and m.topology == (2, 2)
+    with pytest.raises(ValueError):
+        hierarchy_from_config(FFConfig(slices=3), 8)  # 8 % 3
+    with pytest.raises(ValueError):
+        hierarchy_from_config(FFConfig(slices=2, slice_topology="4x4"), 8)
+
+
+# -- placement as a searched dimension -----------------------------------
+
+def _wide_mlp(batch=1024, h=64):
+    ff = FFModel(FFConfig(batch_size=batch))
+    x = ff.create_tensor([batch, h], name="x")
+    t = ff.dense(x, h, activation=ActiMode.RELU)
+    t = ff.dense(t, h, activation=ActiMode.RELU)
+    t = ff.dense(t, 8)
+    ff.softmax(t)
+    return ff
+
+
+def test_placement_is_a_costed_dimension():
+    """The same sharding under different placements simulates to
+    different costs, and the strategy signature separates them."""
+    graph = _wide_mlp().layers
+    m = _hier()
+    ev = IncrementalEvaluator(graph, Simulator(m))
+    s = MCMCSearch(graph, 8, lambda: Simulator(m), budget=0)
+    flags = {c.name: True for c in s.candidates if c.name != "dense_2"}
+    r = {}
+    for p in ("data", "model"):
+        cand = s._build(4, 2, 1, flags, None, p)
+        assert cand.placement == p
+        r[p] = ev.evaluate(cand)
+    assert r["data"].total_time != r["model"].total_time
+    # tensor-parallel partial sums crossing DCN cost more than the
+    # once-per-step hierarchical grad sync at these activation sizes
+    assert r["data"].total_time < r["model"].total_time
+    base = data_parallel_strategy(8)
+    sigs = {
+        strategy_signature(dataclasses.replace(base, placement=p))
+        for p in (None, "data")
+    }
+    assert len(sigs) == 2
+
+
+def test_both_searches_choose_intra_slice_tp_and_hierarchical_reduction(
+        monkeypatch):
+    """The acceptance scenario: 2 slices, DCN >= 10x slower than the
+    effective ICI — both searches keep the tensor-parallel groups
+    intra-slice (the data axis crosses) and choose the hierarchical
+    reduction, surfaced in search_stats."""
+    ff = _wide_mlp()
+    m = _hier(dcn_bw=4e9, dcn_lat=2e-6)  # ICI eff 180e9: 45x slower
+    mcmc = MCMCSearch(ff.layers, 8, lambda: Simulator(m), budget=100,
+                      seed=0)
+    mcmc.factorizations = [(4, 2, 1)]  # dp x tp: placement decides
+    best = mcmc.optimize()
+    assert best.search_stats["placement"] == "data"
+    assert best.search_stats["hierarchical_reduction"] is True
+
+    import flexflow_tpu.pcg.unity as unity_mod
+
+    monkeypatch.setattr(
+        unity_mod, "_factorizations",
+        lambda n, allow_expert=True: [(4, 2, 1)],
+    )
+    unity = UnitySearch(ff.layers, 8, m, OpCostModel(m),
+                        enable_pipeline=False)
+    ub = unity.optimize()
+    assert ub.search_stats["placement"] == "data"
+    assert ub.search_stats["hierarchical_reduction"] is True
+    # the winner's predicted traffic keeps the DCN tier light: dp bytes
+    # cross scattered, tp bytes stay on ICI
+    ev = IncrementalEvaluator(ff.layers, Simulator(m))
+    res = ev.evaluate(ub)
+    tiers = res.comm_tiers
+    assert tiers["dcn_bytes"] > 0
+    assert tiers["dcn_bytes"] < tiers["ici_bytes"]
+
+
+def test_flat_machine_searches_carry_empty_placement():
+    from flexflow_tpu.sim.machine_model import TpuPodModel
+
+    graph = _wide_mlp(batch=16).layers
+    m = TpuPodModel(topology=(8,))
+    best = MCMCSearch(graph, 8, lambda: Simulator(m), budget=10,
+                      seed=0).optimize()
+    assert best.search_stats["placement"] == ""
+    assert best.search_stats["hierarchical_reduction"] is False
+    assert best.placement is None
+
+
+def test_placement_round_trips_serialization():
+    s = data_parallel_strategy(8)
+    s.placement = "data"
+    s2 = Strategy.from_json(s.to_json())
+    assert s2.placement == "data"
+    assert strategy_signature(s) == strategy_signature(s2)
+
+
+# -- executor: hierarchical reduction on the two-level mesh ---------------
+
+def _fit_model(cfg, devices8, wrapper=True):
+    ff = FFModel(cfg)
+    x = ff.create_tensor([16, 32], name="x")
+    t = ff.dense(x, 64, activation=ActiMode.RELU)
+    t = ff.dense(t, 8)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategy=data_parallel_strategy(8), devices=devices8,
+               seed=0)
+    if not wrapper:
+        # the flat-reduction baseline on the SAME two-level mesh:
+        # disable the hierarchical re-spec and rebuild the step
+        assert ff.executor.hier_axis is not None
+        ff.executor.hier_axis = None
+        ff._step_fn = ff.executor.build_step()
+        ff._step_cache[ff.iter_config.seq_length] = (
+            ff._step_fn, ff._eval_fn, ff._fwd_fn,
+        )
+    return ff
+
+
+def _weights(ff):
+    import jax
+
+    return jax.tree.leaves(jax.tree.map(np.asarray, ff._weights))
+
+
+def _assert_trees_close(a, b, rtol=2e-5, atol=2e-6):
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, rtol=rtol, atol=atol)
+
+
+def test_two_level_mesh_and_hier_axis(devices8):
+    cfg = FFConfig(batch_size=16, num_devices=8, slices=2)
+    ff = _fit_model(cfg, devices8)
+    assert ff.mesh.axis_names == (SLICE_AXIS, "data")
+    assert ff.mesh.devices.shape == (2, 4)
+    assert ff.executor.hier_axis == "data"
+    # strategy-facing surfaces keep the UNEXPANDED axes
+    assert ff.strategy.mesh_axes == {"data": 8}
+
+
+def test_hierarchical_reduction_matches_flat_on_same_global_mesh(devices8):
+    """The synthesized RS(ICI)->AR(DCN)->AG(ICI) grad reduction against
+    the flat XLA psum on the SAME two-level mesh: equivalent to within
+    float32 reduction-order noise (the ZeRO-ladder equivalence bar —
+    XLA owns the lowering, so summation order is a hint, not a
+    contract; docs/TOPOLOGY.md)."""
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 32).astype(np.float32)
+    ys = rng.randint(0, 8, 64).astype(np.int32)
+    mk = lambda: FFConfig(batch_size=16, num_devices=8, slices=2)  # noqa
+    ff_hier = _fit_model(mk(), devices8)
+    ff_flat = _fit_model(mk(), devices8, wrapper=False)
+    for ff in (ff_hier, ff_flat):
+        ff.fit(xs, ys, epochs=2, verbose=False)
+    _assert_trees_close(_weights(ff_hier), _weights(ff_flat))
+
+
+def test_single_slice_execution_is_bit_identical_to_pre_topology(devices8):
+    """--slices 1 is EXACTLY the current behavior: same mesh, no
+    wrapper, bit-identical training."""
+    rng = np.random.RandomState(1)
+    xs = rng.randn(64, 32).astype(np.float32)
+    ys = rng.randint(0, 8, 64).astype(np.int32)
+    ff1 = _fit_model(FFConfig(batch_size=16, num_devices=8), devices8)
+    ffs = _fit_model(FFConfig(batch_size=16, num_devices=8, slices=1),
+                     devices8)
+    assert ff1.mesh.axis_names == ffs.mesh.axis_names == ("data",)
+    assert ffs.executor.hier_axis is None
+    for ff in (ff1, ffs):
+        ff.fit(xs, ys, epochs=2, verbose=False)
+    for a, b in zip(_weights(ff1), _weights(ffs)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_multi_slice_with_zero_stage_shards_over_intra_axis(devices8):
+    """ZeRO stage >= 1 on a two-level mesh scatters over the INTRA
+    slice remainder (the reduced data axis): the wus machinery itself
+    produces the hierarchical form, numerics match stage 0."""
+    rng = np.random.RandomState(2)
+    xs = rng.randn(64, 32).astype(np.float32)
+    ys = rng.randint(0, 8, 64).astype(np.int32)
+    ff0 = _fit_model(FFConfig(batch_size=16, num_devices=8, slices=2),
+                     devices8)
+    ff1 = _fit_model(FFConfig(batch_size=16, num_devices=8, slices=2,
+                              zero_stage=1), devices8)
+    assert ff1.executor.wus_axis == "data"
+    assert ff1.executor.hier_axis is None  # wus already hierarchical
+    for ff in (ff0, ff1):
+        ff.fit(xs, ys, epochs=2, verbose=False)
+    _assert_trees_close(_weights(ff0), _weights(ff1))
+
+
+# -- simulator fidelity of the intra-slice wus group ----------------------
+
+def test_wus_group_shrinks_to_intra_remainder():
+    m = _hier()
+    sim = Simulator(m, zero_stage=1)
+    graph = _wide_mlp(batch=16).layers
+    s = data_parallel_strategy(8)
+    ev = IncrementalEvaluator(graph, sim)
+    res = ev.evaluate(s)  # assigns views
+    w = next(op for op in res.ops if op.weights).weights[0]
+    # placement=data (default): the executor scatters over the intra
+    # remainder 8/2 = 4, not the whole axis
+    assert sim.wus_group(w, {"data": 8}, placement="data") == 4
+    assert sim.wus_group(w, {"data": 8}, placement=None) == 8
+
+
+# -- store keys -----------------------------------------------------------
+
+def test_single_slice_store_keys_unchanged():
+    """--slices 1 mesh fingerprints carry NO hierarchy fields and are
+    invariant to the DCN knobs — existing flat-store entries survive
+    the topology subsystem."""
+    from flexflow_tpu.store.key import mesh_fingerprint
+
+    base = mesh_fingerprint(FFConfig(), 8)
+    assert "slices" not in base and "dcn_bandwidth" not in base
+    tweaked = mesh_fingerprint(FFConfig(dcn_bandwidth=1e9,
+                                        dcn_latency=1e-3), 8)
+    assert tweaked == base
+
+
+def test_multi_slice_store_keys_split_by_hierarchy():
+    from flexflow_tpu.store.key import mesh_fingerprint
+
+    a = mesh_fingerprint(FFConfig(slices=2), 8)
+    b = mesh_fingerprint(FFConfig(slices=4), 8)
+    c = mesh_fingerprint(FFConfig(slices=2, dcn_bandwidth=1e9), 8)
+    assert a["slices"] == 2
+    assert a != b and a != c
+
+
+# -- cross-slice rendezvous ----------------------------------------------
+
+def _blob(tmp_path):
+    from flexflow_tpu.store.blobstore import LocalBlobStore
+
+    return LocalBlobStore(str(tmp_path / "blob"))
+
+
+def test_epoch_rendezvous_agrees_on_max(tmp_path):
+    from flexflow_tpu.topology.rendezvous import epoch_rendezvous
+
+    blob = _blob(tmp_path)
+    for sl, ep in [(1, 7), (2, 9)]:
+        blob.put(f"rendezvous/run1/epoch_00000000/host_{sl:05d}",
+                 json.dumps({"host": sl, "epoch": ep}).encode())
+    agreed = epoch_rendezvous(blob, "run1", 5, slice_id=0, num_slices=3,
+                              timeout_s=5.0, sleep=lambda s: None)
+    assert agreed == 9  # laggards run forward, nobody rewinds
+    # a later elastic EVENT uses a fresh round: round 0's posts can't
+    # satisfy its quorum or pollute its agreement (review finding)
+    agreed2 = epoch_rendezvous(blob, "run1", 3, slice_id=0, num_slices=3,
+                               round_id=1, timeout_s=0.2,
+                               sleep=lambda s: None)
+    assert agreed2 == 3  # only our own post this round
+
+
+def test_health_census_reports_posted_slices(tmp_path):
+    from flexflow_tpu.topology.rendezvous import health_census
+
+    blob = _blob(tmp_path)
+    blob.put("rendezvous/runh/health_00000000/host_00001",
+             json.dumps({"host": 1, "healthy": False}).encode())
+    seen = health_census(blob, "runh", slice_id=0, num_slices=3,
+                         timeout_s=0.2, sleep=lambda s: None)
+    assert seen[0] is True and seen[1] is False
+    assert 2 not in seen  # absent slice: presumed dead by the caller
+    # a new census round does NOT see round 0's stale posts — a slice
+    # that died since then is correctly presumed dead
+    seen2 = health_census(blob, "runh", slice_id=0, num_slices=3,
+                          round_id=1, timeout_s=0.2, sleep=lambda s: None)
+    assert 1 not in seen2
+
+
+def test_rendezvous_reduce_counts_own_value_once(tmp_path):
+    """The caller's own post is excluded from the reduced peer values
+    (its local value joins exactly once), so non-idempotent reductions
+    like sum stay correct (review finding)."""
+    from flexflow_tpu.topology.rendezvous import post_and_agree
+
+    blob = _blob(tmp_path)
+    blob.put("rendezvous/runs/cap/host_00001",
+             json.dumps({"host": 1, "step": 10}).encode())
+    total = post_and_agree(blob, "runs", "cap", 5, host_id=0, num_hosts=2,
+                           reduce=sum, timeout_s=5.0,
+                           sleep=lambda s: None)
+    assert total == 15  # 10 + 5, NOT 10 + 5 + 5
+
+
+def test_placement_stats_empty_for_pipeline_winners():
+    """A pipeline winner executes flat on multi-slice runs — its stats
+    must not claim a placement/hierarchical reduction (review
+    finding)."""
+    from flexflow_tpu.topology.hierarchy import placement_stats
+
+    s = Strategy(mesh_axes={"data": 2, "pipe": 4},
+                 pipeline={"degree": 4, "num_microbatches": 8,
+                           "axis": "pipe", "dp_axis": "data"})
+    assert placement_stats(s, 2) == {
+        "placement": "", "hierarchical_reduction": False,
+    }
+
+
+def test_clear_rendezvous(tmp_path):
+    from flexflow_tpu.topology.rendezvous import (
+        clear_rendezvous, post_and_agree,
+    )
+
+    blob = _blob(tmp_path)
+    post_and_agree(blob, "runc", "epoch", 3, host_id=0, num_hosts=1)
+    blob.put("rendezvous/runc/epoch/host_00001", b'{"host":1,"step":3}')
+    assert clear_rendezvous(blob, "runc") >= 1
+    assert blob.list("rendezvous/runc/") == []
+
+
+def test_preemption_barrier_still_rides_legacy_layout(tmp_path):
+    """The barrier delegates to the generic rendezvous but keeps its
+    `barrier/<run_id>/` keys — on-store compatibility with PR 9."""
+    from flexflow_tpu.distributed import preemption_barrier
+
+    blob = _blob(tmp_path)
+    blob.put("barrier/runz/host_00001",
+             json.dumps({"host": 1, "step": 12}).encode())
+    agreed = preemption_barrier(blob, "runz", 10, host_id=0, num_hosts=2,
+                                timeout_s=5.0, sleep=lambda s: None)
+    assert agreed == 12
+    assert any(k.startswith("barrier/runz/host_00000")
+               for k in blob.list("barrier/runz/"))
+
+
+# -- obs: per-tier comm telemetry ----------------------------------------
+
+def test_fidelity_record_carries_tier_split(devices8):
+    from flexflow_tpu.obs.fidelity import report_fidelity
+
+    cfg = FFConfig(batch_size=16, num_devices=8, slices=2,
+                   dcn_bandwidth=2e9, telemetry=True)
+    ff = _fit_model(cfg, devices8)
+    rec = report_fidelity(ff, measured_step_s=1e-3, steps_measured=1)
+    assert rec is not None
+    assert rec["predicted_dcn_bytes"] > 0
+    assert rec["predicted_ici_bytes"] > 0
+    assert ff.telemetry.metrics.counter("comm/dcn_bytes").value == \
+        rec["predicted_dcn_bytes"]
+    assert ff.telemetry.metrics.counter("comm/ici_bytes").value == \
+        rec["predicted_ici_bytes"]
+
+
+def test_flat_fidelity_tier_split_is_all_ici(devices8):
+    from flexflow_tpu.obs.fidelity import report_fidelity
+
+    cfg = FFConfig(batch_size=16, num_devices=8, telemetry=True)
+    ff = _fit_model(cfg, devices8)
+    rec = report_fidelity(ff, measured_step_s=1e-3, steps_measured=1)
+    assert rec["predicted_dcn_bytes"] == 0
+    assert rec["predicted_ici_bytes"] > 0
+
+
+def test_telemetry_summary_renders_comm_section(tmp_path):
+    from flexflow_tpu.obs.metrics import MetricsRegistry
+    from tools.telemetry_summary import summarize
+
+    reg = MetricsRegistry()
+    reg.counter("comm/ici_bytes").inc(1024)
+    reg.counter("comm/dcn_bytes").inc(64)
+    out = summarize(reg.drain())
+    assert "Comm" in out
+    assert "ici_bytes" in out and "dcn_bytes" in out
+
+
+def test_degraded_mesh_machine_model_degrades_to_flat():
+    """Elastic recovery on survivors the hierarchy cannot fit (review
+    finding): make_machine_model degrades to the flat model instead of
+    failing the re-search — both for an indivisible device count and
+    for a slice_topology whose chip product no longer matches."""
+    from flexflow_tpu.sim.machine_model import make_machine_model
+
+    m = make_machine_model(FFConfig(slices=3), 8)  # 8 % 3
+    assert not isinstance(m, SliceHierarchy)
+    m = make_machine_model(FFConfig(slices=2, slice_topology="4"), 4)
+    assert not isinstance(m, SliceHierarchy)  # 4/2=2 chips != product 4
+    # healthy counts still get the hierarchy
+    assert isinstance(
+        make_machine_model(FFConfig(slices=2, slice_topology="4"), 8),
+        SliceHierarchy,
+    )

@@ -48,6 +48,9 @@ from flexflow_tpu.strategy import data_parallel_strategy
 _OPTERMS_DIGEST_BY_VERSION = {
     # v2: the ZeRO ladder — mem_master/mem_grad/mem_gather/gather_xfer
     2: "361bfd29c5f8ec36",
+    # v3: the multi-slice topology subsystem — ici_xfer/dcn_xfer/
+    # ici_bytes/dcn_bytes per-tier split + placement-aware estimators
+    3: "99b6da36d6b61866",
 }
 
 
@@ -77,7 +80,7 @@ def test_store_key_invalidates_on_stage_change():
     assert v0 != v2
     assert v0["search"]["zero_stage"] == 0
     assert v2["search"]["zero_stage"] == 2
-    assert v0["cost_model_version"] == COST_MODEL_VERSION >= 2
+    assert v0["cost_model_version"] == COST_MODEL_VERSION >= 3
 
 
 # -- simulator ladder economics ------------------------------------------

@@ -145,6 +145,16 @@ def summarize(records: List[Dict]) -> str:
             rows.append((short, rec.get("value", 0.0)))
     out.append(_section("Durability", rows))
 
+    # per-tier predicted comm split (topology subsystem,
+    # docs/TOPOLOGY.md): ICI vs DCN bytes/time for the compiled
+    # strategy's placement — zero DCN on single-slice runs
+    rows = [
+        (name.split("/", 1)[1], rec.get("value", 0.0))
+        for name, rec in sorted(metrics.items())
+        if name.startswith("comm/")
+    ]
+    out.append(_section("Comm", rows))
+
     rows = []
     for name, rec in sorted(metrics.items()):
         if not name.startswith("serving/"):
