@@ -642,6 +642,137 @@ def bench_zero_ladder(dev, on_tpu):
     return out
 
 
+def bench_long_context(dev, on_tpu):
+    """Searched-remat long-context leg (manifest v17, docs/PERF.md
+    "Searched rematerialization"): the seq2048 BERT config under
+    --memory-search with a modeled per-device HBM budget sized strictly
+    between the all-on-remat and no-remat footprints.  The no-remat
+    ladder cannot fit (OOM at the modeled ceiling); the search must
+    choose a per-segment remat plan that does, at less simulated time
+    than checkpointing everything.  The chosen plan is then LOWERED
+    through the real executor (jax.checkpoint on exactly the chosen
+    segments) and the leg logs predicted-vs-measured step time for it."""
+    import dataclasses as _dc
+
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_bert
+    from flexflow_tpu.pcg.evaluator import IncrementalEvaluator
+    from flexflow_tpu.pcg.unity import UnitySearch
+    from flexflow_tpu.sim.machine_model import (
+        TpuPodModel,
+        detect_device_spec,
+    )
+    from flexflow_tpu.sim.simulator import (
+        OpCostModel,
+        Simulator,
+        remat_segments,
+    )
+    from flexflow_tpu.strategy import data_parallel_strategy
+
+    leg = MANIFEST["legs"]["long_context"]
+    if on_tpu:
+        batch, seq = leg["batch"], leg["seq"]
+        hidden, layers = leg["hidden"], leg["layers"]
+        heads, inter = leg["heads"], leg["intermediate"]
+        iters, vocab = leg["iters"], 30522
+    else:
+        # smoke dims stay activation-dominated (small vocab/hidden,
+        # larger batch x seq) so the remat decision is still exercised
+        batch, seq, hidden, layers, heads, inter, iters = 32, 128, 64, 2, 4, 128, 3
+        vocab = 512
+
+    print("bench[long-context]: searching remat plan", file=sys.stderr)
+    cfg = FFConfig(batch_size=batch, num_devices=1,
+                   compute_dtype=leg["dtype"] if on_tpu else "float32")
+    ff = FFModel(cfg)
+    build_bert(ff, batch_size=batch, seq_length=seq, hidden_size=hidden,
+               num_layers=layers, num_heads=heads, intermediate_size=inter,
+               vocab_size=vocab, from_token_ids=True)
+    machine = TpuPodModel(topology=(1,), device=detect_device_spec())
+    sim = Simulator(machine)
+    ev = IncrementalEvaluator(ff.layers, sim)
+    dp = data_parallel_strategy(1)
+    dense = ev.evaluate(dp)
+    n_seg = len(remat_segments(dense.ops))
+    all_on = ev.evaluate(_dc.replace(dp, remat=list(range(n_seg))))
+    saved = dense.per_device_memory - all_on.per_device_memory
+    budget = all_on.per_device_memory + int(saved * leg["budget_frac"])
+
+    search = UnitySearch(ff.layers, 1, machine, OpCostModel(machine),
+                         memory_budget=budget, enable_pipeline=False,
+                         remat_search=True, budget=leg["search_budget"])
+    chosen = search.optimize_with_memory()
+    plan = list(chosen.remat or []) if chosen is not None else []
+    res = ev.evaluate(chosen) if chosen is not None else dense
+    out = {
+        "workload": f"BERT-base seq{seq} b{batch} --memory-search with "
+                    f"per-segment remat, modeled HBM budget between the "
+                    f"all-on and no-remat footprints",
+        "segments": n_seg,
+        "remat_plan": ",".join(str(i) for i in plan),
+        "remat_segments_on": len(plan),
+        "modeled_budget_mb": round(budget / 2**20, 1),
+        "no_remat_mb": round(dense.per_device_memory / 2**20, 1),
+        "all_on_mb": round(all_on.per_device_memory / 2**20, 1),
+        "chosen_mb": round(res.per_device_memory / 2**20, 1),
+        # the acceptance triple: the dense ladder OOMs the modeled
+        # ceiling, the chosen plan fits it, and costs less simulated
+        # time than checkpointing everything
+        "no_remat_fits_budget": bool(dense.per_device_memory <= budget),
+        "chosen_fits_budget": bool(res.per_device_memory <= budget),
+        "predicted_step_ms_no_remat": round(dense.total_time * 1e3, 3),
+        "predicted_step_ms_all_on": round(all_on.total_time * 1e3, 3),
+        "predicted_step_ms_chosen": round(res.total_time * 1e3, 3),
+        "chosen_beats_all_on": bool(res.total_time < all_on.total_time),
+        "predicted_recompute_ms": round(res.recompute_s * 1e3, 3),
+        "remat_nontrivial": bool(
+            plan and len(plan) < sum(
+                1 for _, pure in remat_segments(dense.ops) if pure
+            )
+        ),
+        "saved_activation_mb": round(
+            (dense.activation_bytes - res.activation_bytes) / 2**20, 2
+        ),
+    }
+    # the acceptance bar, asserted like the other legs' (a silent
+    # search regression must fail the capture, not footnote it)
+    assert not out["no_remat_fits_budget"]
+    assert out["chosen_fits_budget"], out
+    assert out["chosen_beats_all_on"], out
+
+    # lower the chosen plan through the real executor and measure
+    print("bench[long-context]: compiling chosen plan", file=sys.stderr)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=chosen if chosen is not None else dp,
+        devices=[dev],
+    )
+    rng = np.random.RandomState(0)
+    ids = jax.device_put(
+        rng.randint(0, vocab, size=(batch, seq)).astype(np.int32),
+        ff.executor.input_shardings()["input"],
+    )
+    y = jax.device_put(rng.randint(0, 2, batch).astype(np.int32),
+                       ff.executor.label_sharding())
+    for _ in range(3):
+        m = ff.train_step({"input": ids}, y)
+    _ = float(m["loss"])
+    dt = _steady_state(ff, {"input": ids}, y, iters)
+    out["measured_step_ms"] = round(dt * 1e3, 3)
+    out["predicted_vs_measured"] = round(
+        res.total_time / dt, 3
+    ) if dt > 0 else None
+    out["tokens_per_sec_per_chip"] = round(batch * seq / dt, 0)
+    ex_plan = ff.executor._remat_plan
+    out["executor_segments_checkpointed"] = (
+        sum(1 for *_, pure in ex_plan if pure) if ex_plan else 0
+    )
+    return out
+
+
 def bench_multi_slice(dev, on_tpu):
     """Multi-slice topology leg (manifest v16, docs/TOPOLOGY.md): the
     same model searched on a flat 1x8 mesh vs a 2x4 slice hierarchy
@@ -1608,6 +1739,8 @@ def main():
     host_loss = bench_host_loss(dev, on_tpu)
     gc.collect()
     multi_slice = bench_multi_slice(dev, on_tpu)
+    gc.collect()
+    long_context = bench_long_context(dev, on_tpu)
     geomean = float(np.sqrt(max(bert["vs_a100"], 1e-9)
                             * max(resnet["vs_a100"], 1e-9)))
     result = {
@@ -1631,7 +1764,8 @@ def main():
                  "serving_resilience": serving_resilience,
                  "autoscale": autoscale,
                  "cold_start": cold_start, "host_loss": host_loss,
-                 "multi_slice": multi_slice},
+                 "multi_slice": multi_slice,
+                 "long_context": long_context},
     }
     print(json.dumps(result))
 

@@ -141,6 +141,15 @@ class FFConfig:
     # per-slice ICI torus shape, e.g. "4x4" or "2,2,2"; None = a 1-D
     # ring of num_devices/slices chips
     slice_topology: Optional[str] = None
+    # DCN grad-sync coalescing bucket (MB): the cost model amortizes a
+    # weight leaf's DCN all-reduce LATENCY term over the fraction of a
+    # bucket its DCN-leg bytes fill (real runtimes coalesce grad
+    # all-reduces into ~25MB buckets), so many-leaf models stop paying
+    # the per-leaf DCN launch latency on dp-crossing placements.
+    # Bandwidth/byte terms are untouched.  Only consulted on
+    # multi-slice (SliceHierarchy) machines — flat runs have no DCN leg
+    # and their store keys carry no bucket field.
+    dcn_bucket_mb: float = 25.0
     # bounds per-region search enumeration (its reference role: cap
     # per-segment simulation work); can only lower the built-in cap
     simulator_segment_size: int = 16777216
@@ -402,6 +411,10 @@ class FFConfig:
             from .topology.hierarchy import parse_slice_topology
 
             parse_slice_topology(self.slice_topology)  # raises on bad spec
+        if self.dcn_bucket_mb <= 0:
+            raise ValueError(
+                f"dcn_bucket_mb must be > 0 MB, got {self.dcn_bucket_mb}"
+            )
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(
                 f"zero_stage must be one of (0, 1, 2, 3), "
@@ -511,6 +524,8 @@ class FFConfig:
                        default=10e-6)
         p.add_argument("--slice-topology", dest="slice_topology", type=str,
                        default=None)
+        p.add_argument("--dcn-bucket-mb", dest="dcn_bucket_mb", type=float,
+                       default=25.0)
         # default None so an EXPLICIT --zero-stage 0 is distinguishable
         # from the default: the explicit stage wins over the deprecated
         # flag below (including 0), the shim only fills the default
@@ -637,6 +652,7 @@ class FFConfig:
             dcn_bandwidth=args.dcn_bandwidth,
             dcn_latency=args.dcn_latency,
             slice_topology=args.slice_topology,
+            dcn_bucket_mb=args.dcn_bucket_mb,
             zero_stage=(args.zero_stage if args.zero_stage is not None
                         else (1 if args.weight_update_sharding else 0)),
             weight_update_sharding=(args.weight_update_sharding

@@ -92,6 +92,7 @@ class GraphExecutor:
         wus_axis: Optional[str] = None,
         zero_stage: int = 0,
         hier_axis: Optional[str] = None,
+        remat_segments: Optional[Sequence[int]] = None,
     ):
         self.graph = graph
         self.mesh = mesh
@@ -161,8 +162,21 @@ class GraphExecutor:
         # rematerialisation plan: single-tensor-boundary segments whose
         # internals are recomputed in backward (jax.checkpoint), saving
         # only boundary activations — the HBM/FLOPs trade the reference
-        # cannot express (Legion keeps every region alive)
-        self._remat_plan = self._build_remat_plan() if remat else None
+        # cannot express (Legion keeps every region alive).
+        # `remat_segments` (a strategy's searched per-segment plan,
+        # docs/PERF.md "Searched rematerialization") selects WHICH
+        # segments checkpoint; the global `remat` bool checkpoints every
+        # pure segment (the plan, when present, takes precedence).
+        plan = (
+            self._build_remat_plan(remat_segments)
+            if (remat or remat_segments is not None) else None
+        )
+        if plan is not None and not any(pure for *_, pure in plan):
+            # nothing checkpoints (e.g. an explicit all-off searched
+            # plan): keep the flat interpreter, which also keeps the
+            # ZeRO-3 double-buffered prefetch path
+            plan = None
+        self._remat_plan = plan
         # physical NHWC layout for CNN activations (pcg/layout.py): the
         # logical shapes stay NCHW; conversions happen at exec time
         from .pcg.layout import assign_layouts
@@ -181,13 +195,18 @@ class GraphExecutor:
             self._z3_gather_map() if self.zero_stage >= 3 else None
         )
 
-    def _build_remat_plan(self):
+    def _build_remat_plan(self, selected: Optional[Sequence[int]] = None):
         """[(ops, in_guids, out_guids, pure)] per segment.  Impure
         segments (inputs, cache, state, aux, pipeline blocks) run
-        inline; pure ones are wrapped in jax.checkpoint."""
+        inline; pure ones are wrapped in jax.checkpoint.  `selected`
+        (a searched strategy's per-segment plan) restricts the wrap to
+        the named segment indices — everything else runs inline, so a
+        plan naming every pure segment is exactly the legacy --remat
+        lowering, and an empty plan is numerically the dense step."""
         OT = OperatorType
         from .pcg.segments import external_inputs, split_segments
 
+        sel = None if selected is None else {int(i) for i in selected}
         segments, _ = split_segments(self.graph)
         pos_of = {}
         for i, seg in enumerate(segments):
@@ -209,7 +228,7 @@ class GraphExecutor:
                 if t.guid == sink_out
                 or any(c > i for c in consumers.get(t.guid, ()))
             ]
-            pure = all(
+            pure = (sel is None or i in sel) and all(
                 op.op_type not in impure_types
                 and op.guid not in self._block_guids
                 and _num_trainable(op) == len(op.weight_specs)

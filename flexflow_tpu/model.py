@@ -859,6 +859,17 @@ class FFModel:
             strategy.zero_stage if strategy.zero_stage is not None
             else cfg.zero_stage
         )
+        # searched per-segment remat plan (docs/PERF.md "Searched
+        # rematerialization"): rides the strategy like the ZeRO stage,
+        # so store-restored / imported winners replay their plan; the
+        # global --remat bool remains the plan-less fallback
+        remat_plan = getattr(strategy, "remat", None)
+        if remat_plan is not None:
+            _log.info(
+                "searched remat plan: %d segment(s) checkpointed (%s)",
+                len(remat_plan),
+                ",".join(str(i) for i in remat_plan) or "none",
+            )
         self.executor = GraphExecutor(
             self.operators,
             self.mesh,
@@ -875,6 +886,7 @@ class FFModel:
             wus_axis=(cfg.wus_axis if zero_stage >= 1 else None),
             zero_stage=zero_stage,
             hier_axis=hier_axis,
+            remat_segments=remat_plan,
         )
         # per-leaf fallback observability: parallel/zero.py falls back
         # to the replicated update leaf-by-leaf — count it instead of

@@ -39,6 +39,7 @@ def predicted_step(ff, segment_costs: Optional[
         segment_costs=segment_costs,
         zero_stage=ff.strategy.zero_stage,
         placement=getattr(ff.strategy, "placement", None),
+        remat_plan=getattr(ff.strategy, "remat", None),
     )
 
 
@@ -92,6 +93,20 @@ def fidelity_record(
         record["predicted_ici_bytes"] = int(tiers.get("ici_bytes", 0.0))
         record["predicted_dcn_bytes"] = int(tiers.get("dcn_bytes", 0.0))
         record["placement"] = getattr(ff.strategy, "placement", None)
+    # searched-remat memory/recompute split (docs/PERF.md "Searched
+    # rematerialization"): saved-activation bytes under the compiled
+    # plan and the recompute seconds the plan pays; the plan itself is
+    # recorded so fidelity drift can be attributed to a remat choice
+    record["predicted_activation_bytes"] = int(
+        getattr(res, "activation_bytes", 0.0)
+    )
+    record["predicted_recompute_ms"] = round(
+        getattr(res, "recompute_s", 0.0) * 1e3, 4
+    )
+    plan = getattr(ff.strategy, "remat", None)
+    record["remat"] = (
+        ",".join(str(i) for i in plan) if plan else ""
+    )
     if segment_costs:
         regions: List[Dict] = [
             {"ops": len(guids), "measured_ms": round(cost * 1e3, 4)}
@@ -142,4 +157,13 @@ def report_fidelity(ff, measured_step_s: float, steps_measured: int = 0,
             )
             tel.metrics.gauge("comm/ici_ms").set(record["predicted_ici_ms"])
             tel.metrics.gauge("comm/dcn_ms").set(record["predicted_dcn_ms"])
+        # searched-remat memory telemetry (docs/PERF.md): counters so
+        # multi-run drains accumulate per-run saved-activation bytes
+        # and recompute seconds
+        tel.metrics.counter("mem/activation_bytes").inc(
+            record["predicted_activation_bytes"]
+        )
+        tel.metrics.counter("compute/recompute_s").inc(
+            record["predicted_recompute_ms"] / 1e3
+        )
     return record

@@ -70,7 +70,9 @@ def strategy_signature(strategy: Strategy) -> Tuple:
     order steers how assign_axes factors degrees onto axes of equal
     size); shard_configs and edge_ops are order-normalized.  The ZeRO
     stage is part of the key: the same sharding costed at different
-    rungs of the ladder is a different candidate."""
+    rungs of the ladder is a different candidate — and so is the same
+    sharding under a different per-segment remat plan."""
+    remat = getattr(strategy, "remat", None)
     return (
         tuple(strategy.mesh_axes.items()),
         tuple(sorted(_shard_map(strategy).items())),
@@ -79,6 +81,7 @@ def strategy_signature(strategy: Strategy) -> Tuple:
         _freeze(strategy.pipeline),
         getattr(strategy, "zero_stage", None),
         getattr(strategy, "placement", None),
+        tuple(remat) if remat is not None else None,
     )
 
 
@@ -240,8 +243,13 @@ class IncrementalEvaluator:
         the candidate is not delta-eligible (different mesh / edge
         chains / rewrite trace — or a memory model that needs
         whole-graph structure)."""
-        if self.sim.remat or not self.training:
-            return None  # remat/liveness memory needs full graph wiring
+        if not self.training:
+            return None  # inference liveness memory needs full wiring
+        if self.sim.remat and getattr(strategy, "remat", None) is None:
+            # legacy bool remat prices memory via the whole-graph
+            # _remat_peak; a strategy-carried PLAN instead uses the
+            # order-based accounting, which delta-evaluates fine
+            return None
         if tuple(strategy.mesh_axes.items()) != base.mesh_items:
             return None
         if _freeze(strategy.edge_ops) != base.edges_key:
@@ -293,7 +301,15 @@ class IncrementalEvaluator:
         # across both (OpTerms are cached per stage AND placement)
         stage = getattr(strategy, "zero_stage", None)
         placement = getattr(strategy, "placement", None)
-        if self.training and not self.sim.remat:
+        plan = getattr(strategy, "remat", None)
+        if self.training and plan is not None:
+            # searched per-segment remat: the order-based accounting
+            # works on the delta path (no Graph needed)
+            memory_fn = lambda: self.sim.remat_memory_from_terms(  # noqa: E731
+                order, mesh_axes, plan, self.training, zero_stage=stage,
+                placement=placement,
+            )
+        elif self.training and not self.sim.remat:
             memory_fn = lambda: self.sim.memory_from_terms(  # noqa: E731
                 order, mesh_axes, self.training, zero_stage=stage,
                 placement=placement,
@@ -305,7 +321,7 @@ class IncrementalEvaluator:
             )
         res = self.sim.simulate_ops(order, mesh_axes, training=self.training,
                                     memory_fn=memory_fn, zero_stage=stage,
-                                    placement=placement)
+                                    placement=placement, remat_plan=plan)
         res.ops = order  # applied op sequence, for callers needing shapes
         self._base = _AppliedState(
             mesh_items=tuple(mesh_axes.items()),

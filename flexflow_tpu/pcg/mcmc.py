@@ -42,6 +42,27 @@ def search_stage_candidates(cfg) -> Tuple[int, ...]:
     return tuple(s for s in (0, 1, 2, 3) if s >= cfg.zero_stage)
 
 
+def search_remat_enabled(cfg) -> bool:
+    """Whether the searches may choose per-segment remat plans
+    (docs/PERF.md "Searched rematerialization").  Like the ZeRO ladder,
+    the dimension opens only under the memory-aware search; a global
+    --remat floor does NOT close it — the search can still find a
+    cheaper partial plan (a plan rides the strategy and overrides the
+    bool in the executor)."""
+    return bool(cfg.memory_search)
+
+
+def remat_stats(strategy) -> Dict[str, object]:
+    """The search_stats payload describing a winner's remat plan: the
+    ON segment indices ("" when none) and their count — the
+    placement_stats pattern for the remat dimension."""
+    plan = getattr(strategy, "remat", None)
+    return {
+        "remat": ",".join(str(i) for i in plan) if plan else "",
+        "remat_segments_on": len(plan or ()),
+    }
+
+
 def _factorizations(n: int, allow_expert: bool = True) -> List[Tuple[int, int, int]]:
     """(data, model, expert) triples with product n.  allow_expert=False
     drops ep>1 triples — the single source of the 'expert axis only with
@@ -110,6 +131,7 @@ class MCMCSearch:
         use_eval_cache: bool = True,
         registry=None,
         zero_stages: Optional[Tuple[int, ...]] = None,
+        remat_search: bool = False,
     ):
         # obs.metrics.MetricsRegistry (or None): final counters also
         # land in run telemetry, not just the log line
@@ -160,6 +182,24 @@ class MCMCSearch:
         self.factorizations = _factorizations(
             num_devices, allow_expert=has_experts
         )
+        # searched remat (docs/PERF.md): the chain gains a FLIP-SEGMENT
+        # move — toggle one pure single-tensor-boundary segment's remat
+        # bit.  The flippable universe comes from the FRONTEND graph's
+        # segmentation (applied graphs may split slightly differently
+        # around inserted parallel ops; the evaluator always prices a
+        # plan against the candidate's own applied segmentation, so the
+        # move space is a proposal distribution, not a contract).
+        self.remat_search = remat_search
+        self.remat_flippable: List[int] = []
+        if remat_search:
+            from ..sim.simulator import MAX_REMAT_SEGMENTS
+            from ..sim.simulator import remat_segments as _remat_segments
+
+            self.remat_flippable = [
+                i for i, (_, pure) in enumerate(
+                    _remat_segments(graph.topo_order())
+                ) if pure
+            ][:MAX_REMAT_SEGMENTS]
         self.history: List[Tuple[int, float]] = []
 
     # -- strategy construction ------------------------------------------
@@ -178,7 +218,8 @@ class MCMCSearch:
     def _build(self, dp: int, tp: int, ep: int,
                flags: Dict[str, bool],
                zero_stage: Optional[int] = None,
-               placement: Optional[str] = None) -> Strategy:
+               placement: Optional[str] = None,
+               remat: Optional[Tuple[int, ...]] = None) -> Strategy:
         mesh_axes = self._mesh_axes(dp, tp, ep)
         if placement is not None:
             # a factorization move can strand the placement on an axis
@@ -189,7 +230,8 @@ class MCMCSearch:
             if placement not in legal_placements(mesh_axes, self.slices):
                 placement = None
         s = Strategy(mesh_axes=mesh_axes, zero_stage=zero_stage,
-                     placement=placement)
+                     placement=placement,
+                     remat=sorted(remat) if remat is not None else None)
         if dp > 1:
             s.edge_ops["__inputs__"] = [("repartition", {"dim": 0, "degree": dp})]
         # Megatron column->row pairing: a channel(tp)-sharded linear
@@ -257,20 +299,24 @@ class MCMCSearch:
         )
         stage = self.zero_stages[0] if self.zero_stages else None
         placement = None  # the shared resolve_placement default
-        current = self._build(dp, tp, ep, flags, stage, placement)
+        remat: Optional[Tuple[int, ...]] = None  # not chosen
+        current = self._build(dp, tp, ep, flags, stage, placement, remat)
         current_cost = self.evaluate(current)
         best, best_cost = current, current_cost
         self.best_iteration = -1  # evals needed to reach the winner
-        state = (dp, tp, ep, dict(flags), stage, placement)
+        state = (dp, tp, ep, dict(flags), stage, placement, remat)
+        remat_moves = bool(self.remat_search and self.remat_flippable)
         for it in range(self.budget):
             ndp, ntp, nep, nflags = state[0], state[1], state[2], dict(state[3])
-            nstage, nplacement = state[4], state[5]
+            nstage, nplacement, nremat = state[4], state[5], state[6]
             move = self.rng.random()
             # the placement move carves its window ABOVE the existing
             # thresholds (off shifts them) so the stage/factorization
             # move probabilities are unchanged on hierarchy machines —
-            # and flat machines keep the exact historical distribution
+            # and flat machines keep the exact historical distribution.
+            # The remat flip-segment window stacks the same way (roff).
             off = 0.12 if self._hier else 0.0
+            roff = 0.10 if remat_moves else 0.0
             if self._hier and move < off:
                 # placement move: re-pick the mesh axis spanning the
                 # DCN boundary (sharding unchanged — the evaluator
@@ -282,15 +328,26 @@ class MCMCSearch:
                 nplacement = self.rng.choice(
                     [None] + legal_placements(mesh, self.slices)
                 )
-            elif stage_moves is not None and move < off + 0.15:
+            elif remat_moves and move < off + roff:
+                # flip-segment move (docs/PERF.md "Searched
+                # rematerialization"): toggle one pure segment's remat
+                # bit.  The applied graph is plan-invariant, so the
+                # evaluator re-sums cached OpTerms — a cheap move like
+                # the stage/placement ones.
+                cur = set(nremat or ())
+                seg = self.rng.choice(self.remat_flippable)
+                cur.symmetric_difference_update({seg})
+                nremat = tuple(sorted(cur))
+            elif stage_moves is not None and move < off + roff + 0.15:
                 # ZeRO-stage move: re-rung the ladder (the candidate's
                 # sharding is unchanged, so the evaluator re-sums
                 # cached OpTerms under the new stage — a cheap move)
                 nstage = self.rng.choice(stage_moves)
-            elif move < off + 0.25 or not self.candidates:
+            elif move < off + roff + 0.25 or not self.candidates:
                 ndp, ntp, nep = self.rng.choice(self.factorizations)
             elif (self.propagate
-                  and move < off + 0.25 + 0.75 * self.propagation_chance):
+                  and move < off + roff + 0.25
+                  + 0.75 * self.propagation_chance):
                 # propagate move (reference FFModel::propagate,
                 # model.cc:3180-3258): spread a randomly selected op's
                 # CURRENT config to a walk of adoptable neighbors —
@@ -314,10 +371,12 @@ class MCMCSearch:
                 c = self.rng.choice(self.candidates)
                 nflags[c.name] = not nflags.get(c.name, False)
             if ((ndp, ntp, nep) == state[:3] and nflags == state[3]
-                    and nstage == state[4] and nplacement == state[5]):
+                    and nstage == state[4] and nplacement == state[5]
+                    and nremat == state[6]):
                 continue  # no-op move (e.g. propagate with no peers to
                 # change): don't burn a simulator eval on it
-            cand = self._build(ndp, ntp, nep, nflags, nstage, nplacement)
+            cand = self._build(ndp, ntp, nep, nflags, nstage, nplacement,
+                               nremat)
             cost = self.evaluate(cand)
             self.history.append((it, cost))
             if cost < current_cost or (
@@ -326,7 +385,7 @@ class MCMCSearch:
                 < math.exp(-self.alpha * (cost - current_cost) / max(1e-12, current_cost))
             ):
                 current, current_cost = cand, cost
-                state = (ndp, ntp, nep, nflags, nstage, nplacement)
+                state = (ndp, ntp, nep, nflags, nstage, nplacement, nremat)
                 if cost < best_cost:
                     best, best_cost = cand, cost
                     self.best_iteration = it
@@ -342,6 +401,8 @@ class MCMCSearch:
         best.search_stats.update(placement_stats(
             best, self.slices if self._hier else 1
         ))
+        # the winner's per-segment remat plan ("" when no plan chosen)
+        best.search_stats.update(remat_stats(best))
         # underlying cache layers (term decomposition + op-cost cache)
         best.search_stats["term_hits"] = self.simulator.term_hits
         best.search_stats["term_misses"] = self.simulator.term_misses
@@ -380,6 +441,9 @@ def make_search_simulator(cfg, machine, cost_model):
         remat=cfg.remat,
         zero_stage=cfg.zero_stage,
         wus_axis=cfg.wus_axis,
+        dcn_bucket_bytes=float(
+            getattr(cfg, "dcn_bucket_mb", 25.0)
+        ) * 2**20,
     )
 
 
@@ -413,6 +477,7 @@ def mcmc_optimize(model, num_devices: int) -> Strategy:
             getattr(model, "telemetry", None), "metrics", None
         ),
         zero_stages=search_stage_candidates(cfg),
+        remat_search=search_remat_enabled(cfg),
     )
     best = search.optimize()
     # surface the ZeRO stage the winner was scored under (and the

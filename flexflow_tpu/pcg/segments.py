@@ -46,18 +46,37 @@ def split_segments(graph: Graph) -> Tuple[List[List[Op]], List[Optional[int]]]:
     Returns (segments, crossing_guid_per_boundary): segment k feeds
     segment k+1 through exactly one tensor (the bottleneck); the final
     boundary is None."""
-    topo = graph.topo_order()
+    return split_segments_ops(graph.topo_order())
+
+
+def split_segments_ops(
+    topo: List[Op],
+) -> Tuple[List[List[Op]], List[Optional[int]]]:
+    """`split_segments` over an already topo-ordered op list — the form
+    the searched-remat costing uses on the evaluator's applied op
+    sequences (where no Graph object exists on the delta path).  Runs a
+    single O(n) liveness sweep: a tensor produced at position j with
+    last use at position lu crosses every boundary i with j <= i < lu,
+    so the live set is maintained incrementally instead of rescanning
+    the prefix per position."""
     last_use = last_use_positions(topo)
+    live = set()
     cuts: List[Tuple[int, int]] = []  # (topo position, crossing tensor guid)
-    for i in range(len(topo) - 1):
-        crossing = [
-            t.guid
-            for j in range(i + 1)
-            for t in topo[j].outputs
-            if last_use.get(t.guid, -1) > i
-        ]
-        if len(crossing) == 1:
-            cuts.append((i, crossing[0]))
+    n = len(topo)
+    expire: Dict[int, List[int]] = {}
+    for op in topo:
+        for t in op.outputs:
+            lu = last_use.get(t.guid, -1)
+            if lu >= 0:
+                expire.setdefault(lu, []).append(t.guid)
+    for i, op in enumerate(topo):
+        for t in op.outputs:
+            if last_use.get(t.guid, -1) > i:
+                live.add(t.guid)
+        for g in expire.get(i, ()):
+            live.discard(g)
+        if i < n - 1 and len(live) == 1:
+            cuts.append((i, next(iter(live))))
     segments: List[List[Op]] = []
     boundaries: List[Optional[int]] = []
     start = 0

@@ -48,7 +48,11 @@ from ..tensor import ParallelTensor, ParallelTensorShape
 from ..sim.simulator import Z3_PREFETCH_OVERLAP
 from .evaluator import IncrementalEvaluator
 from .graph import Graph
-from .mcmc import _factorizations, search_stage_candidates
+from .mcmc import (
+    _factorizations,
+    search_remat_enabled,
+    search_stage_candidates,
+)
 from .substitution import (
     GraphXfer,
     XferChoice,
@@ -110,6 +114,8 @@ class UnitySearch:
         zero_stages: Optional[Sequence[int]] = None,
         registry=None,
         enable_pipeline: bool = True,
+        remat_search: bool = False,
+        dcn_bucket_bytes: Optional[float] = None,
     ):
         # obs.metrics.MetricsRegistry (or None): final counters also
         # land in run telemetry, not just the log line
@@ -170,6 +176,18 @@ class UnitySearch:
         )
         self.weight_update_sharding = self.zero_stage >= 1
         self.wus_axis = wus_axis
+        # searched remat (docs/PERF.md): each collected candidate is
+        # additionally re-scored at a bounded family of per-segment
+        # remat plans (_remat_variants) — the _stage_variants shape for
+        # the activation term of the memory ladder
+        self.remat_search = remat_search
+        from ..sim.simulator import DEFAULT_DCN_BUCKET_BYTES
+
+        sim_kw = {}
+        if dcn_bucket_bytes is not None:
+            sim_kw["dcn_bucket_bytes"] = dcn_bucket_bytes
+        else:
+            sim_kw["dcn_bucket_bytes"] = DEFAULT_DCN_BUCKET_BYTES
         self._sim = Simulator(machine, cost_model,
                               overlap_fraction=overlap_fraction,
                               optimizer_slots=optimizer_slots,
@@ -178,7 +196,8 @@ class UnitySearch:
                               remat=remat,
                               compute_scale=compute_scale,
                               zero_stage=self.zero_stage,
-                              wus_axis=wus_axis)
+                              wus_axis=wus_axis,
+                              **sim_kw)
         # multi-slice hierarchy (topology/, docs/TOPOLOGY.md): each
         # collected candidate is additionally re-scored at every legal
         # placement (which mesh axis spans the DCN boundary) through
@@ -810,16 +829,16 @@ class UnitySearch:
         agg["op_cost_hits"] = getattr(self.cost_model, "cost_hits", 0)
         return agg
 
-    def _stage_variants(self, strategy: Strategy, time: float, mem: int,
-                        lam: float) -> List[Tuple[Strategy, float]]:
+    def _stage_variants(self, strategy: Strategy, time: float,
+                        mem: int) -> List[Tuple[Strategy, float, int]]:
         """The candidate scored at every allowed ZeRO stage:
-        [(strategy', obj)].  The base stage keeps the caller's analytic
-        (time, mem); other rungs correct them by the memoized
+        [(strategy', time', mem')].  The base stage keeps the caller's
+        analytic (time, mem); other rungs correct them by the memoized
         evaluator's stage delta (the applied graph is stage-invariant,
         so the delta is exactly the ladder's update/residency terms).
         Ascending stage order + strict objective comparison downstream
         keep ties on the LOWEST stage."""
-        out = [(strategy, self._objective(time, mem, lam))]
+        out = [(strategy, time, mem)]
         extra = [s for s in self.zero_stages if s != self.zero_stage]
         if not extra:
             return out
@@ -832,10 +851,62 @@ class UnitySearch:
             res = self._evaluator().evaluate(cand)
             if res is None:
                 continue
-            out.append((cand, self._objective(
-                time + res.total_time - bt,
-                mem + res.per_device_memory - bm, lam,
-            )))
+            out.append((cand, time + res.total_time - bt,
+                        mem + res.per_device_memory - bm))
+        return out
+
+    def _remat_variants(self, strategy: Strategy, time: float, mem: int,
+                        lam: float) -> List[Tuple[Strategy, float, int]]:
+        """The candidate re-scored at a bounded family of per-segment
+        remat plans (docs/PERF.md "Searched rematerialization"):
+        [(strategy', time', mem')].  Per pure segment, a single-ON plan
+        prices its marginal (recompute seconds vs activation bytes);
+        segments then stack in objective-ascending order (each prefix
+        plan evaluated through the memoized evaluator — a zero-frontier
+        delta re-sum, the applied graph is plan-invariant), plus the
+        all-ON plan (the legacy --remat shape).  No plan = the dense
+        base, which always stays in the family, so remat is only ever
+        chosen when the objective says it wins."""
+        out = [(strategy, time, mem)]
+        if not self.remat_search or strategy.pipeline:
+            return out
+        base = self._evaluator().evaluate(strategy)
+        if base is None:
+            return out
+        from ..sim.simulator import MAX_REMAT_SEGMENTS, remat_segments
+
+        idx = [
+            i for i, (_, pure) in enumerate(remat_segments(base.ops))
+            if pure
+        ][:MAX_REMAT_SEGMENTS]
+        if not idx:
+            return out
+        bt, bm = base.total_time, base.per_device_memory
+
+        def scored(plan):
+            cand = dataclasses.replace(strategy, remat=sorted(plan))
+            res = self._evaluator().evaluate(cand)
+            if res is None:
+                return None
+            return (cand, time + res.total_time - bt,
+                    mem + res.per_device_memory - bm)
+
+        marginals = []
+        for i in idx:
+            r = scored([i])
+            if r is not None:
+                marginals.append((self._objective(r[1], r[2], lam), i))
+        marginals.sort()
+        prefix: List[int] = []
+        for _, i in marginals:
+            prefix.append(i)
+            r = scored(prefix)
+            if r is not None:
+                out.append(r)
+        if len(prefix) != len(idx):
+            r = scored(idx)  # all-ON even when some marginals pruned
+            if r is not None:
+                out.append(r)
         return out
 
     def _placement_variants(self, strategy: Strategy, time: float,
@@ -883,17 +954,22 @@ class UnitySearch:
             nonlocal best_obj
             for pcand, pt, pm in self._placement_variants(strategy, time,
                                                           mem):
-                for cand, obj in self._stage_variants(pcand, pt, pm, lam):
-                    slog.debug(
-                        "candidate %s%s%s: obj=%.3g%s", label,
-                        (f" zero{cand.zero_stage}"
-                         if cand.zero_stage is not None else ""),
-                        (f" place={cand.placement}"
-                         if cand.placement is not None else ""),
-                        obj, " *best*" if obj < best_obj else "",
-                    )
-                    best_obj = min(best_obj, obj)
-                    collector.append((obj, cand, self.graph))
+                for scand, st, sm in self._stage_variants(pcand, pt, pm):
+                    for cand, ct, cm in self._remat_variants(scand, st, sm,
+                                                             lam):
+                        obj = self._objective(ct, cm, lam)
+                        slog.debug(
+                            "candidate %s%s%s%s: obj=%.3g%s", label,
+                            (f" zero{cand.zero_stage}"
+                             if cand.zero_stage is not None else ""),
+                            (f" place={cand.placement}"
+                             if cand.placement is not None else ""),
+                            (f" remat={len(cand.remat)}on"
+                             if cand.remat else ""),
+                            obj, " *best*" if obj < best_obj else "",
+                        )
+                        best_obj = min(best_obj, obj)
+                        collector.append((obj, cand, self.graph))
 
         for dp, tp, ep in _factorizations(self.n, allow_expert=has_moe):
             for mesh_axes in self._mesh_variants(dp, tp, ep):
@@ -991,23 +1067,34 @@ class UnitySearch:
             # with.
             if ((strategy.zero_stage is not None
                     and strategy.zero_stage != self.zero_stage)
-                    or strategy.placement is not None):
+                    or strategy.placement is not None
+                    or strategy.remat is not None):
                 prev = self.graph
                 try:
                     self._set_graph(graph)
                     rb = self._evaluator().evaluate(dataclasses.replace(
                         strategy, zero_stage=self.zero_stage,
-                        placement=None))
+                        placement=None, remat=None))
                     rs = self._evaluator().evaluate(strategy)
                 finally:
                     self._set_graph(prev)
                 if rb is not None and rs is not None:
                     time += rs.total_time - rb.total_time
-            mem = self._sim.per_device_memory(g, training=True,
-                                              op_scale=op_scale,
-                                              mesh_axes=strategy.mesh_axes,
-                                              zero_stage=strategy.zero_stage,
-                                              placement=strategy.placement)
+            if strategy.remat is not None and op_scale is None:
+                # plan-carrying candidates (never pipeline) price the
+                # remat-aware activation accounting
+                mem = self._sim.remat_memory_from_terms(
+                    g.topo_order(), strategy.mesh_axes, strategy.remat,
+                    training=True, zero_stage=strategy.zero_stage,
+                    placement=strategy.placement,
+                )
+            else:
+                mem = self._sim.per_device_memory(
+                    g, training=True, op_scale=op_scale,
+                    mesh_axes=strategy.mesh_axes,
+                    zero_stage=strategy.zero_stage,
+                    placement=strategy.placement,
+                )
             return self._objective(time, mem, lam)
         except Exception as e:  # noqa: BLE001
             slog.debug(
@@ -1044,16 +1131,18 @@ class UnitySearch:
             # contention-aware makespan (reference: candidates are
             # ultimately judged by simulate_runtime, not the analytic
             # estimators)
-            # distinct (mesh, zero stage, placement) only — pp
-            # candidates differing solely in microbatch count would
-            # otherwise crowd the top-K, while stage/placement variants
-            # of one mesh are genuinely different memory/comm trade-offs
+            # distinct (mesh, zero stage, placement, remat on-count)
+            # only — pp candidates differing solely in microbatch count
+            # (or remat prefixes differing by one segment) would
+            # otherwise crowd the top-K, while stage/placement/remat
+            # variants of one mesh are genuinely different trade-offs
             seen_keys = set()
             top: List[Tuple] = []
             for c in collector:
                 key = (tuple(sorted(c[1].mesh_axes.items())),
                        c[1].pipeline is None, c[1].zero_stage,
-                       c[1].placement)
+                       c[1].placement,
+                       len(c[1].remat) if c[1].remat is not None else None)
                 if key in seen_keys:
                     continue
                 seen_keys.add(key)
@@ -1090,6 +1179,10 @@ class UnitySearch:
         strategy.search_stats.update(placement_stats(
             strategy, self.slices if self._hier else 1
         ))
+        from .mcmc import remat_stats
+
+        # the winner's per-segment remat plan ("" when no plan chosen)
+        strategy.search_stats.update(remat_stats(strategy))
         emit_counters(slog, "unity eval stats", strategy.search_stats,
                       registry=self.registry, group="search/unity")
         return strategy
@@ -1380,6 +1473,14 @@ class UnitySearch:
             def op_scale(op, _g=block_guids, _s=S):  # noqa: E731
                 return 1.0 / _s if op.guid in _g else 1.0
 
+        if getattr(strategy, "remat", None) is not None and op_scale is None:
+            # a searched per-segment plan prices the remat-aware
+            # activation accounting — the same model the variants were
+            # ranked with, so the budget check and the ranking agree
+            return sim.remat_memory_from_terms(
+                g.topo_order(), strategy.mesh_axes, strategy.remat,
+                training=True, placement=strategy.placement,
+            )
         return sim.per_device_memory(g, training=True, op_scale=op_scale,
                                      mesh_axes=strategy.mesh_axes,
                                      placement=strategy.placement)
@@ -1455,6 +1556,8 @@ def unity_optimize(model, num_devices: int,
             getattr(model, "telemetry", None), "metrics", None
         ),
         enable_pipeline=enable_pipeline,
+        remat_search=search_remat_enabled(cfg),
+        dcn_bucket_bytes=float(getattr(cfg, "dcn_bucket_mb", 25.0)) * 2**20,
     )
     best = search.optimize_with_memory() if cfg.memory_search else search.optimize()
     cost_model.save_persistent()

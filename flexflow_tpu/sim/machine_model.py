@@ -245,31 +245,38 @@ class TpuPodModel(MachineModel):
         return self.ici_bw, self.ici_lat
 
     # -- axis-aware collective costs (preferred API) --------------------
+    # `lat_scale` scales the per-hop latency term only (bandwidth bytes
+    # are untouched): the DCN grad-sync bucketing amortizes a bucketed
+    # leaf's launch latency over the bucket it rides in
+    # (sim/simulator.py _collective).  1.0 = the unbucketed estimate.
     def axis_allreduce_time(self, size: int, axis_len: int,
-                            over_dcn: bool = False) -> float:
+                            over_dcn: bool = False,
+                            lat_scale: float = 1.0) -> float:
         """Bidirectional-ring all-reduce along one torus axis: each of
         the two directions carries half the data, so the effective
         bandwidth is 2 links."""
         if axis_len <= 1:
             return 0.0
         bw = self.dcn_bw if over_dcn else 2.0 * self.ici_bw
-        lat = self.dcn_lat if over_dcn else self.ici_lat
+        lat = (self.dcn_lat if over_dcn else self.ici_lat) * lat_scale
         return 2.0 * (axis_len - 1) / axis_len * size / bw + 2 * (axis_len - 1) * lat
 
     def axis_allgather_time(self, size: int, axis_len: int,
-                            over_dcn: bool = False) -> float:
+                            over_dcn: bool = False,
+                            lat_scale: float = 1.0) -> float:
         if axis_len <= 1:
             return 0.0
         bw = self.dcn_bw if over_dcn else 2.0 * self.ici_bw
-        lat = self.dcn_lat if over_dcn else self.ici_lat
+        lat = (self.dcn_lat if over_dcn else self.ici_lat) * lat_scale
         return (axis_len - 1) / axis_len * size / bw + (axis_len - 1) * lat
 
     def axis_alltoall_time(self, size: int, axis_len: int,
-                           over_dcn: bool = False) -> float:
+                           over_dcn: bool = False,
+                           lat_scale: float = 1.0) -> float:
         if axis_len <= 1:
             return 0.0
         bw = self.dcn_bw if over_dcn else 2.0 * self.ici_bw
-        lat = self.dcn_lat if over_dcn else self.ici_lat
+        lat = (self.dcn_lat if over_dcn else self.ici_lat) * lat_scale
         t_bw = (axis_len - 1) / axis_len * size / bw
         if not over_dcn:
             # on a ring/torus axis the all-to-all is bisection-bound:

@@ -83,6 +83,12 @@ class SimResult:
             "ici_time": 0.0, "dcn_time": 0.0,
             "ici_bytes": 0.0, "dcn_bytes": 0.0,
         }
+        # searched-remat telemetry (mem/activation_bytes,
+        # compute/recompute_s): saved-activation bytes under the costed
+        # plan and the recompute seconds the plan charges; simulate_ops
+        # fills them (recompute_s is 0 for dense / legacy-bool runs)
+        self.activation_bytes: float = 0.0
+        self.recompute_s: float = 0.0
 
     @property
     def per_device_memory(self) -> int:
@@ -131,6 +137,15 @@ class OpTerms:
     #                           /rep under the sharded update)
     mem_residual: int = 0     # backward-residual activation bytes
     mem_transient: int = 0    # fused transient workspace bytes (max-reduced)
+    mem_activation: int = 0   # per-device saved-activation bytes when this
+    #                           op's remat segment is OFF (== the dense
+    #                           residual term; a remat'd segment drops its
+    #                           internals from the step-long residency)
+    recompute: float = 0.0    # backward re-execution seconds when the
+    #                           op's segment is remat'd: the forward pass
+    #                           runs again inside backward (compute + fwd
+    #                           collectives; at ZeRO-3 the re-gather loses
+    #                           its double-buffered prefetch credit)
 
 
 _KERNEL_OVERHEAD = 2e-6  # per-op dispatch/fusion overhead (XLA fuses, small)
@@ -154,7 +169,22 @@ _KERNEL_OVERHEAD = 2e-6  # per-op dispatch/fusion overhead (XLA fuses, small)
 #: boundary costs the hierarchical / DCN form), and the sharded-update
 #: group shrinks to the intra-slice remainder under a cross-slice
 #: placement — slice-blind v2 rankings must re-search.
-COST_MODEL_VERSION = 3
+#: v4: searched rematerialization (docs/PERF.md "Searched
+#: rematerialization") — OpTerms grew mem_activation/recompute, remat
+#: became a per-segment plan both searches cost under --memory-search,
+#: and DCN grad-sync latency is bucket-amortized (--dcn-bucket-mb) on
+#: hierarchy machines — remat-blind v3 rankings must re-search.
+COST_MODEL_VERSION = 4
+
+#: per-candidate cap on the segments the searches treat as independent
+#: remat decisions; plans may still name higher indices (ignored past
+#: the graph's actual segment count)
+MAX_REMAT_SEGMENTS = 24
+
+#: default DCN grad-sync coalescing bucket (bytes): real runtimes bucket
+#: grad all-reduces (~25MB), so the per-leaf DCN latency term amortizes
+#: over the bucket a leaf rides in instead of being paid per leaf
+DEFAULT_DCN_BUCKET_BYTES = 25 * 2**20
 
 #: overlap credit for the ZeRO-3 per-layer weight all-gathers: the
 #: executor double-buffers (layer k+1's gather issues before layer k's
@@ -397,6 +427,32 @@ def make_cost_model(cfg, machine: MachineModel) -> OpCostModel:
                        device_key=device_key)
 
 
+#: ops whose segments can never rematerialize (side effects / host
+#: state / routing state) — the shared impurity rule of the searched
+#: remat dimension (the executor's _build_remat_plan additionally
+#: excludes pipeline blocks and non-trainable-state ops it alone can
+#: see; an over-approximate simulator plan only mis-prices, never
+#: mis-executes, those segments)
+REMAT_IMPURE_TYPES = frozenset({
+    OperatorType.INPUT, OperatorType.CACHE, OperatorType.GROUP_BY,
+    OperatorType.AGGREGATE, OperatorType.AGGREGATE_SPEC,
+})
+
+
+def remat_segments(ops: Sequence[Op]) -> List[Tuple[List[Op], bool]]:
+    """[(segment, pure)] over a topo-ordered op sequence — the remat
+    decision units a strategy's plan indexes: plan entry i names the
+    i-th single-tensor-boundary segment.  Impure segments (pure=False)
+    always run inline regardless of the plan."""
+    from ..pcg.segments import split_segments_ops
+
+    segments, _ = split_segments_ops(list(ops))
+    return [
+        (seg, all(op.op_type not in REMAT_IMPURE_TYPES for op in seg))
+        for seg in segments
+    ]
+
+
 def _axis_sizes_of_view(pt, mesh_axes: Dict[str, int]) -> Dict[str, int]:
     out = {}
     if pt.machine_view is None:
@@ -425,6 +481,7 @@ class Simulator:
         wus_axis: str = "data",
         zero_stage: Optional[int] = None,
         placement: Optional[str] = None,
+        dcn_bucket_bytes: float = DEFAULT_DCN_BUCKET_BYTES,
     ):
         self.machine = machine
         self.cost_model = cost_model or OpCostModel(machine)
@@ -478,6 +535,13 @@ class Simulator:
         self._hier = (
             self._slices > 1 and hasattr(machine, "collective_cost")
         )
+        # DCN grad-sync bucketing (ROADMAP multi-slice follow-up 1):
+        # runtimes coalesce grad all-reduces into ~bucket-sized chunks,
+        # so a leaf's DCN latency term is amortized by the fraction of
+        # a bucket its DCN-leg bytes fill.  0/None disables (pay the
+        # full per-leaf latency, the pre-v4 behavior).  Flat machines
+        # never consult it — there is no DCN leg to bucket.
+        self.dcn_bucket_bytes = dcn_bucket_bytes
         # (node_key, mesh signature, training) -> OpTerms: per-op
         # contribution terms for the delta/memoized evaluator (the
         # machine and sync mode are fixed per Simulator)
@@ -490,16 +554,30 @@ class Simulator:
 
     # -- comm costs ------------------------------------------------------
     def _collective(self, kind: str, size: float, group_len: int,
-                    cross: bool = False):
+                    cross: bool = False, grad_bucket: bool = False):
         """One collective as a topology.CommCost: the flat single-tier
         estimate on ordinary machines (everything ICI), the
         hierarchical / DCN synthesis on a SliceHierarchy when the
-        group spans the slice boundary (`cross`)."""
+        group spans the slice boundary (`cross`).
+
+        `grad_bucket` marks gradient-sync legs: their DCN latency term
+        is amortized by the bucket fraction the leaf's DCN-leg bytes
+        fill (dcn_bucket_bytes), because real runtimes coalesce grad
+        all-reduces into buckets — many small leaves then cost
+        latency-sublinear in leaf count while total bytes are
+        unchanged.  Activation/resharding collectives are NOT bucketed
+        (each is a real standalone collective on the wire)."""
         if group_len <= 1:
             return ZERO_COST
         if self._hier:
+            lat_scale = 1.0
+            if grad_bucket and cross and self.dcn_bucket_bytes:
+                intra, _ = self.machine.split_group(group_len)
+                dcn_size = size / intra if intra > 1 else size
+                lat_scale = min(1.0, dcn_size / self.dcn_bucket_bytes)
             return self.machine.collective_cost(kind, size, group_len,
-                                                cross=cross)
+                                                cross=cross,
+                                                dcn_lat_scale=lat_scale)
         return CommCost(
             ici_time=self._collective_time(kind, size, group_len),
             ici_bytes=ring_bytes(kind, size, group_len),
@@ -785,7 +863,8 @@ class Simulator:
             sync = CommCost(ici_time=self.sync_time(size, rep),
                             ici_bytes=2.0 * size)
         else:
-            sync = self._collective("reducescatter", size, rep, cross)
+            sync = self._collective("reducescatter", size, rep, cross,
+                                    grad_bucket=True)
         gather = self._collective("allgather", size, rep, cross)
         if stage >= 3:
             return sync, ZERO_COST, gather + gather
@@ -841,6 +920,7 @@ class Simulator:
         self.term_misses += 1
         compute = xfer = partial = grad_sync = opt_numel = 0.0
         opt_xfer = gather_xfer = 0.0
+        fwd_time = recompute_extra = 0.0
         tiers = ZERO_COST  # per-tier time/bytes over every comm term
         mem_weights = mem_master = mem_grad = mem_gather = 0
         mem_opt = mem_residual = mem_transient = 0
@@ -859,6 +939,7 @@ class Simulator:
                     tiers = tiers + cc  # bwd mirror (simulate_ops's 2x)
                 if not skip_compute:
                     cm = self.cost_model.cost(op)
+                    fwd_time = cm.forward_time
                     compute = cm.forward_time + (
                         cm.backward_time if training else 0.0
                     )
@@ -899,11 +980,25 @@ class Simulator:
                             "allreduce", sb // g, rep // g,
                             cross=(not cross_whole
                                    and self._weight_rep_crosses(w, eff_p)),
+                            grad_bucket=True,
                         )
                         grad_sync += rem_cc.time
                         wcc = wcc + rem_cc
                     opt_xfer += x_cc.time
                     gather_xfer += gx_cc.time
+                    if training and gx_cc.time:
+                        # ZeRO-3 x remat: backward recompute re-emits
+                        # the per-layer gather INSIDE the checkpointed
+                        # segment (executor keeps z3_cache=None under
+                        # remat), where the double-buffered prefetch
+                        # cannot run — one of the two gathers loses its
+                        # credit.  The lost credit rides `recompute`
+                        # (charged at full exposure only when the op's
+                        # segment is ON), so remat-off plans keep
+                        # today's gather_xfer pricing exactly.
+                        recompute_extra += (
+                            gx_cc.time / 2.0
+                        ) * Z3_PREFETCH_OVERLAP
                     if training:
                         tiers = tiers + wcc
                     # the update runs on the 1/g shard; slots live
@@ -928,6 +1023,7 @@ class Simulator:
                         rcc = self._collective(
                             "allreduce", sb, rep,
                             cross=self._weight_rep_crosses(w, eff_p),
+                            grad_bucket=True,
                         )
                     else:
                         t = self.sync_time(sb, rep)
@@ -947,6 +1043,18 @@ class Simulator:
                 mem_transient = max(mem_transient, b)
             else:
                 mem_residual += b
+        # searched remat (docs/PERF.md): what this op saves per device
+        # when its segment is OFF, and what re-running its forward in
+        # backward costs when it is ON.  Parallel ops re-run their
+        # resharding collective; compute ops re-run forward plus the
+        # fwd partial-sum psum; measured (skip_compute) ops contribute
+        # no recompute estimate — their fwd split is unknown.
+        recompute = 0.0
+        if training and op.op_type != OperatorType.INPUT:
+            recompute = (
+                xfer if op.is_parallel_op()
+                else fwd_time + partial
+            ) + recompute_extra
         terms = OpTerms(
             compute=compute, xfer=xfer, partial=partial,
             grad_sync=grad_sync, opt_numel=opt_numel, opt_xfer=opt_xfer,
@@ -956,6 +1064,7 @@ class Simulator:
             mem_weights=mem_weights, mem_master=mem_master,
             mem_grad=mem_grad, mem_gather=mem_gather, mem_opt=mem_opt,
             mem_residual=mem_residual, mem_transient=mem_transient,
+            mem_activation=mem_residual, recompute=recompute,
         )
         self._term_cache[key] = terms
         return terms
@@ -995,6 +1104,88 @@ class Simulator:
         else:
             weights = compute_copy
         return int(weights + residuals + transient)
+
+    # -- searched rematerialization (docs/PERF.md) -----------------------
+    def remat_layout(self, ops: Sequence[Op],
+                     plan: Optional[Sequence[int]],
+                     op_scale=None) -> Tuple[set, float, float]:
+        """(on_guids, residual_bytes, worst_internal) for a per-segment
+        remat plan over a topo-ordered op sequence.
+
+          * on_guids — guids of ops inside ON (and pure) segments, whose
+            `recompute` term the aggregation charges;
+          * residual_bytes — activations that persist to backward under
+            the plan: every segment-boundary tensor (the checkpoint
+            saves — live as later segments' inputs either way) plus the
+            internals of OFF / impure segments;
+          * worst_internal — the largest ON segment's internal bytes,
+            alive only while that segment's backward recomputes.
+
+        plan=None means every pure segment is ON (the legacy --remat
+        shape); an empty plan reproduces the dense accounting exactly
+        (residual_bytes == the sum of mem_activation terms)."""
+        from ..pcg.segments import split_segments_ops
+
+        ops = list(ops)
+        segments, boundaries = split_segments_ops(ops)
+        boundary_guids = {g for g in boundaries if g is not None}
+        sel = None if plan is None else {int(i) for i in plan}
+        sc = op_scale or (lambda op: 1.0)
+        on_guids: set = set()
+        residual = 0.0
+        worst = 0.0
+        for i, seg in enumerate(segments):
+            pure = all(op.op_type not in REMAT_IMPURE_TYPES for op in seg)
+            on = pure and (sel is None or i in sel)
+            internal = 0.0
+            for op in seg:
+                if op.op_type in self._FUSED_ACT_TYPES:
+                    continue  # transient workspace, never a residual
+                for t in op.outputs:
+                    b = t.shape.shard_bytes() * sc(op)
+                    if t.guid in boundary_guids:
+                        residual += b
+                    else:
+                        internal += b
+            if on:
+                worst = max(worst, internal)
+                on_guids.update(op.guid for op in seg)
+            else:
+                residual += internal
+        return on_guids, residual, worst
+
+    def remat_memory_from_terms(
+        self, ops: Sequence[Op], mesh_axes: Dict[str, int],
+        plan: Optional[Sequence[int]], training: bool = True,
+        zero_stage: Optional[int] = None,
+        placement: Optional[str] = None,
+    ) -> int:
+        """per_device_memory under a per-segment remat plan, aggregated
+        from cached OpTerms + one O(n) segment sweep over the op
+        sequence — usable on the evaluator's DELTA path (no Graph
+        needed), unlike the legacy whole-graph _remat_peak.  Weight /
+        optimizer residency is identical to memory_from_terms (the
+        ZeRO ladder accounting); only the activation term changes.  An
+        all-OFF plan is bit-identical to memory_from_terms."""
+        compute_copy = master = grads = opt = transient = 0
+        gather_peak = 0
+        for op in ops:
+            terms = self.op_terms(op, mesh_axes, training,
+                                  zero_stage=zero_stage,
+                                  placement=placement)
+            compute_copy += terms.mem_weights
+            master += terms.mem_master
+            grads += terms.mem_grad
+            opt += terms.mem_opt
+            transient = max(transient, terms.mem_transient)
+            gather_peak = max(gather_peak, terms.mem_gather)
+        _, residual, worst = self.remat_layout(ops, plan)
+        if training:
+            weights = (master + grads + self.optimizer_slots * opt
+                       + 2 * gather_peak)
+        else:
+            weights = compute_copy
+        return int(weights + residual + worst + transient)
 
     # -- memory ----------------------------------------------------------
 
@@ -1165,11 +1356,19 @@ class Simulator:
         segment_costs: Optional[Sequence[Tuple[Sequence[int], float]]] = None,
         zero_stage: Optional[int] = None,
         placement: Optional[str] = None,
+        remat_plan: Optional[Sequence[int]] = None,
     ) -> SimResult:
         """segment_costs: [(member op guids, fwd+bwd seconds)] from
         profiler.measure_segment_costs — ops inside a measured region
         take the measurement (fused-granularity calibration); everything
-        else stays analytic."""
+        else stays analytic.
+
+        remat_plan: a strategy's per-segment remat plan (list of ON
+        segment indices; docs/PERF.md "Searched rematerialization") —
+        charges each ON segment's recompute seconds and prices memory
+        with the plan-aware accounting.  None keeps the legacy
+        behavior: the `remat` bool changes memory only (_remat_peak),
+        never time."""
         measured_ops: Dict[int, float] = {}  # op guid -> its region's cost
         seg_cost_total = 0.0
         if segment_costs:
@@ -1178,7 +1377,12 @@ class Simulator:
                 for g in guids:
                     measured_ops[g] = c
         topo = graph.topo_order()
-        if training and not self.remat:
+        if training and remat_plan is not None:
+            memory_fn = lambda: self.remat_memory_from_terms(  # noqa: E731
+                topo, mesh_axes, remat_plan, training,
+                zero_stage=zero_stage, placement=placement,
+            )
+        elif training and not self.remat:
             memory_fn = lambda: self.memory_from_terms(  # noqa: E731
                 topo, mesh_axes, training, zero_stage=zero_stage,
                 placement=placement,
@@ -1192,6 +1396,7 @@ class Simulator:
             topo, mesh_axes, training=training, measured_ops=measured_ops,
             seg_cost_total=seg_cost_total, memory_fn=memory_fn,
             zero_stage=zero_stage, placement=placement,
+            remat_plan=remat_plan,
         )
 
     def simulate_ops(
@@ -1204,12 +1409,17 @@ class Simulator:
         memory_fn: Optional[Callable[[], int]] = None,
         zero_stage: Optional[int] = None,
         placement: Optional[str] = None,
+        remat_plan: Optional[Sequence[int]] = None,
     ) -> SimResult:
         """Aggregate cached per-op terms over `ops` (a topo-ordered op
         sequence).  The ONE aggregation path shared by full and delta
         evaluations: the invariant delta_eval(state) == full_eval(state)
         holds bit-for-bit because both sum identical cached OpTerms in
-        identical order."""
+        identical order.  A remat_plan (docs/PERF.md "Searched
+        rematerialization") adds each ON segment's `recompute` terms to
+        the analytic compute — the segment sweep is a deterministic
+        function of the op sequence, so the invariant extends across
+        remat flips."""
         measured_ops = measured_ops or {}
         compute = seg_cost_total if training else seg_cost_total / 3.0
         analytic_compute = 0.0  # compute_scale applies ONLY here —
@@ -1220,14 +1430,34 @@ class Simulator:
         opt_xfer = 0.0
         gather_xfer = 0.0
         ici_time = dcn_time = ici_bytes = dcn_bytes = 0.0
+        recompute_s = 0.0
+        activation_bytes = 0.0
+        on_guids = None
+        if training and remat_plan is not None:
+            on_guids, activation_bytes, _ = self.remat_layout(
+                ops, remat_plan
+            )
         breakdown: Dict[str, float] = {}
         for op in ops:
             if op.op_type == OperatorType.INPUT:
+                if training and on_guids is None:
+                    # keep the dense telemetry consistent with the
+                    # memory accounting (and the plan-aware sweep),
+                    # which both count input residuals
+                    activation_bytes += sum(
+                        t.shape.shard_bytes() for t in op.outputs
+                    )
                 continue
             terms = self.op_terms(op, mesh_axes, training,
                                   skip_compute=op.guid in measured_ops,
                                   zero_stage=zero_stage,
                                   placement=placement)
+            if on_guids is None:
+                if training:
+                    activation_bytes += terms.mem_activation
+            elif op.guid in on_guids:
+                recompute_s += terms.recompute
+                analytic_compute += terms.recompute
             ici_time += terms.ici_xfer
             dcn_time += terms.dcn_xfer
             ici_bytes += terms.ici_bytes
@@ -1287,4 +1517,8 @@ class Simulator:
             "ici_time": ici_time, "dcn_time": dcn_time,
             "ici_bytes": ici_bytes, "dcn_bytes": dcn_bytes,
         }
+        # searched-remat telemetry: plan-aware saved activations + the
+        # recompute seconds charged (as-scaled, matching total_time)
+        res.activation_bytes = activation_bytes
+        res.recompute_s = recompute_s * self.compute_scale
         return res

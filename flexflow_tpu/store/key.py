@@ -146,7 +146,7 @@ def simulator_version(cfg) -> Dict:
             catalog_sha = catalog_fingerprint(path).get("sha256")
     except Exception:
         catalog_sha = "unresolved"
-    return {
+    out = {
         "cost_model_version": COST_MODEL_VERSION,
         "measure_cache_version": OpCostModel.MEASURE_CACHE_VERSION,
         "calibration_digest": _calibration_digest(),
@@ -179,6 +179,21 @@ def simulator_version(cfg) -> Dict:
             "seed": int(cfg.seed),
         },
     }
+    # DCN grad-sync bucketing (--dcn-bucket-mb) reshapes grad-sync
+    # costs only where a DCN tier exists, so — like the mesh
+    # fingerprint's slice fields — the knob joins the key ONLY on
+    # multi-slice configs: single-slice keys are bit-identical with or
+    # without it.  The searched remat dimension needs no key field of
+    # its own: it opens under memory_search (already keyed), the chosen
+    # plan rides the stored strategy body (which serializes the plan
+    # only when one was chosen — remat-free strategy digests are
+    # unchanged), and the v4 cost-model bump already re-keys everything
+    # once.
+    if int(getattr(cfg, "slices", 1)) > 1:
+        out["search"]["dcn_bucket_mb"] = float(
+            getattr(cfg, "dcn_bucket_mb", 25.0)
+        )
+    return out
 
 
 # -- the composed key -------------------------------------------------------

@@ -93,24 +93,33 @@ class Strategy:
     # topology.resolve_placement default.  Meaningless (and ignored) on
     # single-slice runs, so flat strategies serialize unchanged.
     placement: Optional[str] = None
+    # search-chosen per-segment remat plan (docs/PERF.md "Searched
+    # rematerialization"): sorted indices of the single-tensor-boundary
+    # segments whose internals recompute in backward (jax.checkpoint).
+    # None means "not chosen" — the executor falls back to the global
+    # FFConfig.remat bool (all pure segments).  [] is an explicit
+    # all-off plan.  Serialized ONLY when set, so remat-free strategies
+    # keep byte-identical JSON (and store-entry digests) to before the
+    # dimension existed — the single-slice key guarantee's pattern.
+    remat: Optional[List[int]] = None
 
     # -- serialization ---------------------------------------------------
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "mesh_axes": self.mesh_axes,
-                "shard_configs": {
-                    k: dataclasses.asdict(v) for k, v in self.shard_configs.items()
-                },
-                "edge_ops": self.edge_ops,
-                "rewrites": [list(r) for r in self.rewrites],
-                "pipeline": self.pipeline,
-                "catalog": self.catalog,
-                "zero_stage": self.zero_stage,
-                "placement": self.placement,
+        payload = {
+            "mesh_axes": self.mesh_axes,
+            "shard_configs": {
+                k: dataclasses.asdict(v) for k, v in self.shard_configs.items()
             },
-            indent=2,
-        )
+            "edge_ops": self.edge_ops,
+            "rewrites": [list(r) for r in self.rewrites],
+            "pipeline": self.pipeline,
+            "catalog": self.catalog,
+            "zero_stage": self.zero_stage,
+            "placement": self.placement,
+        }
+        if self.remat is not None:
+            payload["remat"] = [int(i) for i in self.remat]
+        return json.dumps(payload, indent=2)
 
     @classmethod
     def from_json(cls, text: str) -> "Strategy":
@@ -129,6 +138,10 @@ class Strategy:
             catalog=d.get("catalog"),
             zero_stage=d.get("zero_stage"),
             placement=d.get("placement"),
+            remat=(
+                [int(i) for i in d["remat"]] if d.get("remat") is not None
+                else None
+            ),
         )
 
     def save(self, path: str):

@@ -75,16 +75,25 @@ class SliceHierarchy(TpuPodModel):
 
     # -- single-tier legs (flat API, tier explicit) ---------------------
     def tier_collective(self, kind: str, size: float, n: int,
-                        over_dcn: bool = False) -> CommCost:
-        """One collective entirely on one tier, as a CommCost."""
+                        over_dcn: bool = False,
+                        dcn_lat_scale: float = 1.0) -> CommCost:
+        """One collective entirely on one tier, as a CommCost.
+
+        `dcn_lat_scale` scales the latency term of DCN legs only (the
+        grad-sync bucketing amortization, sim/simulator.py); ICI legs
+        and all bandwidth/byte terms are untouched."""
         if n <= 1:
             return ZERO_COST
+        lat_scale = dcn_lat_scale if over_dcn else 1.0
         if kind == "allreduce":
-            t = self.axis_allreduce_time(size, n, over_dcn)
+            t = self.axis_allreduce_time(size, n, over_dcn,
+                                         lat_scale=lat_scale)
         elif kind in ("allgather", "reducescatter"):
-            t = self.axis_allgather_time(size, n, over_dcn)
+            t = self.axis_allgather_time(size, n, over_dcn,
+                                         lat_scale=lat_scale)
         else:
-            t = self.axis_alltoall_time(size, n, over_dcn)
+            t = self.axis_alltoall_time(size, n, over_dcn,
+                                        lat_scale=lat_scale)
         b = ring_bytes(kind, size, n)
         if over_dcn:
             return CommCost(dcn_time=t, dcn_bytes=b)
@@ -107,7 +116,8 @@ class SliceHierarchy(TpuPodModel):
         return self.hierarchical_cost("allreduce", size, intra, inter).time
 
     def hierarchical_cost(self, kind: str, size: float, intra: int,
-                          inter: int) -> CommCost:
+                          inter: int,
+                          dcn_lat_scale: float = 1.0) -> CommCost:
         """Two-level synthesis of one collective over `intra * inter`
         devices where the inter leg crosses DCN.
 
@@ -120,26 +130,30 @@ class SliceHierarchy(TpuPodModel):
                          cross-slice fraction (inter-1)/inter over DCN.
         """
         if intra <= 1:
-            return self.tier_collective(kind, size, inter, over_dcn=True)
+            return self.tier_collective(kind, size, inter, over_dcn=True,
+                                        dcn_lat_scale=dcn_lat_scale)
         if inter <= 1:
             return self.tier_collective(kind, size, intra)
         if kind == "allreduce":
             return (
                 self.tier_collective("reducescatter", size, intra)
                 + self.tier_collective("allreduce", size / intra, inter,
-                                       over_dcn=True)
+                                       over_dcn=True,
+                                       dcn_lat_scale=dcn_lat_scale)
                 + self.tier_collective("allgather", size, intra)
             )
         if kind == "reducescatter":
             return (
                 self.tier_collective("reducescatter", size, intra)
                 + self.tier_collective("reducescatter", size / intra,
-                                       inter, over_dcn=True)
+                                       inter, over_dcn=True,
+                                       dcn_lat_scale=dcn_lat_scale)
             )
         if kind == "allgather":
             return (
                 self.tier_collective("allgather", size / intra, inter,
-                                     over_dcn=True)
+                                     over_dcn=True,
+                                     dcn_lat_scale=dcn_lat_scale)
                 + self.tier_collective("allgather", size, intra)
             )
         # alltoall: each device exchanges (n-1)/n of size; the slices it
@@ -147,20 +161,24 @@ class SliceHierarchy(TpuPodModel):
         cross = size * (inter - 1) / inter
         return (
             self.tier_collective("alltoall", size - cross, intra)
-            + self.tier_collective("alltoall", cross, inter, over_dcn=True)
+            + self.tier_collective("alltoall", cross, inter, over_dcn=True,
+                                   dcn_lat_scale=dcn_lat_scale)
         )
 
     def collective_cost(self, kind: str, size: float, group_len: int,
-                        cross: bool = False) -> CommCost:
+                        cross: bool = False,
+                        dcn_lat_scale: float = 1.0) -> CommCost:
         """The cost the simulator charges one collective: flat ICI when
         the group stays inside a slice, the hierarchical synthesis when
-        it spans the DCN boundary."""
+        it spans the DCN boundary.  `dcn_lat_scale` (grad-sync
+        bucketing) scales only the DCN legs' latency terms."""
         if group_len <= 1:
             return ZERO_COST
         if not cross or self.slices <= 1:
             return self.tier_collective(kind, size, group_len)
         intra, inter = self.split_group(group_len)
-        return self.hierarchical_cost(kind, size, intra, inter)
+        return self.hierarchical_cost(kind, size, intra, inter,
+                                      dcn_lat_scale=dcn_lat_scale)
 
 
 PodModel = SliceHierarchy  # the ISSUE's alias

@@ -1,0 +1,405 @@
+"""Searched rematerialization (ISSUE 13): per-segment activation
+checkpointing as a simulator-costed strategy dimension.
+
+Pins the PR's contracts:
+
+  * delta_eval == full_eval bit-for-bit across remat flips at
+    COST_MODEL_VERSION 4 (the remat plan, like the ZeRO stage, changes
+    only how cached OpTerms aggregate — never the applied graph);
+  * the executor lowers a per-segment plan (only the named segments
+    wrap in jax.checkpoint) with loss bit-identity vs the dense
+    (no-remat) oracle, including the ZeRO-3 interaction;
+  * both searches choose a NON-TRIVIAL plan under memory pressure
+    whose simulated cost beats all-on and all-off;
+  * remat-free strategies keep byte-identical serialization and
+    flat configs keep bucket-free store keys (the single-slice key
+    guarantee's pattern);
+  * DCN grad-sync bucketing: latency-sublinear in leaf count, total
+    bytes unchanged.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.optimizer import AdamOptimizer, SGDOptimizer
+from flexflow_tpu.pcg.evaluator import (
+    IncrementalEvaluator,
+    strategy_signature,
+)
+from flexflow_tpu.pcg.mcmc import MCMCSearch, remat_stats
+from flexflow_tpu.pcg.unity import UnitySearch
+from flexflow_tpu.sim.machine_model import TpuPodModel
+from flexflow_tpu.sim.simulator import (
+    COST_MODEL_VERSION,
+    OpCostModel,
+    Simulator,
+    remat_segments,
+)
+from flexflow_tpu.strategy import Strategy, data_parallel_strategy
+
+
+def _residual_mlp(batch=32, width=256, layers=6, **cfg_kw):
+    """Residual MLP: each block is a multi-op single-tensor segment
+    (the residual edge forbids interior cuts) — the graph shape where
+    per-segment remat actually trades internals for recompute."""
+    cfg_kw.setdefault("num_devices", 1)
+    ff = FFModel(FFConfig(batch_size=batch, **cfg_kw))
+    x = ff.create_tensor([batch, width], name="input")
+    t = x
+    for i in range(layers):
+        h = ff.dense(t, width * 2, name=f"up{i}")
+        h = ff.relu(h, name=f"act{i}")
+        h = ff.dense(h, width, name=f"down{i}")
+        t = ff.add(t, h, name=f"res{i}")
+    t = ff.dense(t, 8, name="head")
+    ff.softmax(t)
+    return ff
+
+
+def _pure_segment_count(ev, strategy):
+    res = ev.evaluate(strategy)
+    return sum(1 for _, pure in remat_segments(res.ops) if pure)
+
+
+# -- simulator economics --------------------------------------------------
+
+def test_remat_plan_trades_memory_for_recompute():
+    """All-off is bit-identical to the dense accounting; all-on drops
+    activation residuals and pays recompute seconds; a single ON
+    segment trades its residual for an equal-size recompute window
+    (no net memory win until >= 2 segments are on — Checkmate
+    semantics, arXiv:1910.02653)."""
+    assert COST_MODEL_VERSION >= 4
+    g = _residual_mlp().layers
+    ev = IncrementalEvaluator(g, Simulator(TpuPodModel(topology=(8,))))
+    dp = data_parallel_strategy(8)
+    dense = ev.evaluate(dp)
+    n = _pure_segment_count(ev, dp)
+    assert n >= 6
+    r_off = ev.evaluate(dataclasses.replace(dp, remat=[]))
+    assert r_off.total_time == dense.total_time
+    assert r_off.per_device_memory == dense.per_device_memory
+    r_on = ev.evaluate(dataclasses.replace(dp, remat=list(range(n + 1))))
+    assert r_on.total_time > dense.total_time
+    assert r_on.per_device_memory < dense.per_device_memory
+    assert r_on.recompute_s > 0
+    assert dense.recompute_s == 0
+    r_one = ev.evaluate(dataclasses.replace(dp, remat=[3]))
+    assert dense.total_time < r_one.total_time < r_on.total_time
+    assert r_one.per_device_memory == dense.per_device_memory
+    r_two = ev.evaluate(dataclasses.replace(dp, remat=[3, 4]))
+    assert r_on.per_device_memory < r_two.per_device_memory \
+        < dense.per_device_memory
+    # activation telemetry: the plan's saved bytes shrink with coverage
+    assert r_on.activation_bytes < r_two.activation_bytes \
+        < dense.activation_bytes
+
+
+def test_inference_costing_unaffected_by_remat_dimension():
+    """training=False simulation (inference liveness costing) must not
+    consult the remat machinery — regression for the v4 aggregation."""
+    from flexflow_tpu.strategy import apply_strategy, assign_views
+
+    g = _residual_mlp().layers
+    sim = Simulator(TpuPodModel(topology=(8,)))
+    dp = data_parallel_strategy(8)
+    applied = apply_strategy(g, dp)
+    assign_views(applied, dp.mesh_axes)
+    res = sim.simulate(applied, dp.mesh_axes, training=False)
+    assert res.total_time > 0
+    assert res.recompute_s == 0
+    ev = IncrementalEvaluator(g, sim, training=False)
+    assert ev.evaluate(dp) is not None
+
+
+def test_delta_eval_matches_full_eval_across_remat_flips():
+    """The exactness invariant extends to the remat dimension: a remat
+    flip is a zero-frontier delta (the applied graph is plan-invariant)
+    and must agree with the always-full reference path bit-for-bit."""
+    g = _residual_mlp().layers
+    machine = TpuPodModel(topology=(8,))
+    ev_delta = IncrementalEvaluator(g, Simulator(machine), use_cache=True)
+    ev_full = IncrementalEvaluator(g, Simulator(machine), use_cache=False)
+    dp = data_parallel_strategy(8)
+    plans = [None, [], [2], [1, 4], list(range(8)), [2], None, [0, 2, 6]]
+    stages = [None, 3, None, 2, None, 3, None, None]
+    delta_seen = 0
+    for plan, stage in zip(plans, stages):
+        s = dataclasses.replace(
+            dp,
+            remat=list(plan) if plan is not None else None,
+            zero_stage=stage,
+        )
+        rd = ev_delta.evaluate(s)
+        rf = ev_full.evaluate(dataclasses.replace(
+            s, remat=list(plan) if plan is not None else None))
+        assert rd.total_time == rf.total_time
+        assert rd.per_device_memory == rf.per_device_memory
+        assert rd.recompute_s == rf.recompute_s
+        delta_seen = ev_delta.stats.delta_evals
+    assert delta_seen > 0  # remat flips actually rode the delta path
+
+
+def test_signature_and_serialization_separate_plans():
+    dp = data_parallel_strategy(8)
+    sigs = {
+        strategy_signature(dataclasses.replace(dp, remat=p))
+        for p in (None, [], [1], [1, 2])
+    }
+    assert len(sigs) == 4
+    s = dataclasses.replace(dp, remat=[1, 3])
+    s2 = Strategy.from_json(s.to_json())
+    assert s2.remat == [1, 3]
+    assert strategy_signature(s) == strategy_signature(s2)
+    assert remat_stats(s) == {"remat": "1,3", "remat_segments_on": 2}
+
+
+# -- store-key / serialization stability for remat-free strategies --------
+
+def test_remat_free_strategies_keep_stable_keys():
+    """No plan -> no 'remat' key in the JSON body (store-entry digests
+    of remat-free strategies are unchanged), and flat configs carry no
+    dcn_bucket field in the simulator key while multi-slice configs do
+    (the single-slice key guarantee's pattern)."""
+    import json
+
+    from flexflow_tpu.store.key import simulator_version
+
+    body = json.loads(data_parallel_strategy(8).to_json())
+    assert "remat" not in body
+    planned = json.loads(
+        dataclasses.replace(data_parallel_strategy(8), remat=[2]).to_json()
+    )
+    assert planned["remat"] == [2]
+
+    flat = simulator_version(FFConfig())
+    assert flat["cost_model_version"] == COST_MODEL_VERSION >= 4
+    assert "dcn_bucket_mb" not in flat["search"]
+    sliced = simulator_version(FFConfig(slices=2, num_devices=8))
+    assert sliced["search"]["dcn_bucket_mb"] == 25.0
+    # the bucket knob splits multi-slice keys only
+    sliced_b = simulator_version(
+        FFConfig(slices=2, num_devices=8, dcn_bucket_mb=50.0)
+    )
+    assert sliced != sliced_b
+    assert simulator_version(FFConfig(dcn_bucket_mb=50.0)) == flat
+
+
+# -- the searches choose the plan -----------------------------------------
+
+def _pressure_setup():
+    """dp-8 residual MLP whose activations dominate memory, with a
+    budget strictly between the all-on and all-off footprints — the
+    deterministic face of the remat decision."""
+    g = _residual_mlp(batch=4096, width=512).layers
+    machine = TpuPodModel(topology=(8,))
+    ev = IncrementalEvaluator(g, Simulator(machine))
+    dp = data_parallel_strategy(8)
+    dense = ev.evaluate(dp)
+    n = _pure_segment_count(ev, dp)
+    r_on = ev.evaluate(dataclasses.replace(dp, remat=list(range(n))))
+    assert r_on.per_device_memory < dense.per_device_memory
+    budget = r_on.per_device_memory + (
+        dense.per_device_memory - r_on.per_device_memory
+    ) // 4
+    return g, machine, ev, dp, dense, r_on, n, budget
+
+
+def test_unity_chooses_nontrivial_plan_under_memory_pressure(monkeypatch):
+    """Unity's remat variants land on a partial plan: fits the budget
+    (beats all-off, which does not) at less simulated time than all-on."""
+    import flexflow_tpu.pcg.unity as unity_mod
+
+    g, machine, ev, dp, dense, r_on, n, budget = _pressure_setup()
+    monkeypatch.setattr(
+        unity_mod, "_factorizations",
+        lambda nn, allow_expert=True: [(nn, 1, 1)],
+    )
+    search = UnitySearch(g, 8, machine, OpCostModel(machine),
+                         memory_budget=budget, enable_pipeline=False,
+                         remat_search=True)
+    best = search.optimize_with_memory()
+    assert best is not None and best.remat
+    assert 0 < len(best.remat) < n  # some on, some off
+    res = ev.evaluate(best)
+    assert res.per_device_memory <= budget < dense.per_device_memory
+    assert res.total_time < r_on.total_time
+    assert best.search_stats["remat_segments_on"] == len(best.remat)
+    assert best.search_stats["remat"] == ",".join(map(str, best.remat))
+
+
+def test_mcmc_flip_segment_move_lands_plan_under_memory_pressure():
+    g, machine, ev, dp, dense, r_on, n, budget = _pressure_setup()
+    search = MCMCSearch(g, 8, lambda: Simulator(machine), budget=150,
+                        seed=0, memory_budget=budget, memory_lambda=3.0,
+                        remat_search=True)
+    search.factorizations = [(8, 1, 1)]
+    best = search.optimize()
+    assert best.remat
+    res = search.evaluator.evaluate(best)
+    assert res.per_device_memory <= budget
+    assert res.total_time < r_on.total_time
+    assert best.search_stats["remat"] == ",".join(map(str, best.remat))
+
+
+def test_remat_dimension_gated_on_memory_search():
+    from flexflow_tpu.pcg.mcmc import search_remat_enabled
+
+    assert search_remat_enabled(FFConfig(memory_search=True))
+    assert not search_remat_enabled(FFConfig())
+    # a global --remat floor does NOT close the dimension: the search
+    # may still find a cheaper partial plan
+    assert search_remat_enabled(FFConfig(memory_search=True, remat=True))
+
+
+# -- ZeRO-3 interaction ----------------------------------------------------
+
+def test_stage3_regather_rides_recompute_only_when_on():
+    """At ZeRO-3 a remat'd segment's backward re-gather runs inside the
+    checkpointed region (no prefetch), so an ON plan at stage 3 pays
+    more recompute than at stage 0 — while OFF plans price gather_xfer
+    exactly as before (time-identical across plans=None/[])."""
+    g = _residual_mlp(batch=4096, width=512).layers
+    ev = IncrementalEvaluator(g, Simulator(TpuPodModel(topology=(8,))))
+    dp = data_parallel_strategy(8)
+    n = _pure_segment_count(ev, dp)
+    plan = list(range(n))
+
+    def res(stage, remat):
+        return ev.evaluate(dataclasses.replace(
+            dp, zero_stage=stage, remat=remat))
+
+    extra_s0 = res(0, plan).total_time - res(0, []).total_time
+    extra_s3 = res(3, plan).total_time - res(3, []).total_time
+    assert extra_s3 > extra_s0  # the lost prefetch credit is priced
+    assert res(3, []).total_time == res(3, None).total_time
+
+
+# -- executor lowering -----------------------------------------------------
+
+def _exec_model(batch=16, width=32, layers=3, **cfg_kw):
+    return _residual_mlp(batch=batch, width=width, layers=layers, **cfg_kw)
+
+
+def _fit(ff, strategy, devices, seed=0, steps=4, optimizer=None):
+    """Compile under `strategy` and run `steps` real train steps,
+    returning the PER-STEP loss values read off the device (the
+    PerfMetrics loss fields are not populated without the loss metric
+    configured, so reading them would make the comparison vacuous)."""
+    ff.compile(
+        optimizer=optimizer or SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=strategy, devices=devices, seed=seed,
+    )
+    rng = np.random.RandomState(0)
+    width = ff.layers.source_ops()[0].outputs[0].shape.logical_shape[1]
+    xs = rng.randn(64, width).astype(np.float32)
+    ys = rng.randint(0, 8, 64).astype(np.int32)
+    losses = []
+    for _ in range(steps):
+        m = ff.train_step({"input": xs}, ys)
+        losses.append(float(np.asarray(m["loss"])))
+    assert losses[-1] < losses[0]  # actually training, not zeros
+    return losses
+
+
+def test_partial_plan_lowers_and_matches_dense_numerics(devices8):
+    """A strategy-carried partial plan wraps ONLY the named segments in
+    jax.checkpoint; the loss trajectory is bit-compatible with the
+    dense oracle (remat never changes math)."""
+    dp = data_parallel_strategy(8)
+    ff_dense = _exec_model(num_devices=8)
+    losses_dense = _fit(ff_dense, dp, devices8)
+    assert ff_dense.executor._remat_plan is None
+
+    plan = [2, 3]
+    ff_plan = _exec_model(num_devices=8)
+    losses_plan = _fit(
+        ff_plan, dataclasses.replace(dp, remat=plan), devices8
+    )
+    ex = ff_plan.executor
+    assert ex._remat_plan is not None
+    wrapped = [i for i, (_, _, _, pure) in enumerate(ex._remat_plan)
+               if pure]
+    assert wrapped == plan  # only the named segments checkpoint
+    np.testing.assert_allclose(losses_plan, losses_dense, rtol=1e-6)
+
+    # a plan naming every segment == the legacy --remat lowering
+    ff_all = _exec_model(num_devices=8, remat=True)
+    losses_all = _fit(
+        ff_all, dataclasses.replace(
+            dp, remat=list(range(32))), devices8,
+    )
+    legacy = _exec_model(num_devices=8, remat=True)
+    losses_legacy = _fit(legacy, data_parallel_strategy(8), devices8)
+    pure_plan = [p for *_, p in ff_all.executor._remat_plan]
+    pure_legacy = [p for *_, p in legacy.executor._remat_plan]
+    assert pure_plan == pure_legacy  # identical segment wrapping
+    np.testing.assert_allclose(losses_all, losses_legacy, rtol=1e-6)
+
+
+def test_zero3_with_partial_remat_matches_stage0_dense(devices8):
+    """ZeRO-3 + per-segment remat: gathers re-emitted inside the
+    checkpointed segments still produce stage-0 dense numerics."""
+    dp = data_parallel_strategy(8)
+    base = _fit(_exec_model(num_devices=8, zero_stage=0), dp, devices8,
+                optimizer=AdamOptimizer(alpha=0.01))
+    z3 = _fit(
+        _exec_model(num_devices=8, zero_stage=3),
+        dataclasses.replace(dp, remat=[1, 3]), devices8,
+        optimizer=AdamOptimizer(alpha=0.01),
+    )
+    np.testing.assert_allclose(z3, base, rtol=2e-5)
+
+
+# -- DCN grad-sync bucketing ----------------------------------------------
+
+def test_dcn_bucketing_latency_sublinear_bytes_unchanged():
+    """Many small grad leaves stop over-paying the per-leaf DCN latency
+    term: with bucketing the summed DCN time of N small leaves is
+    latency-sublinear in N (well under N x the unbucketed per-leaf
+    cost), while per-device ring bytes are unchanged.  A leaf at or
+    above the bucket size pays the full latency exactly as before."""
+    from flexflow_tpu.topology.hierarchy import SliceHierarchy
+
+    m = SliceHierarchy(topology=(4,), slices=2, dcn_bw_per_host=4e9,
+                       dcn_latency=10e-6)
+    bucket = 25 * 2**20
+    sim_b = Simulator(m, dcn_bucket_bytes=bucket)
+    sim_0 = Simulator(m, dcn_bucket_bytes=0)
+    leaf = 16 * 1024  # 16KB leaves, latency-dominated on DCN
+    n_leaves = 64
+    cc_b = [sim_b._collective("allreduce", leaf, 8, cross=True,
+                              grad_bucket=True) for _ in range(n_leaves)]
+    cc_0 = [sim_0._collective("allreduce", leaf, 8, cross=True,
+                              grad_bucket=True) for _ in range(n_leaves)]
+    t_b = sum(c.dcn_time for c in cc_b)
+    t_0 = sum(c.dcn_time for c in cc_0)
+    assert sum(c.dcn_bytes for c in cc_b) == sum(c.dcn_bytes for c in cc_0)
+    assert sum(c.ici_time for c in cc_b) == sum(c.ici_time for c in cc_0)
+    assert t_b < t_0 / 8  # latency-sublinear in leaf count
+    # the bandwidth term is a floor the bucketing never crosses
+    bw_only = sum(
+        c.dcn_bytes / m.dcn_bw for c in cc_0
+    )
+    assert t_b >= bw_only
+    # a bucket-sized leaf pays the full unbucketed cost
+    big = sim_b._collective("allreduce", bucket * 8, 8, cross=True,
+                            grad_bucket=True)
+    big0 = sim_0._collective("allreduce", bucket * 8, 8, cross=True,
+                             grad_bucket=True)
+    assert big.dcn_time == big0.dcn_time
+    # activation/resharding collectives are never bucketed
+    x = sim_b._collective("allreduce", leaf, 8, cross=True)
+    x0 = sim_0._collective("allreduce", leaf, 8, cross=True)
+    assert x.dcn_time == x0.dcn_time
+
+
+def test_dcn_bucket_config_knob():
+    with pytest.raises(ValueError):
+        FFConfig(dcn_bucket_mb=0)
+    cfg = FFConfig.from_args(["--dcn-bucket-mb", "50"])
+    assert cfg.dcn_bucket_mb == 50.0

@@ -51,6 +51,9 @@ _OPTERMS_DIGEST_BY_VERSION = {
     # v3: the multi-slice topology subsystem — ici_xfer/dcn_xfer/
     # ici_bytes/dcn_bytes per-tier split + placement-aware estimators
     3: "99b6da36d6b61866",
+    # v4: searched rematerialization — mem_activation/recompute (plus
+    # the DCN grad-sync bucketing change to the comm estimators)
+    4: "baf98457befeaf37",
 }
 
 

@@ -155,6 +155,16 @@ def summarize(records: List[Dict]) -> str:
     ]
     out.append(_section("Comm", rows))
 
+    # searched-remat memory split (docs/PERF.md "Searched
+    # rematerialization"): per-run saved-activation bytes under the
+    # compiled plan + the recompute seconds the plan pays
+    rows = [
+        (name.split("/", 1)[1], rec.get("value", 0.0))
+        for name, rec in sorted(metrics.items())
+        if name.startswith("mem/") or name == "compute/recompute_s"
+    ]
+    out.append(_section("Memory", rows))
+
     rows = []
     for name, rec in sorted(metrics.items()):
         if not name.startswith("serving/"):
