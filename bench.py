@@ -1355,6 +1355,135 @@ def bench_serving(dev, on_tpu):
     }
 
 
+def bench_serving_prefix(dev, on_tpu):
+    """Prefix-cache + chunked-prefill throughput leg (manifest v18):
+    the SAME shared-prefix workload (K system prompts, per-request
+    unique tails) and arrival sequence through the PR 6 continuous
+    tier (sharing off, one-token prefill) and the prefix-cached tier
+    (COW block sharing + [slots, C] chunked prefill) at EQUAL KV pool
+    bytes.  Reports tokens/s both ways, p50/p99 TTFT, prefix-cache
+    hit/shared/eviction counters and the shared-block high-water mark;
+    asserts greedy completions byte-identical across modes, with the
+    kv_pool invariant checker running at EVERY scheduler step of both
+    runs.  Acceptance bar: >= 1.3x the baseline's tokens/s with lower
+    p50 TTFT on the shared-prefix smoke workload."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+    from flexflow_tpu.serving import ContinuousScheduler
+    from flexflow_tpu.serving.loadgen import (run_loadgen,
+                                              sample_shared_prefix_workload)
+
+    leg = MANIFEST["legs"]["serving_prefix"]
+    if on_tpu:
+        vocab, max_seq = leg["vocab"], leg["max_seq"]
+        hidden, layers, heads = leg["hidden"], leg["layers"], leg["heads"]
+        inter, slots = leg["intermediate"], leg["slots"]
+        page, n_req = leg["kv_page_size"], leg["requests"]
+        rate, chunk = leg["offered_rps"], leg["prefill_chunk"]
+        n_prefixes, prefix_len = leg["num_prefixes"], leg["prefix_len"]
+        tail_range = tuple(leg["tail_range"])
+        mnt_range = tuple(leg["max_new_range"])
+    else:
+        # prefill-heavy smoke shape: long shared prefixes (half the
+        # position table), short unique tails and replies — the
+        # system-prompt regime where the PR 6 tier burns most of its
+        # steps re-prefilling identical tokens one at a time
+        vocab, max_seq = 128, 64
+        hidden, layers, heads, inter = 256, 3, 8, 512
+        slots, page, n_req, rate, chunk = 8, 8, 64, 600.0, 8
+        n_prefixes, prefix_len = 4, 32
+        tail_range, mnt_range = (1, 7), (2, 8)
+
+    cfg = FFConfig(batch_size=slots, num_devices=1)
+    ff = FFModel(cfg)
+    build_gpt(ff, batch_size=slots, seq_length=max_seq,
+              hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=inter, vocab_size=vocab)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (slots, max_seq)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                          (slots, max_seq)).copy()
+    ff.train_step({"input": ids, "positions": pos}, ids)  # real weights
+
+    wl_rng = np.random.RandomState(23)
+    workload, prefixes = sample_shared_prefix_workload(
+        wl_rng, n_req, vocab, num_prefixes=n_prefixes,
+        prefix_len=prefix_len, tail_range=tail_range,
+        max_new_range=mnt_range)
+
+    # equal-HBM pitch (the serving leg's): both pools get the block
+    # bytes of a dense [slots, max_seq] cache, spent on 2x slots
+    max_blocks = max_seq // page
+    num_blocks = 1 + slots * max_blocks
+    warm_rng = np.random.RandomState(999)
+    warm = warm_rng.randint(0, vocab, page).tolist()  # 1 aligned page
+
+    def run_tier(prefix_cache, prefill_chunk):
+        sched = ContinuousScheduler.from_trained(
+            ff, batch_slots=2 * slots, page_size=page,
+            num_blocks=num_blocks, devices=[dev],
+            prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+            check_invariants=True)  # invariant sweep at EVERY step
+        try:
+            # warm every program before timing: decode, chunked
+            # prefill, and (second warm call = full-prompt hit) the
+            # COW block copy.  The warm prompt is disjoint from the
+            # workload prefixes.
+            sched.generate(warm, 2, timeout=120.0)
+            sched.generate(warm, 2, timeout=120.0)
+            report = run_loadgen(sched, workload, rate, seed=13,
+                                 detail=True, record_tokens=True)
+            stats = sched.stats()
+            sched.pool.check_invariants()
+            return report, stats
+        finally:
+            sched.close()
+
+    base_report, base_stats = run_tier(False, 0)
+    prefix_report, prefix_stats = run_tier(True, chunk)
+
+    # greedy completions must be byte-identical across modes
+    def by_idx(report):
+        return {r["idx"]: r["tokens"] for r in report["records"]
+                if r.get("ok")}
+    base_toks, prefix_toks = by_idx(base_report), by_idx(prefix_report)
+    assert set(base_toks) == set(prefix_toks), "completion sets differ"
+    mismatched = sum(1 for i in base_toks
+                     if base_toks[i] != prefix_toks[i])
+    assert mismatched == 0, \
+        f"{mismatched} completions differ between sharing on/off"
+
+    hit_total = sum(r.get("prefix_hit_tokens", 0)
+                    for r in prefix_report["records"])
+    ratio = (prefix_report.get("tokens_per_s", 0.0)
+             / max(base_report.get("tokens_per_s", 0.0), 1e-9))
+    pc = prefix_stats["prefix_cache"]
+    return {
+        "workload": (
+            f"{n_req} reqs over {n_prefixes} shared {prefix_len}-token "
+            f"prefixes, tails {tail_range}, max_new {mnt_range}, "
+            f"Poisson {rate} rps, greedy, {2 * slots} slots, "
+            f"page {page}, chunk {chunk}, equal KV bytes"
+        ),
+        "baseline": base_report,
+        "prefix_cached": prefix_report,
+        "prefix_vs_baseline_tokens_per_s": round(ratio, 3),
+        "speedup_at_least_1_3": bool(ratio >= 1.3),
+        "ttft_p50_lower": bool(
+            prefix_report.get("ttft", {}).get("p50_ms", 1e9)
+            < base_report.get("ttft", {}).get("p50_ms", 0.0)),
+        "prefix_hit_tokens": hit_total,
+        "prefix_cache": pc,
+        "kv_shared_blocks_high_water": pc["peak_shared_blocks"],
+        "prefill_steps": prefix_stats["prefill_steps"],
+        "completions_identical": True,  # asserted above
+        "invariants_checked_every_step": True,  # check_invariants=True
+    }
+
+
 def bench_serving_resilience(dev, on_tpu):
     """Replicated-front availability leg (manifest v12): the Poisson
     workload of the serving leg against a 2-replica ServingFront with
@@ -1730,6 +1859,8 @@ def main():
     gc.collect()
     serving = bench_serving(dev, on_tpu)
     gc.collect()
+    serving_prefix = bench_serving_prefix(dev, on_tpu)
+    gc.collect()
     serving_resilience = bench_serving_resilience(dev, on_tpu)
     gc.collect()
     autoscale = bench_autoscale(dev, on_tpu)
@@ -1761,6 +1892,7 @@ def main():
                  "moe_dispatch": moe, "weight_update": wu,
                  "zero_ladder": ladder,
                  "checkpoint": ckpt, "serving": serving,
+                 "serving_prefix": serving_prefix,
                  "serving_resilience": serving_resilience,
                  "autoscale": autoscale,
                  "cold_start": cold_start, "host_loss": host_loss,

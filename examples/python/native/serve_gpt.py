@@ -77,6 +77,10 @@ def main():
         ff.config.serving_slots = serving_cfg.serving_slots
         ff.config.kv_page_size = page
         ff.config.kv_pool_blocks = serving_cfg.kv_pool_blocks
+        # prefix cache + chunked prefill ride into every replica's
+        # engine (--prefill-chunk / --no-prefix-cache)
+        ff.config.prefill_chunk = serving_cfg.prefill_chunk
+        ff.config.prefix_cache = serving_cfg.prefix_cache
         ff.config.serving_step_timeout = \
             serving_cfg.serving_step_timeout
         ff.config.serving_max_restarts = \

@@ -259,6 +259,13 @@ class FFConfig:
     kv_page_size: int = 16     # tokens per KV block (must divide max_seq)
     kv_pool_blocks: int = 0    # physical blocks incl. scratch; 0 = auto
     serving_slots: int = 8     # continuous decode batch slots
+    # prefix cache & chunked prefill (docs/SERVING.md "Prefix cache &
+    # chunked prefill"): copy-on-write sharing of block-aligned prompt
+    # prefixes in the KV pool, and a second [slots, C] compiled step
+    # that prefills C prompt tokens per dispatch (0/1 = one-token
+    # prefill, the PR 6 path).  Both preserve greedy token-identity.
+    prefill_chunk: int = 8
+    prefix_cache: bool = True
     # replicated front (serving/front.py, docs/SERVING.md "Replicated
     # front"): N supervised ContinuousScheduler replicas behind one
     # admission queue.  1 = single supervised replica (still gains the
@@ -302,6 +309,11 @@ class FFConfig:
         if self.serving_slots < 1:
             raise ValueError(
                 f"serving_slots must be >= 1, got {self.serving_slots}"
+            )
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0 (0 = one-token prefill), "
+                f"got {self.prefill_chunk}"
             )
         if self.serving_replicas < 1:
             raise ValueError(
@@ -591,6 +603,10 @@ class FFConfig:
                        type=int, default=0)
         p.add_argument("--serving-slots", dest="serving_slots", type=int,
                        default=8)
+        p.add_argument("--prefill-chunk", dest="prefill_chunk",
+                       type=int, default=8)
+        p.add_argument("--no-prefix-cache", dest="prefix_cache",
+                       action="store_false")
         p.add_argument("--serving-replicas", dest="serving_replicas",
                        type=int, default=1)
         p.add_argument("--serving-step-timeout",
@@ -689,6 +705,8 @@ class FFConfig:
             kv_page_size=args.kv_page_size,
             kv_pool_blocks=args.kv_pool_blocks,
             serving_slots=args.serving_slots,
+            prefill_chunk=args.prefill_chunk,
+            prefix_cache=args.prefix_cache,
             serving_replicas=args.serving_replicas,
             serving_step_timeout=args.serving_step_timeout,
             serving_max_restarts=args.serving_max_restarts,

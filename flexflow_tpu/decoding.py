@@ -64,19 +64,35 @@ def _gpt_dims(ff: FFModel) -> Dict[str, int]:
 
 def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
                      devices=None, kv_page_size: int = 0,
-                     kv_num_blocks: int = 0) -> FFModel:
+                     kv_num_blocks: int = 0,
+                     step_tokens: int = 1) -> FFModel:
     """Build + compile the KV-cache decode twin of a trained GPT and
-    transfer its weights.  The decode graph is seq-1 with
-    decode_max_seq = the trained model's position-table size.
+    transfer its weights.  The decode graph is seq-`step_tokens`
+    (default 1) with decode_max_seq = the trained model's
+    position-table size.
 
     kv_page_size > 0 builds the PAGED twin (serving/scheduler.py):
     every attention layer's k/v cache is a [kv_num_blocks,
     kv_page_size, h, d] block pool with a host-owned per-slot block
     table + seq_lens instead of a dense per-slot [b, max_seq, h, d]
-    buffer — continuous batching's allocation substrate."""
+    buffer — continuous batching's allocation substrate.
+
+    step_tokens > 1 (paged mode only) builds the [b, C] CHUNKED twin:
+    one step scatters C tokens at each row's own positions and attends
+    causally within the chunk — the multi-token prefill shape
+    (build_paged_chunk_step).  Its state pytree is congruent with the
+    seq-1 twin's (pools, tables and seq_lens are all seq-independent),
+    so both programs thread one shared state."""
     from .config import FFConfig
     from .models.transformer import build_gpt
 
+    if step_tokens < 1:
+        raise ValueError(f"step_tokens must be >= 1, got {step_tokens}")
+    if step_tokens > 1 and not kv_page_size:
+        raise ValueError(
+            "step_tokens > 1 needs the paged twin (kv_page_size > 0): "
+            "the dense cache's scalar position counter cannot express "
+            "per-row chunk positions")
     dims = _gpt_dims(ff_train)
     b = batch_size or ff_train.config.batch_size
     cfg = FFConfig(
@@ -93,7 +109,7 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
     )
     ffd = FFModel(cfg)
     build_gpt(
-        ffd, batch_size=b, seq_length=1,
+        ffd, batch_size=b, seq_length=step_tokens,
         hidden_size=dims["hidden_size"], num_layers=dims["num_layers"],
         num_heads=dims["num_heads"],
         intermediate_size=dims["intermediate_size"],
@@ -436,3 +452,154 @@ def build_paged_decode_step(ffd: FFModel):
 
     with ex.mesh:
         return jax.jit(step, donate_argnums=(1,))
+
+
+def build_paged_prefill_step(ffd: FFModel, chunk: int):
+    """ONE compiled [slots, C] CHUNKED-PREFILL program for the paged
+    decode twin (the second step program of the continuous engine,
+    built alongside build_paged_decode_step):
+
+        prefill(weights, state, tokens[b, C], positions[b], block_table)
+            -> new_state
+
+    Feeds each row C consecutive prompt tokens starting at its own
+    position (row i's token j lands at positions[i] + j), filling the
+    KV pool C tokens per dispatch — a P-token prompt costs ~P/C steps
+    instead of P.  Logits are not returned: prefill ignores them (the
+    final prompt token runs through the decode program, whose logits
+    seed sampling), and rows past their real token count just write
+    overwritten-before-attended garbage (see the scheduler).
+
+    BIT-IDENTITY DISCIPLINE: internally this is a lax.scan of the
+    SEQ-1 decode graph over the chunk, not a seq-C forward.  Every op
+    in the scan body has exactly the decode program's shapes, so the
+    K/V bytes it writes are bit-identical to one-token-at-a-time
+    prefill — XLA:CPU lowers same-shape dots identically, but NOT
+    matmuls whose leading dim changed (a [b*C, e] FFN matmul is not
+    rowwise-bitwise-equal to its [b, e] slice), which rules out the
+    fused seq-C graph (build_paged_chunk_step) wherever the dense
+    gather oracle's byte-identity guarantee must hold."""
+    import jax
+    import jax.numpy as jnp
+
+    if chunk < 2:
+        raise ValueError(f"chunk must be >= 2, got {chunk}")
+    ex = ffd.executor
+    max_seq = _gpt_dims(ffd)["max_seq"]
+
+    def prefill(weights, state, tokens, positions, block_table):
+        def body(carry, xs):
+            tok, j = xs
+            pos_j = (positions + j).astype(jnp.int32)
+            # a row's trailing PAD tokens can run past the position
+            # table (a near-max_seq prompt whose last chunk is mostly
+            # padding).  Route those writes to scratch (zeroed table
+            # row) and clamp the position in-range EXPLICITLY: today
+            # jax's fill-mode gather turns the out-of-range block-id
+            # lookup into an out-of-range scatter that XLA drops, but
+            # that is a mode default (plain `arr[idx]` gathers CLAMP
+            # instead), not a contract — an attention rewrite or
+            # indexing-mode change must not be able to turn a pad
+            # write into a clamped overwrite of the row's last real
+            # block.  tests/test_serving_continuous.py pins the
+            # byte-level contract either way.
+            bt_j = jnp.where((pos_j < max_seq)[:, None], block_table, 0)
+            pos_j = jnp.minimum(pos_j, max_seq - 1)
+            st = {
+                op: {
+                    k: (bt_j if k == "block_table"
+                        else pos_j if k == "seq_lens" else v)
+                    for k, v in entries.items()
+                }
+                for op, entries in carry.items()
+            }
+            _, new_state, _, _ = ex.run_forward(
+                weights, st,
+                {"input": tok[:, None], "positions": pos_j[:, None]},
+                training=False, rng=None,
+            )
+            return new_state, None
+
+        state, _ = jax.lax.scan(
+            body, state,
+            (jnp.swapaxes(tokens, 0, 1),
+             jnp.arange(chunk, dtype=jnp.int32)),
+        )
+        return state
+
+    with ex.mesh:
+        return jax.jit(prefill, donate_argnums=(1,))
+
+
+def build_paged_chunk_step(ffd: FFModel):
+    """Step function for a CHUNKED paged twin built with
+    make_gpt_decoder(step_tokens=C): one true seq-C forward per call,
+
+        step(weights, state, tokens[b, C], positions[b], block_table)
+            -> (logits [b, C, vocab], new_state)
+
+    The attention paged path scatters each row's C tokens at its own
+    positions and attends causally within the chunk (per-position
+    gathers, ops/attention.py).  This is the TPU-native prefill shape
+    — the MXU sees [b*C, e] matmuls instead of C seq-1 slivers — but
+    its FFN/vocab matmuls are NOT rowwise-bitwise-equal to the seq-1
+    program's, so the continuous engine's byte-identity oracle uses
+    build_paged_prefill_step instead; this program is for
+    throughput-first deployments (and the future fused Pallas kernel's
+    natural host-side twin)."""
+    import jax
+    import jax.numpy as jnp
+
+    ex = ffd.executor
+
+    def step(weights, state, tokens, positions, block_table):
+        positions = positions.astype(jnp.int32)
+        chunk = tokens.shape[1]
+        pos_grid = positions[:, None] + jnp.arange(chunk, dtype=jnp.int32)
+        state = {
+            op: {
+                k: (block_table if k == "block_table"
+                    else positions if k == "seq_lens" else v)
+                for k, v in entries.items()
+            }
+            for op, entries in state.items()
+        }
+        logits, new_state, _, _ = ex.run_forward(
+            weights, state,
+            {"input": tokens, "positions": pos_grid},
+            training=False, rng=None,
+        )
+        return logits, new_state
+
+    with ex.mesh:
+        return jax.jit(step, donate_argnums=(1,))
+
+
+def build_paged_copy_block(ffd: FFModel):
+    """Compiled one-block copy-on-write for the paged pools:
+
+        copy(state, src, dst) -> new_state
+
+    copies physical block `src`'s page to block `dst` in EVERY layer's
+    k/v pool (scalar int32 ids; state donated, so on TPU the copy is
+    in-place scatter, not a pool clone).  The prefix cache's COW path
+    (serving/kv_pool.py ensure_writable) runs this before a full-hit
+    request's first write, so shared blocks stay immutable while the
+    request gets a bit-exact private tail."""
+    import jax
+    import jax.numpy as jnp
+
+    ex = ffd.executor
+
+    def copy(state, src, dst):
+        return {
+            op: {
+                k: (v.at[dst].set(v[src])
+                    if k in ("k_cache", "v_cache") else v)
+                for k, v in entries.items()
+            }
+            for op, entries in state.items()
+        }
+
+    with ex.mesh:
+        return jax.jit(copy, donate_argnums=(0,))

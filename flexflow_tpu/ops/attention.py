@@ -249,11 +249,14 @@ class MultiHeadAttention(Op):
         n, page, nb = self._decode_n(), self._kv_page_size, \
             self._kv_num_blocks
         q = self.inputs[0].shape
-        if qd[1].size != 1:
+        if not 1 <= qd[1].size <= n:
+            # seq length C > 1 is the CHUNKED-PREFILL twin
+            # (decoding.build_paged_chunk_step): C tokens scattered at
+            # each row's own positions per step, causal within the
+            # chunk.  seq 1 remains the decode twin.
             raise ShapeError(
-                f"{self.name}: paged decode steps one token at a time "
-                f"(build the decode twin with seq_length=1, got "
-                f"{qd[1].size})"
+                f"{self.name}: paged decode chunk must be within [1, "
+                f"decode_max_seq={n}], got {qd[1].size}"
             )
         if qd[0].degree != 1 or self.shard.channel != 1 \
                 or q.replica_degree != 1:
@@ -399,36 +402,60 @@ class MultiHeadAttention(Op):
         (exp underflow of the finfo.min fill), so cross-sequence leaks
         are structurally impossible, not just unlikely.
 
-        Rows always step one token; idle scheduler slots point their
-        table at scratch block 0 with seq_len 0, so their (garbage)
-        writes land in scratch and their logits are ignored host-side."""
+        A step of s > 1 tokens (the chunked-prefill twin,
+        decoding.build_paged_chunk_step) scatters row i's token j at
+        position slen[i] + j and attends each chunk token over the
+        prefix INCLUDING its own chunk predecessors — the math runs
+        per position (scatter j, gather, attend q=1) so every op keeps
+        the decode twin's shapes: the per-token k/v bytes match the
+        one-token program's wherever XLA lowers same-shape ops
+        identically.  (The one-gather/full-matrix formulation is NOT
+        rowwise-bitwise-stable — its [s, n] x [n, d] context matmul
+        accumulates differently per s — so it is deliberately not
+        used.)
+
+        Rows always step the full chunk; idle scheduler slots point
+        their table at scratch block 0 with seq_len 0, so their
+        (garbage) writes land in scratch and their logits are ignored
+        host-side."""
         p: MultiHeadAttentionParams = self.params
-        b = qh.shape[0]
+        b, s = qh.shape[0], qh.shape[1]
         page = self._kv_page_size
         pos = slen.reshape(b).astype(jnp.int32)  # [b] incoming position
-        blk = jnp.take_along_axis(
-            btab, (pos // page)[:, None], axis=1
-        )[:, 0]
-        off = pos % page
-        k_cache = k_cache.at[blk, off].set(kh[:, 0].astype(k_cache.dtype))
-        v_cache = v_cache.at[blk, off].set(vh[:, 0].astype(v_cache.dtype))
         n = btab.shape[1] * page
-        kv_k = jnp.take(k_cache, btab, axis=0).reshape(
-            b, n, p.num_heads, -1)
-        kv_v = jnp.take(v_cache, btab, axis=0).reshape(
-            b, n, p.num_heads, -1)
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", qh, kv_k.astype(qh.dtype)
-        ) * scale
         key_pos = jnp.arange(n, dtype=jnp.int32)
-        # one-token steps: causal and visible-prefix masks coincide at
-        # key_pos <= pos_i (the row's just-written slot is attendable)
-        mask = key_pos[None, :] <= pos[:, None]  # [b, n]
-        scores = jnp.where(
-            mask[:, None, None, :], scores, jnp.finfo(scores.dtype).min
-        )
-        probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, kv_v.astype(qh.dtype))
+        ctxs = []
+        for j in range(s):
+            # j == 0 keeps the exact seq-1 trace (no +0 constant node)
+            pj = pos if j == 0 else pos + jnp.int32(j)
+            blk = jnp.take_along_axis(
+                btab, (pj // page)[:, None], axis=1
+            )[:, 0]
+            off = pj % page
+            k_cache = k_cache.at[blk, off].set(
+                kh[:, j].astype(k_cache.dtype))
+            v_cache = v_cache.at[blk, off].set(
+                vh[:, j].astype(v_cache.dtype))
+            kv_k = jnp.take(k_cache, btab, axis=0).reshape(
+                b, n, p.num_heads, -1)
+            kv_v = jnp.take(v_cache, btab, axis=0).reshape(
+                b, n, p.num_heads, -1)
+            qj = qh if s == 1 else qh[:, j:j + 1]
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", qj, kv_k.astype(qh.dtype)
+            ) * scale
+            # one-token attends: causal and visible-prefix masks
+            # coincide at key_pos <= pos_i + j (the just-written slot
+            # is attendable; later chunk slots are not yet)
+            mask = key_pos[None, :] <= pj[:, None]  # [b, n]
+            scores = jnp.where(
+                mask[:, None, None, :], scores,
+                jnp.finfo(scores.dtype).min
+            )
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctxs.append(jnp.einsum(
+                "bhqk,bkhd->bqhd", probs, kv_v.astype(qh.dtype)))
+        ctx = ctxs[0] if s == 1 else jnp.concatenate(ctxs, axis=1)
         return ctx, k_cache, v_cache
 
     # -- attention core dispatch ----------------------------------------

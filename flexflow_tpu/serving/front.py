@@ -69,7 +69,8 @@ class FrontRequest:
     __slots__ = ("prompt", "max_new_tokens", "temperature", "event",
                  "result", "error", "t_submit", "t_first_token",
                  "t_done", "n_generated", "retries",
-                 "queue_depth_at_admit", "deadline_s")
+                 "queue_depth_at_admit", "deadline_s",
+                 "prefix_hit_tokens")
 
     def __init__(self, prompt, max_new_tokens, temperature,
                  deadline_s: Optional[float] = None):
@@ -86,6 +87,7 @@ class FrontRequest:
         self.retries = 0  # requeues consumed (replica deaths/faults)
         self.queue_depth_at_admit = 0  # front backlog seen at admission
         self.deadline_s = deadline_s   # TTFT SLO for admission control
+        self.prefix_hit_tokens = 0     # stamped from the replica handle
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.event.wait(timeout):
@@ -235,6 +237,8 @@ class ServingFront:
                 page_size=cfg.kv_page_size,
                 num_blocks=cfg.kv_pool_blocks or None,
                 devices=devs,
+                prefill_chunk=getattr(cfg, "prefill_chunk", 0),
+                prefix_cache=getattr(cfg, "prefix_cache", True),
             )
 
         kw.setdefault("step_timeout", cfg.serving_step_timeout)
@@ -374,6 +378,35 @@ class ServingFront:
             return None
         return (run - 1) / span
 
+    def _prefix_discount(self, prompt, max_new: int) -> float:
+        """The candidate request's own service cost relative to an
+        uncached request of the same shape: cached prefix tokens cost
+        ZERO prefill steps, so a request whose prompt is largely in a
+        replica's prefix cache consumes (plen - hit + max_new) of the
+        (plen + max_new) steps an uncached twin would.  Each replica's
+        pool caches independently and the dispatcher is least-loaded
+        (not cache-affine), so the discount uses the WORST live
+        replica's hit — an optimistic probe of a warm replica must not
+        admit a request a cold one will then serve past its SLO.
+        1.0 when nothing is cached or no live replica exposes a
+        probe."""
+        worst = None
+        for r in self.replicas:
+            sched = r.scheduler
+            if r.state != "live" or sched is None:
+                continue
+            probe = getattr(sched, "cached_prefix_tokens", None)
+            if probe is None:
+                return 1.0
+            try:
+                hit = probe(prompt)
+            except Exception:  # noqa: BLE001 — a probe must never shed
+                return 1.0
+            total = len(prompt) + max_new
+            cost = max(0, total - hit) / max(total, 1)
+            worst = cost if worst is None else max(worst, cost)
+        return 1.0 if worst is None else worst
+
     def _predict_wait_s(self, depth: int) -> Optional[float]:
         """Predicted time for `depth` queued requests to clear at the
         measured service rate (None with no measurements yet)."""
@@ -454,8 +487,13 @@ class ServingFront:
                 # Retry-After may hint from an arrival-paced window,
                 # but shedding on one would be wrong
                 rate = self._capacity_rate()
+                # the request's own cost discounts its prefix-cache
+                # hit: cached tokens cost zero prefill steps, so a
+                # fully cached prompt predicts backlog-drain time only
+                own = self._prefix_discount(req.prompt,
+                                            req.max_new_tokens)
                 predicted = (None if rate is None or rate <= 0
-                             else (backlog + 1) / rate)
+                             else (backlog + own) / rate)
                 if predicted is not None and predicted > slo:
                     self.admission_shed += 1
                     if self.registry is not None:
@@ -571,6 +609,7 @@ class ServingFront:
         req.n_generated = handle.n_generated
         req.t_first_token = handle.t_first_token
         req.t_done = handle.t_done or time.monotonic()
+        req.prefix_hit_tokens = getattr(handle, "prefix_hit_tokens", 0)
         with self._lat_lock:
             self._latencies.append(req.t_done - req.t_submit)
             if req.t_first_token is not None:

@@ -1,5 +1,6 @@
 """Paged KV-cache pool accounting (the vLLM PagedAttention design,
-SOSP'23, on the host side).
+SOSP'23, on the host side) — now a PREFIX CACHE with copy-on-write
+block sharing (the RadixAttention idea, SGLang arXiv:2312.07104).
 
 The device holds per-layer block pools ([num_blocks, page, heads, d]
 state arrays built by `make_gpt_decoder(kv_page_size=...)`); this
@@ -17,21 +18,51 @@ Accounting protocol (no mid-flight OOM by construction):
   are then popped lazily by `extend` as the sequence actually grows
   (allocate-on-extend), so a short reply never pins its worst case and
   `used_blocks` tracks real occupancy.
-* **Retire frees.**  `retire` returns every block (and the unused
-  reservation) to the pool the moment a sequence finishes — early eos
-  makes room for the next admission immediately.
+* **Retire frees — into the prefix cache.**  `retire` drops every
+  block's refcount the moment a sequence finishes.  Blocks whose
+  content is indexed under a token-prefix key stay CACHED (refcount 0,
+  LRU-evictable) instead of returning to the free list; everything
+  else frees immediately.  Capacity pressure reclaims cached blocks
+  on demand, so caching never refuses an admission the free list
+  alone could have served.
+* **Prefix sharing.**  The pool keys every FULL (block-aligned) token
+  prefix it has seen — registered live as prompt blocks fill, and at
+  retirement for the generated suffix — to the physical block holding
+  that prefix's last page.  `try_admit(prompt=...)` matches the
+  longest indexed prefix of the new prompt and maps the request's
+  table directly onto the shared physical blocks (refcount++), so
+  those tokens skip prefill entirely.  Shared blocks are IMMUTABLE by
+  construction: the scatter-at-own-position write path only ever
+  targets positions past the shared region, except for a full-prompt
+  hit, where the write at plen-1 re-lands in the last shared block —
+  `ensure_writable` copy-on-writes that block (fresh private copy,
+  refcount--) before the scheduler feeds the token, so no block with
+  refcount > 1 (or an index entry) is ever written.
 * **Block 0 is scratch.**  Idle scheduler slots point their table at
   block 0; their per-step garbage writes land there and are never
   attendable (masked by seq_len 0), so scratch never needs zeroing.
+
+The pool tracks per-sequence token counts itself (`extend` sees every
+growth), so `occupancy()`/`fragmentation()` cannot drift from the
+tables under sharing — callers no longer pass scheduler-side counts.
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
 SCRATCH_BLOCK = 0
+
+
+def _prefix_key(tokens: Sequence[int], n: int) -> bytes:
+    """Exact-content key for the first `n` tokens (block-aligned).
+    Bytes of the int32 ids: compact, collision-free.  (A rolling hash
+    would amortize the O(n) rebuild per boundary; at serving prompt
+    scales the exact key is cheap and removes any collision story.)"""
+    return np.asarray(tokens[:n], np.int32).tobytes()
 
 
 class PoolExhausted(Exception):
@@ -46,10 +77,12 @@ class KVPool:
     num_blocks counts the PHYSICAL pool including the scratch block;
     usable capacity is num_blocks - 1.  max_blocks_per_seq is the
     table width (decode_max_seq // page for the bit-identical gather).
+    prefix_cache=False restores the PR 6 behavior exactly (no index,
+    no refcount sharing, retire frees immediately).
     """
 
     def __init__(self, num_blocks: int, page_size: int,
-                 max_blocks_per_seq: int):
+                 max_blocks_per_seq: int, prefix_cache: bool = True):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks {num_blocks} < 2 (scratch + at least one "
@@ -63,12 +96,33 @@ class KVPool:
         self.num_blocks = int(num_blocks)
         self.page_size = int(page_size)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.prefix_cache = bool(prefix_cache)
         # LIFO free list: recently-freed blocks are re-used first (their
         # pool rows are the likeliest to still be in cache)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._tables: Dict[int, List[int]] = {}   # seq id -> block ids
-        self._reserved: Dict[int, int] = {}       # seq id -> max blocks
+        self._reserved: Dict[int, int] = {}       # seq id -> max PRIVATE
+        self._ref: Dict[int, int] = {}            # block -> live tables
+        # prefix index: block-aligned token-prefix key -> the physical
+        # block holding that prefix's LAST page (one key per block)
+        self._index: Dict[bytes, int] = {}
+        self._block_key: Dict[int, bytes] = {}
+        # refcount-0 indexed blocks, LRU order (oldest first)
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # per-seq sharing bookkeeping
+        self._shared_of: Dict[int, Set[int]] = {}  # shared-mapped blocks
+        self._shared_pin: Dict[int, int] = {}      # block -> sharing seqs
+        self._hit_tokens: Dict[int, int] = {}      # matched at admission
+        self._prompt: Dict[int, List[int]] = {}    # for live indexing
+        self._indexed_upto: Dict[int, int] = {}    # blocks registered
+        self._tokens_of: Dict[int, int] = {}       # current token count
         self.peak_used = 0
+        self.peak_shared = 0
+        self.prefix_hits = 0          # admissions with a non-empty match
+        self.prefix_hit_tokens = 0    # total tokens served from cache
+        self.prefix_evictions = 0     # cached blocks reclaimed (LRU)
+        self.prefix_invalidations = 0  # blocks dropped by a state reset
+        self.cow_copies = 0           # tail blocks copy-on-written
         # the scheduler worker mutates the pool while /v2/stats reads
         # it from HTTP threads — iteration over _tables must not race
         # a retire()'s pop
@@ -81,7 +135,20 @@ class KVPool:
 
     @property
     def used_blocks(self) -> int:
-        return self.usable_blocks - len(self._free)
+        """Physical blocks referenced by >= 1 live table — shared
+        blocks counted ONCE.  Cached (refcount-0) blocks are
+        reclaimable, so they are neither used nor free."""
+        return self.usable_blocks - len(self._free) - len(self._cached)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._cached)
+
+    @property
+    def shared_blocks(self) -> int:
+        """Distinct physical blocks currently shared-mapped by at
+        least one live sequence."""
+        return len(self._shared_pin)
 
     @property
     def reserved_blocks(self) -> int:
@@ -92,11 +159,94 @@ class KVPool:
         """ceil(tokens / page): blocks a sequence of that length needs."""
         return max(1, -(-int(tokens) // self.page_size))
 
+    # -- prefix index (internal; callers hold self._lock) ----------------
+    def _match_prefix(self, prompt: Sequence[int]) -> List[int]:
+        """Longest indexed block-aligned prefix of `prompt`, as the
+        physical block chain (walks progressively: every sub-prefix of
+        a registered chain was registered with it)."""
+        page = self.page_size
+        blocks: List[int] = []
+        for j in range(1, len(prompt) // page + 1):
+            blk = self._index.get(_prefix_key(prompt, j * page))
+            if blk is None:
+                break
+            blocks.append(blk)
+        return blocks
+
+    def _register(self, seq_id: int, tokens: Sequence[int]) -> None:
+        """Index every not-yet-registered FULL block of seq_id whose
+        page is covered by `tokens` (the sequence's written prefix).
+        First key wins — a duplicate block stays private-unindexed and
+        frees normally at retirement."""
+        if not self.prefix_cache:
+            return
+        page = self.page_size
+        table = self._tables[seq_id]
+        b = self._indexed_upto.get(seq_id, 0)
+        while (b + 1) * page <= len(tokens) and b < len(table):
+            blk = table[b]
+            key = _prefix_key(tokens, (b + 1) * page)
+            if key not in self._index and blk not in self._block_key:
+                self._index[key] = blk
+                self._block_key[blk] = key
+            b += 1
+        self._indexed_upto[seq_id] = b
+
+    def _evict_lru(self) -> None:
+        blk, _ = self._cached.popitem(last=False)
+        key = self._block_key.pop(blk)
+        del self._index[key]
+        self._free.append(blk)
+        self.prefix_evictions += 1
+
+    def _pop_free(self) -> int:
+        """A free physical block, reclaiming the LRU cached block under
+        capacity pressure (the reservation discipline guarantees one of
+        the two sources is non-empty)."""
+        if not self._free:
+            if not self._cached:
+                raise PoolExhausted(
+                    "no free or cached block available — the admission "
+                    "accounting is wrong")
+            self._evict_lru()
+        return self._free.pop()
+
+    def invalidate_prefix_cache(self) -> None:
+        """Drop every index entry and free all cached blocks — called
+        after a device-state reset (a failed step zeroes the pools, so
+        cached bytes are garbage).  Live blocks keep their tables; any
+        live index entries are dropped too (their content is suspect)."""
+        with self._lock:
+            for blk in list(self._cached):
+                self._free.append(blk)
+                # NOT prefix_evictions: that counter means capacity
+                # pressure (operators size the pool from it) — a
+                # fault-driven invalidation is its own signal
+                self.prefix_invalidations += 1
+            self._cached.clear()
+            self._index.clear()
+            self._block_key.clear()
+            for sid in self._indexed_upto:
+                # sentinel past any possible table: live survivors (if
+                # any) never re-register their suspect content; new
+                # sequences re-populate the index
+                self._indexed_upto[sid] = self.max_blocks_per_seq + 1
+
     # -- lifecycle --------------------------------------------------------
-    def try_admit(self, seq_id: int, max_tokens: int) -> bool:
+    def try_admit(self, seq_id: int, max_tokens: int,
+                  prompt: Optional[Sequence[int]] = None,
+                  cow_ok: bool = True) -> bool:
         """Reserve worst-case capacity for a new sequence.  False means
         the pool cannot guarantee the sequence will finish — the caller
-        keeps it queued and retries after the next retirement."""
+        keeps it queued and retries after the next retirement.
+
+        With `prompt` given and the prefix cache on, the longest
+        indexed block-aligned prefix is mapped straight into the new
+        table (refcount++ per block) and `admit_hit_tokens` reports how
+        many tokens skip prefill.  A FULL-prompt hit keeps its last
+        shared block only when `cow_ok` (the engine can copy-on-write a
+        device block); otherwise the match drops one block so the tail
+        is re-prefilled privately."""
         if seq_id in self._reserved:
             raise ValueError(f"sequence {seq_id} already admitted")
         need = self.blocks_for(max_tokens)
@@ -106,36 +256,191 @@ class KVPool:
                 f"{self.max_blocks_per_seq} (prompt + max_new_tokens "
                 f"exceed decode_max_seq)")
         with self._lock:  # raw sum: the lock is not reentrant
-            if sum(self._reserved.values()) + need > self.usable_blocks:
+            matched: List[int] = []
+            full_hit = False
+            if self.prefix_cache and prompt is not None:
+                matched = self._match_prefix(prompt)
+                full_hit = bool(matched) and \
+                    len(matched) * self.page_size == len(prompt)
+                if full_hit and not cow_ok:
+                    matched.pop()  # tail re-prefilled privately instead
+                    full_hit = False
+            # private worst case: blocks drawn from the free pool —
+            # everything past the shared prefix, plus the COW copy of
+            # the tail block on a full-prompt hit
+            need_priv = need - len(matched) + (1 if full_hit else 0)
+            # shared blocks are pinned (unevictable while mapped), so
+            # they consume capacity alongside the reservations.  A
+            # block both live-private elsewhere and shared here double
+            # counts — conservative, never an undercount.
+            pinned = set(self._shared_pin) | set(matched)
+            if sum(self._reserved.values()) + need_priv + len(pinned) \
+                    > self.usable_blocks:
                 return False
-            self._reserved[seq_id] = need
-            self._tables[seq_id] = []
+            self._reserved[seq_id] = need_priv
+            self._tables[seq_id] = list(matched)
+            self._shared_of[seq_id] = set(matched)
+            for blk in matched:
+                self._cached.pop(blk, None)  # revive from the cache
+                self._ref[blk] = self._ref.get(blk, 0) + 1
+                self._shared_pin[blk] = self._shared_pin.get(blk, 0) + 1
+            hit = len(matched) * self.page_size
+            self._hit_tokens[seq_id] = hit
+            self._prompt[seq_id] = (list(int(t) for t in prompt)
+                                    if prompt is not None else [])
+            self._indexed_upto[seq_id] = len(matched)
+            self._tokens_of[seq_id] = hit
+            if matched:
+                self.prefix_hits += 1
+                self.prefix_hit_tokens += hit
+            if self.shared_blocks > self.peak_shared:
+                self.peak_shared = self.shared_blocks
         return True
 
-    def extend(self, seq_id: int, tokens: int) -> List[int]:
+    def admit_hit_tokens(self, seq_id: int) -> int:
+        """Tokens of seq_id's prompt served from the prefix cache at
+        admission (block-aligned; the scheduler skips their prefill)."""
+        with self._lock:
+            return self._hit_tokens.get(seq_id, 0)
+
+    def cached_prefix_tokens(self, prompt: Sequence[int]) -> int:
+        """Read-only probe: tokens of `prompt` the cache would serve if
+        admitted now (admission control discounts them — cached tokens
+        cost zero prefill steps).  Does not touch LRU order."""
+        if not self.prefix_cache:
+            return 0
+        with self._lock:
+            return len(self._match_prefix(prompt)) * self.page_size
+
+    def ensure_writable(self, seq_id: int, pos: int
+                        ) -> Optional[Tuple[int, int]]:
+        """Copy-on-write guard for the scatter at position `pos`: if
+        the target block is shared (refcount > 1) or its content is
+        index-pinned, swap a fresh private copy into the table and
+        return (src, dst) so the engine copies the device bytes.
+        Returns None when the write is already safe.  Only a
+        full-prompt hit can reach a shared tail block, but the guard is
+        total: NO write path ever touches a block another table or the
+        index still vouches for."""
+        with self._lock:
+            table = self._tables[seq_id]
+            bi = pos // self.page_size
+            if bi >= len(table):
+                return None  # block not allocated yet: fresh by nature
+            blk = table[bi]
+            if self._ref.get(blk, 0) <= 1 and blk not in self._block_key:
+                return None
+            dst = self._pop_free()
+            table[bi] = dst
+            self._ref[dst] = 1
+            self._ref[blk] -= 1
+            if self._ref[blk] == 0:
+                del self._ref[blk]
+                if blk in self._block_key:
+                    self._cached[blk] = None  # back to the LRU cache
+                else:
+                    self._free.append(blk)
+            shared = self._shared_of[seq_id]
+            if blk in shared:
+                shared.discard(blk)
+                n = self._shared_pin[blk] - 1
+                if n:
+                    self._shared_pin[blk] = n
+                else:
+                    del self._shared_pin[blk]
+            self.cow_copies += 1
+            if self.used_blocks > self.peak_used:
+                self.peak_used = self.used_blocks
+            return blk, dst
+
+    def extend(self, seq_id: int, tokens: int,
+               written: Optional[int] = None) -> List[int]:
         """Grow seq_id's table to cover `tokens` total tokens; returns
-        the block ids allocated by THIS call (allocate-on-extend)."""
+        the block ids allocated by THIS call (allocate-on-extend).
+        `written` is how many tokens are already in the cache (defaults
+        to tokens - 1, the one-token decode step's invariant; chunked
+        prefill passes its own) — every full PROMPT block it covers is
+        registered in the prefix index."""
         with self._lock:
             table = self._tables[seq_id]
             need = self.blocks_for(tokens)
-            if need > self._reserved[seq_id]:
+            shared = len(self._shared_of[seq_id])
+            if need - shared > self._reserved[seq_id]:
                 raise PoolExhausted(
-                    f"sequence {seq_id} grew to {need} blocks past its "
-                    f"reservation of {self._reserved[seq_id]}")
+                    f"sequence {seq_id} grew to {need - shared} private "
+                    f"blocks past its reservation of "
+                    f"{self._reserved[seq_id]}")
             grown = []
             while len(table) < need:
-                blk = self._free.pop()  # reservation guarantees non-empty
+                blk = self._pop_free()
+                self._ref[blk] = 1
                 table.append(blk)
                 grown.append(blk)
+            done = (int(tokens) - 1) if written is None else int(written)
+            self._tokens_of[seq_id] = max(
+                self._tokens_of.get(seq_id, 0), done)
+            prompt = self._prompt.get(seq_id) or []
+            if prompt and done > 0:
+                self._register(seq_id, prompt[:min(done, len(prompt))])
             if self.used_blocks > self.peak_used:
                 self.peak_used = self.used_blocks
             return grown
 
-    def retire(self, seq_id: int) -> None:
-        """Free every block and drop the reservation (free-on-retire)."""
+    def note_written(self, seq_id: int, tokens: int) -> None:
+        """Advance seq_id's written-token watermark — the scheduler
+        calls this after every step that lands tokens (per-row decode
+        advance and the chunked-prefill path), so freshly filled
+        prompt blocks join the prefix index immediately and
+        fragmentation stays truthful between block boundaries.  Hot
+        path: the registration sweep only runs when a NEW full prompt
+        block is actually covered."""
         with self._lock:
-            self._free.extend(self._tables.pop(seq_id))
+            if seq_id not in self._tables:
+                return
+            n = int(tokens)
+            if n > self._tokens_of.get(seq_id, 0):
+                self._tokens_of[seq_id] = n
+            prompt = self._prompt.get(seq_id) or []
+            if prompt:
+                covered = min(n, len(prompt)) // self.page_size
+                if self._indexed_upto.get(seq_id, 0) < covered:
+                    self._register(seq_id, prompt[:min(n, len(prompt))])
+
+    def retire(self, seq_id: int,
+               tokens: Optional[Sequence[int]] = None) -> None:
+        """Drop the sequence: refcount-- on every block.  Blocks whose
+        content is indexed stay CACHED (refcount 0, LRU-evictable);
+        the rest free immediately.  `tokens` — the sequence's full
+        written token list (prompt + generated prefix) — lets the
+        generated suffix's full blocks join the prefix index too (k/v
+        bytes are a pure function of the token prefix, so a future
+        prompt extending this completion hits them)."""
+        with self._lock:
+            if self.prefix_cache and tokens is not None \
+                    and seq_id in self._tables:
+                self._register(seq_id, list(int(t) for t in tokens))
+            table = self._tables.pop(seq_id)
+            for blk in self._shared_of.pop(seq_id, ()):
+                n = self._shared_pin.get(blk, 0) - 1
+                if n > 0:
+                    self._shared_pin[blk] = n
+                else:
+                    self._shared_pin.pop(blk, None)
+            for blk in table:
+                self._ref[blk] -= 1
+                if self._ref[blk] == 0:
+                    del self._ref[blk]
+                    if blk in self._block_key:
+                        # most-recently-retired = most-recently-used
+                        self._cached[blk] = None
+                        self._cached.move_to_end(blk)
+                    else:
+                        self._free.append(blk)
             del self._reserved[seq_id]
+            self._hit_tokens.pop(seq_id, None)
+            self._prompt.pop(seq_id, None)
+            self._indexed_upto.pop(seq_id, None)
+            self._tokens_of.pop(seq_id, None)
 
     def live_sequences(self) -> List[int]:
         with self._lock:
@@ -157,39 +462,87 @@ class KVPool:
 
     # -- telemetry --------------------------------------------------------
     def occupancy(self) -> float:
-        """Fraction of usable blocks currently allocated."""
+        """Fraction of usable blocks held by live sequences (shared
+        blocks counted once; cached blocks are reclaimable and do not
+        count)."""
         return self.used_blocks / self.usable_blocks
 
-    def fragmentation(self, seq_tokens: Dict[int, int]) -> float:
-        """Internal fragmentation: fraction of allocated slots not
-        holding a live token (waste in each sequence's last block).
-        seq_tokens maps live seq id -> its current token count."""
+    def fragmentation(self) -> float:
+        """Internal fragmentation: fraction of live-allocated slots not
+        holding a written token.  Computed from the pool's OWN
+        per-sequence token counts (tracked by extend), so it cannot
+        drift from the tables — shared full blocks never waste; only
+        each sequence's private tail can."""
         with self._lock:
             alloc = self.used_blocks * self.page_size
             if not alloc:
                 return 0.0
-            live = sum(min(seq_tokens.get(s, 0),
-                           len(self._tables[s]) * self.page_size)
-                       for s in self._tables)
-        return 1.0 - live / alloc
+            waste = 0
+            for sid, table in self._tables.items():
+                shared = len(self._shared_of.get(sid, ()))
+                priv_alloc = (len(table) - shared) * self.page_size
+                priv_tokens = max(
+                    0, self._tokens_of.get(sid, 0)
+                    - shared * self.page_size)
+                waste += max(0, priv_alloc - min(priv_tokens, priv_alloc))
+        return waste / alloc
+
+    def prefix_stats(self) -> Dict[str, int]:
+        """Prefix-cache telemetry block for /v2/stats and the bench."""
+        with self._lock:
+            return {
+                "hits": self.prefix_hits,
+                "hit_tokens": self.prefix_hit_tokens,
+                "shared_blocks": len(self._shared_pin),
+                "cached_blocks": len(self._cached),
+                "evictions": self.prefix_evictions,
+                "invalidations": self.prefix_invalidations,
+                "cow_copies": self.cow_copies,
+                "peak_shared_blocks": self.peak_shared,
+            }
 
     def check_invariants(self) -> None:
-        """Every block is exactly one of: scratch, free, or in exactly
-        one live table — and allocated == sum of live tables.  Raises
-        AssertionError on leaks or double-frees (tested property)."""
+        """Every block is exactly one of: scratch, free, cached
+        (refcount 0 + indexed), or live — and every physical block's
+        refcount equals the number of live tables referencing it, with
+        cached blocks disjoint from free blocks.  Raises AssertionError
+        on leaks, double-frees, or refcount drift (tested property)."""
         with self._lock:
-            owned: List[int] = []
+            refcount: Dict[int, int] = {}
             for table in self._tables.values():
-                owned.extend(table)
-            assert len(owned) == len(set(owned)), "block in two tables"
-            assert SCRATCH_BLOCK not in owned, "scratch block allocated"
+                seen = set()
+                for blk in table:
+                    assert blk not in seen, "block twice in one table"
+                    seen.add(blk)
+                    refcount[blk] = refcount.get(blk, 0) + 1
+            assert SCRATCH_BLOCK not in refcount, "scratch block allocated"
+            assert refcount == self._ref, (
+                f"refcount drift: tables say {refcount}, "
+                f"pool says {self._ref}")
             free = set(self._free)
+            cached = set(self._cached)
             assert len(free) == len(self._free), "double-freed block"
-            assert not (free & set(owned)), \
+            assert not (free & set(refcount)), \
                 "block both free and allocated"
-            assert free | set(owned) | {SCRATCH_BLOCK} == \
+            assert not (cached & free), "cached block also free"
+            assert not (cached & set(refcount)), \
+                "cached block has live references"
+            assert free | cached | set(refcount) | {SCRATCH_BLOCK} == \
                 set(range(self.num_blocks)), "block leaked"
-            assert self.used_blocks == len(owned)
+            assert self.used_blocks == len(refcount)
+            for blk in cached:
+                assert blk in self._block_key, "cached block unindexed"
+            for key, blk in self._index.items():
+                assert self._block_key.get(blk) == key, \
+                    "index/block_key mismatch"
+                assert blk not in free, "indexed block on the free list"
             for sid, table in self._tables.items():
-                assert len(table) <= self._reserved[sid], \
+                shared = self._shared_of.get(sid, set())
+                assert shared <= set(table), "shared block not in table"
+                assert len(table) - len(shared) <= self._reserved[sid], \
                     "over-reservation"
+            pin: Dict[int, int] = {}
+            for shared in self._shared_of.values():
+                for blk in shared:
+                    pin[blk] = pin.get(blk, 0) + 1
+            assert pin == self._shared_pin, "shared-pin drift"

@@ -59,6 +59,35 @@ def sample_workload(rng: np.random.RandomState, n_requests: int,
     return reqs
 
 
+def sample_shared_prefix_workload(rng: np.random.RandomState,
+                                  n_requests: int, vocab_size: int,
+                                  num_prefixes: int = 4,
+                                  prefix_len: int = 32,
+                                  tail_range=(1, 8),
+                                  max_new_range=(2, 12)):
+    """Seeded prefix-heavy workload: every request draws one of
+    `num_prefixes` shared system prompts (prefix_len tokens) and
+    appends a per-request UNIQUE tail — the system-prompt / few-shot
+    template shape the KV prefix cache exists for.  Same seed -> same
+    prefix pool, same request list, so a bench run and its baseline
+    see byte-identical traffic.  Returns (requests, prefixes)."""
+    if num_prefixes < 1:
+        raise ValueError(f"num_prefixes must be >= 1, got {num_prefixes}")
+    if prefix_len < 1:
+        raise ValueError(f"prefix_len must be >= 1, got {prefix_len}")
+    prefixes = [rng.randint(0, vocab_size, prefix_len).tolist()
+                for _ in range(num_prefixes)]
+    reqs = []
+    for _ in range(n_requests):
+        prefix = prefixes[int(rng.randint(num_prefixes))]
+        tail = rng.randint(
+            0, vocab_size,
+            int(rng.randint(tail_range[0], tail_range[1] + 1))).tolist()
+        mnt = int(rng.randint(max_new_range[0], max_new_range[1] + 1))
+        reqs.append((prefix + tail, mnt))
+    return reqs, prefixes
+
+
 def arrival_gaps(rng: np.random.RandomState, n: int, rate_rps: float,
                  pattern: str = "poisson", *,
                  ramp_to: Optional[float] = None,
@@ -199,6 +228,11 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
                "done_s": round(t_done - t0, 4)}
         if depth is not None:
             rec["queue_depth_at_admit"] = depth
+        hit = getattr(h, "prefix_hit_tokens", None)
+        if hit is not None:
+            # prompt tokens the KV prefix cache served (zero prefill
+            # steps) — the serving_prefix bench leg buckets on these
+            rec["prefix_hit_tokens"] = int(hit)
         if record_tokens:
             # token-identity audits (the autoscale leg proves zero
             # requests were corrupted by a drain) need the completions

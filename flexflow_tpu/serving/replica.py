@@ -68,6 +68,13 @@ class SupervisedDecodeModel:
         for name in ("batch_slots", "page_size", "num_blocks",
                      "max_blocks_per_seq", "max_seq", "vocab"):
             setattr(self, name, getattr(model, name))
+        # prefix-cache / chunked-prefill surface (PagedKVDecodeModel;
+        # absent on bare test fakes -> the scheduler degrades cleanly)
+        self.prefill_chunk = getattr(model, "prefill_chunk", 0)
+        self.prefix_cache = getattr(model, "prefix_cache", True)
+        if getattr(model, "prefill_step", None) is None:
+            self.prefill_chunk = 0
+        self._has_copy = getattr(model, "copy_block", None) is not None
 
     def reset(self):
         reset = getattr(self._model, "reset", None)
@@ -86,6 +93,45 @@ class SupervisedDecodeModel:
             # the scheduler must drain-and-die, not fail-in-flight-only
             e.fatal_to_engine = True
             raise
+
+    def prefill_step(self, tokens, positions, block_tables):
+        # chunked prefill is a decode-fleet step like any other: fault
+        # injection and the hang watchdog see it under the same
+        # replica-lifetime step index
+        idx = next(self._steps)
+        try:
+            self._fault_plan.check_step(idx)
+            return self._watchdog.sync(
+                lambda: self._model.prefill_step(
+                    tokens, positions, block_tables),
+                step=idx,
+            )
+        except FATAL_DECODE_FAULTS as e:
+            e.fatal_to_engine = True
+            raise
+
+    @property
+    def copy_block(self):
+        # exposed as an attribute so the scheduler's capability probe
+        # (getattr(..., "copy_block", None)) reflects the wrapped
+        # model's.  The copy is a device dispatch like any step, so it
+        # runs under the same fault plan + hang watchdog — a wedged
+        # COW must surface as HungStepTimeout (fatal -> supervised
+        # restart), not silently park the scheduler worker.
+        if not self._has_copy:
+            return None
+
+        def _copy(src, dst):
+            idx = next(self._steps)
+            try:
+                self._fault_plan.check_step(idx)
+                return self._watchdog.sync(
+                    lambda: self._model.copy_block(src, dst), step=idx)
+            except FATAL_DECODE_FAULTS as e:
+                e.fatal_to_engine = True
+                raise
+
+        return _copy
 
 
 class ServingReplica:
@@ -387,7 +433,12 @@ class ServingReplica:
         for k in _CARRIED_COUNTERS:
             out[k] = self._carried[k] + int(getattr(sched, k, 0) or 0)
         if sched is not None:
-            out["queue_depth"] = sched.stats()["queue_depth"]
+            sstats = sched.stats()
+            out["queue_depth"] = sstats["queue_depth"]
+            # prefix-cache visibility per replica (each pool caches
+            # independently; shared blocks counted once per pool)
+            if "prefix_cache" in sstats:
+                out["prefix_cache"] = sstats["prefix_cache"]
         return out
 
     def close(self, timeout_s: Optional[float] = None) -> None:

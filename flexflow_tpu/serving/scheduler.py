@@ -17,6 +17,17 @@ at admission (a full pool QUEUES the request — never a crash), extend
 block-by-block as they grow, and free on retirement, so resident KV
 HBM is sum-of-live-lengths instead of slots x max_seq.
 
+The pool is also a PREFIX CACHE (kv_pool.py): admissions map the
+longest indexed block-aligned token prefix of their prompt straight
+onto shared physical blocks (skipping prefill for those tokens, with
+copy-on-write isolating a full-prompt hit's tail block), and a second
+compiled [slots, C] program chunk-prefills the uncached remainder C
+tokens per dispatch — docs/SERVING.md "Prefix cache & chunked
+prefill".  Greedy output is token-identical with sharing and chunking
+on or off: shared bytes were written by the same programs at the same
+positions, and the chunk program scans the seq-1 graph so every op
+keeps the decode step's shapes.
+
 Shape discipline (the TPU-native part): one compiled [slots, 1] step
 program serves the engine's whole lifetime — admissions, retirements
 and per-row positions are DATA (block tables + seq_lens), never
@@ -44,17 +55,33 @@ from .kv_pool import KVPool
 
 class PagedKVDecodeModel:
     """Device half of the continuous engine: the paged decode twin of
-    a trained GPT plus its single compiled step function.
+    a trained GPT plus its compiled step programs.
 
     step(tokens[b], seq_lens[b], block_tables[b, max_blocks]) runs one
     decode step for every slot at its OWN position and returns host
     logits [b, vocab].  The block tables and seq_lens are host-owned
-    scheduler data written into the op-state pytree each step."""
+    scheduler data written into the op-state pytree each step.
+
+    prefill_chunk = C > 1 additionally compiles the [b, C]
+    chunked-prefill program (decoding.build_paged_prefill_step): one
+    dispatch fills C prompt tokens per row at its own positions, so a
+    P-token prompt costs ~P/C steps.  Internally it scans the SAME
+    seq-1 graph, so the K/V bytes it writes are bit-identical to
+    one-token prefill — chunked greedy output stays token-identical to
+    the unchunked oracle.
+
+    copy_block(src, dst) is the prefix cache's copy-on-write primitive
+    (one physical block cloned across every layer's pool, compiled
+    once); prefix_cache=False lets the scheduler skip sharing without
+    rebuilding the twin."""
 
     def __init__(self, ff_train, batch_slots: int = 8,
                  page_size: int = 16, num_blocks: Optional[int] = None,
-                 devices=None):
-        from ..decoding import (_gpt_dims, build_paged_decode_step,
+                 devices=None, prefill_chunk: int = 0,
+                 prefix_cache: bool = True):
+        from ..decoding import (_gpt_dims, build_paged_copy_block,
+                                build_paged_decode_step,
+                                build_paged_prefill_step,
                                 make_gpt_decoder)
 
         dims = _gpt_dims(ff_train)
@@ -81,8 +108,16 @@ class PagedKVDecodeModel:
         self.max_blocks_per_seq = max_blocks
         self.max_seq = max_seq
         self.vocab = dims["vocab_size"]
+        self.prefill_chunk = max(0, int(prefill_chunk))
+        if self.prefill_chunk == 1:
+            self.prefill_chunk = 0  # a 1-token chunk IS the decode step
+        self.prefix_cache = bool(prefix_cache)
         self._step_fn = build_paged_decode_step(self.ffd)
-        # the step fn DONATES its state argument; keep the twin's own
+        self._prefill_fn = (
+            build_paged_prefill_step(self.ffd, self.prefill_chunk)
+            if self.prefill_chunk else None)
+        self._copy_fn = build_paged_copy_block(self.ffd)
+        # the step fns DONATE their state argument; keep the twin's own
         # pristine pytree intact and thread a private copy (reset()
         # rebuilds from the pristine shapes after a failed step)
         import jax
@@ -92,7 +127,9 @@ class PagedKVDecodeModel:
 
     def reset(self):
         """Fresh zero decode state (fault recovery: a step that died
-        mid-execution may have invalidated the donated buffers)."""
+        mid-execution may have invalidated the donated buffers).  The
+        scheduler invalidates the pool's prefix index right after —
+        cached blocks' bytes are zeroed with everything else."""
         import jax
         import jax.numpy as jnp
 
@@ -110,6 +147,24 @@ class PagedKVDecodeModel:
         )
         return np.asarray(logits, np.float32)
 
+    def prefill_step(self, tokens: np.ndarray, positions: np.ndarray,
+                     block_tables: np.ndarray) -> None:
+        """Chunked prefill: scatter tokens[b, C] at positions[b]..+C-1
+        into the pool.  No logits come back — prefill ignores them."""
+        self._state = self._prefill_fn(
+            self.ffd._weights, self._state, tokens, positions,
+            block_tables,
+        )
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Copy-on-write: clone physical block src -> dst in every
+        layer's k/v pool (ordered with the step stream by jax's state
+        dependency, so a following step reads the copied bytes)."""
+        import jax.numpy as jnp
+
+        self._state = self._copy_fn(
+            self._state, jnp.int32(src), jnp.int32(dst))
+
 
 class _PendingSeq:
     """Future-style handle for one continuous-mode request.  Besides
@@ -123,8 +178,8 @@ class _PendingSeq:
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
                  "event", "result", "error", "t_submit", "t_first_token",
-                 "t_done", "n_generated", "on_done", "_settle_lock",
-                 "_settled")
+                 "t_done", "n_generated", "prefix_hit_tokens", "on_done",
+                 "_settle_lock", "_settled")
 
     def __init__(self, prompt, max_new_tokens, temperature, seed,
                  on_done=None):
@@ -139,6 +194,7 @@ class _PendingSeq:
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
         self.n_generated = 0
+        self.prefix_hit_tokens = 0  # prompt tokens served from cache
         self.on_done = on_done
         self._settle_lock = threading.Lock()
         self._settled = False
@@ -167,16 +223,19 @@ class _PendingSeq:
 
 
 class _Live:
-    """Slot-resident decoding state for one admitted sequence."""
+    """Slot-resident decoding state for one admitted sequence.
+    `start` > 0 means a prefix-cache hit: positions [0, start) are
+    already in shared KV blocks and never prefill."""
 
     __slots__ = ("req", "seq_id", "pos", "next_token", "generated",
                  "max_new", "rng")
 
-    def __init__(self, req: _PendingSeq, seq_id: int, max_new: int):
+    def __init__(self, req: _PendingSeq, seq_id: int, max_new: int,
+                 start: int = 0):
         self.req = req
         self.seq_id = seq_id
-        self.pos = 0                      # tokens already in the cache
-        self.next_token = req.prompt[0]   # token fed at position `pos`
+        self.pos = start                  # tokens already in the cache
+        self.next_token = req.prompt[start]  # token fed at position pos
         self.generated: List[int] = []
         self.max_new = max_new            # clamped to the position table
         self.rng = (np.random.RandomState(req.seed)
@@ -195,10 +254,23 @@ class ContinuousScheduler:
                  eos_id: int = -1, registry=None, seed: int = 0,
                  latency_window: int = 1024,
                  close_timeout_s: float = 60.0,
-                 on_death=None):
+                 on_death=None, check_invariants: bool = False):
         self.model = model
         self.pool = pool or KVPool(
-            model.num_blocks, model.page_size, model.max_blocks_per_seq)
+            model.num_blocks, model.page_size, model.max_blocks_per_seq,
+            prefix_cache=bool(getattr(model, "prefix_cache", True)))
+        # chunked prefill: C prompt tokens per dispatch through the
+        # model's second compiled program (0 = one-token prefill, the
+        # PR 6 path); COW needs the model's device block copy
+        self._chunk = int(getattr(model, "prefill_chunk", 0) or 0)
+        if self._chunk and getattr(model, "prefill_step", None) is None:
+            self._chunk = 0
+        self._can_cow = getattr(model, "copy_block", None) is not None
+        # bench/debug: run the pool's full invariant sweep after every
+        # scheduler step (the serving_prefix leg's acceptance bar)
+        self._check_invariants = bool(check_invariants)
+        self._evictions_seen = 0  # delta base for the obs counter
+        self.prefill_steps = 0    # chunked-prefill dispatches
         self.eos_id = int(eos_id)
         self.registry = registry
         self._queue: "queue.Queue[_PendingSeq]" = queue.Queue()
@@ -241,12 +313,18 @@ class ContinuousScheduler:
                      page_size: int = 16,
                      num_blocks: Optional[int] = None, devices=None,
                      eos_id: int = -1, registry=None,
-                     seed: int = 0) -> "ContinuousScheduler":
+                     seed: int = 0, prefill_chunk: int = 0,
+                     prefix_cache: bool = True,
+                     check_invariants: bool = False
+                     ) -> "ContinuousScheduler":
         model = PagedKVDecodeModel(ff_train, batch_slots=batch_slots,
                                    page_size=page_size,
                                    num_blocks=num_blocks,
-                                   devices=devices)
-        return cls(model, eos_id=eos_id, registry=registry, seed=seed)
+                                   devices=devices,
+                                   prefill_chunk=prefill_chunk,
+                                   prefix_cache=prefix_cache)
+        return cls(model, eos_id=eos_id, registry=registry, seed=seed,
+                   check_invariants=check_invariants)
 
     # -- client API -----------------------------------------------------
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
@@ -317,13 +395,21 @@ class ContinuousScheduler:
 
         return latency_percentiles(self._ttfts, self._lat_lock)
 
+    def cached_prefix_tokens(self, prompt) -> int:
+        """Read-only probe: prompt tokens the prefix cache would serve
+        right now.  Admission control discounts them — cached tokens
+        cost zero prefill steps (serving/front.py)."""
+        return self.pool.cached_prefix_tokens(
+            [int(t) for t in prompt])
+
     def stats(self) -> Dict:
         live = [s for s in self._slots if s is not None]
-        seq_tokens = {s.seq_id: s.pos for s in live}
         return {
             "mode": "continuous",
             "draining": self._draining,
             "steps": self.batches_run,
+            "prefill_steps": self.prefill_steps,
+            "prefill_chunk": self._chunk,
             "requests_done": self.requests_done,
             "tokens_generated": self.tokens_generated,
             "step_failures": self.step_failures,
@@ -336,9 +422,9 @@ class ContinuousScheduler:
                 "reserved_blocks": self.pool.reserved_blocks,
                 "peak_used_blocks": self.pool.peak_used,
                 "occupancy": round(self.pool.occupancy(), 4),
-                "fragmentation": round(
-                    self.pool.fragmentation(seq_tokens), 4),
+                "fragmentation": round(self.pool.fragmentation(), 4),
             },
+            "prefix_cache": self.pool.prefix_stats(),
             "ttft": self.ttft_stats(),
             "latency": self.latency_stats(),
         }
@@ -415,7 +501,14 @@ class ContinuousScheduler:
         """Pull arrivals, then admit FIFO into free slots while the
         pool can GUARANTEE completion.  Strict FIFO: a head-of-line
         request that doesn't fit blocks later (smaller) ones — no
-        starvation, predictable SLO."""
+        starvation, predictable SLO.
+
+        Admission consults the prefix cache: the longest indexed
+        block-aligned prefix of the prompt is mapped straight onto the
+        shared physical blocks (those tokens never prefill).  A
+        FULL-prompt hit still re-runs the last prompt token for its
+        logits — its write position lands in the shared tail block, so
+        the pool copy-on-writes it here, BEFORE any step runs."""
         while True:
             try:
                 self._waiting.append(self._queue.get_nowait())
@@ -424,12 +517,13 @@ class ContinuousScheduler:
         free = [i for i, s in enumerate(self._slots) if s is None]
         while free and self._waiting:
             req = self._waiting[0]
-            max_new = min(req.max_new_tokens,
-                          self.model.max_seq - len(req.prompt))
+            plen = len(req.prompt)
+            max_new = min(req.max_new_tokens, self.model.max_seq - plen)
             sid = self._next_seq_id
             try:
                 admitted = self.pool.try_admit(
-                    sid, len(req.prompt) + max_new)
+                    sid, plen + max_new, prompt=req.prompt,
+                    cow_ok=self._can_cow)
             except ValueError as e:
                 # can never fit any pool state (table width): fail it
                 # alone instead of wedging the FIFO head forever
@@ -443,7 +537,7 @@ class ContinuousScheduler:
                     # serve the request — fail instead of starving
                     self._waiting.popleft()
                     req.error = ValueError(
-                        f"request needs {self.pool.blocks_for(len(req.prompt) + max_new)} "
+                        f"request needs {self.pool.blocks_for(plen + max_new)} "
                         f"KV blocks but the pool only has "
                         f"{self.pool.usable_blocks}")
                     req._settle()
@@ -454,13 +548,42 @@ class ContinuousScheduler:
                 break
             self._waiting.popleft()
             self._next_seq_id += 1
-            live = _Live(req, sid, max_new)
+            hit = self.pool.admit_hit_tokens(sid)
+            # a full-prompt hit still feeds the LAST prompt token (its
+            # logits seed sampling); everything before `start` is
+            # served from shared blocks
+            start = min(hit, plen - 1)
+            req.prefix_hit_tokens = hit
+            if hit and self.registry is not None:
+                self.registry.counter("serving/prefix_hits").inc()
+                self.registry.counter(
+                    "serving/prefix_hit_tokens").inc(hit)
+            cow = self.pool.ensure_writable(sid, start)
+            if cow is not None:
+                try:
+                    self.model.copy_block(*cow)
+                except Exception as e:
+                    # the COW device copy is a dispatch like any step:
+                    # fail the admitting request alone on a transient
+                    # fault; a fatal (hung copy, device loss) drains
+                    # the engine through the normal death path
+                    self.pool.retire(sid)
+                    req.error = e
+                    req._settle()
+                    if getattr(e, "fatal_to_engine", False):
+                        raise
+                    continue
+                if self.registry is not None:
+                    self.registry.counter("serving/kv_cow_copies").inc()
+            live = _Live(req, sid, max_new, start=start)
             slot = free.pop(0)
             self._slots[slot] = live
-            self.pool.extend(sid, 1)  # first block, allocate-on-admit
+            # first private block (or a no-op after a full hit):
+            # allocate-on-admit
+            self.pool.extend(sid, start + 1, written=start)
             self._btab[slot] = self.pool.table_row(sid)
             self._tokens[slot] = live.next_token
-            self._slens[slot] = 0
+            self._slens[slot] = start
 
     def _loop(self):
         """Thread body: run the decode loop, then drain no matter how
@@ -502,6 +625,77 @@ class ContinuousScheduler:
             except Exception:  # noqa: BLE001 — the worker is exiting;
                 pass           # a retire hook must never mask that
 
+    def _fail_inflight(self, e: Exception):
+        """Transient step fault: fail in-flight only; queued requests
+        survive on the same engine."""
+        self.step_failures += 1
+        if self.registry is not None:
+            self.registry.counter("serving/step_failures").inc()
+        for i, live in enumerate(self._slots):
+            if live is None:
+                continue
+            self.pool.retire(live.seq_id)
+            live.req.error = e
+            live.req._settle()
+            self._slots[i] = None
+            self._free_slot_buffers(i)
+        # a step that died mid-execution may have consumed the
+        # donated state buffers — rebuild before the next admit
+        reset = getattr(self.model, "reset", None)
+        if reset is not None:
+            reset()
+            # the rebuild ZEROED the device pools: every cached
+            # prefix block's bytes are garbage now — drop the index
+            # so no future admission maps onto them
+            self.pool.invalidate_prefix_cache()
+
+    def _prefill_chunk_step(self, pre) -> bool:
+        """One [slots, C] chunked-prefill dispatch advancing every
+        mid-prefill row by up to C prompt tokens (never past plen-1:
+        the last prompt token runs through the decode program, whose
+        logits seed sampling).  Decode-phase rows ride along pointed
+        at scratch (all-zero table row, position 0), and a prefill
+        row's trailing pad tokens write garbage only at positions
+        PAST its own frontier — overwritten by its later real writes
+        before any query can attend them, or absorbed by scratch via
+        the table padding — the same argument that makes idle-slot
+        writes safe.  Returns False after a transient fault (already
+        handled); fatal faults propagate."""
+        C = self._chunk
+        tok = np.zeros((self.model.batch_slots, C), np.int32)
+        slen = np.zeros(self.model.batch_slots, np.int32)
+        btab = np.zeros_like(self._btab)
+        plan = []
+        for i, live in pre:
+            plen = len(live.req.prompt)
+            upto = min(live.pos + C, plen - 1)
+            self.pool.extend(live.seq_id, upto, written=live.pos)
+            self._btab[i] = self.pool.table_row(live.seq_id)
+            tok[i, :upto - live.pos] = live.req.prompt[live.pos:upto]
+            slen[i] = live.pos
+            btab[i] = self._btab[i]
+            plan.append((i, live, upto))
+        try:
+            self.model.prefill_step(tok, slen, btab)
+        except Exception as e:
+            if getattr(e, "fatal_to_engine", False):
+                raise
+            self._fail_inflight(e)
+            return False
+        self.prefill_steps += 1
+        for i, live, upto in plan:
+            live.pos = upto
+            # the freshly written prompt blocks join the prefix index
+            # NOW, so a same-prefix arrival in the next admit already
+            # shares them
+            self.pool.note_written(live.seq_id, upto)
+            live.next_token = live.req.prompt[live.pos]
+            self._tokens[i] = live.next_token
+            self._slens[i] = live.pos
+        if self._check_invariants:
+            self.pool.check_invariants()
+        return True
+
     def _decode_loop(self):
         page = self.pool.page_size
         while not self._stop.is_set():
@@ -520,6 +714,15 @@ class ContinuousScheduler:
                 except queue.Empty:
                     pass
                 continue
+            if self._chunk:
+                # chunked prefill first: mid-prefill rows jump up to C
+                # positions, then everyone (them included) takes the
+                # normal one-token decode step below
+                pre = [(i, live) for i, live in enumerate(self._slots)
+                       if live is not None
+                       and live.pos < len(live.req.prompt) - 1]
+                if pre and not self._prefill_chunk_step(pre):
+                    continue
             for i, live in enumerate(self._slots):
                 if live is None:
                     continue
@@ -539,24 +742,7 @@ class ContinuousScheduler:
                     # so _loop drains everything and fires on_death —
                     # the supervisor restarts the replica.
                     raise
-                # transient step fault: fail in-flight only; queued
-                # survive on the same engine
-                self.step_failures += 1
-                if self.registry is not None:
-                    self.registry.counter("serving/step_failures").inc()
-                for i, live in enumerate(self._slots):
-                    if live is None:
-                        continue
-                    self.pool.retire(live.seq_id)
-                    live.req.error = e
-                    live.req._settle()
-                    self._slots[i] = None
-                    self._free_slot_buffers(i)
-                # a step that died mid-execution may have consumed the
-                # donated state buffers — rebuild before the next admit
-                reset = getattr(self.model, "reset", None)
-                if reset is not None:
-                    reset()
+                self._fail_inflight(e)
                 continue
             self.batches_run += 1
             now = time.monotonic()
@@ -564,6 +750,9 @@ class ContinuousScheduler:
                 if live is None:
                     continue
                 live.pos += 1
+                # keep the pool's written-token watermark current so
+                # fragmentation never over-reports a mid-page tail
+                self.pool.note_written(live.seq_id, live.pos)
                 plen = len(live.req.prompt)
                 if live.pos < plen:
                     # prefill: the next token is given, logits ignored
@@ -601,7 +790,13 @@ class ContinuousScheduler:
                            live.rng)[0]
 
     def _finish(self, slot: int, live: _Live):
-        self.pool.retire(live.seq_id)
+        # the written token prefix (everything fed; excludes the final
+        # sampled token, whose k/v never landed) keys the retired
+        # blocks into the prefix cache — a future prompt extending
+        # this completion hits them
+        self.pool.retire(
+            live.seq_id,
+            tokens=(live.req.prompt + live.generated)[:live.pos])
         self._slots[slot] = None
         self._free_slot_buffers(slot)
         req = live.req
@@ -623,6 +818,8 @@ class ContinuousScheduler:
         req._settle()
 
     def _observe_step(self):
+        if self._check_invariants:
+            self.pool.check_invariants()
         if self.registry is None:
             return
         reg = self.registry
@@ -632,7 +829,16 @@ class ContinuousScheduler:
             self._queue.qsize() + len(self._waiting))
         reg.gauge("serving/live_sequences").set(len(live))
         reg.gauge("serving/kv_used_blocks").set(self.pool.used_blocks)
+        reg.gauge("serving/kv_shared_blocks").set(
+            self.pool.shared_blocks)
+        reg.gauge("serving/kv_cached_blocks").set(
+            self.pool.cached_blocks)
+        ev = self.pool.prefix_evictions
+        if ev > self._evictions_seen:
+            reg.counter("serving/prefix_evictions").inc(
+                ev - self._evictions_seen)
+            self._evictions_seen = ev
         reg.histogram("serving/kv_occupancy").observe(
             self.pool.occupancy())
         reg.histogram("serving/kv_fragmentation").observe(
-            self.pool.fragmentation({s.seq_id: s.pos for s in live}))
+            self.pool.fragmentation())

@@ -300,6 +300,19 @@ def test_serving_config_validated():
         FFConfig(kv_pool_blocks=-1)
     with pytest.raises(ValueError):
         FFConfig(serving_slots=0)
+    with pytest.raises(ValueError):
+        FFConfig(prefill_chunk=-1)
+
+
+def test_prefix_cache_cli_flags_parse():
+    cfg = FFConfig.from_args(["--prefill-chunk", "16",
+                              "--no-prefix-cache"])
+    assert cfg.prefill_chunk == 16
+    assert cfg.prefix_cache is False
+    base = FFConfig.from_args([])
+    assert base.prefill_chunk == 8      # chunked prefill on by default
+    assert base.prefix_cache is True    # sharing on by default
+    assert FFConfig.from_args(["--prefill-chunk", "0"]).prefill_chunk == 0
 
 
 def test_serving_front_cli_flags_parse():

@@ -24,10 +24,14 @@ class FakeStepModel:
     """Pure-host stand-in for PagedKVDecodeModel: the next token is
     always (input token + 1) % vocab, delivered as one-hot logits, so
     greedy expectations are computable in closed form.  Optional
-    per-step delay (close-drain tests) and scripted failures."""
+    per-step delay (close-drain tests) and scripted failures.
+    prefill_chunk/prefix_cache mirror the real model's knobs — the
+    fake has no device cache, so prefill_step/copy_block just record
+    calls (scheduler logic is what's under test here)."""
 
     def __init__(self, batch_slots=2, max_seq=32, page_size=4,
-                 num_blocks=None, delay_s=0.0):
+                 num_blocks=None, delay_s=0.0, prefill_chunk=0,
+                 prefix_cache=True):
         self.batch_slots = batch_slots
         self.max_seq = max_seq
         self.page_size = page_size
@@ -36,7 +40,11 @@ class FakeStepModel:
                            else 1 + batch_slots * self.max_blocks_per_seq)
         self.vocab = V
         self.delay_s = delay_s
+        self.prefill_chunk = prefill_chunk
+        self.prefix_cache = prefix_cache
         self.steps = 0
+        self.prefill_calls = 0
+        self.copied_blocks = []
         self.fail_at_steps = set()
         self.resets = 0
 
@@ -53,6 +61,14 @@ class FakeStepModel:
         nxt = (np.asarray(tokens) + 1) % V
         logits[np.arange(self.batch_slots), nxt] = 1.0
         return logits
+
+    def prefill_step(self, tokens, positions, block_tables):
+        self.prefill_calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+
+    def copy_block(self, src, dst):
+        self.copied_blocks.append((src, dst))
 
 
 def expected(prompt, mnt):
@@ -229,6 +245,138 @@ def test_loadgen_against_fake_scheduler():
         assert report["ttft"]["n"] == 8 and report["per_token"]["n"] > 0
     finally:
         sched.close()
+
+
+# -- prefix cache + chunked prefill (scheduler logic, fake model) -------
+
+def test_chunked_prefill_cuts_prompt_steps():
+    """A long prompt through chunked prefill costs ~plen/C prefill
+    dispatches plus the decode steps — and the closed-form greedy
+    output is unchanged (the chunk program is acceleration, never
+    semantics)."""
+    model = FakeStepModel(batch_slots=2, prefill_chunk=4)
+    sched = ContinuousScheduler(model, check_invariants=True)
+    try:
+        prompt = [(3 * i + 1) % V for i in range(20)]
+        assert sched.generate(prompt, 4, timeout=30.0) == \
+            expected(prompt, 4)
+        assert model.prefill_calls > 0
+        # unchunked would pay ~19 prefill steps; chunked pays ~19/4
+        # chunk dispatches (plus the decode steps that ride along)
+        assert sched.prefill_steps <= 6
+        st = sched.stats()
+        assert st["prefill_chunk"] == 4
+        assert st["prefill_steps"] == sched.prefill_steps
+    finally:
+        sched.close()
+
+
+def test_prefix_hit_skips_prefill_and_stamps_handle():
+    model = FakeStepModel(batch_slots=2)
+    reg = MetricsRegistry()
+    sched = ContinuousScheduler(model, registry=reg,
+                                check_invariants=True)
+    try:
+        prompt = list(range(1, 13))  # 12 tokens = 3 full pages of 4
+        h1 = sched.generate_async(prompt + [13, 14], 3)
+        assert h1.wait(30.0) == expected(prompt + [13, 14], 3)
+        assert h1.prefix_hit_tokens == 0
+        steps_cold = model.steps
+        # same 12-token prefix, different tail: the cached blocks are
+        # mapped at admission and those positions never prefill
+        h2 = sched.generate_async(prompt + [20, 21], 3)
+        assert h2.wait(30.0) == expected(prompt + [20, 21], 3)
+        assert h2.prefix_hit_tokens == 12
+        assert model.steps - steps_cold < steps_cold
+        assert reg.counter("serving/prefix_hit_tokens").value >= 12
+        st = sched.stats()["prefix_cache"]
+        assert st["hits"] >= 1 and st["hit_tokens"] >= 12
+        sched.pool.check_invariants()
+    finally:
+        sched.close()
+
+
+def test_full_prompt_hit_cows_and_matches_closed_form():
+    """An identical repeated prompt is a FULL-prompt hit: only the
+    last prompt token re-runs (for its logits), the shared tail block
+    is copy-on-written first, and the output is byte-equal."""
+    model = FakeStepModel(batch_slots=2)
+    sched = ContinuousScheduler(model, check_invariants=True)
+    try:
+        prompt = list(range(1, 9))  # exactly 2 pages
+        first = sched.generate(prompt, 5, timeout=30.0)
+        steps_cold = model.steps
+        again = sched.generate(prompt, 5, timeout=30.0)
+        assert again == first == expected(prompt, 5)
+        assert model.copied_blocks, "full hit must trigger COW"
+        # replay cost: 1 re-run token + 5 decode steps, not 8 + 5
+        assert model.steps - steps_cold <= 7
+        sched.pool.check_invariants()
+    finally:
+        sched.close()
+
+
+def test_sharing_and_chunking_compose_with_faults():
+    """The PR 6 fault discipline survives the new machinery: a step
+    fault mid-decode fails in-flight only, the reset invalidates the
+    prefix index (cached bytes were zeroed), and later same-prefix
+    requests still complete correctly (re-prefilled, then re-cached)."""
+    model = FakeStepModel(batch_slots=2, prefill_chunk=4)
+    model.fail_at_steps = {2}
+    sched = ContinuousScheduler(model, check_invariants=True)
+    try:
+        prompt = list(range(1, 13))
+        h1 = sched.generate_async(prompt, 6)
+        with pytest.raises(RuntimeError, match="injected step fault"):
+            h1.wait(30.0)
+        assert model.resets == 1
+        assert sched.pool.cached_blocks == 0  # index invalidated
+        assert sched.generate(prompt, 3, timeout=30.0) == \
+            expected(prompt, 3)
+        # and the re-run re-populated the cache for the NEXT hit
+        h3 = sched.generate_async(prompt + [20], 3)
+        assert h3.wait(30.0) == expected(prompt + [20], 3)
+        assert h3.prefix_hit_tokens > 0
+        sched.pool.check_invariants()
+    finally:
+        sched.close()
+
+
+def test_prefix_cache_off_never_shares():
+    model = FakeStepModel(batch_slots=2, prefix_cache=False)
+    sched = ContinuousScheduler(model, check_invariants=True)
+    try:
+        prompt = list(range(1, 9))
+        assert sched.generate(prompt, 3, timeout=30.0) == \
+            expected(prompt, 3)
+        h = sched.generate_async(prompt, 3)
+        assert h.wait(30.0) == expected(prompt, 3)
+        assert h.prefix_hit_tokens == 0
+        assert sched.stats()["prefix_cache"]["hits"] == 0
+    finally:
+        sched.close()
+
+
+def test_prefix_metrics_and_summary_render(tmp_path):
+    reg = MetricsRegistry()
+    model = FakeStepModel(batch_slots=2, prefill_chunk=4)
+    sched = ContinuousScheduler(model, registry=reg)
+    try:
+        prompt = list(range(1, 13))
+        sched.generate(prompt, 3, timeout=30.0)
+        sched.generate(prompt + [20, 21], 3, timeout=30.0)
+    finally:
+        sched.close()
+    path = tmp_path / "run_telemetry.jsonl"
+    assert reg.write_jsonl(str(path)) > 0
+    recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+    by_name = {r["name"]: r for r in recs if "name" in r}
+    assert by_name["serving/prefix_hit_tokens"]["value"] >= 12
+    assert "serving/kv_shared_blocks" in by_name
+    import importlib
+    summary = importlib.import_module("tools.telemetry_summary")
+    text = summary.summarize(recs)
+    assert "prefix" in text  # the Serving section's prefix-cache rows
 
 
 # -- serve_http satellites ----------------------------------------------

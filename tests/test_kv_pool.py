@@ -1,7 +1,10 @@
 """Paged KV-cache pool accounting (serving/kv_pool.py): block
 conservation under arbitrary admit/extend/retire interleavings, the
-reservation discipline (a full pool queues, never crashes), and the
-occupancy/fragmentation telemetry the scheduler reports."""
+reservation discipline (a full pool queues, never crashes), the
+occupancy/fragmentation telemetry the scheduler reports, and the
+prefix cache — refcounted copy-on-write block sharing, LRU eviction
+of retired sequences' blocks, and the sharing-aware invariants
+(refcount == live tables referencing, cached disjoint from free)."""
 import numpy as np
 import pytest
 
@@ -99,8 +102,7 @@ def test_property_random_interleaving():
         assert pool.used_blocks == sum(
             len(pool.table_of(s)) for s in live)
         assert 0.0 <= pool.occupancy() <= 1.0
-        frag = pool.fragmentation({s: live[s][1] for s in live})
-        assert 0.0 <= frag <= 1.0
+        assert 0.0 <= pool.fragmentation() <= 1.0
     assert admitted > 50 and refused > 10  # both paths exercised
     for sid in list(live):
         pool.retire(sid)
@@ -110,8 +112,263 @@ def test_property_random_interleaving():
 
 
 def test_fragmentation_counts_last_block_waste():
+    """The pool tracks per-sequence written-token counts ITSELF
+    (extend watermark + note_written), so fragmentation cannot drift
+    from the tables under sharing — callers pass nothing."""
     pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
     assert pool.try_admit(1, 5)
-    pool.extend(1, 5)  # 2 blocks = 8 slots for 5 tokens
-    assert pool.fragmentation({1: 5}) == pytest.approx(3 / 8)
-    assert pool.fragmentation({1: 8}) == 0.0  # full blocks: no waste
+    pool.extend(1, 5)  # 2 blocks = 8 slots, covering a write at pos 4
+    assert pool.fragmentation() == pytest.approx(4 / 8)  # 4 written
+    pool.note_written(1, 5)
+    assert pool.fragmentation() == pytest.approx(3 / 8)
+    pool.note_written(1, 8)
+    assert pool.fragmentation() == 0.0  # full blocks: no waste
+
+
+# -- prefix cache: sharing, COW, eviction --------------------------------
+
+def _run_seq(pool, sid, prompt, total=None):
+    """Admit + extend a sequence through `total` tokens (default: the
+    whole prompt) the way the scheduler would, then leave it live."""
+    total = len(prompt) if total is None else total
+    assert pool.try_admit(sid, total, prompt=prompt)
+    start = pool.admit_hit_tokens(sid)
+    for t in range(max(start, 1), total + 1):
+        pool.extend(sid, t)
+    pool.note_written(sid, total)
+    return start
+
+
+def test_retired_blocks_stay_cached_and_rehit():
+    pool = KVPool(num_blocks=17, page_size=4, max_blocks_per_seq=4)
+    prompt = list(range(10, 22))  # 12 tokens = 3 full blocks
+    _run_seq(pool, 1, prompt)
+    blocks = pool.table_of(1)
+    pool.retire(1, tokens=prompt)
+    assert pool.used_blocks == 0
+    assert pool.cached_blocks == 3  # refcount 0, LRU-evictable
+    pool.check_invariants()
+    # same prompt again: full table mapped from cache, zero prefill
+    assert pool.try_admit(2, 14, prompt=prompt)
+    assert pool.admit_hit_tokens(2) == 12
+    assert pool.table_of(2) == blocks
+    assert pool.prefix_hits == 1 and pool.prefix_hit_tokens == 12
+    pool.check_invariants()
+
+
+def test_live_sharing_refcounts_two_tables():
+    pool = KVPool(num_blocks=17, page_size=4, max_blocks_per_seq=4)
+    shared = list(range(8))           # 2 full blocks once written
+    _run_seq(pool, 1, shared + [8, 9])
+    # seq 1 still live: its full prompt blocks are indexed live, so a
+    # concurrent same-prefix request shares them (refcount 2)
+    assert pool.try_admit(2, 12, prompt=shared + [30, 31])
+    assert pool.admit_hit_tokens(2) == 8
+    assert pool.table_of(2) == pool.table_of(1)[:2]
+    assert pool.shared_blocks == 2
+    pool.check_invariants()
+    # first holder retires: blocks stay live through seq 2's refcount
+    pool.retire(1)
+    assert set(pool.table_of(2)) <= set(range(1, 17))
+    pool.check_invariants()
+    pool.retire(2)
+    pool.check_invariants()
+
+
+def test_full_prompt_hit_cow_tail_block():
+    pool = KVPool(num_blocks=17, page_size=4, max_blocks_per_seq=4)
+    prompt = list(range(8))  # exactly 2 blocks: a FULL-prompt hit
+    _run_seq(pool, 1, prompt)
+    pool.retire(1, tokens=prompt)
+    assert pool.try_admit(2, 12, prompt=prompt)
+    assert pool.admit_hit_tokens(2) == 8
+    tail = pool.table_of(2)[1]
+    # the write at plen-1 re-lands in the shared tail block: the COW
+    # guard must swap in a fresh private copy (src stays cached)
+    cow = pool.ensure_writable(2, 7)
+    assert cow is not None
+    src, dst = cow
+    assert src == tail and dst != tail
+    assert pool.table_of(2)[1] == dst
+    assert pool.cow_copies == 1
+    # a second write to the same position is now private: no-op
+    assert pool.ensure_writable(2, 7) is None
+    pool.check_invariants()
+    # the ORIGINAL block's cached entry survives for the next hit
+    pool.retire(2)
+    assert pool.try_admit(3, 12, prompt=prompt)
+    assert pool.admit_hit_tokens(3) == 8
+    pool.check_invariants()
+
+
+def test_cow_divergence_isolated():
+    """Two requests sharing a full-prompt prefix then diverging must
+    never corrupt each other: each COWs its own private tail, tables
+    end disjoint past the shared region, invariants hold throughout."""
+    pool = KVPool(num_blocks=33, page_size=4, max_blocks_per_seq=8)
+    prompt = list(range(8))
+    _run_seq(pool, 1, prompt)
+    pool.retire(1, tokens=prompt)
+    assert pool.try_admit(2, 16, prompt=prompt)
+    assert pool.try_admit(3, 16, prompt=prompt)
+    cow2 = pool.ensure_writable(2, 7)
+    cow3 = pool.ensure_writable(3, 7)
+    assert cow2 is not None and cow3 is not None
+    assert cow2[1] != cow3[1]  # distinct private copies
+    pool.check_invariants()
+    # diverge: each grows its own blocks
+    for t in range(9, 13):
+        pool.extend(2, t)
+        pool.extend(3, t)
+    t2, t3 = pool.table_of(2), pool.table_of(3)
+    assert t2[0] == t3[0]                      # still-shared first block
+    assert not set(t2[1:]) & set(t3[1:])       # private pasts disjoint
+    pool.check_invariants()
+    pool.retire(2)
+    pool.retire(3)
+    pool.check_invariants()
+
+
+def test_cow_ok_false_drops_tail_from_match():
+    pool = KVPool(num_blocks=17, page_size=4, max_blocks_per_seq=4)
+    prompt = list(range(8))
+    _run_seq(pool, 1, prompt)
+    pool.retire(1, tokens=prompt)
+    assert pool.try_admit(2, 12, prompt=prompt, cow_ok=False)
+    # full hit capped one block short: the tail re-prefills privately,
+    # so an engine without a device block-copy never needs COW
+    assert pool.admit_hit_tokens(2) == 4
+    assert pool.ensure_writable(2, 7) is None or \
+        pool.table_of(2)  # write pos 7 targets a private block
+    pool.check_invariants()
+
+
+def test_lru_eviction_reclaims_cached_blocks():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=8)
+    a = list(range(100, 108))   # 2 blocks
+    b = list(range(200, 208))   # 2 blocks
+    _run_seq(pool, 1, a)
+    pool.retire(1, tokens=a)
+    _run_seq(pool, 2, b)
+    pool.retire(2, tokens=b)
+    assert pool.cached_blocks == 4
+    # a new 4-block sequence needs the whole pool: cached blocks are
+    # reclaimed LRU-first (a's, retired earlier), never refused
+    assert pool.try_admit(3, 32)
+    for t in range(1, 33):
+        pool.extend(3, t)
+    assert pool.prefix_evictions >= 4
+    assert pool.cached_blocks + pool.used_blocks <= pool.usable_blocks
+    pool.check_invariants()
+    pool.retire(3)
+    # a's entries were evicted; b's too (whole pool was needed)
+    assert pool.cached_prefix_tokens(a) == 0
+    pool.check_invariants()
+
+
+def test_mru_survives_pressure_over_lru():
+    pool = KVPool(num_blocks=13, page_size=4, max_blocks_per_seq=8)
+    a, b = list(range(100, 108)), list(range(200, 208))
+    _run_seq(pool, 1, a)
+    pool.retire(1, tokens=a)
+    _run_seq(pool, 2, b)
+    pool.retire(2, tokens=b)
+    # pressure for 2 blocks: evicts from a (older), keeps b
+    _run_seq(pool, 3, list(range(300, 310)))
+    assert pool.cached_prefix_tokens(b) == 8
+    pool.check_invariants()
+
+
+def test_probe_is_readonly():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    p = list(range(8))
+    assert pool.cached_prefix_tokens(p) == 0
+    _run_seq(pool, 1, p)
+    pool.retire(1, tokens=p)
+    before = pool.prefix_stats()
+    assert pool.cached_prefix_tokens(p) == 8
+    assert pool.prefix_stats() == before  # no counters, no LRU touch
+
+
+def test_invalidate_prefix_cache_frees_everything():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    p = list(range(8))
+    _run_seq(pool, 1, p)
+    pool.retire(1, tokens=p)
+    assert pool.cached_blocks == 2
+    pool.invalidate_prefix_cache()
+    assert pool.cached_blocks == 0
+    assert pool.cached_prefix_tokens(p) == 0
+    pool.check_invariants()
+
+
+def test_prefix_cache_off_restores_pr6_behavior():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4,
+                  prefix_cache=False)
+    p = list(range(8))
+    _run_seq(pool, 1, p)
+    pool.retire(1, tokens=p)
+    assert pool.cached_blocks == 0 and pool.used_blocks == 0
+    assert pool.try_admit(2, 8, prompt=p)
+    assert pool.admit_hit_tokens(2) == 0
+    pool.check_invariants()
+
+
+def test_property_random_interleaving_with_sharing():
+    """The refcounted acceptance property: under random admit (with a
+    pool of shared prompts) / extend / COW-write / retire
+    interleavings, every physical block's refcount equals the number
+    of live tables referencing it, cached blocks stay disjoint from
+    free blocks, and used_blocks counts shared blocks once."""
+    rng = np.random.RandomState(7)
+    page = 4
+    pool = KVPool(num_blocks=33, page_size=page, max_blocks_per_seq=8)
+    prefixes = [rng.randint(0, 999, 8).tolist() for _ in range(3)]
+    live = {}  # sid -> [prompt, target_total, written]
+    next_id = 0
+    admitted = hits = 0
+    for _ in range(2500):
+        op = rng.randint(3)
+        if op == 0:  # admit a prompt sharing one of the prefixes
+            prefix = prefixes[rng.randint(len(prefixes))]
+            tail = rng.randint(0, 999, rng.randint(0, 6)).tolist()
+            prompt = prefix + tail
+            total = len(prompt) + int(rng.randint(1, 9))
+            if total > 8 * page:
+                continue
+            if pool.try_admit(next_id, total, prompt=prompt):
+                start = pool.admit_hit_tokens(next_id)
+                if start:
+                    hits += 1
+                start = min(start, len(prompt) - 1)
+                pool.ensure_writable(next_id, start)
+                pool.extend(next_id, start + 1)
+                live[next_id] = [prompt, total, start + 1]
+                admitted += 1
+            next_id += 1
+        elif op == 1 and live:  # grow one live sequence a token
+            sid = list(live)[rng.randint(len(live))]
+            prompt, target, cur = live[sid]
+            if cur < target:
+                pool.ensure_writable(sid, cur)
+                pool.extend(sid, cur + 1)
+                live[sid][2] = cur + 1
+        elif op == 2 and live:  # retire one, caching its blocks
+            sid = list(live)[rng.randint(len(live))]
+            prompt, _, cur = live[sid]
+            toks = (prompt + rng.randint(0, 999, 8).tolist())[:cur]
+            del live[sid]
+            pool.retire(sid, tokens=toks)
+        pool.check_invariants()
+        distinct = set()
+        for s in live:
+            distinct.update(pool.table_of(s))
+        assert pool.used_blocks == len(distinct)
+        assert 0.0 <= pool.occupancy() <= 1.0
+        assert 0.0 <= pool.fragmentation() <= 1.0
+    assert admitted > 100 and hits > 20  # sharing genuinely exercised
+    for sid in list(live):
+        pool.retire(sid)
+    pool.check_invariants()
+    assert pool.used_blocks == 0 and pool.reserved_blocks == 0
+    assert pool.peak_shared > 0

@@ -8,6 +8,7 @@ from flexflow_tpu.serving import ServingFront
 from flexflow_tpu.serving.loadgen import (
     arrival_gaps,
     run_loadgen,
+    sample_shared_prefix_workload,
     sample_workload,
 )
 
@@ -104,3 +105,43 @@ def test_detail_records_carry_queue_depth_and_tokens():
         assert all(r["idx"] == i for i, r in enumerate(recs))
     finally:
         front.close()
+
+
+def test_shared_prefix_workload_seeded_and_shaped():
+    rng = np.random.RandomState(5)
+    reqs, prefixes = sample_shared_prefix_workload(
+        rng, 20, 64, num_prefixes=3, prefix_len=16,
+        tail_range=(1, 4), max_new_range=(2, 5))
+    assert len(reqs) == 20 and len(prefixes) == 3
+    keys = {tuple(p) for p in prefixes}
+    for prompt, mnt in reqs:
+        # every request = one shared prefix + a unique tail
+        assert tuple(prompt[:16]) in keys
+        assert 17 <= len(prompt) <= 20
+        assert 2 <= mnt <= 5
+    # same seed -> byte-identical trace (bench baseline parity)
+    again, _ = sample_shared_prefix_workload(
+        np.random.RandomState(5), 20, 64, num_prefixes=3,
+        prefix_len=16, tail_range=(1, 4), max_new_range=(2, 5))
+    assert again == reqs
+    with pytest.raises(ValueError):
+        sample_shared_prefix_workload(rng, 4, 64, num_prefixes=0)
+
+
+def test_detail_records_carry_prefix_hit_tokens():
+    from flexflow_tpu.serving import ContinuousScheduler
+
+    sched = ContinuousScheduler(_FakeStepModel())
+    try:
+        reqs, _ = sample_shared_prefix_workload(
+            np.random.RandomState(3), 10, 16, num_prefixes=2,
+            prefix_len=8, tail_range=(1, 3), max_new_range=(2, 4))
+        rep = run_loadgen(sched, reqs, rate_rps=300.0, seed=2,
+                          detail=True)
+        assert rep["completed"] == len(reqs)
+        recs = rep["records"]
+        assert all("prefix_hit_tokens" in r for r in recs)
+        # the shared 8-token prefixes (2 full pages of 4) get re-hit
+        assert sum(r["prefix_hit_tokens"] for r in recs) > 0
+    finally:
+        sched.close()

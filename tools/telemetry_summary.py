@@ -166,6 +166,20 @@ def summarize(records: List[Dict]) -> str:
     out.append(_section("Memory", rows))
 
     rows = []
+    # prefix cache (docs/SERVING.md "Prefix cache & chunked prefill"):
+    # one composite line ahead of the raw serving/* rows
+    hits = metrics.get("serving/prefix_hits")
+    hit_toks = metrics.get("serving/prefix_hit_tokens")
+    if hits is not None or hit_toks is not None:
+        shared = metrics.get("serving/kv_shared_blocks", {})
+        evicted = metrics.get("serving/prefix_evictions", {})
+        rows.append((
+            "prefix cache",
+            f"hits={int((hits or {}).get('value', 0))} "
+            f"hit_tokens={int((hit_toks or {}).get('value', 0))} "
+            f"shared_blocks={int(shared.get('value', 0))} "
+            f"evictions={int(evicted.get('value', 0))}",
+        ))
     for name, rec in sorted(metrics.items()):
         if not name.startswith("serving/"):
             continue
