@@ -44,6 +44,40 @@ NAN_POLICIES = ("raise", "skip_step", "restore", "off")
 # fallback (one program per coalesced batch, dense per-slot caches).
 SERVING_MODES = ("continuous", "static")
 
+# valid FFConfig.paged_kernel values (docs/SERVING.md "Fused paged
+# attention"): "gather" = the dense block-gather formulation, the
+# bit-identity reference oracle; "pallas" = the fused PagedAttention
+# kernel reading KV blocks in place (ops/pallas/paged_attention.py).
+PAGED_KERNELS = ("gather", "pallas")
+
+
+class ConfigError(ValueError):
+    """A configuration that can never run in this build/runtime —
+    raised at BUILD time with the fix spelled out, so a bad flag never
+    surfaces as a deep ImportError mid-compile."""
+
+
+def resolve_paged_kernel(paged_kernel: str) -> str:
+    """Validate the paged-attention formulation choice against this
+    runtime.  The "pallas" kernel needs jax.experimental.pallas; when
+    it is missing, selecting the kernel raises ConfigError HERE — at
+    engine build time — instead of an ImportError from inside a trace.
+    Returns the validated value."""
+    if paged_kernel not in PAGED_KERNELS:
+        raise ConfigError(
+            f"paged_kernel must be one of {PAGED_KERNELS}, "
+            f"got {paged_kernel!r}")
+    if paged_kernel == "pallas":
+        from .ops.pallas.paged_attention import have_paged_kernel
+
+        if not have_paged_kernel():
+            raise ConfigError(
+                "--paged-kernel pallas needs jax.experimental.pallas, "
+                "which this jax build does not provide — use "
+                "--paged-kernel gather (the reference formulation) or "
+                "install a jax with Pallas support")
+    return paged_kernel
+
 
 @dataclasses.dataclass
 class FFConfig:
@@ -266,6 +300,14 @@ class FFConfig:
     # prefill, the PR 6 path).  Both preserve greedy token-identity.
     prefill_chunk: int = 8
     prefix_cache: bool = True
+    # paged-attention read formulation (docs/SERVING.md "Fused paged
+    # attention"): "gather" keeps the dense block-gather view — the
+    # bit-identity reference oracle; "pallas" runs the fused
+    # PagedAttention kernel that streams KV blocks in place through
+    # the block table, so per-step HBM reads scale with live tokens
+    # instead of decode_max_seq.  Validated against the runtime at
+    # engine build time (resolve_paged_kernel).
+    paged_kernel: str = "gather"
     # replicated front (serving/front.py, docs/SERVING.md "Replicated
     # front"): N supervised ContinuousScheduler replicas behind one
     # admission queue.  1 = single supervised replica (still gains the
@@ -314,6 +356,11 @@ class FFConfig:
             raise ValueError(
                 f"prefill_chunk must be >= 0 (0 = one-token prefill), "
                 f"got {self.prefill_chunk}"
+            )
+        if self.paged_kernel not in PAGED_KERNELS:
+            raise ValueError(
+                f"paged_kernel must be one of {PAGED_KERNELS}, "
+                f"got {self.paged_kernel!r}"
             )
         if self.serving_replicas < 1:
             raise ValueError(
@@ -607,6 +654,8 @@ class FFConfig:
                        type=int, default=8)
         p.add_argument("--no-prefix-cache", dest="prefix_cache",
                        action="store_false")
+        p.add_argument("--paged-kernel", dest="paged_kernel", type=str,
+                       default="gather", choices=PAGED_KERNELS)
         p.add_argument("--serving-replicas", dest="serving_replicas",
                        type=int, default=1)
         p.add_argument("--serving-step-timeout",
@@ -707,6 +756,7 @@ class FFConfig:
             serving_slots=args.serving_slots,
             prefill_chunk=args.prefill_chunk,
             prefix_cache=args.prefix_cache,
+            paged_kernel=args.paged_kernel,
             serving_replicas=args.serving_replicas,
             serving_step_timeout=args.serving_step_timeout,
             serving_max_restarts=args.serving_max_restarts,

@@ -65,7 +65,8 @@ def _gpt_dims(ff: FFModel) -> Dict[str, int]:
 def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
                      devices=None, kv_page_size: int = 0,
                      kv_num_blocks: int = 0,
-                     step_tokens: int = 1) -> FFModel:
+                     step_tokens: int = 1,
+                     kv_kernel: str = "gather") -> FFModel:
     """Build + compile the KV-cache decode twin of a trained GPT and
     transfer its weights.  The decode graph is seq-`step_tokens`
     (default 1) with decode_max_seq = the trained model's
@@ -82,8 +83,14 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
     causally within the chunk — the multi-token prefill shape
     (build_paged_chunk_step).  Its state pytree is congruent with the
     seq-1 twin's (pools, tables and seq_lens are all seq-independent),
-    so both programs thread one shared state."""
-    from .config import FFConfig
+    so both programs thread one shared state.
+
+    kv_kernel selects the paged READ formulation (docs/SERVING.md
+    "Fused paged attention"): "gather" (default) is the dense
+    block-gather oracle; "pallas" streams blocks in place through the
+    fused kernel.  Validated against the runtime HERE — a pallas-less
+    jax fails with ConfigError before any graph is built."""
+    from .config import FFConfig, resolve_paged_kernel
     from .models.transformer import build_gpt
 
     if step_tokens < 1:
@@ -93,6 +100,14 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
             "step_tokens > 1 needs the paged twin (kv_page_size > 0): "
             "the dense cache's scalar position counter cannot express "
             "per-row chunk positions")
+    # validate the NAME first so a typo gets the "must be one of"
+    # diagnostic, not advice to turn on paging
+    kv_kernel = resolve_paged_kernel(kv_kernel)
+    if kv_kernel != "gather" and not kv_page_size:
+        raise ValueError(
+            f"kv_kernel={kv_kernel!r} needs the paged twin "
+            "(kv_page_size > 0): the dense cache has no block table "
+            "to stream through")
     dims = _gpt_dims(ff_train)
     b = batch_size or ff_train.config.batch_size
     cfg = FFConfig(
@@ -116,6 +131,7 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
         vocab_size=dims["vocab_size"], dropout=0.0,
         max_positions=dims["max_seq"], decode_max_seq=dims["max_seq"],
         kv_page_size=kv_page_size, kv_num_blocks=kv_num_blocks,
+        kv_kernel=kv_kernel,
     )
     ffd.compile(
         optimizer=SGDOptimizer(lr=0.0),
@@ -545,8 +561,10 @@ def build_paged_chunk_step(ffd: FFModel):
     its FFN/vocab matmuls are NOT rowwise-bitwise-equal to the seq-1
     program's, so the continuous engine's byte-identity oracle uses
     build_paged_prefill_step instead; this program is for
-    throughput-first deployments (and the future fused Pallas kernel's
-    natural host-side twin)."""
+    throughput-first deployments and is the fused Pallas kernel's
+    natural host-side twin (make_gpt_decoder(kv_kernel="pallas",
+    step_tokens=C) runs the whole chunk's attention as ONE kernel
+    dispatch per layer — ops/pallas/paged_attention.py)."""
     import jax
     import jax.numpy as jnp
 

@@ -80,3 +80,9 @@ calib_logger = RecursiveLogger("flexflow_tpu.calib")
 # all non-fatal by design, so the log line is the only trace beyond the
 # store/* counters
 store_logger = RecursiveLogger("flexflow_tpu.store")
+
+# serving-tier observability (serving/): engine build decisions (which
+# paged-attention formulation is active — gather oracle vs fused
+# Pallas kernel), surfaced here so operators can confirm the hot path
+# from logs without scraping /v2/stats
+serving_logger = RecursiveLogger("flexflow_tpu.serving")

@@ -316,6 +316,7 @@ class FFModel:
         decode_max_seq: int = 0,
         kv_page_size: int = 0,
         kv_num_blocks: int = 0,
+        kv_kernel: str = "gather",
     ) -> ParallelTensor:
         p = MultiHeadAttentionParams(
             embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
@@ -326,7 +327,8 @@ class FFModel:
                                name=self._name("attention", name),
                                decode_max_seq=decode_max_seq,
                                kv_page_size=kv_page_size,
-                               kv_num_blocks=kv_num_blocks)
+                               kv_num_blocks=kv_num_blocks,
+                               kv_kernel=kv_kernel)
         )
 
     def batch_matmul(

@@ -123,6 +123,7 @@ def build_gpt(
     decode_max_seq: int = 0,
     kv_page_size: int = 0,
     kv_num_blocks: int = 0,
+    kv_kernel: str = "gather",
 ):
     """Decoder-only causal LM (pre-LN GPT-2 shape) — a model family
     BEYOND the reference's zoo (its transformer example is encoder-only,
@@ -153,6 +154,7 @@ def build_gpt(
             causal=True, name=f"attn_{i}",
             decode_max_seq=decode_max_seq,
             kv_page_size=kv_page_size, kv_num_blocks=kv_num_blocks,
+            kv_kernel=kv_kernel,
         )
         t = ff.add(t, a, name=f"attn_res_{i}")
         h = ff.layer_norm(t, axes=[-1], name=f"ln2_{i}")

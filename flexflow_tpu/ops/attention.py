@@ -65,13 +65,20 @@ class MultiHeadAttention(Op):
 
     def __init__(self, params, inputs, name="", shard=None,
                  decode_max_seq: int = 0, kv_page_size: int = 0,
-                 kv_num_blocks: int = 0):
+                 kv_num_blocks: int = 0, kv_kernel: str = "gather"):
         from .op import ShardConfig
 
         # must exist before Op.__init__ runs make_weight_specs
         self._decode_max_seq = int(decode_max_seq)
         self._kv_page_size = int(kv_page_size)
         self._kv_num_blocks = int(kv_num_blocks)
+        # paged READ formulation: "gather" materializes the dense
+        # [b, N, h, d] view (the bit-identity oracle); "pallas" streams
+        # blocks in place through the fused kernel
+        # (ops/pallas/paged_attention.py).  Callers validate the value
+        # and Pallas availability BEFORE building the graph
+        # (config.resolve_paged_kernel).
+        self._kv_kernel = str(kv_kernel or "gather")
         super().__init__(params, inputs, name=name,
                          shard=shard or ShardConfig())
 
@@ -152,6 +159,8 @@ class MultiHeadAttention(Op):
         if self._paged():
             kw["kv_page_size"] = self._kv_page_size
             kw["kv_num_blocks"] = self._kv_num_blocks
+            if getattr(self, "_kv_kernel", "gather") != "gather":
+                kw["kv_kernel"] = self._kv_kernel
         return kw
 
     def num_trainable_weights(self) -> int:
@@ -417,11 +426,22 @@ class MultiHeadAttention(Op):
         Rows always step the full chunk; idle scheduler slots point
         their table at scratch block 0 with seq_len 0, so their
         (garbage) writes land in scratch and their logits are ignored
-        host-side."""
+        host-side.
+
+        kv_kernel="pallas" keeps the scatter writes (so the POOL bytes
+        stay byte-identical to this oracle) but replaces the dense
+        gather + attend with one fused kernel dispatch that streams
+        each row's own blocks in place
+        (ops/pallas/paged_attention.py) — per-step HBM reads scale
+        with live tokens instead of decode_max_seq, outputs match this
+        path to fp32 tolerance (tests/test_paged_kernel.py)."""
         p: MultiHeadAttentionParams = self.params
         b, s = qh.shape[0], qh.shape[1]
         page = self._kv_page_size
         pos = slen.reshape(b).astype(jnp.int32)  # [b] incoming position
+        if getattr(self, "_kv_kernel", "gather") == "pallas":
+            return self._attend_decode_paged_kernel(
+                qh, kh, vh, k_cache, v_cache, btab, pos, scale)
         n = btab.shape[1] * page
         key_pos = jnp.arange(n, dtype=jnp.int32)
         ctxs = []
@@ -456,6 +476,45 @@ class MultiHeadAttention(Op):
             ctxs.append(jnp.einsum(
                 "bhqk,bkhd->bqhd", probs, kv_v.astype(qh.dtype)))
         ctx = ctxs[0] if s == 1 else jnp.concatenate(ctxs, axis=1)
+        return ctx, k_cache, v_cache
+
+    def _attend_decode_paged_kernel(self, qh, kh, vh, k_cache, v_cache,
+                                    btab, pos, scale):
+        """Fused-kernel paged attention: scatter this step's k/v at
+        each row's own positions (the SAME writes, in the same order,
+        as the gather oracle — pool state stays byte-identical between
+        formulations), then one paged_attention dispatch reads each
+        row's blocks in place.  Scattering the whole chunk before
+        attending is equivalent to the oracle's interleaved loop: a
+        later chunk position's write lands at a key position the
+        earlier queries' masks exclude."""
+        from .pallas.paged_attention import paged_attention
+
+        s, page = qh.shape[1], self._kv_page_size
+        n = btab.shape[1] * page
+        for j in range(s):
+            pj = pos if j == 0 else pos + jnp.int32(j)
+            if j > 0:
+                # a chunk's trailing PAD positions can run past the
+                # position table; route those writes to scratch (zeroed
+                # table row) and clamp in-range EXPLICITLY — the same
+                # guard build_paged_prefill_step carries, because jax's
+                # fill-mode OOB-scatter drop is a mode default, not a
+                # contract (decoding.py's v18 hardening note)
+                safe = (pj < n)[:, None]
+                bt_j = jnp.where(safe, btab, 0)
+                pj = jnp.minimum(pj, n - 1)
+            else:
+                bt_j = btab  # decode positions are in-range by contract
+            blk = jnp.take_along_axis(
+                bt_j, (pj // page)[:, None], axis=1
+            )[:, 0]
+            off = pj % page
+            k_cache = k_cache.at[blk, off].set(
+                kh[:, j].astype(k_cache.dtype))
+            v_cache = v_cache.at[blk, off].set(
+                vh[:, j].astype(v_cache.dtype))
+        ctx = paged_attention(qh, k_cache, v_cache, btab, pos, scale)
         return ctx, k_cache, v_cache
 
     # -- attention core dispatch ----------------------------------------

@@ -239,6 +239,7 @@ class ServingFront:
                 devices=devs,
                 prefill_chunk=getattr(cfg, "prefill_chunk", 0),
                 prefix_cache=getattr(cfg, "prefix_cache", True),
+                paged_kernel=getattr(cfg, "paged_kernel", "gather"),
             )
 
         kw.setdefault("step_timeout", cfg.serving_step_timeout)

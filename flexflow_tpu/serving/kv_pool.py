@@ -28,7 +28,11 @@ Accounting protocol (no mid-flight OOM by construction):
 * **Prefix sharing.**  The pool keys every FULL (block-aligned) token
   prefix it has seen — registered live as prompt blocks fill, and at
   retirement for the generated suffix — to the physical block holding
-  that prefix's last page.  `try_admit(prompt=...)` matches the
+  that prefix's last page.  Keys are ROLLING HASHES extended one page
+  per block boundary (O(plen) admission-key builds, not the exact-key
+  O(plen^2/page)); every hit is verified exactly through the entry's
+  parent chain + per-page bytes before any block is shared, so the
+  collision-free story is unchanged (see _PrefixEntry).  `try_admit(prompt=...)` matches the
   longest indexed prefix of the new prompt and maps the request's
   table directly onto the shared physical blocks (refcount++), so
   those tokens skip prefill entirely.  Shared blocks are IMMUTABLE by
@@ -56,13 +60,56 @@ import numpy as np
 
 SCRATCH_BLOCK = 0
 
+# rolling prefix hash (index keys): 61-bit Mersenne-prime modulus
+# polynomial hash, extended one PAGE at a time so building every
+# block-boundary key of a plen-token prompt costs O(plen) total
+# instead of the exact-bytes key's O(plen^2/page).  Collisions cannot
+# corrupt matches: every index hit is verified exactly (see
+# _PrefixEntry) before any block is shared.
+_HASH_MOD = (1 << 61) - 1
+_HASH_BASE = 1_000_003
+_HASH_EMPTY = 0
 
-def _prefix_key(tokens: Sequence[int], n: int) -> bytes:
-    """Exact-content key for the first `n` tokens (block-aligned).
-    Bytes of the int32 ids: compact, collision-free.  (A rolling hash
-    would amortize the O(n) rebuild per boundary; at serving prompt
-    scales the exact key is cheap and removes any collision story.)"""
-    return np.asarray(tokens[:n], np.int32).tobytes()
+
+def _hash_block(h: int, tokens: Sequence[int]) -> int:
+    """Extend the rolling prefix hash `h` over one page of tokens —
+    O(page) per block boundary (the unit the linear-admission test
+    counts)."""
+    for t in tokens:
+        h = (h * _HASH_BASE + int(t) + 1) % _HASH_MOD
+    return h
+
+
+def _page_bytes(tokens: Sequence[int]) -> bytes:
+    """Exact int32 bytes of ONE page — the per-boundary verification
+    payload (compact: entries store one page each, not the whole
+    prefix)."""
+    return np.asarray(tokens, np.int32).tobytes()
+
+
+class _PrefixEntry:
+    """One indexed block boundary: the physical block holding the
+    prefix's last page, keyed by the rolling hash of the FULL prefix.
+
+    The collision-free story of the old exact-bytes keys is preserved
+    by construction, not by hash width: entries chain through `parent`
+    (the entry for the one-page-shorter prefix, fixed at registration),
+    and a match walk accepts boundary j only when (a) the hash hits,
+    (b) the entry's parent IS the entry object verified at j-1, and
+    (c) the entry's last-page bytes equal the prompt's page j exactly.
+    By induction the accepted chain's content equals the prompt's
+    prefix byte for byte — each comparison is O(page), so a full match
+    of a plen-token prompt verifies in O(plen)."""
+
+    __slots__ = ("key", "block", "parent", "page_bytes")
+
+    def __init__(self, key: int, block: int,
+                 parent: Optional["_PrefixEntry"],
+                 page_bytes: bytes):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.page_bytes = page_bytes
 
 
 class PoolExhausted(Exception):
@@ -103,10 +150,14 @@ class KVPool:
         self._tables: Dict[int, List[int]] = {}   # seq id -> block ids
         self._reserved: Dict[int, int] = {}       # seq id -> max PRIVATE
         self._ref: Dict[int, int] = {}            # block -> live tables
-        # prefix index: block-aligned token-prefix key -> the physical
-        # block holding that prefix's LAST page (one key per block)
-        self._index: Dict[bytes, int] = {}
-        self._block_key: Dict[int, bytes] = {}
+        # prefix index: rolling hash of a FULL block-aligned token
+        # prefix -> its _PrefixEntry (block + exact per-page
+        # verification chain); _block_key maps block -> hash for
+        # eviction.  _chain tracks each live sequence's verified entry
+        # chain so registration extends it in O(page) per boundary.
+        self._index: Dict[int, _PrefixEntry] = {}
+        self._block_key: Dict[int, int] = {}
+        self._chain: Dict[int, List[_PrefixEntry]] = {}
         # refcount-0 indexed blocks, LRU order (oldest first)
         self._cached: "OrderedDict[int, None]" = OrderedDict()
         # per-seq sharing bookkeeping
@@ -160,35 +211,70 @@ class KVPool:
         return max(1, -(-int(tokens) // self.page_size))
 
     # -- prefix index (internal; callers hold self._lock) ----------------
-    def _match_prefix(self, prompt: Sequence[int]) -> List[int]:
+    def _match_prefix(self, prompt: Sequence[int]
+                      ) -> Tuple[List[int], List["_PrefixEntry"]]:
         """Longest indexed block-aligned prefix of `prompt`, as the
-        physical block chain (walks progressively: every sub-prefix of
-        a registered chain was registered with it)."""
+        physical block chain plus the verified entries (walks
+        progressively: every sub-prefix of a registered chain was
+        registered with it).  O(plen) total: one _hash_block extension
+        and one page-bytes compare per boundary — see _PrefixEntry for
+        why this is exactly as collision-free as the byte keys."""
         page = self.page_size
         blocks: List[int] = []
+        entries: List[_PrefixEntry] = []
+        h = _HASH_EMPTY
+        parent: Optional[_PrefixEntry] = None
         for j in range(1, len(prompt) // page + 1):
-            blk = self._index.get(_prefix_key(prompt, j * page))
-            if blk is None:
+            seg = prompt[(j - 1) * page:j * page]
+            h = _hash_block(h, seg)
+            e = self._index.get(h)
+            if e is None or e.parent is not parent \
+                    or e.page_bytes != _page_bytes(seg):
                 break
-            blocks.append(blk)
-        return blocks
+            blocks.append(e.block)
+            entries.append(e)
+            parent = e
+        return blocks, entries
 
     def _register(self, seq_id: int, tokens: Sequence[int]) -> None:
         """Index every not-yet-registered FULL block of seq_id whose
-        page is covered by `tokens` (the sequence's written prefix).
-        First key wins — a duplicate block stays private-unindexed and
-        frees normally at retirement."""
+        page is covered by `tokens` (the sequence's written prefix),
+        extending the sequence's verified entry chain one page-hash at
+        a time.  First key wins — when the prefix is already indexed
+        (same bytes, verified), the existing entry is adopted into the
+        chain and this sequence's duplicate block stays
+        private-unindexed, freeing normally at retirement.  A FOREIGN
+        hash hit (a different prefix colliding, or a chain broken by a
+        mid-chain eviction + re-registration) stops indexing this
+        sequence for good rather than ever sharing unverified bytes."""
         if not self.prefix_cache:
             return
         page = self.page_size
         table = self._tables[seq_id]
+        chain = self._chain.setdefault(seq_id, [])
         b = self._indexed_upto.get(seq_id, 0)
+        if b != len(chain):
+            return  # invalidation sentinel / previously stopped chain
         while (b + 1) * page <= len(tokens) and b < len(table):
+            seg = tokens[b * page:(b + 1) * page]
+            h = _hash_block(chain[-1].key if chain else _HASH_EMPTY, seg)
+            parent = chain[-1] if chain else None
+            e = self._index.get(h)
+            if e is not None:
+                if e.parent is parent and e.page_bytes == _page_bytes(seg):
+                    chain.append(e)
+                    b += 1
+                    continue
+                b = self.max_blocks_per_seq + 1  # foreign: stop for good
+                break
             blk = table[b]
-            key = _prefix_key(tokens, (b + 1) * page)
-            if key not in self._index and blk not in self._block_key:
-                self._index[key] = blk
-                self._block_key[blk] = key
+            if blk in self._block_key:
+                b = self.max_blocks_per_seq + 1
+                break
+            e = _PrefixEntry(h, blk, parent, _page_bytes(seg))
+            self._index[h] = e
+            self._block_key[blk] = h
+            chain.append(e)
             b += 1
         self._indexed_upto[seq_id] = b
 
@@ -198,6 +284,9 @@ class KVPool:
         del self._index[key]
         self._free.append(blk)
         self.prefix_evictions += 1
+        # longer-prefix entries chained through the evicted one are now
+        # unreachable (the match walk stops at the missing parent);
+        # their blocks remain LRU-evictable like any cached block
 
     def _pop_free(self) -> int:
         """A free physical block, reclaiming the LRU cached block under
@@ -226,6 +315,7 @@ class KVPool:
             self._cached.clear()
             self._index.clear()
             self._block_key.clear()
+            self._chain.clear()  # every entry object is dead now
             for sid in self._indexed_upto:
                 # sentinel past any possible table: live survivors (if
                 # any) never re-register their suspect content; new
@@ -257,13 +347,15 @@ class KVPool:
                 f"exceed decode_max_seq)")
         with self._lock:  # raw sum: the lock is not reentrant
             matched: List[int] = []
+            entries: List[_PrefixEntry] = []
             full_hit = False
             if self.prefix_cache and prompt is not None:
-                matched = self._match_prefix(prompt)
+                matched, entries = self._match_prefix(prompt)
                 full_hit = bool(matched) and \
                     len(matched) * self.page_size == len(prompt)
                 if full_hit and not cow_ok:
                     matched.pop()  # tail re-prefilled privately instead
+                    entries.pop()
                     full_hit = False
             # private worst case: blocks drawn from the free pool —
             # everything past the shared prefix, plus the COW copy of
@@ -289,6 +381,7 @@ class KVPool:
             self._prompt[seq_id] = (list(int(t) for t in prompt)
                                     if prompt is not None else [])
             self._indexed_upto[seq_id] = len(matched)
+            self._chain[seq_id] = list(entries)
             self._tokens_of[seq_id] = hit
             if matched:
                 self.prefix_hits += 1
@@ -310,7 +403,7 @@ class KVPool:
         if not self.prefix_cache:
             return 0
         with self._lock:
-            return len(self._match_prefix(prompt)) * self.page_size
+            return len(self._match_prefix(prompt)[0]) * self.page_size
 
     def ensure_writable(self, seq_id: int, pos: int
                         ) -> Optional[Tuple[int, int]]:
@@ -440,6 +533,7 @@ class KVPool:
             self._hit_tokens.pop(seq_id, None)
             self._prompt.pop(seq_id, None)
             self._indexed_upto.pop(seq_id, None)
+            self._chain.pop(seq_id, None)
             self._tokens_of.pop(seq_id, None)
 
     def live_sequences(self) -> List[int]:
@@ -532,10 +626,14 @@ class KVPool:
             assert self.used_blocks == len(refcount)
             for blk in cached:
                 assert blk in self._block_key, "cached block unindexed"
-            for key, blk in self._index.items():
-                assert self._block_key.get(blk) == key, \
+            for key, entry in self._index.items():
+                assert entry.key == key, "entry keyed under wrong hash"
+                assert self._block_key.get(entry.block) == key, \
                     "index/block_key mismatch"
-                assert blk not in free, "indexed block on the free list"
+                assert entry.block not in free, \
+                    "indexed block on the free list"
+                assert len(entry.page_bytes) == 4 * self.page_size, \
+                    "entry verification payload is not one page"
             for sid, table in self._tables.items():
                 shared = self._shared_of.get(sid, set())
                 assert shared <= set(table), "shared block not in table"

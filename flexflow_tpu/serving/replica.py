@@ -72,6 +72,10 @@ class SupervisedDecodeModel:
         # absent on bare test fakes -> the scheduler degrades cleanly)
         self.prefill_chunk = getattr(model, "prefill_chunk", 0)
         self.prefix_cache = getattr(model, "prefix_cache", True)
+        # fused-kernel surface: which paged formulation runs + the
+        # per-block byte unit the scheduler's read telemetry uses
+        self.paged_kernel = getattr(model, "paged_kernel", "gather")
+        self.kv_block_bytes = getattr(model, "kv_block_bytes", 0)
         if getattr(model, "prefill_step", None) is None:
             self.prefill_chunk = 0
         self._has_copy = getattr(model, "copy_block", None) is not None
@@ -439,6 +443,10 @@ class ServingReplica:
             # independently; shared blocks counted once per pool)
             if "prefix_cache" in sstats:
                 out["prefix_cache"] = sstats["prefix_cache"]
+            # which paged formulation this replica runs + its fused
+            # kernel's KV-read counters (zeroes under the gather oracle)
+            if "paged_kernel" in sstats:
+                out["paged_kernel"] = sstats["paged_kernel"]
         return out
 
     def close(self, timeout_s: Optional[float] = None) -> None:

@@ -73,17 +73,28 @@ class PagedKVDecodeModel:
     copy_block(src, dst) is the prefix cache's copy-on-write primitive
     (one physical block cloned across every layer's pool, compiled
     once); prefix_cache=False lets the scheduler skip sharing without
-    rebuilding the twin."""
+    rebuilding the twin.
+
+    paged_kernel picks the attention READ formulation (docs/SERVING.md
+    "Fused paged attention"): "gather" (default) materializes the
+    dense [slots, decode_max_seq] K/V view — the bit-identity oracle;
+    "pallas" streams each row's blocks in place through the fused
+    kernel (ops/pallas/paged_attention.py), so per-step HBM reads
+    scale with live tokens.  Validated + logged at build time
+    (engine.resolve_paged_formulation)."""
 
     def __init__(self, ff_train, batch_slots: int = 8,
                  page_size: int = 16, num_blocks: Optional[int] = None,
                  devices=None, prefill_chunk: int = 0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 paged_kernel: str = "gather"):
         from ..decoding import (_gpt_dims, build_paged_copy_block,
                                 build_paged_decode_step,
                                 build_paged_prefill_step,
                                 make_gpt_decoder)
+        from .engine import resolve_paged_formulation
 
+        self.paged_kernel = resolve_paged_formulation(paged_kernel)
         dims = _gpt_dims(ff_train)
         max_seq = dims["max_seq"]
         if page_size < 1 or max_seq % page_size:
@@ -101,6 +112,7 @@ class PagedKVDecodeModel:
         self.ffd = make_gpt_decoder(
             ff_train, batch_size=batch_slots, devices=devices,
             kv_page_size=page_size, kv_num_blocks=num_blocks,
+            kv_kernel=self.paged_kernel,
         )
         self.batch_slots = batch_slots
         self.page_size = page_size
@@ -124,6 +136,15 @@ class PagedKVDecodeModel:
         import jax.numpy as jnp
 
         self._state = jax.tree.map(jnp.copy, self.ffd._state)
+        # bytes of ONE physical block summed across every layer's k/v
+        # pool — the unit of the kernel-read telemetry (blocks read *
+        # this = per-step KV bytes the fused kernel streams; the
+        # dense-gather equivalent is table_width blocks per slot)
+        self.kv_block_bytes = sum(
+            int(np.prod(v.shape[1:])) * v.dtype.itemsize
+            for entries in self._state.values()
+            for k, v in entries.items()
+            if k in ("k_cache", "v_cache"))
 
     def reset(self):
         """Fresh zero decode state (fault recovery: a step that died
@@ -266,6 +287,17 @@ class ContinuousScheduler:
         if self._chunk and getattr(model, "prefill_step", None) is None:
             self._chunk = 0
         self._can_cow = getattr(model, "copy_block", None) is not None
+        # fused-kernel read telemetry (docs/SERVING.md "Fused paged
+        # attention"): under paged_kernel="pallas" every dispatch
+        # streams only each live row's own blocks, so we track the
+        # physical blocks actually read vs what the dense gather
+        # formulation would have materialized for the same dispatches
+        # (scratch-block fetches excluded — they are one elided page).
+        self._paged_kernel = str(getattr(model, "paged_kernel",
+                                         "gather"))
+        self._kv_block_bytes = int(getattr(model, "kv_block_bytes", 0))
+        self.kernel_blocks_read = 0   # physical blocks streamed
+        self.kernel_dense_blocks = 0  # gather-equivalent block reads
         # bench/debug: run the pool's full invariant sweep after every
         # scheduler step (the serving_prefix leg's acceptance bar)
         self._check_invariants = bool(check_invariants)
@@ -315,6 +347,7 @@ class ContinuousScheduler:
                      eos_id: int = -1, registry=None,
                      seed: int = 0, prefill_chunk: int = 0,
                      prefix_cache: bool = True,
+                     paged_kernel: str = "gather",
                      check_invariants: bool = False
                      ) -> "ContinuousScheduler":
         model = PagedKVDecodeModel(ff_train, batch_slots=batch_slots,
@@ -322,7 +355,8 @@ class ContinuousScheduler:
                                    num_blocks=num_blocks,
                                    devices=devices,
                                    prefill_chunk=prefill_chunk,
-                                   prefix_cache=prefix_cache)
+                                   prefix_cache=prefix_cache,
+                                   paged_kernel=paged_kernel)
         return cls(model, eos_id=eos_id, registry=registry, seed=seed,
                    check_invariants=check_invariants)
 
@@ -425,6 +459,17 @@ class ContinuousScheduler:
                 "fragmentation": round(self.pool.fragmentation(), 4),
             },
             "prefix_cache": self.pool.prefix_stats(),
+            "paged_kernel": {
+                "formulation": self._paged_kernel,
+                "blocks_read": self.kernel_blocks_read,
+                "dense_blocks_equiv": self.kernel_dense_blocks,
+                "bytes_read":
+                    self.kernel_blocks_read * self._kv_block_bytes,
+                "dense_bytes_avoided":
+                    max(0, self.kernel_dense_blocks
+                        - self.kernel_blocks_read)
+                    * self._kv_block_bytes,
+            },
             "ttft": self.ttft_stats(),
             "latency": self.latency_stats(),
         }
@@ -649,6 +694,23 @@ class ContinuousScheduler:
             # so no future admission maps onto them
             self.pool.invalidate_prefix_cache()
 
+    def _note_kernel_reads(self, blocks: int, dense_blocks: int):
+        """Account one fused-kernel dispatch's KV reads: `blocks`
+        physical blocks actually streamed vs the `dense_blocks` the
+        gather formulation would have materialized for the same
+        dispatch (obs: serving/paged_kernel_* counters)."""
+        self.kernel_blocks_read += blocks
+        self.kernel_dense_blocks += dense_blocks
+        if self.registry is None:
+            return
+        reg = self.registry
+        reg.counter("serving/paged_kernel_blocks_read").inc(blocks)
+        if self._kv_block_bytes:
+            reg.counter("serving/paged_kernel_bytes_read").inc(
+                blocks * self._kv_block_bytes)
+            reg.counter("serving/paged_dense_bytes_avoided").inc(
+                max(0, dense_blocks - blocks) * self._kv_block_bytes)
+
     def _prefill_chunk_step(self, pre) -> bool:
         """One [slots, C] chunked-prefill dispatch advancing every
         mid-prefill row by up to C prompt tokens (never past plen-1:
@@ -683,6 +745,21 @@ class ContinuousScheduler:
             self._fail_inflight(e)
             return False
         self.prefill_steps += 1
+        if self._paged_kernel == "pallas":
+            # the prefill program scans the seq-1 kernel C times per
+            # row: account each scan position as one seq-1 dispatch
+            # over the plan rows (shared formula with the kernel:
+            # paged_attention.blocks_read)
+            from ..ops.pallas.paged_attention import blocks_read
+
+            tw = self.pool.max_blocks_per_seq
+            slens = np.array([live.pos for _, live, _ in plan])
+            mask = np.ones(len(plan), bool)
+            blocks = sum(
+                blocks_read(slens + j, mask, 1, self.pool.page_size, tw)
+                for j in range(C))
+            self._note_kernel_reads(
+                blocks, self.model.batch_slots * tw * C)
         for i, live, upto in plan:
             live.pos = upto
             # the freshly written prompt blocks join the prefix index
@@ -745,6 +822,16 @@ class ContinuousScheduler:
                 self._fail_inflight(e)
                 continue
             self.batches_run += 1
+            if self._paged_kernel == "pallas":
+                from ..ops.pallas.paged_attention import blocks_read
+
+                self._note_kernel_reads(
+                    blocks_read(
+                        self._slens,
+                        np.array([s is not None for s in self._slots]),
+                        1, page, self.pool.max_blocks_per_seq),
+                    self.model.batch_slots
+                    * self.pool.max_blocks_per_seq)
             now = time.monotonic()
             for i, live in enumerate(self._slots):
                 if live is None:

@@ -71,3 +71,20 @@ def test_every_referenced_leg_config_exists():
 def test_bench_output_carries_manifest_version():
     _, source = _load()
     assert '"manifest_version": MANIFEST["version"]' in source
+
+
+def test_serving_paged_kernel_leg_keys_frozen():
+    """The v19 gather-vs-pallas leg is only round-over-round comparable
+    if its workload geometry stays pinned: every TPU-shape key
+    bench_serving_paged_kernel reads must exist, and it must mirror the
+    serving_prefix leg's workload fields (same shared-prefix pitch, so
+    the two legs' tokens/s stay cross-readable)."""
+    manifest, _ = _load()
+    leg = manifest["legs"]["serving_paged_kernel"]
+    needed = {"vocab", "max_seq", "hidden", "layers", "heads",
+              "intermediate", "slots", "kv_page_size", "requests",
+              "offered_rps", "prefill_chunk", "num_prefixes",
+              "prefix_len", "tail_range", "max_new_range"}
+    assert needed <= set(leg), sorted(needed - set(leg))
+    prefix_leg = manifest["legs"]["serving_prefix"]
+    assert needed <= set(prefix_leg)

@@ -314,6 +314,73 @@ def test_prefix_cache_off_restores_pr6_behavior():
     pool.check_invariants()
 
 
+def test_rolling_hash_admission_linear_in_prompt_length(monkeypatch):
+    """Satellite acceptance (rolling-hash prefix keys): building a
+    plen-token prompt's admission keys costs O(plen) — ONE
+    page-at-a-time hash extension per block boundary, each seeing
+    exactly `page` tokens — for cold admissions, prefill-time
+    registration, warm rehits AND the read-only probe.  (The old
+    exact-bytes keys rebuilt the whole prefix per boundary:
+    O(plen^2/page).)"""
+    from flexflow_tpu.serving import kv_pool as kvp
+
+    calls = []
+    real = kvp._hash_block
+
+    def counting(h, tokens):
+        calls.append(len(list(tokens)))
+        return real(h, tokens)
+
+    monkeypatch.setattr(kvp, "_hash_block", counting)
+    page, P = 4, 64  # 16 block boundaries
+    nb = P // page
+    pool = KVPool(num_blocks=2 * nb + 1, page_size=page,
+                  max_blocks_per_seq=nb)
+    prompt = [int(x) for x in np.random.RandomState(5).randint(
+        0, 997, P)]
+    calls.clear()
+    assert pool.try_admit(1, P, prompt=prompt)
+    assert len(calls) <= 1  # cold cache: the first extension misses
+    calls.clear()  # prefill registration: one extension per boundary
+    for t in range(1, P + 1):
+        pool.extend(1, t)
+    pool.note_written(1, P)
+    assert len(calls) == nb and all(n == page for n in calls)
+    pool.retire(1, tokens=prompt)
+    calls.clear()  # read-only probe of the warm cache
+    assert pool.cached_prefix_tokens(prompt) == P
+    assert len(calls) == nb and all(n == page for n in calls)
+    calls.clear()  # warm full-prompt rehit at admission
+    assert pool.try_admit(2, P, prompt=prompt)
+    assert pool.admit_hit_tokens(2) == P
+    assert len(calls) == nb and all(n == page for n in calls)
+    pool.retire(2)
+    pool.check_invariants()
+
+
+def test_rolling_hash_hit_verified_exactly(monkeypatch):
+    """Collision-free story: a hash hit whose bytes DIFFER is a miss,
+    never a false share — forced by making every page hash collide."""
+    from flexflow_tpu.serving import kv_pool as kvp
+
+    # hashes depend only on prefix LENGTH: any two same-length
+    # prefixes collide, but a chain's own boundaries stay distinct
+    monkeypatch.setattr(kvp, "_hash_block",
+                        lambda h, tokens: (h + 1) % 997)
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    a, b = list(range(8)), list(range(50, 58))
+    _run_seq(pool, 1, a)
+    pool.retire(1, tokens=a)
+    # same hash (forced), different bytes: the exact per-page compare
+    # must refuse the match
+    assert pool.cached_prefix_tokens(b) == 0
+    assert pool.try_admit(2, 8, prompt=b)
+    assert pool.admit_hit_tokens(2) == 0
+    # identical bytes still match through the collision
+    assert pool.cached_prefix_tokens(a) == 8
+    pool.check_invariants()
+
+
 def test_property_random_interleaving_with_sharing():
     """The refcounted acceptance property: under random admit (with a
     pool of shared prompts) / extend / COW-write / retire

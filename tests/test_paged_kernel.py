@@ -1,0 +1,404 @@
+"""Fused Pallas PagedAttention (ops/pallas/paged_attention.py) vs the
+gather oracle (ops/attention.py `_attend_decode_paged`, the reference
+formulation): interpret-mode parity across page sizes, partial tail
+blocks, scratch rows, CoW-shared prefix blocks and the seq-C chunk
+twin; the build-time ConfigError gate for pallas-less runtimes; the
+jaxpr assertion that the kernel-path decode step materializes NO dense
+[slots, decode_max_seq] K/V view; and scheduler-level greedy
+token-identity between `--paged-kernel gather` and `pallas` on the
+shared-prefix smoke workload (docs/SERVING.md "Fused paged
+attention")."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import ConfigError, FFConfig, resolve_paged_kernel
+from flexflow_tpu.ops.pallas import paged_attention as pk
+
+V, S, B = 32, 16, 4
+
+
+# -- kernel-level parity (interpret mode; no model compiles) ----------
+
+def _gather_oracle(qh, k_pool, v_pool, btab, slen, scale):
+    """The read math of ops/attention._attend_decode_paged, verbatim:
+    dense per-row gather + per-position masked softmax."""
+    b, s, h, _ = qh.shape
+    page = k_pool.shape[1]
+    n = btab.shape[1] * page
+    key_pos = jnp.arange(n, dtype=jnp.int32)
+    pos = slen.reshape(b).astype(jnp.int32)
+    ctxs = []
+    for j in range(s):
+        pj = pos + j
+        kv_k = jnp.take(k_pool, btab, axis=0).reshape(b, n, h, -1)
+        kv_v = jnp.take(v_pool, btab, axis=0).reshape(b, n, h, -1)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qh[:, j:j + 1],
+                            kv_k.astype(qh.dtype)) * scale
+        mask = key_pos[None, :] <= pj[:, None]
+        scores = jnp.where(mask[:, None, None, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctxs.append(jnp.einsum("bhqk,bkhd->bqhd", probs,
+                               kv_v.astype(qh.dtype)))
+    return ctxs[0] if s == 1 else jnp.concatenate(ctxs, axis=1)
+
+
+def _random_case(rng, b, s, h, d, page, table_width, extra_blocks=0):
+    """Pools + per-row tables with PARTIAL TAIL positions and one
+    SCRATCH row (slot 0: seq_len 0, table all zeros — the idle-slot
+    shape).  Every live row gets distinct non-contiguous blocks."""
+    nb = 1 + (b * table_width) + extra_blocks
+    k_pool = jnp.asarray(rng.randn(nb, page, h, d), jnp.float32)
+    v_pool = jnp.asarray(rng.randn(nb, page, h, d), jnp.float32)
+    qh = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    perm = rng.permutation(np.arange(1, nb))[:b * table_width]
+    btab = perm.reshape(b, table_width).astype(np.int32)
+    btab[0] = 0  # scratch row
+    # partial tails on purpose: positions NOT page-aligned, and the
+    # chunk must fit inside the table for every row
+    top = table_width * page - s
+    slen = np.array([0] + [1 + rng.randint(top - 1)
+                           for _ in range(b - 1)], np.int32)
+    return qh, k_pool, v_pool, jnp.asarray(btab), jnp.asarray(slen)
+
+
+@pytest.mark.parametrize("page", [4, 8])
+@pytest.mark.parametrize("chunk", [1, 4])
+def test_kernel_parity_vs_gather_oracle(page, chunk):
+    """fp32-tolerance parity of the fused kernel against the gather
+    read math — page sizes {4, 8}, partial tail blocks, a scratch row,
+    both the seq-1 decode twin and the seq-C chunk twin."""
+    rng = np.random.RandomState(7 * page + chunk)
+    qh, kp, vp, btab, slen = _random_case(
+        rng, b=5, s=chunk, h=3, d=16, page=page, table_width=4)
+    scale = 1.0 / np.sqrt(16)
+    got = pk.paged_attention(qh, kp, vp, btab, slen, scale,
+                             interpret=True)
+    want = _gather_oracle(qh, kp, vp, btab, slen, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_kernel_parity_cow_shared_prefix_blocks():
+    """Two rows whose tables map the SAME physical blocks (the prefix
+    cache's CoW sharing shape) read identically to the oracle — the
+    kernel must stream a shared page once per row without caring who
+    else references it."""
+    rng = np.random.RandomState(11)
+    qh, kp, vp, btab, slen = _random_case(
+        rng, b=4, s=1, h=2, d=8, page=4, table_width=4)
+    btab = np.asarray(btab).copy()
+    btab[2, :2] = btab[1, :2]  # rows 1 and 2 share their first 2 blocks
+    btab[3, 0] = btab[1, 0]    # row 3 shares one
+    slen = jnp.asarray([0, 9, 10, 5], jnp.int32)
+    btab = jnp.asarray(btab)
+    got = pk.paged_attention(qh, kp, vp, btab, slen, 0.25,
+                             interpret=True)
+    want = _gather_oracle(qh, kp, vp, btab, slen, 0.25)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_kernel_decode_and_chunk_twins_agree():
+    """The seq-C chunk twin over a freshly scattered chunk equals C
+    seq-1 decode calls at successive positions (the host-side twin
+    relationship build_paged_chunk_step documents)."""
+    rng = np.random.RandomState(3)
+    C, page, tw = 4, 4, 4
+    qh, kp, vp, btab, slen = _random_case(
+        rng, b=3, s=C, h=2, d=8, page=page, table_width=tw)
+    scale = 0.3
+    chunk_out = np.asarray(pk.paged_chunk_attention(
+        qh, kp, vp, btab, slen, scale, interpret=True))
+    for j in range(C):
+        one = np.asarray(pk.paged_decode_attention(
+            qh[:, j:j + 1], kp, vp, btab, slen + j, scale,
+            interpret=True))
+        np.testing.assert_allclose(chunk_out[:, j:j + 1], one,
+                                   rtol=2e-6, atol=2e-6)
+
+
+def test_blocks_read_scales_with_live_tokens():
+    """The host telemetry twin of the kernel's traffic discipline:
+    per-step blocks follow live tokens, not the table width — the
+    bench leg's 'KV bytes read' signal."""
+    page, tw = 4, 8
+    seq_lens = np.array([0, 5, 12, 0])
+    live = np.array([False, True, True, True])
+    # idle rows cost 0 (their scratch fetch is an elided repeat); pos 5
+    # -> 2 blocks; pos 12 -> 4 blocks; live pos 0 -> 1 block
+    assert pk.blocks_read(seq_lens, live, 1, page, tw) == 0 + 2 + 4 + 1
+    # dense equivalent is ALWAYS slots * table width
+    assert len(seq_lens) * tw == 32
+    # widening the table does not change what live rows read
+    assert pk.blocks_read(seq_lens, live, 1, page, 64) == 7
+    # a chunk reaches chunk-1 positions further
+    assert pk.blocks_read(np.array([3]), np.array([True]), 4, page, tw) \
+        == 2
+    # ...but never past the table
+    assert pk.blocks_read(np.array([30]), np.array([True]), 4, page, tw) \
+        == tw
+
+
+# -- config gate ------------------------------------------------------
+
+def test_selecting_kernel_without_pallas_is_config_error(monkeypatch):
+    """The clean-fallback satellite: a pallas-less jax fails the flag
+    at BUILD time with a ConfigError naming the fix — never a deep
+    ImportError mid-compile."""
+    assert resolve_paged_kernel("gather") == "gather"
+    assert resolve_paged_kernel("pallas") == "pallas"  # this runtime has it
+    monkeypatch.setattr(pk, "_HAVE_PALLAS", False)
+    with pytest.raises(ConfigError, match="pallas"):
+        resolve_paged_kernel("pallas")
+    # the gather oracle never needs pallas
+    assert resolve_paged_kernel("gather") == "gather"
+
+
+def test_paged_kernel_flag_validated_and_parsed():
+    with pytest.raises(ValueError, match="paged_kernel"):
+        FFConfig(paged_kernel="fused")
+    with pytest.raises(ConfigError, match="paged_kernel"):
+        resolve_paged_kernel("fused")
+    assert FFConfig.from_args([]).paged_kernel == "gather"
+    assert FFConfig.from_args(
+        ["--paged-kernel", "pallas"]).paged_kernel == "pallas"
+
+
+def test_dense_cache_rejects_kernel_selection():
+    """kv_kernel='pallas' without a paged pool has no block table to
+    stream through — refused loudly at make_gpt_decoder time."""
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.decoding import make_gpt_decoder
+    from flexflow_tpu.models.transformer import build_gpt
+
+    ff = FFModel(FFConfig(batch_size=2, num_devices=1))
+    build_gpt(ff, batch_size=2, seq_length=8, hidden_size=16,
+              num_layers=1, num_heads=2, intermediate_size=32,
+              vocab_size=16)
+    with pytest.raises(ValueError, match="kv_page_size"):
+        make_gpt_decoder(ff, kv_kernel="pallas")
+
+
+# -- model-level: the compiled decode step ----------------------------
+
+@pytest.fixture(scope="module")
+def trained(devices8):
+    from flexflow_tpu import FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+
+    ff = FFModel(FFConfig(batch_size=B, num_devices=1))
+    build_gpt(ff, batch_size=B, seq_length=S, hidden_size=32,
+              num_layers=2, num_heads=4, intermediate_size=64,
+              vocab_size=V)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devices8[:1])
+    rng = np.random.RandomState(0)
+    start = rng.randint(0, V, (B, 1))
+    step = rng.randint(1, 6, (B, 1))
+    seq_ids = (start + step * np.arange(S + 1)) % V
+    ids = seq_ids[:, :-1].astype(np.int32)
+    labels = seq_ids[:, 1:].astype(np.int32)
+    pos = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S)).copy()
+    for _ in range(30):
+        ff.train_step({"input": ids, "positions": pos}, labels)
+    return ff, ids
+
+
+def _collect_avals(jaxpr, acc):
+    """Every intermediate aval in `jaxpr`, recursing into sub-jaxprs
+    (pjit bodies, scan bodies, the pallas kernel jaxpr, ...)."""
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    def subs(val):
+        if isinstance(val, ClosedJaxpr):
+            yield val.jaxpr
+        elif isinstance(val, Jaxpr):
+            yield val
+        elif isinstance(val, (list, tuple)):
+            for v in val:
+                yield from subs(v)
+
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            acc.append(v.aval)
+        for val in eqn.params.values():
+            for sub in subs(val):
+                _collect_avals(sub, acc)
+    return acc
+
+
+def _decode_step_avals(ff, devices8, kv_kernel):
+    from flexflow_tpu.decoding import (build_paged_decode_step,
+                                       make_gpt_decoder)
+
+    page = 4
+    nb = 1 + B * (S // page)
+    paged = make_gpt_decoder(ff, devices=devices8[:1], kv_page_size=page,
+                             kv_num_blocks=nb, kv_kernel=kv_kernel)
+    step = build_paged_decode_step(paged)
+    btab = np.arange(1, nb, dtype=np.int32).reshape(B, S // page)
+    args = (paged._weights, paged._state, jnp.zeros(B, jnp.int32),
+            jnp.zeros(B, jnp.int32), jnp.asarray(btab))
+    jaxpr = jax.make_jaxpr(lambda *a: step(*a))(*args)
+    return _collect_avals(jaxpr.jaxpr, []), paged, step, btab
+
+
+def test_jaxpr_kernel_step_has_no_dense_gather(trained, devices8):
+    """THE traffic assertion: the kernel-path decode step's jaxpr
+    contains NO [slots, decode_max_seq, heads, head_dim] intermediate
+    — the dense K/V view the gather oracle materializes every step is
+    structurally absent, not just optimized away."""
+    ff, _ = trained
+    dense_view = (B, S, 4, 8)  # [slots, decode_max_seq, heads, head_dim]
+
+    gather_avals, _, _, _ = _decode_step_avals(ff, devices8, "gather")
+    assert any(getattr(a, "shape", None) == dense_view
+               for a in gather_avals), \
+        "oracle sanity: the gather formulation must materialize the view"
+
+    kernel_avals, _, _, _ = _decode_step_avals(ff, devices8, "pallas")
+    offenders = [a for a in kernel_avals
+                 if getattr(a, "shape", None) == dense_view]
+    assert not offenders, (
+        f"kernel decode step materializes dense K/V views: {offenders}")
+
+
+def test_kernel_decode_step_matches_gather_through_model(trained,
+                                                        devices8):
+    """End-to-end fp32 parity of the compiled kernel-path decode step
+    against the gather oracle, and identical greedy argmax over a full
+    sequence (the property the scheduler-level identity test rides)."""
+    ff, ids = trained
+    _, g_paged, g_step, btab = _decode_step_avals(ff, devices8, "gather")
+    _, k_paged, k_step, _ = _decode_step_avals(ff, devices8, "pallas")
+    g_state, k_state = g_paged._state, k_paged._state
+    for t in range(S - 1):
+        toks = jnp.asarray(ids[:, t])
+        slens = jnp.asarray(np.full(B, t, np.int32))
+        bt = jnp.asarray(btab)
+        g_logits, g_state = g_step(g_paged._weights, g_state, toks,
+                                   slens, bt)
+        k_logits, k_state = k_step(k_paged._weights, k_state, toks,
+                                   slens, bt)
+        g, k = np.asarray(g_logits), np.asarray(k_logits)
+        np.testing.assert_allclose(k, g, rtol=2e-5, atol=2e-5)
+        np.testing.assert_array_equal(k.argmax(-1), g.argmax(-1))
+    # the kernel only replaces the READ side: the first attention
+    # layer's pool bytes (whose k/v inputs are pure embeddings,
+    # identical between formulations) must match BIT FOR BIT — deeper
+    # layers legitimately drift at fp tolerance, because their k/v
+    # inputs ride the previous layer's attention output
+    for key in ("k_cache", "v_cache"):
+        np.testing.assert_array_equal(
+            np.asarray(g_state["attn_0"][key]),
+            np.asarray(k_state["attn_0"][key]),
+            err_msg=f"attn_0.{key} write bytes diverged — the kernel "
+                    "path must scatter exactly like the oracle")
+        np.testing.assert_allclose(
+            np.asarray(k_state["attn_1"][key]),
+            np.asarray(g_state["attn_1"][key]), rtol=2e-4, atol=2e-6)
+
+
+def test_kernel_chunk_twin_matches_gather_chunk_twin(trained, devices8):
+    """The seq-C chunk twin under the kernel (one fused dispatch per
+    layer) matches the gather chunk twin to fp tolerance, and a chunk
+    whose trailing PAD positions run past the position table never
+    corrupts a real block — the kernel scatter carries the same
+    scratch-routing clamp build_paged_prefill_step pins."""
+    from flexflow_tpu.decoding import (build_paged_chunk_step,
+                                       make_gpt_decoder)
+
+    ff, ids = trained
+    page, C = 4, 4
+    max_blocks = S // page
+    nb = 1 + B * max_blocks
+    btab = np.arange(1, nb, dtype=np.int32).reshape(B, max_blocks)
+
+    def twin(kv_kernel):
+        m = make_gpt_decoder(ff, devices=devices8[:1], kv_page_size=page,
+                             kv_num_blocks=nb, step_tokens=C,
+                             kv_kernel=kv_kernel)
+        return m, build_paged_chunk_step(m)
+
+    g_twin, g_step = twin("gather")
+    k_twin, k_step = twin("pallas")
+    g_state, k_state = g_twin._state, k_twin._state
+    for start in (0, C):  # two full chunks: positions 0..7
+        toks = jnp.asarray(ids[:, start:start + C])
+        pos = jnp.asarray(np.full(B, start, np.int32))
+        bt = jnp.asarray(btab)
+        g_logits, g_state = g_step(g_twin._weights, g_state, toks, pos, bt)
+        k_logits, k_state = k_step(k_twin._weights, k_state, toks, pos, bt)
+        np.testing.assert_allclose(np.asarray(k_logits),
+                                   np.asarray(g_logits),
+                                   rtol=2e-4, atol=2e-5)
+    # pad overflow: a chunk at S-2 puts positions S, S+1 past the
+    # table — the kernel path must not let those writes clamp onto the
+    # row's last real block (key slot S-1 stays byte-stable)
+    before = {key: np.asarray(k_state["attn_0"][key]).copy()
+              for key in ("k_cache", "v_cache")}
+    toks = jnp.asarray(ids[:, :C])
+    pos = jnp.asarray(np.full(B, S - 2, np.int32))
+    _, k_state = k_step(k_twin._weights, k_state, toks, pos,
+                        jnp.asarray(btab))
+    for key in ("k_cache", "v_cache"):
+        after = np.asarray(k_state["attn_0"][key])
+        for i in range(B):
+            for t in range(S - 2):  # every position before the chunk
+                blk, off = btab[i, t // page], t % page
+                np.testing.assert_array_equal(
+                    after[blk, off], before[key][blk, off],
+                    err_msg=f"attn_0.{key} row {i} position {t} "
+                            "corrupted by a pad write")
+
+
+# -- scheduler-level: the serving smoke workload ----------------------
+
+def test_scheduler_greedy_token_identical_gather_vs_kernel(trained,
+                                                           devices8):
+    """Acceptance: greedy completions on the shared-prefix smoke
+    workload are token-identical under --paged-kernel pallas vs the
+    gather oracle (prefix cache + chunked prefill ON in both), and the
+    kernel's per-step KV reads actually undercut the dense-gather
+    equivalent."""
+    from flexflow_tpu.serving import ContinuousScheduler
+
+    ff, _ = trained
+
+    def run(paged_kernel):
+        sched = ContinuousScheduler.from_trained(
+            ff, batch_slots=B, page_size=4, devices=devices8[:1],
+            prefix_cache=True, prefill_chunk=4,
+            paged_kernel=paged_kernel, check_invariants=True)
+        try:
+            rng = np.random.RandomState(9)
+            prefix = rng.randint(0, V, 8).tolist()  # 2 full pages
+            prompts = [prefix]
+            prompts += [prefix
+                        + rng.randint(0, V, rng.randint(1, 5)).tolist()
+                        for _ in range(6)]
+            prompts.append(prefix)  # full-prompt COW rehit
+            mnts = [int(rng.randint(2, 7)) for _ in prompts]
+            handles = [sched.generate_async(p, m)
+                       for p, m in zip(prompts, mnts)]
+            got = [h.wait(120.0) for h in handles]
+            sched.pool.check_invariants()
+            return got, sched.stats()
+        finally:
+            sched.close()
+
+    want, g_stats = run("gather")
+    got, k_stats = run("pallas")
+    assert got == want
+    assert g_stats["paged_kernel"]["formulation"] == "gather"
+    assert g_stats["paged_kernel"]["blocks_read"] == 0
+    kk = k_stats["paged_kernel"]
+    assert kk["formulation"] == "pallas"
+    # reads happened, and they undercut the dense-gather equivalent
+    assert 0 < kk["blocks_read"] < kk["dense_blocks_equiv"]
+    assert kk["bytes_read"] > 0
+    assert kk["dense_bytes_avoided"] > 0

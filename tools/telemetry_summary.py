@@ -180,6 +180,18 @@ def summarize(records: List[Dict]) -> str:
             f"shared_blocks={int(shared.get('value', 0))} "
             f"evictions={int(evicted.get('value', 0))}",
         ))
+    # fused paged kernel (docs/SERVING.md "Fused paged attention"):
+    # one composite read-traffic line when the kernel formulation ran
+    blocks = metrics.get("serving/paged_kernel_blocks_read")
+    if blocks is not None:
+        read = metrics.get("serving/paged_kernel_bytes_read", {})
+        avoided = metrics.get("serving/paged_dense_bytes_avoided", {})
+        rows.append((
+            "paged kernel",
+            f"blocks_read={int(blocks.get('value', 0))} "
+            f"bytes_read={int(read.get('value', 0))} "
+            f"dense_bytes_avoided={int(avoided.get('value', 0))}",
+        ))
     for name, rec in sorted(metrics.items()):
         if not name.startswith("serving/"):
             continue
