@@ -24,6 +24,17 @@ import time
 
 import numpy as np
 
+# CPU smoke runs need a multi-device host for the tensor-parallel
+# serving leg (tp=2 replica mesh); mirror tests/conftest.py's virtual
+# 8-CPU topology.  Must land before jax initializes, and never touches
+# the TPU path.
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 with open(os.path.join(_HERE, "bench_manifest.json")) as f:
     MANIFEST = json.load(f)
@@ -1604,6 +1615,143 @@ def bench_serving_paged_kernel(dev, on_tpu):
     }
 
 
+def bench_serving_gspmd(dev, on_tpu):
+    """GSPMD tensor-parallel serving leg (manifest v20): the shared-
+    prefix workload through the paged continuous tier single-chip
+    (tp=1) and on a 2-chip replica mesh (tp=2) at EQUAL PER-CHIP KV
+    POOL BYTES.  Head-sharded pools halve each block's per-chip bytes,
+    so the tp=2 engine funds 2x the blocks — and 2x the decode slots —
+    in the same per-chip HBM; the host-owned block-table machinery
+    (prefix sharing, COW, chunked prefill) runs unchanged on the
+    sharded physical blocks.  Greedy completions are asserted
+    token-identical across degrees (the single-chip gather formulation
+    is the oracle) with the kv_pool invariant checker at EVERY
+    scheduler step of both runs.  Off TPU the mesh is virtual CPU
+    devices, so tokens/s measures emulated collectives; the capacity
+    (2x slots at equal per-chip bytes) + identity assertions are the
+    acceptance bar."""
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+    from flexflow_tpu.serving import ContinuousScheduler
+    from flexflow_tpu.serving.loadgen import (run_loadgen,
+                                              sample_shared_prefix_workload)
+
+    leg = MANIFEST["legs"]["serving_gspmd"]
+    devs = jax.devices()
+    tp = leg["tp"]
+    if len(devs) < tp:
+        return {"skipped": (f"needs >= {tp} visible devices for the "
+                            f"tp={tp} replica, have {len(devs)}")}
+    if on_tpu:
+        vocab, max_seq = leg["vocab"], leg["max_seq"]
+        hidden, layers, heads = leg["hidden"], leg["layers"], leg["heads"]
+        inter, slots = leg["intermediate"], leg["slots"]
+        page, n_req = leg["kv_page_size"], leg["requests"]
+        rate, chunk = leg["offered_rps"], leg["prefill_chunk"]
+        n_prefixes, prefix_len = leg["num_prefixes"], leg["prefix_len"]
+        tail_range = tuple(leg["tail_range"])
+        mnt_range = tuple(leg["max_new_range"])
+    else:
+        # two engines compile (one under GSPMD search), so the smoke
+        # shape is smaller than serving_prefix's
+        vocab, max_seq = 64, 32
+        hidden, layers, heads, inter = 64, 2, 4, 128
+        slots, page, n_req, rate, chunk = 4, 4, 24, 600.0, 4
+        n_prefixes, prefix_len = 2, 8
+        tail_range, mnt_range = (1, 5), (2, 6)
+
+    cfg = FFConfig(batch_size=slots, num_devices=1)
+    ff = FFModel(cfg)
+    build_gpt(ff, batch_size=slots, seq_length=max_seq,
+              hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=inter, vocab_size=vocab)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=devs[:1])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (slots, max_seq)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                          (slots, max_seq)).copy()
+    ff.train_step({"input": ids, "positions": pos}, ids)  # real weights
+
+    wl_rng = np.random.RandomState(29)
+    workload, _ = sample_shared_prefix_workload(
+        wl_rng, n_req, vocab, num_prefixes=n_prefixes,
+        prefix_len=prefix_len, tail_range=tail_range,
+        max_new_range=mnt_range)
+
+    # equal PER-CHIP bytes: each tp=2 block costs 1/2 per chip, so the
+    # 2-chip pool funds 2x the blocks — spent on 2x the decode slots
+    max_blocks = max_seq // page
+    base_blocks = 1 + slots * max_blocks
+
+    def run_degree(degree, n_slots, n_blocks):
+        sched = ContinuousScheduler.from_trained(
+            ff, batch_slots=n_slots, page_size=page,
+            num_blocks=n_blocks, devices=devs[:degree],
+            prefix_cache=True, prefill_chunk=chunk,
+            check_invariants=True, tp=degree)  # audit at EVERY step
+        try:
+            report = run_loadgen(sched, workload, rate, seed=17,
+                                 detail=True, record_tokens=True)
+            stats = sched.stats()
+            sched.pool.check_invariants()
+            return report, stats
+        finally:
+            sched.close()
+
+    base_report, base_stats = run_degree(1, slots, base_blocks)
+    tp_report, tp_stats = run_degree(tp, tp * slots, tp * base_blocks)
+
+    # greedy completions token-identical across degrees: the
+    # single-chip gather formulation is the oracle
+    def by_idx(report):
+        return {r["idx"]: r["tokens"] for r in report["records"]
+                if r.get("ok")}
+    base_toks, tp_toks = by_idx(base_report), by_idx(tp_report)
+    assert set(base_toks) == set(tp_toks), "completion sets differ"
+    mismatched = sum(1 for i in base_toks
+                     if base_toks[i] != tp_toks[i])
+    assert mismatched == 0, \
+        f"{mismatched} completions differ between tp=1 and tp={tp}"
+
+    # the headline capacity claim, checked on the telemetry the
+    # engines themselves report
+    per_chip_1 = base_stats["tp"]["kv_pool_bytes_per_chip"]
+    per_chip_tp = tp_stats["tp"]["kv_pool_bytes_per_chip"]
+    assert per_chip_tp == per_chip_1, \
+        f"per-chip pool bytes differ: {per_chip_1} vs {per_chip_tp}"
+    assert tp_stats["tp"]["degree"] == tp
+    assert tp_stats["tp"]["kv_block_bytes_per_chip"] * tp == \
+        tp_stats["tp"]["kv_block_bytes"]
+
+    ratio = (tp_report.get("tokens_per_s", 0.0)
+             / max(base_report.get("tokens_per_s", 0.0), 1e-9))
+    return {
+        "workload": (
+            f"{n_req} reqs over {n_prefixes} shared {prefix_len}-token "
+            f"prefixes, tails {tail_range}, max_new {mnt_range}, "
+            f"Poisson {rate} rps, greedy, page {page}, chunk {chunk}, "
+            f"tp=1 ({slots} slots, {base_blocks} blocks) vs tp={tp} "
+            f"({tp * slots} slots, {tp * base_blocks} blocks) at equal "
+            f"per-chip KV bytes"
+        ),
+        "tp1": base_report,
+        f"tp{tp}": tp_report,
+        "tp_vs_tp1_tokens_per_s": round(ratio, 3),
+        "kv_pool_bytes_per_chip": per_chip_1,
+        "per_chip_bytes_equal": True,    # asserted above
+        "slots": {"tp1": slots, f"tp{tp}": tp * slots},
+        "slots_ratio_at_equal_per_chip_hbm": float(tp),
+        "replica_mesh": tp_stats["tp"]["mesh_shape"],
+        "prefix_cache_tp": tp_stats["prefix_cache"],
+        "completions_identical": True,   # asserted above
+        "invariants_checked_every_step": True,  # check_invariants=True
+    }
+
+
 def bench_serving_resilience(dev, on_tpu):
     """Replicated-front availability leg (manifest v12): the Poisson
     workload of the serving leg against a 2-replica ServingFront with
@@ -1983,6 +2131,8 @@ def main():
     gc.collect()
     serving_paged_kernel = bench_serving_paged_kernel(dev, on_tpu)
     gc.collect()
+    serving_gspmd = bench_serving_gspmd(dev, on_tpu)
+    gc.collect()
     serving_resilience = bench_serving_resilience(dev, on_tpu)
     gc.collect()
     autoscale = bench_autoscale(dev, on_tpu)
@@ -2016,6 +2166,7 @@ def main():
                  "checkpoint": ckpt, "serving": serving,
                  "serving_prefix": serving_prefix,
                  "serving_paged_kernel": serving_paged_kernel,
+                 "serving_gspmd": serving_gspmd,
                  "serving_resilience": serving_resilience,
                  "autoscale": autoscale,
                  "cold_start": cold_start, "host_loss": host_loss,

@@ -79,6 +79,43 @@ def resolve_paged_kernel(paged_kernel: str) -> str:
     return paged_kernel
 
 
+def resolve_serving_tp(
+    tp: int,
+    num_heads: Optional[int] = None,
+    visible_devices: Optional[int] = None,
+) -> int:
+    """Validate a replica's tensor-parallel degree at BUILD time
+    (docs/SERVING.md "Tensor-parallel replicas").  A tp that cannot
+    shard the model raises ConfigError here, with the fix spelled out —
+    never a shape error from inside a GSPMD trace.  Returns the
+    validated degree."""
+    tp = int(tp)
+    if tp < 1:
+        raise ConfigError(
+            f"--serving-tp must be >= 1 (1 = single-chip replica), "
+            f"got {tp}")
+    if num_heads is not None and num_heads % tp != 0:
+        raise ConfigError(
+            f"--serving-tp {tp} does not divide the attention head "
+            f"count ({num_heads}) — the KV pool shards the head axis "
+            f"over the 'model' mesh axis, so tp must divide num_heads "
+            f"(try one of "
+            f"{[d for d in range(1, num_heads + 1) if num_heads % d == 0]})")
+    if visible_devices is None and tp > 1:
+        try:
+            import jax
+
+            visible_devices = len(jax.devices())
+        except Exception:
+            visible_devices = None
+    if visible_devices is not None and tp > visible_devices:
+        raise ConfigError(
+            f"--serving-tp {tp} exceeds the {visible_devices} visible "
+            f"device(s) — a replica's mesh spans tp chips, so tp must "
+            f"be <= the device count available to it")
+    return tp
+
+
 @dataclasses.dataclass
 class FFConfig:
     # -- training (reference: -e, -b, --lr, --wd, parse_args model.cc:3560-3600)
@@ -332,6 +369,19 @@ class FFConfig:
     # (backlog / measured service rate) exceeds this many seconds
     # (0 = off; per-request deadline_s overrides)
     admission_deadline_s: float = 0.0
+    # tensor-parallel degree of ONE serving replica (docs/SERVING.md
+    # "Tensor-parallel replicas"): each replica spans tp chips under
+    # GSPMD — attention heads and the paged KV block pools shard over a
+    # 'model' mesh axis, so per-chip KV bytes are 1/tp and a replica
+    # can hold a model bigger than one chip.  Must divide the head
+    # count and fit the visible devices (resolve_serving_tp validates
+    # at build time).  1 = single-chip replicas (prior behavior).
+    serving_tp: int = 1
+    # total chips the serving fleet may hold (0 = unbounded): the front
+    # refuses an add_replica that would push
+    # len(replicas) * serving_tp past the budget, and the autoscaler
+    # counts the refusal as a spawn failure instead of flapping
+    serving_chip_budget: int = 0
 
     def __post_init__(self):
         if self.serving_mode not in SERVING_MODES:
@@ -417,6 +467,16 @@ class FFConfig:
             raise ValueError(
                 f"admission_deadline_s must be >= 0 (0 = off), "
                 f"got {self.admission_deadline_s}"
+            )
+        if self.serving_tp < 1:
+            raise ValueError(
+                f"serving_tp must be >= 1 (1 = single-chip replicas), "
+                f"got {self.serving_tp}"
+            )
+        if self.serving_chip_budget < 0:
+            raise ValueError(
+                f"serving_chip_budget must be >= 0 (0 = unbounded), "
+                f"got {self.serving_chip_budget}"
             )
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(
@@ -683,6 +743,10 @@ class FFConfig:
         p.add_argument("--admission-deadline",
                        dest="admission_deadline_s", type=float,
                        default=0.0)
+        p.add_argument("--serving-tp", dest="serving_tp", type=int,
+                       default=1)
+        p.add_argument("--serving-chip-budget",
+                       dest="serving_chip_budget", type=int, default=0)
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -768,6 +832,8 @@ class FFConfig:
             serving_slo_ttft=args.serving_slo_ttft,
             serving_drain_timeout=args.serving_drain_timeout,
             admission_deadline_s=args.admission_deadline_s,
+            serving_tp=args.serving_tp,
+            serving_chip_budget=args.serving_chip_budget,
         )
 
 

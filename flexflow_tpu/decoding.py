@@ -62,11 +62,31 @@ def _gpt_dims(ff: FFModel) -> Dict[str, int]:
     }
 
 
+def gpt_decode_tp_strategy(tp: int, num_layers: int):
+    """Head-tensor-parallel strategy for a decode twin: one replica
+    spans tp chips on a {"data": 1, "model": tp} mesh — attention
+    heads and FFN out-channels column-parallel on the model axis
+    (ffn2 row-parallel automatically), and every paged KV pool's head
+    dim rides the same axis (ops/attention._paged_state_specs), so
+    per-chip KV bytes are 1/tp.  The bert_tp_strategy shape with the
+    data axis degenerate: decode batches are slot-owned, never
+    repartitioned."""
+    from .ops.op import ShardConfig
+    from .strategy import Strategy
+
+    s = Strategy(mesh_axes={"data": 1, "model": int(tp)})
+    for i in range(num_layers):
+        s.shard_configs[f"attn_{i}"] = ShardConfig(channel=tp)
+        s.shard_configs[f"ffn1_{i}"] = ShardConfig(channel=tp)
+    return s
+
+
 def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
                      devices=None, kv_page_size: int = 0,
                      kv_num_blocks: int = 0,
                      step_tokens: int = 1,
-                     kv_kernel: str = "gather") -> FFModel:
+                     kv_kernel: str = "gather",
+                     tp: int = 1) -> FFModel:
     """Build + compile the KV-cache decode twin of a trained GPT and
     transfer its weights.  The decode graph is seq-`step_tokens`
     (default 1) with decode_max_seq = the trained model's
@@ -89,8 +109,19 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
     "Fused paged attention"): "gather" (default) is the dense
     block-gather oracle; "pallas" streams blocks in place through the
     fused kernel.  Validated against the runtime HERE — a pallas-less
-    jax fails with ConfigError before any graph is built."""
-    from .config import FFConfig, resolve_paged_kernel
+    jax fails with ConfigError before any graph is built.
+
+    tp > 1 compiles the twin over a tp-chip {"data": 1, "model": tp}
+    replica mesh under GSPMD (docs/SERVING.md "Tensor-parallel
+    replicas"): heads, FFN channels and the KV pools' head dims shard
+    over the model axis, per-chip KV bytes drop to 1/tp, and greedy
+    decoding stays token-identical to the tp=1 twin.  The strategy is
+    served through the strategy store keyed by the decode graph x the
+    replica mesh fingerprint (store/key.py) — the same consult-then-
+    publish path training compiles use at spin-up.  Validated against
+    the head count and visible devices HERE (resolve_serving_tp) —
+    never a mid-compile shape error."""
+    from .config import FFConfig, resolve_paged_kernel, resolve_serving_tp
     from .models.transformer import build_gpt
 
     if step_tokens < 1:
@@ -109,16 +140,21 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
             "(kv_page_size > 0): the dense cache has no block table "
             "to stream through")
     dims = _gpt_dims(ff_train)
+    tp = resolve_serving_tp(
+        tp, num_heads=dims["num_heads"],
+        visible_devices=len(devices) if devices is not None else None,
+    )
     b = batch_size or ff_train.config.batch_size
     cfg = FFConfig(
-        batch_size=b, num_devices=1,
+        batch_size=b, num_devices=tp,
         compute_dtype=ff_train.config.compute_dtype,
-        only_data_parallel=True,
+        only_data_parallel=(tp == 1),
         # replica cold start (docs/STORE.md): the twin's compile keeps
         # the train model's artifact-store wiring, so its decode step
         # reloads from the XLA persistent cache on spin-up instead of
-        # recompiling (only_data_parallel means it never searches —
-        # the compilation cache is the piece that matters here)
+        # recompiling (tp=1 never searches — the compilation cache is
+        # the piece that matters there; tp>1 additionally restores its
+        # sharding strategy through the store below)
         strategy_store=ff_train.config.strategy_store,
         compilation_cache=ff_train.config.compilation_cache,
     )
@@ -133,13 +169,33 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
         kv_page_size=kv_page_size, kv_num_blocks=kv_num_blocks,
         kv_kernel=kv_kernel,
     )
+    strategy = None
+    if tp > 1:
+        # consult-then-publish through the strategy store, keyed by the
+        # DECODE graph x the replica's tp-chip mesh fingerprint — a new
+        # replica at the same tp restores the layout instead of
+        # rebuilding it (FFModel.compile skips the store for explicit
+        # strategies, so the decoder routes through it here)
+        from .store import cached_search
+
+        strategy = cached_search(
+            ffd, tp,
+            lambda: gpt_decode_tp_strategy(tp, dims["num_layers"]),
+        )
     ffd.compile(
         optimizer=SGDOptimizer(lr=0.0),
         loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        strategy=strategy,
         devices=devices,
     )
     # weight transfer by (op, spec) name — all shapes are
-    # seq-independent, so the trained pytree drops straight in
+    # seq-independent, so the trained pytree drops straight in.
+    # Each entry is device_put onto the DECODE twin's sharding (the
+    # compile-initialized placeholder carries it): on a tp replica
+    # mesh this shards the trained weights over the model axis; at
+    # tp=1 it is the identity placement.
+    import jax
+
     missing = []
     new_w = {}
     for op_name, entries in ffd._weights.items():
@@ -156,7 +212,10 @@ def make_gpt_decoder(ff_train: FFModel, batch_size: Optional[int] = None,
                     f"decode weight {op_name}.{k}: trained shape "
                     f"{tuple(sv.shape)} != decode shape {tuple(v.shape)}"
                 )
-            new_entries[k] = sv if sv.dtype == v.dtype else sv.astype(v.dtype)
+            sv = sv if sv.dtype == v.dtype else sv.astype(v.dtype)
+            if tp > 1:
+                sv = jax.device_put(np.asarray(sv), v.sharding)
+            new_entries[k] = sv
         new_w[op_name] = new_entries
     if missing:
         raise ValueError(f"decode graph weights missing in trained "

@@ -257,7 +257,6 @@ class MultiHeadAttention(Op):
         p: MultiHeadAttentionParams = self.params
         n, page, nb = self._decode_n(), self._kv_page_size, \
             self._kv_num_blocks
-        q = self.inputs[0].shape
         if not 1 <= qd[1].size <= n:
             # seq length C > 1 is the CHUNKED-PREFILL twin
             # (decoding.build_paged_chunk_step): C tokens scattered at
@@ -267,12 +266,17 @@ class MultiHeadAttention(Op):
                 f"{self.name}: paged decode chunk must be within [1, "
                 f"decode_max_seq={n}], got {qd[1].size}"
             )
-        if qd[0].degree != 1 or self.shard.channel != 1 \
-                or q.replica_degree != 1:
+        if qd[0].degree != 1:
+            # head (channel) sharding IS supported — the pool shards
+            # its head dim over the 'model' axis below, the block
+            # scatter/gather index only the block/page dims, and the
+            # Pallas dispatch shard_maps over heads.  Batch sharding is
+            # not: slots are scheduler-owned host state, and splitting
+            # them would split the block table.
             raise ShapeError(
                 f"{self.name}: paged decode mode needs an unsharded "
-                "decode graph (the block gather is not GSPMD-partitioned "
-                "yet)"
+                "batch dim (slots are host-owned; use head "
+                "tensor-parallelism via ShardConfig.channel instead)"
             )
         if page < 1 or n % page:
             raise ShapeError(
@@ -288,9 +292,16 @@ class MultiHeadAttention(Op):
         zero = ZeroInitializer()
 
         def pool(d_head):
+            # head dim carries the channel (tp) degree: the pool shards
+            # [nb, page, h/tp, d] per chip — per-chip KV bytes are 1/tp
+            # — while the block scatter/gather address only the
+            # unsharded block/page dims, so the host-owned block
+            # table / COW / prefix-sharing plumbing never sees the
+            # sharding.
             dims = (
                 ParallelDim(nb), ParallelDim(page),
-                ParallelDim(p.num_heads), ParallelDim(d_head),
+                ParallelDim(p.num_heads, self.shard.channel),
+                ParallelDim(d_head),
                 ParallelDim(1, 1, is_replica_dim=True),
             )
             return ParallelTensorShape(dims, dt)
@@ -514,7 +525,32 @@ class MultiHeadAttention(Op):
                 kh[:, j].astype(k_cache.dtype))
             v_cache = v_cache.at[blk, off].set(
                 vh[:, j].astype(v_cache.dtype))
-        ctx = paged_attention(qh, k_cache, v_cache, btab, pos, scale)
+        mesh = getattr(self, "_mesh", None)
+        if self.shard.channel > 1 and mesh is not None \
+                and mesh.devices.size > 1:
+            # GSPMD cannot partition a pallas_call: shard the kernel
+            # grid over the head axis explicitly (the _flash_sharded
+            # pattern).  Per shard the kernel sees [b, s, h/tp, d]
+            # queries against the local [nb, page, h/tp, d] pool slice;
+            # the block table and positions are replicated host state.
+            # No TPU gate — CPU meshes run the kernel in interpret mode
+            # so tests exercise this exact dispatch.
+            from jax.sharding import PartitionSpec
+
+            batch_spec, _, head_spec = self._view_specs()
+            qspec = PartitionSpec(batch_spec, None, head_spec, None)
+            pool_spec = PartitionSpec(None, None, head_spec, None)
+            ctx = _shard_map(
+                lambda q_, k_, v_, bt_, ps_: paged_attention(
+                    q_, k_, v_, bt_, ps_, scale),
+                mesh=mesh,
+                in_specs=(qspec, pool_spec, pool_spec,
+                          PartitionSpec(None, None), PartitionSpec(None)),
+                out_specs=qspec,
+                check_vma=False,
+            )(qh, k_cache, v_cache, btab, pos)
+        else:
+            ctx = paged_attention(qh, k_cache, v_cache, btab, pos, scale)
         return ctx, k_cache, v_cache
 
     # -- attention core dispatch ----------------------------------------

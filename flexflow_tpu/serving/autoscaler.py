@@ -111,6 +111,8 @@ class ServingAutoscaler:
         self.log = logger
         self.scale_ups = 0
         self.scale_downs = 0
+        self.spawn_failures = 0  # add_replica refusals (chip budget,
+        #                          compile errors) observed by tick()
         self.forced_retires = 0
         self.ticks = 0
         self.last_action_t: Optional[float] = None
@@ -220,9 +222,14 @@ class ServingAutoscaler:
                 f"KV occupancy {s['kv_occupancy']:.2f} > "
                 f"{self.kv_high:.2f}")
         if up_reasons:
-            if committed >= self.max_replicas:
-                return "hold", (f"at max_replicas={self.max_replicas} "
-                                f"({'; '.join(up_reasons)})")
+            max_fleet = self._max_fleet()
+            if committed >= max_fleet:
+                cap = (f"chip budget "
+                       f"{getattr(self.front, 'chip_budget', 0)} caps "
+                       f"the fleet at {max_fleet}"
+                       if max_fleet < self.max_replicas
+                       else f"at max_replicas={self.max_replicas}")
+                return "hold", f"{cap} ({'; '.join(up_reasons)})"
             return "up", "; ".join(up_reasons)
         # scale-down wants EVERY signal comfortable (hysteresis: the
         # down band sits well below the up band)
@@ -237,6 +244,17 @@ class ServingAutoscaler:
                 f"queue/replica {s['queue_per_replica']:.1f} < "
                 f"{self.queue_low:.1f} and SLO margin ample")
         return "hold", "within bands"
+
+    def _max_fleet(self) -> int:
+        """max_replicas, further capped by the front's chip budget:
+        each replica spans chips_per_replica chips (its tensor-parallel
+        degree), so a budget of B chips holds at most B // tp engines
+        regardless of what --serving-max-replicas allows."""
+        budget = int(getattr(self.front, "chip_budget", 0) or 0)
+        if not budget:
+            return self.max_replicas
+        per = max(1, int(getattr(self.front, "chips_per_replica", 1)))
+        return min(self.max_replicas, budget // per)
 
     # -- actuation -------------------------------------------------------
     def _pick_drain_target(self):
@@ -306,6 +324,7 @@ class ServingAutoscaler:
                 self.scale_ups += 1
             except Exception as e:  # noqa: BLE001 — a failed spawn
                 action, reason = "hold", f"spawn failed: {e}"
+                self.spawn_failures += 1
                 # _record only logs non-hold actions and only they set
                 # the cooldown: without both, a persistent build
                 # failure retries a full compile every tick, silently
@@ -378,17 +397,32 @@ class ServingAutoscaler:
     # -- surfaces --------------------------------------------------------
     def stats(self) -> Dict:
         """The /v2/stats "autoscaler" block."""
-        with self.front._cv:
-            current = len(self.front.replicas)
+        front = self.front
+        with front._cv:
+            current = len(front.replicas)
+            meshes = [
+                {"id": r.replica_id,
+                 "mesh_shape": dict(getattr(
+                     getattr(r.scheduler, "model", None),
+                     "mesh_shape", None) or {})}
+                for r in front.replicas if r.scheduler is not None
+            ]
         # single read: the loop thread clears _draining concurrently
         draining = self._draining
+        per = max(1, int(getattr(front, "chips_per_replica", 1)))
         return {
             "current_replicas": current,
             "target_replicas": self.target_replicas(),
             "min_replicas": self.min_replicas,
             "max_replicas": self.max_replicas,
+            "max_fleet": self._max_fleet(),
+            "chips_per_replica": per,
+            "chip_budget": int(getattr(front, "chip_budget", 0) or 0),
+            "fleet_chips": current * per,
+            "replica_meshes": meshes,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
+            "spawn_failures": self.spawn_failures,
             "forced_retires": self.forced_retires,
             "ticks": self.ticks,
             "drain_in_flight": (draining[0].replica_id

@@ -120,6 +120,7 @@ class ServingFront:
         max_restarts: int = 3,
         retry_backoff: float = 0.1,
         request_retry_limit: int = 2,
+        chip_budget: int = 0,
         fault_plans: Optional[Dict[int, FaultPlan]] = None,
         latency_window: int = 1024,
         close_timeout_s: float = 5.0,
@@ -138,6 +139,8 @@ class ServingFront:
                 f"got {request_retry_limit}")
         self.registry = registry
         self.request_retry_limit = int(request_retry_limit)
+        self.chip_budget = int(chip_budget)  # 0 = unbounded
+        self._pending_replicas = 0  # add_replica compiles in flight
         self.shed_retry_after_s = float(shed_retry_after_s)
         self.admission_deadline_s = float(admission_deadline_s)
         self.rate_staleness_s = float(rate_staleness_s)
@@ -184,6 +187,20 @@ class ServingFront:
             for i in range(num_replicas)
         ]
         self._next_replica_id = num_replicas
+        # every engine in the fleet spans the same tensor-parallel
+        # degree; the chip budget bounds
+        # len(replicas) * chips_per_replica (docs/SERVING.md)
+        self.chips_per_replica = max(1, int(getattr(
+            self.replicas[0].scheduler.model, "tp", 1)))
+        if self.chip_budget and (len(self.replicas)
+                                 * self.chips_per_replica
+                                 > self.chip_budget):
+            for r in self.replicas:
+                r.close(close_timeout_s)
+            raise ValueError(
+                f"chip budget {self.chip_budget} cannot hold "
+                f"{len(self.replicas)} replica(s) x "
+                f"{self.chips_per_replica} chip(s) each")
         self.max_seq = self.replicas[0].scheduler.model.max_seq
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
@@ -240,6 +257,7 @@ class ServingFront:
                 prefill_chunk=getattr(cfg, "prefill_chunk", 0),
                 prefix_cache=getattr(cfg, "prefix_cache", True),
                 paged_kernel=getattr(cfg, "paged_kernel", "gather"),
+                tp=getattr(cfg, "serving_tp", 1),
             )
 
         kw.setdefault("step_timeout", cfg.serving_step_timeout)
@@ -248,9 +266,20 @@ class ServingFront:
         kw.setdefault("seed", cfg.seed)
         kw.setdefault("admission_deadline_s",
                       getattr(cfg, "admission_deadline_s", 0.0))
+        kw.setdefault("chip_budget",
+                      getattr(cfg, "serving_chip_budget", 0))
+        n = cfg.serving_replicas if num_replicas is None else num_replicas
+        tp = getattr(cfg, "serving_tp", 1)
+        budget = int(kw.get("chip_budget") or 0)
+        if budget and n * tp > budget:
+            from ..config import ConfigError
+
+            raise ConfigError(
+                f"--serving-chip-budget {budget} cannot hold the "
+                f"initial fleet: {n} replica(s) x --serving-tp {tp} "
+                f"= {n * tp} chip(s)")
         return cls(
-            factory,
-            cfg.serving_replicas if num_replicas is None else num_replicas,
+            factory, n,
             eos_id=eos_id, registry=registry, fault_plans=fault_plans,
             **kw,
         )
@@ -273,14 +302,36 @@ class ServingFront:
     def add_replica(self) -> ServingReplica:
         """Scale-up: build one more supervised replica (the compile is
         warm through the strategy store whenever any replica has paid
-        it — docs/STORE.md) and put it in the dispatcher's rotation."""
+        it — docs/STORE.md) and put it in the dispatcher's rotation.
+        With a chip budget set, a replica that would not fit
+        (fleet chips + chips_per_replica > budget) is refused BEFORE
+        any compile — the autoscaler counts the refusal as a spawn
+        failure (serving/autoscaler_spawn_failed)."""
         if self._closed or self._terminating:
             raise RuntimeError("ServingFront is closing")
         with self._cv:
+            if self.chip_budget:
+                in_use = (len(self.replicas) + self._pending_replicas
+                          ) * self.chips_per_replica
+                if in_use + self.chips_per_replica > self.chip_budget:
+                    if self.registry is not None:
+                        self.registry.counter(
+                            "serving/chip_budget_refused").inc()
+                    raise RuntimeError(
+                        f"chip budget exhausted: {in_use} of "
+                        f"{self.chip_budget} chip(s) in use and a new "
+                        f"replica spans {self.chips_per_replica}")
+            self._pending_replicas += 1
             rid = self._next_replica_id
             self._next_replica_id += 1
-        replica = self._build_replica(rid)  # compile OUTSIDE the lock
+        try:
+            replica = self._build_replica(rid)  # compile OUTSIDE the lock
+        except Exception:
+            with self._cv:
+                self._pending_replicas -= 1
+            raise
         with self._cv:
+            self._pending_replicas -= 1
             # close()/terminate() may have swept the fleet while we
             # were compiling; appending now would leak a live engine
             # nobody ever closes
@@ -384,14 +435,13 @@ class ServingFront:
         uncached request of the same shape: cached prefix tokens cost
         ZERO prefill steps, so a request whose prompt is largely in a
         replica's prefix cache consumes (plen - hit + max_new) of the
-        (plen + max_new) steps an uncached twin would.  Each replica's
-        pool caches independently and the dispatcher is least-loaded
-        (not cache-affine), so the discount uses the WORST live
-        replica's hit — an optimistic probe of a warm replica must not
-        admit a request a cold one will then serve past its SLO.
-        1.0 when nothing is cached or no live replica exposes a
-        probe."""
-        worst = None
+        (plen + max_new) steps an uncached twin would.  The dispatcher
+        is CACHE-AFFINE (_pick_replica routes a request to the replica
+        holding its longest cached prefix), so the discount uses the
+        BEST live replica's hit — that is the replica that will
+        actually serve it.  1.0 when nothing is cached or no live
+        replica exposes a probe."""
+        best = None
         for r in self.replicas:
             sched = r.scheduler
             if r.state != "live" or sched is None:
@@ -405,8 +455,8 @@ class ServingFront:
                 return 1.0
             total = len(prompt) + max_new
             cost = max(0, total - hit) / max(total, 1)
-            worst = cost if worst is None else max(worst, cost)
-        return 1.0 if worst is None else worst
+            best = cost if best is None else min(best, cost)
+        return 1.0 if best is None else best
 
     def _predict_wait_s(self, depth: int) -> Optional[float]:
         """Predicted time for `depth` queued requests to clear at the
@@ -520,19 +570,38 @@ class ServingFront:
             prompt, max_new_tokens, temperature).wait(timeout)
 
     # -- dispatch --------------------------------------------------------
-    def _pick_replica(self) -> Optional[ServingReplica]:
-        """Least-outstanding live replica with dispatch headroom (the
-        cap keeps the backlog at the FRONT, where a replica death
-        can't strand it)."""
-        best = None
+    def _pick_replica(self, req: Optional[FrontRequest] = None
+                      ) -> Optional[ServingReplica]:
+        """Cache-affine pick: among live replicas with dispatch
+        headroom (the cap keeps the backlog at the FRONT, where a
+        replica death can't strand it), prefer the replica whose
+        prefix cache holds the LONGEST prefix of the request's prompt
+        — each pool caches independently, so routing a shared-prefix
+        request to the holder turns its prefill into a block-table
+        metadata hit instead of a recompute on a cold pool.  Ties and
+        cold prompts fall back to least-outstanding."""
+        best, best_hit = None, -1
         for r in self.replicas:
             sched = r.scheduler  # may concurrently flip to None on death
             if r.state != "live" or sched is None:
                 continue
             if r.outstanding >= sched.model.batch_slots:
                 continue
-            if best is None or r.outstanding < best.outstanding:
-                best = r
+            hit = 0
+            if req is not None:
+                probe = getattr(sched, "cached_prefix_tokens", None)
+                if probe is not None:
+                    try:
+                        hit = int(probe(req.prompt))
+                    except Exception:  # noqa: BLE001 — a probe must
+                        hit = 0        # never stall dispatch
+            if (best is None or hit > best_hit
+                    or (hit == best_hit
+                        and r.outstanding < best.outstanding)):
+                best, best_hit = r, hit
+        if (best is not None and best_hit > 0
+                and self.registry is not None):
+            self.registry.counter("serving/cache_affine_routed").inc()
         return best
 
     def _dispatch_loop(self) -> None:
@@ -543,7 +612,7 @@ class ServingFront:
                     if self._admission:
                         if self._all_permanently_dead():
                             break
-                        replica = self._pick_replica()
+                        replica = self._pick_replica(self._admission[0])
                         if replica is not None:
                             break
                     self._cv.wait(0.2)
@@ -756,6 +825,9 @@ class ServingFront:
         rate = self.service_rate()
         out = {
             "mode": "replicated",
+            "chips_per_replica": self.chips_per_replica,
+            "chip_budget": self.chip_budget,
+            "fleet_chips": len(replicas) * self.chips_per_replica,
             "replicas_live": len(self._live()),
             "replicas_draining": sum(1 for r in replicas
                                      if r["state"] == "draining"),

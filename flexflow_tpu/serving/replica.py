@@ -76,6 +76,13 @@ class SupervisedDecodeModel:
         # per-block byte unit the scheduler's read telemetry uses
         self.paged_kernel = getattr(model, "paged_kernel", "gather")
         self.kv_block_bytes = getattr(model, "kv_block_bytes", 0)
+        # tensor-parallel surface: how many chips this engine spans and
+        # the per-chip share of each KV block (1 chip / full block on
+        # single-device engines and bare test fakes)
+        self.tp = getattr(model, "tp", 1)
+        self.mesh_shape = dict(getattr(model, "mesh_shape", {}) or {})
+        self.kv_block_bytes_per_chip = getattr(
+            model, "kv_block_bytes_per_chip", self.kv_block_bytes)
         if getattr(model, "prefill_step", None) is None:
             self.prefill_chunk = 0
         self._has_copy = getattr(model, "copy_block", None) is not None
@@ -447,6 +454,9 @@ class ServingReplica:
             # kernel's KV-read counters (zeroes under the gather oracle)
             if "paged_kernel" in sstats:
                 out["paged_kernel"] = sstats["paged_kernel"]
+            # tensor-parallel geometry: chips spanned + per-chip KV share
+            if "tp" in sstats:
+                out["tp"] = sstats["tp"]
         return out
 
     def close(self, timeout_s: Optional[float] = None) -> None:

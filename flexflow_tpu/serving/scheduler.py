@@ -87,7 +87,8 @@ class PagedKVDecodeModel:
                  page_size: int = 16, num_blocks: Optional[int] = None,
                  devices=None, prefill_chunk: int = 0,
                  prefix_cache: bool = True,
-                 paged_kernel: str = "gather"):
+                 paged_kernel: str = "gather", tp: int = 1):
+        from ..config import resolve_serving_tp
         from ..decoding import (_gpt_dims, build_paged_copy_block,
                                 build_paged_decode_step,
                                 build_paged_prefill_step,
@@ -96,6 +97,16 @@ class PagedKVDecodeModel:
 
         self.paged_kernel = resolve_paged_formulation(paged_kernel)
         dims = _gpt_dims(ff_train)
+        # tensor-parallel replica degree (docs/SERVING.md
+        # "Tensor-parallel replicas"): the decode twin compiles over a
+        # tp-chip {"data": 1, "model": tp} mesh, heads + KV pools
+        # sharded — validated against head count / visible devices
+        # HERE so a bad degree is a ConfigError at build, never a
+        # mid-compile shape error
+        self.tp = resolve_serving_tp(
+            tp, num_heads=dims["num_heads"],
+            visible_devices=(len(devices) if devices is not None
+                             else None))
         max_seq = dims["max_seq"]
         if page_size < 1 or max_seq % page_size:
             raise ValueError(
@@ -112,7 +123,7 @@ class PagedKVDecodeModel:
         self.ffd = make_gpt_decoder(
             ff_train, batch_size=batch_slots, devices=devices,
             kv_page_size=page_size, kv_num_blocks=num_blocks,
-            kv_kernel=self.paged_kernel,
+            kv_kernel=self.paged_kernel, tp=self.tp,
         )
         self.batch_slots = batch_slots
         self.page_size = page_size
@@ -139,23 +150,38 @@ class PagedKVDecodeModel:
         # bytes of ONE physical block summed across every layer's k/v
         # pool — the unit of the kernel-read telemetry (blocks read *
         # this = per-step KV bytes the fused kernel streams; the
-        # dense-gather equivalent is table_width blocks per slot)
+        # dense-gather equivalent is table_width blocks per slot).
+        # Shapes here are GLOBAL (GSPMD arrays report the logical
+        # shape); each of a tp replica's chips holds 1/tp of the head
+        # axis, so per-chip bytes are the global count / tp.
         self.kv_block_bytes = sum(
             int(np.prod(v.shape[1:])) * v.dtype.itemsize
             for entries in self._state.values()
             for k, v in entries.items()
             if k in ("k_cache", "v_cache"))
+        self.kv_block_bytes_per_chip = self.kv_block_bytes // self.tp
+        self.mesh_shape = {
+            str(k): int(s)
+            for k, s in zip(self.ffd.mesh.axis_names,
+                            self.ffd.mesh.devices.shape)
+        } if getattr(self.ffd, "mesh", None) is not None else {}
 
     def reset(self):
         """Fresh zero decode state (fault recovery: a step that died
         mid-execution may have invalidated the donated buffers).  The
         scheduler invalidates the pool's prefix index right after —
-        cached blocks' bytes are zeroed with everything else."""
+        cached blocks' bytes are zeroed with everything else.  Zeros
+        are placed onto each leaf's compiled NamedSharding — on a tp
+        replica mesh a bare jnp.zeros would land single-device and the
+        donated step program would reject (or silently reshard) the
+        mismatched state on the next dispatch."""
         import jax
         import jax.numpy as jnp
 
         self._state = jax.tree.map(
-            lambda x: jnp.zeros(x.shape, x.dtype), self.ffd._state)
+            lambda x: jax.device_put(
+                jnp.zeros(x.shape, x.dtype), x.sharding),
+            self.ffd._state)
 
     def step(self, tokens: np.ndarray, seq_lens: np.ndarray,
              block_tables: np.ndarray) -> np.ndarray:
@@ -305,6 +331,22 @@ class ContinuousScheduler:
         self.prefill_steps = 0    # chunked-prefill dispatches
         self.eos_id = int(eos_id)
         self.registry = registry
+        # tensor-parallel geometry gauges (serving/tp_* group,
+        # docs/OBSERVABILITY.md): static per-engine facts, set once
+        if registry is not None:
+            tp = int(getattr(model, "tp", 1))
+            registry.gauge("serving/tp_degree").set(tp)
+            registry.gauge("serving/tp_chips").set(
+                max(1, int(np.prod(list(
+                    (getattr(model, "mesh_shape", None) or {"": tp})
+                    .values())))))
+            registry.gauge("serving/tp_kv_block_bytes_per_chip").set(
+                int(getattr(model, "kv_block_bytes_per_chip",
+                            getattr(model, "kv_block_bytes", 0))))
+            registry.gauge("serving/tp_kv_pool_bytes_per_chip").set(
+                int(getattr(model, "kv_block_bytes_per_chip",
+                            getattr(model, "kv_block_bytes", 0)))
+                * int(getattr(model, "num_blocks", 0)))
         self._queue: "queue.Queue[_PendingSeq]" = queue.Queue()
         self._waiting: deque = deque()  # worker-local FIFO admit order
         self._stop = threading.Event()
@@ -348,15 +390,15 @@ class ContinuousScheduler:
                      seed: int = 0, prefill_chunk: int = 0,
                      prefix_cache: bool = True,
                      paged_kernel: str = "gather",
-                     check_invariants: bool = False
-                     ) -> "ContinuousScheduler":
+                     check_invariants: bool = False,
+                     tp: int = 1) -> "ContinuousScheduler":
         model = PagedKVDecodeModel(ff_train, batch_slots=batch_slots,
                                    page_size=page_size,
                                    num_blocks=num_blocks,
                                    devices=devices,
                                    prefill_chunk=prefill_chunk,
                                    prefix_cache=prefix_cache,
-                                   paged_kernel=paged_kernel)
+                                   paged_kernel=paged_kernel, tp=tp)
         return cls(model, eos_id=eos_id, registry=registry, seed=seed,
                    check_invariants=check_invariants)
 
@@ -459,6 +501,19 @@ class ContinuousScheduler:
                 "fragmentation": round(self.pool.fragmentation(), 4),
             },
             "prefix_cache": self.pool.prefix_stats(),
+            "tp": {
+                "degree": int(getattr(self.model, "tp", 1)),
+                "mesh_shape": dict(getattr(self.model, "mesh_shape",
+                                           {}) or {}),
+                "kv_block_bytes": self._kv_block_bytes,
+                "kv_block_bytes_per_chip": int(getattr(
+                    self.model, "kv_block_bytes_per_chip",
+                    self._kv_block_bytes)),
+                "kv_pool_bytes_per_chip": int(getattr(
+                    self.model, "kv_block_bytes_per_chip",
+                    self._kv_block_bytes))
+                * int(getattr(self.model, "num_blocks", 0)),
+            },
             "paged_kernel": {
                 "formulation": self._paged_kernel,
                 "blocks_read": self.kernel_blocks_read,

@@ -984,3 +984,66 @@ def test_from_config_wires_knobs():
         assert sc.drain_timeout_s == 9.0
     finally:
         front.close()
+
+
+# -- chip budget: chips-per-replica aware fleet sizing ------------------
+
+def _tp_factory(tp):
+    def f(replica_id, survivors=None):
+        m = FakeStepModel()
+        m.tp = tp
+        m.mesh_shape = {"data": 1, "model": tp}
+        m.kv_block_bytes = 1024
+        m.kv_block_bytes_per_chip = 1024 // tp
+        return m
+    return f
+
+
+def test_chip_budget_caps_fleet_below_max_replicas():
+    """A chip budget of B holds at most B // tp engines: the policy
+    holds at that cap (with the budget named in the reason) instead of
+    paying a spawn attempt that the front would refuse every tick."""
+    front = ServingFront(_tp_factory(2), num_replicas=2, chip_budget=4,
+                         sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 8, time_fn=lambda: 100.0)
+    try:
+        assert sc._max_fleet() == 2
+        action, reason = sc.decide(sig(live=2, fleet=2,
+                                       queue_per_replica=10.0))
+        assert action == "hold"
+        assert "chip budget 4 caps the fleet at 2" in reason
+        # below the cap the same pressure still scales up
+        action, _ = sc.decide(sig(live=1, fleet=1,
+                                  queue_per_replica=10.0))
+        assert action == "up"
+        st = sc.stats()
+        assert st["max_fleet"] == 2
+        assert st["chips_per_replica"] == 2
+        assert st["chip_budget"] == 4
+        assert st["fleet_chips"] == 4
+        assert all(m["mesh_shape"] == {"data": 1, "model": 2}
+                   for m in st["replica_meshes"])
+    finally:
+        front.close()
+
+
+def test_spawn_failures_surface_in_autoscaler_stats():
+    """add_replica refusals (chip budget, compile errors) observed by
+    tick() are counted on the scaler itself, not only the registry."""
+    tm = [100.0]
+    front = ServingFront(factory, num_replicas=1, sleep=NO_SLEEP)
+    sc = ServingAutoscaler(front, 1, 4, cooldown_s=1.0,
+                           time_fn=lambda: tm[0])
+    try:
+        front.add_replica = lambda: (_ for _ in ()).throw(
+            RuntimeError("chip budget exhausted: 4 of 4 chip(s) in "
+                         "use and a new replica spans 2"))
+        sc.observe = lambda: sig(t=tm[0], live=1, fleet=1,
+                                 queue_per_replica=10.0)
+        entry = sc.tick()
+        assert entry["action"] == "hold"
+        assert "chip budget exhausted" in entry["reason"]
+        assert sc.spawn_failures == 1
+        assert sc.stats()["spawn_failures"] == 1
+    finally:
+        front.close()

@@ -88,3 +88,21 @@ def test_serving_paged_kernel_leg_keys_frozen():
     assert needed <= set(leg), sorted(needed - set(leg))
     prefix_leg = manifest["legs"]["serving_prefix"]
     assert needed <= set(prefix_leg)
+
+
+def test_serving_gspmd_leg_keys_frozen():
+    """The v20 tensor-parallel leg stays round-over-round comparable
+    only with its workload geometry pinned: every TPU-shape key
+    bench_serving_gspmd reads must exist, it must mirror the
+    serving_prefix workload fields (same shared-prefix pitch), and the
+    tp degree itself is frozen — a silent tp bump would change the
+    equal-per-chip-bytes capacity claim."""
+    manifest, _ = _load()
+    leg = manifest["legs"]["serving_gspmd"]
+    needed = {"vocab", "max_seq", "hidden", "layers", "heads",
+              "intermediate", "slots", "kv_page_size", "requests",
+              "offered_rps", "prefill_chunk", "num_prefixes",
+              "prefix_len", "tail_range", "max_new_range", "tp"}
+    assert needed <= set(leg), sorted(needed - set(leg))
+    assert leg["tp"] >= 2  # a tp=1 "replica mesh" measures nothing
+    assert leg["heads"] % leg["tp"] == 0  # heads shard over the mesh

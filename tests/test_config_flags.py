@@ -464,3 +464,36 @@ def test_slices_selects_hierarchy_machine_model():
     assert m.num_devices() == 8
     flat = make_machine_model(FFConfig(), 8)
     assert not isinstance(flat, SliceHierarchy)
+
+
+def test_serving_tp_cli_flags_parse():
+    cfg = FFConfig.from_args(
+        ["--serving-tp", "4", "--serving-chip-budget", "16"])
+    assert cfg.serving_tp == 4
+    assert cfg.serving_chip_budget == 16
+    d = FFConfig.from_args([])
+    assert d.serving_tp == 1 and d.serving_chip_budget == 0
+
+
+def test_serving_tp_config_validated():
+    with pytest.raises(ValueError):
+        FFConfig(serving_tp=0)
+    with pytest.raises(ValueError):
+        FFConfig(serving_chip_budget=-1)
+    FFConfig(serving_tp=2, serving_chip_budget=8)  # valid
+
+
+def test_resolve_serving_tp_rejects_bad_degrees():
+    """--serving-tp misconfigurations must fail at BUILD time with a
+    ConfigError naming the flag, never surface as a mid-compile shape
+    error (the resolve_paged_kernel discipline)."""
+    from flexflow_tpu.config import ConfigError, resolve_serving_tp
+
+    assert resolve_serving_tp(1) == 1
+    assert resolve_serving_tp(2, num_heads=4, visible_devices=8) == 2
+    with pytest.raises(ConfigError, match="must be >= 1"):
+        resolve_serving_tp(0)
+    with pytest.raises(ConfigError, match="does not divide"):
+        resolve_serving_tp(3, num_heads=4, visible_devices=8)
+    with pytest.raises(ConfigError, match="exceeds the 2 visible"):
+        resolve_serving_tp(4, num_heads=4, visible_devices=2)

@@ -400,3 +400,109 @@ def test_http_front_serves_and_degrades():
     finally:
         server.shutdown()
         front.close()
+
+
+# -- tensor-parallel replicas: chip budget + cache-affine dispatch ------
+
+def _tp_factory(tp):
+    """FakeStepModel dressed with the tensor-parallel surface a
+    PagedKVDecodeModel exposes (tp / mesh_shape / per-chip KV bytes)."""
+    def f(replica_id, survivors=None):
+        m = FakeStepModel()
+        m.tp = tp
+        m.mesh_shape = {"data": 1, "model": tp}
+        m.kv_block_bytes = 1024
+        m.kv_block_bytes_per_chip = 1024 // tp
+        return m
+    return f
+
+
+def test_front_chip_budget_refuses_add_replica():
+    """Fleet chips = replicas x tp; an add_replica that would exceed
+    --serving-chip-budget is refused BEFORE any compile and counted."""
+    reg = MetricsRegistry()
+    front = ServingFront(_tp_factory(2), num_replicas=1, chip_budget=4,
+                         registry=reg, sleep=NO_SLEEP)
+    try:
+        assert front.chips_per_replica == 2
+        front.add_replica()  # 4 chips: fits exactly
+        with pytest.raises(RuntimeError, match="chip budget exhausted"):
+            front.add_replica()
+        assert reg.counter("serving/chip_budget_refused").value == 1
+        st = front.stats()
+        assert st["chips_per_replica"] == 2
+        assert st["chip_budget"] == 4
+        assert st["fleet_chips"] == 4
+        # the per-replica tp block rides /v2/stats
+        tp = st["replicas"][0]["tp"]
+        assert tp["degree"] == 2
+        assert tp["mesh_shape"] == {"data": 1, "model": 2}
+        assert tp["kv_block_bytes_per_chip"] * 2 == tp["kv_block_bytes"]
+    finally:
+        front.close()
+
+
+def test_front_chip_budget_validates_initial_fleet():
+    with pytest.raises(ValueError, match="chip budget"):
+        ServingFront(_tp_factory(4), num_replicas=2, chip_budget=4,
+                     sleep=NO_SLEEP)
+
+
+def test_front_without_budget_keeps_prior_behavior():
+    front = ServingFront(_tp_factory(2), num_replicas=1, sleep=NO_SLEEP)
+    try:
+        for _ in range(3):
+            front.add_replica()  # unbounded: no refusal
+        assert len(front.replicas) == 4
+        assert front.stats()["chip_budget"] == 0
+    finally:
+        front.close()
+
+
+def test_dispatch_is_cache_affine():
+    """The dispatcher routes a request to the replica whose prefix
+    cache holds the longest prefix of its prompt — not least-loaded —
+    and falls back to least-loaded for cold prompts."""
+    reg = MetricsRegistry()
+    front = ServingFront(factory, num_replicas=2, registry=reg,
+                         sleep=NO_SLEEP)
+    try:
+        r0, r1 = front.replicas
+        # pretend replica 1 (NOT first in rotation) holds the blocks
+        r1.scheduler.cached_prefix_tokens = (
+            lambda p: 4 if list(p)[:4] == [1, 2, 3, 4] else 0)
+        r0.scheduler.cached_prefix_tokens = lambda p: 0
+        h = front.generate_async([1, 2, 3, 4, 5], 3)
+        assert h.wait(30.0) == expected([1, 2, 3, 4, 5], 3)
+        assert r1.stats()["batches_run"] > 0
+        assert r0.stats()["batches_run"] == 0
+        assert reg.counter("serving/cache_affine_routed").value == 1
+        # a cold prompt falls back to least-loaded (replica 0 first)
+        h = front.generate_async([9, 9], 2)
+        assert h.wait(30.0) == expected([9, 9], 2)
+        assert r0.stats()["batches_run"] > 0
+    finally:
+        front.close()
+
+
+def test_cache_affinity_follows_real_prefix_cache():
+    """End to end on the real block pool: the first shared-prefix
+    request warms ONE replica's prefix cache; every later request with
+    the same prefix routes to that same replica (its prefill becomes a
+    block-table metadata hit), leaving the other replica cold."""
+    front = ServingFront(factory, num_replicas=2, sleep=NO_SLEEP)
+    try:
+        prefix = [1, 2, 3, 4]  # one full page (page_size=4)
+        assert front.generate(prefix + [5], 3) == \
+            expected(prefix + [5], 3)
+        warm = [r for r in front.replicas
+                if r.stats()["batches_run"] > 0]
+        assert len(warm) == 1
+        for tail in ([6], [7, 8], [5]):
+            assert front.generate(prefix + tail, 3) == \
+                expected(prefix + tail, 3)
+        cold = [r for r in front.replicas if r is not warm[0]]
+        assert cold[0].stats()["batches_run"] == 0
+        assert warm[0].scheduler.cached_prefix_tokens(prefix + [5]) >= 4
+    finally:
+        front.close()

@@ -180,6 +180,21 @@ def summarize(records: List[Dict]) -> str:
             f"shared_blocks={int(shared.get('value', 0))} "
             f"evictions={int(evicted.get('value', 0))}",
         ))
+    # tensor-parallel replicas (docs/SERVING.md "Tensor-parallel
+    # replicas"): one composite line when a multi-chip engine
+    # registered its mesh geometry
+    tp = metrics.get("serving/tp_degree")
+    if tp is not None:
+        chips = metrics.get("serving/tp_chips", {})
+        per_blk = metrics.get("serving/tp_kv_block_bytes_per_chip", {})
+        per_pool = metrics.get("serving/tp_kv_pool_bytes_per_chip", {})
+        rows.append((
+            "tensor parallel",
+            f"degree={int(tp.get('value', 1))} "
+            f"chips={int(chips.get('value', 1))} "
+            f"kv_block_bytes_per_chip={int(per_blk.get('value', 0))} "
+            f"kv_pool_bytes_per_chip={int(per_pool.get('value', 0))}",
+        ))
     # fused paged kernel (docs/SERVING.md "Fused paged attention"):
     # one composite read-traffic line when the kernel formulation ran
     blocks = metrics.get("serving/paged_kernel_blocks_read")
