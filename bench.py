@@ -1886,6 +1886,153 @@ def bench_serving_resilience(dev, on_tpu):
     }
 
 
+def bench_serving_disagg(dev, on_tpu):
+    """Disaggregated prefill/decode fleet leg (manifest v21): the
+    shared-prefix workload plus a sub-page prompt mix through a
+    1-prefill + 1-decode DisaggServingFront vs the colocated 2-mixed
+    ServingFront at EQUAL TOTAL CHIPS.  Multi-page prompts land on the
+    migrate side of the dispatcher's cost model (KV blocks stream
+    replica-to-replica and re-enter as a prefix-cache hit on the
+    decode class); sub-page prompts have nothing block-aligned to ship
+    and re-prefill — the leg asserts BOTH decisions fire, and that
+    greedy completions are TOKEN-IDENTICAL between the two fleets (the
+    colocated front is the oracle).  Reports per-class TTFT/per-token
+    latency, migration decision/bytes counters, and the tokens/s
+    ratio.  docs/SERVING.md "Disaggregated fleet"."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+    from flexflow_tpu.obs.metrics import MetricsRegistry
+    from flexflow_tpu.serving import DisaggServingFront, ServingFront
+    from flexflow_tpu.serving.loadgen import (
+        run_loadgen, sample_shared_prefix_workload, sample_workload)
+
+    leg = MANIFEST["legs"]["serving_disagg"]
+    if on_tpu:
+        vocab, max_seq = leg["vocab"], leg["max_seq"]
+        hidden, layers, heads = leg["hidden"], leg["layers"], leg["heads"]
+        inter, slots = leg["intermediate"], leg["slots"]
+        page, n_req = leg["kv_page_size"], leg["requests"]
+        rate, chunk = leg["offered_rps"], leg["prefill_chunk"]
+        n_prefixes, prefix_len = leg["num_prefixes"], leg["prefix_len"]
+        tail_range = tuple(leg["tail_range"])
+        mnt_range = tuple(leg["max_new_range"])
+        n_sub = leg["subpage_requests"]
+        sub_range = tuple(leg["subpage_len_range"])
+    else:
+        vocab, max_seq = 64, 32
+        hidden, layers, heads, inter = 64, 2, 4, 128
+        slots, page, n_req, rate, chunk = 4, 4, 24, 400.0, 4
+        n_prefixes, prefix_len = 2, 8
+        tail_range, mnt_range = (1, 4), (2, 6)
+        n_sub, sub_range = 8, (2, 4)
+
+    cfg = FFConfig(batch_size=slots, num_devices=1,
+                   serving_slots=slots, kv_page_size=page,
+                   serving_replicas=2, prefill_chunk=chunk)
+    ff = FFModel(cfg)
+    build_gpt(ff, batch_size=slots, seq_length=max_seq,
+              hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=inter, vocab_size=vocab)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (slots, max_seq)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                          (slots, max_seq)).copy()
+    ff.train_step({"input": ids, "positions": pos}, ids)  # real weights
+
+    wl_rng = np.random.RandomState(31)
+    shared_wl, _ = sample_shared_prefix_workload(
+        wl_rng, n_req, vocab, num_prefixes=n_prefixes,
+        prefix_len=prefix_len, tail_range=tail_range,
+        max_new_range=mnt_range)
+    # sub-page prompts: nothing block-aligned to ship — the cost
+    # model's guaranteed re-prefill side
+    sub_wl = sample_workload(wl_rng, n_sub, vocab,
+                             prompt_len_range=sub_range,
+                             max_new_range=mnt_range)
+    workload = shared_wl + sub_wl
+
+    def run_front(front):
+        try:
+            # warm every replica's compiles off the clock: a sub-page
+            # prompt exercises the direct decode path, a multi-page
+            # one the prefill pass + migration path
+            warm = [front.generate_async([1, 2], 2)
+                    for _ in range(2 * slots)]
+            warm.append(front.generate_async(
+                list(range(1, 2 * page + 2)), 2))
+            for h in warm:
+                h.wait(300.0)
+            report = run_loadgen(front, workload, rate, seed=19,
+                                 detail=True, record_tokens=True)
+            return report, front.stats()
+        finally:
+            front.close()
+
+    colo_report, _ = run_front(ServingFront.from_trained(
+        ff, devices=[dev]))
+    reg = MetricsRegistry()
+    disagg_report, disagg_stats = run_front(
+        DisaggServingFront.from_trained(
+            ff, num_replicas=2, devices=[dev],
+            roles=["prefill", "decode"], registry=reg))
+
+    # greedy completions token-identical: the colocated fleet is the
+    # oracle, migration is invisible in the output stream
+    def by_idx(report):
+        return {r["idx"]: r["tokens"] for r in report["records"]
+                if r.get("ok")}
+    colo_toks, disagg_toks = by_idx(colo_report), by_idx(disagg_report)
+    assert set(colo_toks) == set(disagg_toks), "completion sets differ"
+    mismatched = sum(1 for i in colo_toks
+                     if colo_toks[i] != disagg_toks[i])
+    assert mismatched == 0, \
+        f"{mismatched} completions differ colocated vs disaggregated"
+
+    dg = disagg_stats["disagg"]
+    # both dispatcher decisions must fire, or the leg measured only
+    # half the machinery
+    assert dg["migrate_decisions"] > 0, "no migration was ever chosen"
+    assert dg["reprefill_decisions"] > 0, \
+        "no re-prefill was ever chosen (sub-page mix missing?)"
+    roles = disagg_stats["roles"]
+    for r in colo_report, disagg_report:
+        r.pop("records", None)
+    ratio = (disagg_report.get("tokens_per_s", 0.0)
+             / max(colo_report.get("tokens_per_s", 0.0), 1e-9))
+    return {
+        "workload": (
+            f"{n_req} shared-prefix reqs ({n_prefixes} x "
+            f"{prefix_len}-token prefixes, tails {tail_range}) + "
+            f"{n_sub} sub-page reqs {sub_range}, max_new {mnt_range}, "
+            f"Poisson {rate} rps, greedy, page {page}, chunk {chunk}; "
+            f"colocated 2-mixed vs prefill=1,decode=1 at equal chips"
+        ),
+        "colocated": colo_report,
+        "disaggregated": disagg_report,
+        "disagg_vs_colocated_tokens_per_s": round(ratio, 3),
+        "decisions": {
+            "migrate": dg["migrate_decisions"],
+            "reprefill": dg["reprefill_decisions"],
+            "migrations_ok": dg["migrations_ok"],
+            "migrations_failed": dg["migrations_failed"],
+        },
+        "kv_transfer": dg["kv_transfer"],
+        "per_class": {
+            role: {
+                "replicas": st["replicas"],
+                "ttft_ms": st["ttft"],
+                "per_token_ms": st["per_token"],
+                "service_rate_rps": st["service_rate_rps"],
+            } for role, st in roles.items()
+        },
+        "completions_identical": True,  # asserted above
+        "both_decisions_exercised": True,  # asserted above
+    }
+
+
 def bench_autoscale(dev, on_tpu):
     """Autoscaling-front leg (manifest v15): a SEEDED square-wave
     burst trace against a ServingFront that starts at min_replicas
@@ -2135,6 +2282,8 @@ def main():
     gc.collect()
     serving_resilience = bench_serving_resilience(dev, on_tpu)
     gc.collect()
+    serving_disagg = bench_serving_disagg(dev, on_tpu)
+    gc.collect()
     autoscale = bench_autoscale(dev, on_tpu)
     gc.collect()
     cold_start = bench_cold_start(dev, on_tpu)
@@ -2168,6 +2317,7 @@ def main():
                  "serving_paged_kernel": serving_paged_kernel,
                  "serving_gspmd": serving_gspmd,
                  "serving_resilience": serving_resilience,
+                 "serving_disagg": serving_disagg,
                  "autoscale": autoscale,
                  "cold_start": cold_start, "host_loss": host_loss,
                  "multi_slice": multi_slice,

@@ -17,6 +17,7 @@ Run: python serve_gpt.py [-e STEPS] [-b BATCH]
                          [--kv-page-size N] [--serving-slots N]
                          [--serving-replicas N]
                          [--serving-step-timeout S]
+                         [--serving-roles prefill=1,decode=1]
 """
 import argparse
 import json
@@ -70,8 +71,10 @@ def main():
         # the front supervises even a SINGLE replica (watchdog +
         # budget-capped restarts — the config.py contract for
         # --serving-step-timeout at replicas=1), so continuous mode
-        # always serves through it
-        from flexflow_tpu.serving import ServingFront
+        # always serves through it; --serving-roles upgrades it to a
+        # disaggregated prefill/decode fleet (docs/SERVING.md
+        # "Disaggregated fleet")
+        from flexflow_tpu.serving import build_front
 
         ff.config.serving_replicas = serving_cfg.serving_replicas
         ff.config.serving_slots = serving_cfg.serving_slots
@@ -92,7 +95,10 @@ def main():
             serving_cfg.serving_max_restarts
         ff.config.request_retry_limit = \
             serving_cfg.request_retry_limit
-        batcher = ServingFront.from_trained(ff)
+        ff.config.serving_roles = serving_cfg.serving_roles
+        ff.config.kv_transfer = serving_cfg.kv_transfer
+        ff.config.migration_cost_cap = serving_cfg.migration_cost_cap
+        batcher = build_front(ff, serving_cfg)
         # SIGTERM/SIGINT drain instead of kill for ANY front — the
         # grace machinery lives in ServingFront, not the autoscaler
         grace_displaced = batcher.install_grace_handlers(

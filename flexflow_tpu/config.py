@@ -50,6 +50,11 @@ SERVING_MODES = ("continuous", "static")
 # kernel reading KV blocks in place (ops/pallas/paged_attention.py).
 PAGED_KERNELS = ("gather", "pallas")
 
+# valid FFConfig.kv_transfer values (serving/kv_transfer.py): the
+# fabric a disaggregated fleet streams KV blocks over — "inproc" =
+# same-host handoff, "blob" = store-tier hop (store/blobstore.py).
+KV_TRANSFER_FABRICS = ("inproc", "blob")
+
 
 class ConfigError(ValueError):
     """A configuration that can never run in this build/runtime —
@@ -382,6 +387,24 @@ class FFConfig:
     # len(replicas) * serving_tp past the budget, and the autoscaler
     # counts the refusal as a spawn failure instead of flapping
     serving_chip_budget: int = 0
+    # disaggregated prefill/decode fleet (serving/disagg.py,
+    # docs/SERVING.md "Disaggregated fleet"): per-replica role spec
+    # "prefill=N,decode=M[,mixed=K]" — counts must include at least one
+    # decode-capable replica; "" = colocated fleet (every replica
+    # mixed, the prior behavior).  Validated by parse_serving_roles.
+    serving_roles: str = ""
+    # KV block streaming fabric between replicas: "inproc" (same-host
+    # handoff) or "blob" (store tier hop — inherits the blob fault
+    # matrix, so torn streams degrade to re-prefill)
+    kv_transfer: str = "inproc"
+    # migrate iff migrate_time <= cap * reprefill_time (the dispatcher
+    # costs each handoff with the topology interconnect terms); lower
+    # caps migrate less, must be > 0
+    migration_cost_cap: float = 1.0
+    # predictive autoscaling: project the admission queue forward from
+    # the measured admission-rate slope and scale BEFORE the reactive
+    # queue threshold breaches (serving/autoscaler.py)
+    autoscale_predictive: bool = False
 
     def __post_init__(self):
         if self.serving_mode not in SERVING_MODES:
@@ -477,6 +500,22 @@ class FFConfig:
             raise ValueError(
                 f"serving_chip_budget must be >= 0 (0 = unbounded), "
                 f"got {self.serving_chip_budget}"
+            )
+        if self.serving_roles:
+            # full spec validation (role names, counts, decode-capable
+            # floor) lives with the parser the front consumes
+            from .serving.disagg import parse_serving_roles
+
+            parse_serving_roles(self.serving_roles)
+        if self.kv_transfer not in KV_TRANSFER_FABRICS:
+            raise ValueError(
+                f"kv_transfer must be one of {KV_TRANSFER_FABRICS}, "
+                f"got {self.kv_transfer!r}"
+            )
+        if self.migration_cost_cap <= 0:
+            raise ValueError(
+                f"migration_cost_cap must be > 0, "
+                f"got {self.migration_cost_cap}"
             )
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(
@@ -747,6 +786,14 @@ class FFConfig:
                        default=1)
         p.add_argument("--serving-chip-budget",
                        dest="serving_chip_budget", type=int, default=0)
+        p.add_argument("--serving-roles", dest="serving_roles", type=str,
+                       default="")
+        p.add_argument("--kv-transfer", dest="kv_transfer", type=str,
+                       default="inproc", choices=KV_TRANSFER_FABRICS)
+        p.add_argument("--migration-cost-cap", dest="migration_cost_cap",
+                       type=float, default=1.0)
+        p.add_argument("--autoscale-predictive",
+                       dest="autoscale_predictive", action="store_true")
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -834,6 +881,10 @@ class FFConfig:
             admission_deadline_s=args.admission_deadline_s,
             serving_tp=args.serving_tp,
             serving_chip_budget=args.serving_chip_budget,
+            serving_roles=args.serving_roles,
+            kv_transfer=args.kv_transfer,
+            migration_cost_cap=args.migration_cost_cap,
+            autoscale_predictive=args.autoscale_predictive,
         )
 
 

@@ -29,13 +29,24 @@ gracefully DRAINS the least-loaded one when calm — in-flight work runs
 to completion token-identically before the engine retires and frees
 its KV pool — with hysteresis bands, a cooldown, and
 min/max-replica bounds so the loop cannot flap.
+
+`DisaggServingFront` (docs/SERVING.md "Disaggregated fleet") splits
+the replica classes — prefill passes on one, client decodes on the
+other — and streams each request's finished KV blocks across replicas
+through a `KVTransferFabric` (serving/kv_transfer.py), costing every
+handoff against re-prefilling with the topology model's interconnect
+terms.  Token-identical to the colocated fleet by construction.
 """
 from .autoscaler import ServingAutoscaler
 from .batcher import DynamicBatcher
+from .disagg import (DisaggServingFront, MigrationCostModel,
+                     build_front, parse_serving_roles)
 from .engine import InferenceEngine
 from .front import FrontRequest, ServiceUnavailable, ServingFront
 from .generation import GenerationBatcher, GenerationEngine
 from .kv_pool import KVPool
+from .kv_transfer import (BlobStoreFabric, InProcessFabric, KVMigrator,
+                          KVTransferFabric, resolve_kv_transfer)
 from .replica import ServingReplica, SupervisedDecodeModel
 from .scheduler import ContinuousScheduler, PagedKVDecodeModel
 from .server import serve_http
@@ -44,4 +55,7 @@ __all__ = ["InferenceEngine", "DynamicBatcher", "GenerationEngine",
            "GenerationBatcher", "ContinuousScheduler",
            "PagedKVDecodeModel", "KVPool", "serve_http",
            "ServingFront", "ServingReplica", "SupervisedDecodeModel",
-           "FrontRequest", "ServiceUnavailable", "ServingAutoscaler"]
+           "FrontRequest", "ServiceUnavailable", "ServingAutoscaler",
+           "DisaggServingFront", "MigrationCostModel", "build_front",
+           "parse_serving_roles", "KVTransferFabric", "KVMigrator",
+           "InProcessFabric", "BlobStoreFabric", "resolve_kv_transfer"]

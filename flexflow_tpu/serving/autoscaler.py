@@ -43,6 +43,7 @@ block.  docs/SERVING.md "Autoscaling & drain lifecycle".
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -73,6 +74,9 @@ class ServingAutoscaler:
         slo_ttft_s: float = 0.0,
         kv_high: float = 0.9,
         drain_timeout_s: float = 30.0,
+        predictive: bool = False,
+        predict_horizon_s: float = 10.0,
+        slo_per_token_s: float = 0.0,
         history: int = 256,
         registry=None,
         time_fn: Callable[[], float] = time.monotonic,
@@ -105,6 +109,16 @@ class ServingAutoscaler:
         self.slo_ttft_s = float(slo_ttft_s)
         self.kv_high = float(kv_high)
         self.drain_timeout_s = float(drain_timeout_s)
+        # predictive scaling (--autoscale-predictive): project the
+        # admission queue forward from the measured admission-rate
+        # slope and scale BEFORE the reactive thresholds breach — a
+        # loadgen ramp is visible in the slope several intervals
+        # before it is visible in the queue
+        self.predictive = bool(predictive)
+        self.predict_horizon_s = float(predict_horizon_s)
+        # decode-class per-token SLO (role-aware fleets; 0 = off)
+        self.slo_per_token_s = float(slo_per_token_s)
+        self._admit_samples: "deque[tuple]" = deque(maxlen=8)
         self.registry = registry if registry is not None \
             else front.registry
         self.time_fn = time_fn
@@ -117,6 +131,8 @@ class ServingAutoscaler:
         self.ticks = 0
         self.last_action_t: Optional[float] = None
         self.last_decision: Optional[Dict] = None
+        self.up_role: Optional[str] = None  # roles fleet: class the
+        #                                     next scale-up grows
         self.history: "deque[Dict]" = deque(maxlen=history)
         self._draining = None  # replica with a drain in flight
         self._spawning = False  # a scale-up build (compile) in flight
@@ -142,6 +158,8 @@ class ServingAutoscaler:
         kw.setdefault("cooldown_s", cfg.autoscale_cooldown)
         kw.setdefault("slo_ttft_s", cfg.serving_slo_ttft)
         kw.setdefault("drain_timeout_s", cfg.serving_drain_timeout)
+        kw.setdefault("predictive",
+                      getattr(cfg, "autoscale_predictive", False))
         return cls(front, cfg.serving_min_replicas,
                    cfg.serving_max_replicas, **kw)
 
@@ -153,6 +171,7 @@ class ServingAutoscaler:
         with front._cv:
             replicas = list(front.replicas)
             queue_depth = len(front._admission)
+            admitted = int(getattr(front, "requests_admitted", 0))
         live = [r for r in replicas if r.alive]
         draining = [r for r in replicas if r.state == "draining"]
         # restarting replicas come back live after their rebuild, so
@@ -160,17 +179,24 @@ class ServingAutoscaler:
         # no engine and never return — they don't)
         restarting = [r for r in replicas if r.state == "restarting"]
         outstanding = sum(r.outstanding for r in live)
+        # disaggregated fleets (serving/disagg.py) scale the two
+        # classes on their OWN signals: KV occupancy is a DECODE-class
+        # signal there (the prefill pool recycles per pass and its
+        # occupancy says nothing about serving capacity)
+        roles_active = any(r.role != "mixed" for r in replicas)
         occ = 0.0
         for r in live:
             sched = r.scheduler
-            if sched is not None:
-                try:
-                    occ = max(occ, sched.pool.occupancy())
-                except Exception:  # noqa: BLE001 — a dying replica's
-                    pass           # pool must not kill the loop
+            if sched is None or (roles_active and r.role == "prefill"):
+                continue
+            try:
+                occ = max(occ, sched.pool.occupancy())
+            except Exception:  # noqa: BLE001 — a dying replica's
+                pass           # pool must not kill the loop
         ttft = front.ttft_stats()  # percentile_summary keys, in ms
-        return {
-            "t": self.time_fn(),
+        t = self.time_fn()
+        s = {
+            "t": t,
             "live": len(live),
             "draining": len(draining),
             "restarting": len(restarting),
@@ -180,12 +206,46 @@ class ServingAutoscaler:
             "queue_per_replica": queue_depth / max(len(live), 1),
             "p99_ttft_s": (ttft.get("p99_ms", 0.0) or 0.0) / 1e3,
             "kv_occupancy": occ,
+            "roles_active": roles_active,
         }
+        if roles_active:
+            s["prefill_live"] = sum(1 for r in live
+                                    if r.role == "prefill")
+            s["decode_live"] = sum(1 for r in live
+                                   if r.role != "prefill")
+            tok = None
+            with front._lat_lock:
+                samples = sorted(front._class_tok.get("decode", ()))
+            if len(samples) >= 3:  # nearest-rank p99
+                tok = samples[min(len(samples) - 1,
+                                  math.ceil(0.99 * len(samples)) - 1)]
+            s["decode_per_token_s"] = tok
+            s["decode_rate_rps"] = front.service_rate("decode")
+        # admission-rate slope (predictive scaling): completions/s the
+        # queue is FILLING at, measured over the sample window
+        self._admit_samples.append((t, admitted))
+        rate = None
+        if len(self._admit_samples) >= 2:
+            (t0, a0), (t1, a1) = (self._admit_samples[0],
+                                  self._admit_samples[-1])
+            if t1 > t0:
+                rate = (a1 - a0) / (t1 - t0)
+        s["admit_rate_rps"] = rate
+        # the measured drain rate the projection subtracts: the decode
+        # class's own window in a roles fleet, the fleet's otherwise
+        drain_rate = (s.get("decode_rate_rps") if roles_active
+                      else front.service_rate())
+        s["drain_rate_rps"] = drain_rate
+        return s
 
     # -- policy ----------------------------------------------------------
     def decide(self, s: Dict) -> tuple:
-        """(action, reason) for one signal sample.  Pure policy — no
-        side effects, directly unit-testable."""
+        """(action, reason) for one signal sample.  Pure policy over
+        the sample (directly unit-testable); in a roles fleet it also
+        records WHICH class a scale-up targets (self.up_role — queue/
+        TTFT breaches grow the prefill class, KV-occupancy/per-token
+        breaches grow decode), which tick() passes to add_replica."""
+        self.up_role = None
         if self._draining is not None:
             return "hold", "drain in flight"
         if (self.last_action_t is not None
@@ -207,21 +267,55 @@ class ServingAutoscaler:
         # an idle fleet at max forever (and block its drain).  Gate the
         # TTFT signal on actual load — an idle fleet breaches no SLO.
         busy = s["queue_depth"] + s["outstanding"] > 0
-        up_reasons = []
+        roles = bool(s.get("roles_active"))
+        # ingest-side breaches (grow the PREFILL class in a roles
+        # fleet: the queue backs up when prompts wait for a pass)
+        ingest_reasons = []
+        # capacity-side breaches (grow the DECODE class: its pools and
+        # per-token pace bound how many streams the fleet sustains)
+        capacity_reasons = []
         if s["queue_per_replica"] > self.queue_high:
-            up_reasons.append(
+            ingest_reasons.append(
                 f"queue/replica {s['queue_per_replica']:.1f} > "
                 f"{self.queue_high:.1f}")
         if (self.slo_ttft_s > 0 and busy
                 and s["p99_ttft_s"] > self.slo_ttft_s):
-            up_reasons.append(
+            ingest_reasons.append(
                 f"p99 TTFT {s['p99_ttft_s'] * 1e3:.0f}ms > SLO "
                 f"{self.slo_ttft_s * 1e3:.0f}ms")
+        if (self.predictive and s.get("admit_rate_rps") is not None):
+            # loadgen ramp: the admission-rate slope projects a queue
+            # breach before the reactive threshold sees it
+            drain = s.get("drain_rate_rps") or 0.0
+            growth = s["admit_rate_rps"] - drain
+            if growth > 0:
+                projected = (s["queue_depth"]
+                             + growth * self.predict_horizon_s
+                             ) / max(s["live"], 1)
+                if projected > self.queue_high:
+                    ingest_reasons.append(
+                        f"projected queue/replica {projected:.1f} > "
+                        f"{self.queue_high:.1f} within "
+                        f"{self.predict_horizon_s:.0f}s (admit "
+                        f"{s['admit_rate_rps']:.2f}/s vs drain "
+                        f"{drain:.2f}/s)")
         if s["kv_occupancy"] > self.kv_high:
-            up_reasons.append(
+            capacity_reasons.append(
                 f"KV occupancy {s['kv_occupancy']:.2f} > "
                 f"{self.kv_high:.2f}")
+        tok = s.get("decode_per_token_s")
+        if (roles and self.slo_per_token_s > 0 and busy
+                and tok is not None and tok > self.slo_per_token_s):
+            capacity_reasons.append(
+                f"decode p99 per-token {tok * 1e3:.0f}ms > SLO "
+                f"{self.slo_per_token_s * 1e3:.0f}ms")
+        up_reasons = ingest_reasons + capacity_reasons
         if up_reasons:
+            if roles:
+                # capacity first: a decode class out of KV headroom
+                # queues admissions no matter how fast prefill runs
+                self.up_role = ("decode" if capacity_reasons
+                                else "prefill")
             max_fleet = self._max_fleet()
             if committed >= max_fleet:
                 cap = (f"chip budget "
@@ -258,10 +352,20 @@ class ServingAutoscaler:
 
     # -- actuation -------------------------------------------------------
     def _pick_drain_target(self):
-        """Least-loaded live replica — the cheapest one to retire."""
+        """Least-loaded live replica — the cheapest one to retire.  In
+        a roles fleet, never the last decode-capable one (a healthy
+        prefill class cannot serve a single client request); with the
+        decode class at its floor, an idle prefill replica drains
+        instead (the fleet degrades to colocated re-prefill)."""
         live = self.front._live()
         if len(live) <= self.min_replicas:
             return None
+        if any(r.role != "mixed" for r in live):
+            serving = [r for r in live if r.role != "prefill"]
+            if len(serving) <= 1:
+                live = [r for r in live if r.role == "prefill"]
+                if not live:
+                    return None
         return min(live, key=lambda r: r.outstanding)
 
     def _record(self, action: str, reason: str, s: Dict) -> None:
@@ -275,6 +379,8 @@ class ServingAutoscaler:
             "p99_ttft_s": round(s["p99_ttft_s"], 4),
             "kv_occupancy": round(s["kv_occupancy"], 4),
         }
+        if action == "up" and self.up_role is not None:
+            entry["role"] = self.up_role
         self.history.append(entry)
         if action != "hold":
             self.last_decision = entry
@@ -320,7 +426,7 @@ class ServingAutoscaler:
         if action == "up":
             self._spawning = True  # visible while the build compiles
             try:
-                self.front.add_replica()
+                self.front.add_replica(role=self.up_role or "mixed")
                 self.scale_ups += 1
             except Exception as e:  # noqa: BLE001 — a failed spawn
                 action, reason = "hold", f"spawn failed: {e}"
@@ -420,6 +526,7 @@ class ServingAutoscaler:
             "chip_budget": int(getattr(front, "chip_budget", 0) or 0),
             "fleet_chips": current * per,
             "replica_meshes": meshes,
+            "predictive": self.predictive,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
             "spawn_failures": self.spawn_failures,

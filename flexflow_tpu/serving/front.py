@@ -70,7 +70,7 @@ class FrontRequest:
                  "result", "error", "t_submit", "t_first_token",
                  "t_done", "n_generated", "retries",
                  "queue_depth_at_admit", "deadline_s",
-                 "prefix_hit_tokens")
+                 "prefix_hit_tokens", "served_role", "migration")
 
     def __init__(self, prompt, max_new_tokens, temperature,
                  deadline_s: Optional[float] = None):
@@ -88,6 +88,8 @@ class FrontRequest:
         self.queue_depth_at_admit = 0  # front backlog seen at admission
         self.deadline_s = deadline_s   # TTFT SLO for admission control
         self.prefix_hit_tokens = 0     # stamped from the replica handle
+        self.served_role = None        # class of the replica that served
+        self.migration = None  # disagg routing record (serving/disagg.py)
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.event.wait(timeout):
@@ -122,6 +124,8 @@ class ServingFront:
         request_retry_limit: int = 2,
         chip_budget: int = 0,
         fault_plans: Optional[Dict[int, FaultPlan]] = None,
+        roles: Optional[Sequence[str]] = None,
+        check_invariants: bool = False,
         latency_window: int = 1024,
         close_timeout_s: float = 5.0,
         shed_retry_after_s: float = 1.0,
@@ -137,6 +141,27 @@ class ServingFront:
             raise ValueError(
                 f"request_retry_limit must be >= 0, "
                 f"got {request_retry_limit}")
+        # replica roles (disaggregated serving, serving/disagg.py):
+        # "prefill" replicas never serve client decodes — the
+        # dispatcher skips them — while "decode"/"mixed" replicas do.
+        # A fleet with no decode-capable member could admit but never
+        # serve, so it is refused at construction.
+        if roles is None:
+            roles = ["mixed"] * num_replicas
+        roles = [str(r) for r in roles]
+        if len(roles) != num_replicas:
+            raise ValueError(
+                f"roles must name every replica: got {len(roles)} "
+                f"role(s) for {num_replicas} replica(s)")
+        for r in roles:
+            if r not in ("prefill", "decode", "mixed"):
+                raise ValueError(
+                    f"unknown replica role {r!r} (expected prefill, "
+                    "decode, or mixed)")
+        if all(r == "prefill" for r in roles):
+            raise ValueError(
+                "fleet needs at least one decode-capable replica "
+                "(role decode or mixed)")
         self.registry = registry
         self.request_retry_limit = int(request_retry_limit)
         self.chip_budget = int(chip_budget)  # 0 = unbounded
@@ -150,6 +175,8 @@ class ServingFront:
         self._closed = False
         self._terminating = False
         self.requests_done = 0
+        self.requests_admitted = 0  # accepted into the queue (the
+        #                             predictive autoscaler's ramp input)
         self.shed_requests = 0
         self.admission_shed = 0   # overload-control sheds (deadline)
         self.requeued_requests = 0
@@ -164,6 +191,12 @@ class ServingFront:
         # uncontended completion merely tracks the arrival rate)
         self._done_times = deque(maxlen=256)
         self._done_busy = deque(maxlen=256)
+        # per-CLASS completion windows (role -> timestamps) and
+        # per-token samples: once roles split, a single fleet-wide
+        # window would blend prefill-pass throughput into the decode
+        # drain rate and mis-size Retry-After / admission control
+        self._class_done: Dict[str, deque] = {}
+        self._class_tok: Dict[str, deque] = {}
         # the autoscaler attaches itself here (serving/autoscaler.py);
         # /v2/stats surfaces its block when present
         self.autoscaler = None
@@ -180,10 +213,12 @@ class ServingFront:
             eos_id=eos_id, registry=registry, seed=seed,
             step_timeout=step_timeout, max_restarts=max_restarts,
             retry_backoff=retry_backoff,
+            check_invariants=check_invariants,
             close_timeout_s=close_timeout_s, sleep=sleep, logger=logger,
         )
         self.replicas: List[ServingReplica] = [
-            self._build_replica(i, fault_plan=plans.get(i))
+            self._build_replica(i, fault_plan=plans.get(i),
+                                role=roles[i])
             for i in range(num_replicas)
         ]
         self._next_replica_id = num_replicas
@@ -209,7 +244,8 @@ class ServingFront:
         self._dispatcher.start()
 
     def _build_replica(self, replica_id: int,
-                       fault_plan=None) -> ServingReplica:
+                       fault_plan=None,
+                       role: str = "mixed") -> ServingReplica:
         kw = self._replica_kw
         r = ServingReplica(
             replica_id, self._model_factory,
@@ -220,6 +256,8 @@ class ServingFront:
                               base_backoff=kw["retry_backoff"],
                               seed=kw["seed"] + replica_id),
             fault_plan=fault_plan,
+            role=role,
+            check_invariants=kw["check_invariants"],
             close_timeout_s=kw["close_timeout_s"],
             sleep=kw["sleep"],
             logger=kw["logger"],
@@ -292,14 +330,27 @@ class ServingFront:
     def _live(self) -> List[ServingReplica]:
         return [r for r in self.replicas if r.alive]
 
+    def _serving(self) -> List[ServingReplica]:
+        """Decode-capable subset: the replicas client requests can be
+        dispatched to.  Identical to the fleet while every role is
+        mixed; prefill-class replicas only run migration passes."""
+        return [r for r in self.replicas if r.role != "prefill"]
+
+    def _serving_live(self) -> List[ServingReplica]:
+        return [r for r in self._serving() if r.alive]
+
     def _all_permanently_dead(self) -> bool:
         # vacuous truth on an empty fleet would mislabel terminate()'s
-        # residue (all replicas retired) as "restart budgets exhausted"
-        return bool(self.replicas) and all(
-            r.state == "dead" for r in self.replicas)
+        # residue (all replicas retired) as "restart budgets exhausted".
+        # Only the decode-capable subset counts: a fleet whose decode
+        # class is gone cannot finish a client request no matter how
+        # healthy its prefill class is.
+        serving = self._serving()
+        return bool(serving) and all(
+            r.state == "dead" for r in serving)
 
     # -- fleet lifecycle (autoscaler / SIGTERM grace) --------------------
-    def add_replica(self) -> ServingReplica:
+    def add_replica(self, role: str = "mixed") -> ServingReplica:
         """Scale-up: build one more supervised replica (the compile is
         warm through the strategy store whenever any replica has paid
         it — docs/STORE.md) and put it in the dispatcher's rotation.
@@ -324,8 +375,13 @@ class ServingFront:
             self._pending_replicas += 1
             rid = self._next_replica_id
             self._next_replica_id += 1
+        if role not in ("prefill", "decode", "mixed"):
+            with self._cv:
+                self._pending_replicas -= 1
+            raise ValueError(f"unknown replica role {role!r}")
         try:
-            replica = self._build_replica(rid)  # compile OUTSIDE the lock
+            # compile OUTSIDE the lock
+            replica = self._build_replica(rid, role=role)
         except Exception:
             with self._cv:
                 self._pending_replicas -= 1
@@ -382,16 +438,36 @@ class ServingFront:
                       replica.replica_id, len(self.replicas))
 
     # -- measured service rate -------------------------------------------
-    def service_rate(self) -> Optional[float]:
+    def _note_class_done(self, role: Optional[str], t: float,
+                         per_token_s: Optional[float] = None) -> None:
+        """Record one completion in the per-class window.  Client
+        completions land here via _complete; a disaggregated front also
+        records its internal prefill passes so service_rate("prefill")
+        measures that class's real pass rate instead of staying empty.
+        Caller holds no lock."""
+        if not role:
+            return
+        with self._lat_lock:
+            self._class_done.setdefault(
+                role, deque(maxlen=256)).append(t)
+            if per_token_s is not None:
+                self._class_tok.setdefault(
+                    role, deque(maxlen=256)).append(per_token_s)
+
+    def service_rate(self, role: Optional[str] = None
+                     ) -> Optional[float]:
         """Measured completions/s over the recent window; None until
         two completions have landed, and None again once the newest
         completion is older than `rate_staleness_s` — after an idle
         gap the old span measures ARRIVALS, not capacity, and a stale
         near-zero rate would shed traffic an idle fleet could trivially
         serve.  This is the drain rate Retry-After and predicted-TTFT
-        admission control are computed from."""
+        admission control are computed from.  With `role` set, the
+        window is that replica class's alone (disaggregated fleets:
+        prefill passes must not blend into the decode drain rate)."""
         with self._lat_lock:
-            ts = list(self._done_times)
+            ts = list(self._done_times if role is None
+                      else self._class_done.get(role, ()))
         if len(ts) < 2:
             return None
         if time.monotonic() - ts[-1] > self.rate_staleness_s:
@@ -442,7 +518,7 @@ class ServingFront:
         actually serve it.  1.0 when nothing is cached or no live
         replica exposes a probe."""
         best = None
-        for r in self.replicas:
+        for r in self._serving():
             sched = r.scheduler
             if r.state != "live" or sched is None:
                 continue
@@ -510,9 +586,9 @@ class ServingFront:
                     retry_after_s=self._retry_after(
                         len(self._admission) + 1),
                 )
-            if not self._live():
-                # all replicas down: shed instead of queueing against
-                # a service that may never come back
+            if not self._serving_live():
+                # no decode-capable replica up: shed instead of
+                # queueing against a service that may never come back
                 self.shed_requests += 1
                 if self.registry is not None:
                     self.registry.counter("serving/shed_requests").inc()
@@ -560,6 +636,7 @@ class ServingFront:
                     )
             req.queue_depth_at_admit = depth
             self._admission.append(req)
+            self.requests_admitted += 1
             self._cv.notify_all()
         return req
 
@@ -581,7 +658,7 @@ class ServingFront:
         metadata hit instead of a recompute on a cold pool.  Ties and
         cold prompts fall back to least-outstanding."""
         best, best_hit = None, -1
-        for r in self.replicas:
+        for r in self._serving():  # prefill-class never serves clients
             sched = r.scheduler  # may concurrently flip to None on death
             if r.state != "live" or sched is None:
                 continue
@@ -603,6 +680,15 @@ class ServingFront:
                 and self.registry is not None):
             self.registry.counter("serving/cache_affine_routed").inc()
         return best
+
+    def _divert_plan(self, req: FrontRequest,
+                     replica: ServingReplica) -> Optional[Callable]:
+        """Subclass hook, called under _cv with the request popped and
+        `replica` the cache-affine pick.  Return None to dispatch
+        normally, or a zero-arg thunk to run outside the lock instead
+        (the subclass then owns the request's settlement or requeue).
+        The base front never diverts."""
+        return None
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -626,8 +712,18 @@ class ServingFront:
                         retry_after_s=self.shed_retry_after_s,
                     ))
                     continue
-                replica.outstanding += 1
-                self._observe_depth(replica)
+                # disaggregation hook (serving/disagg.py): a subclass
+                # may claim the request for a prefill pass + KV
+                # migration instead of direct dispatch.  The decision
+                # runs under _cv (it books outstanding slots); the
+                # returned thunk runs OUTSIDE the lock (it submits).
+                divert = self._divert_plan(req, replica)
+                if divert is None:
+                    replica.outstanding += 1
+                    self._observe_depth(replica)
+            if divert is not None:
+                divert()
+                continue
             try:
                 replica.submit(
                     req.prompt, req.max_new_tokens, req.temperature,
@@ -674,12 +770,20 @@ class ServingFront:
         req.error = err
         req.event.set()
 
-    def _complete(self, req: FrontRequest, handle) -> None:
+    def _complete(self, req: FrontRequest, handle,
+                  role: Optional[str] = None) -> None:
         req.result = handle.result
         req.n_generated = handle.n_generated
         req.t_first_token = handle.t_first_token
         req.t_done = handle.t_done or time.monotonic()
         req.prefix_hit_tokens = getattr(handle, "prefix_hit_tokens", 0)
+        req.served_role = role
+        per_tok = None
+        if (role and req.t_first_token is not None
+                and req.n_generated > 1):
+            per_tok = ((req.t_done - req.t_first_token)
+                       / (req.n_generated - 1))
+        self._note_class_done(role, req.t_done, per_tok)
         with self._lat_lock:
             self._latencies.append(req.t_done - req.t_submit)
             if req.t_first_token is not None:
@@ -704,7 +808,7 @@ class ServingFront:
             self._cv.notify_all()
         err = handle.error
         if err is None:
-            self._complete(req, handle)
+            self._complete(req, handle, role=replica.role)
             return
         if isinstance(err, ValueError):
             self._fail(req, err)  # unservable as posed, retry won't help
@@ -773,6 +877,49 @@ class ServingFront:
 
         return latency_percentiles(self._ttfts, self._lat_lock)
 
+    @property
+    def roles_active(self) -> bool:
+        """True once any replica carries a non-mixed role (the fleet is
+        disaggregated or transitioning)."""
+        return any(r.role != "mixed" for r in self.replicas)
+
+    def class_stats(self) -> Dict[str, Dict]:
+        """Per-role fleet accounting: replica counts, outstanding,
+        measured class service rate, merged TTFT percentiles from each
+        member scheduler's window (the prefill class's TTFT is its
+        internal pass time — there is no client TTFT for it), and
+        per-token decode percentiles from front-side samples."""
+        from .batcher import percentile_summary
+
+        with self._cv:
+            replicas = list(self.replicas)
+        by_role: Dict[str, List[ServingReplica]] = {}
+        for r in replicas:
+            by_role.setdefault(r.role, []).append(r)
+        with self._lat_lock:
+            toks = {k: list(v) for k, v in self._class_tok.items()}
+        out: Dict[str, Dict] = {}
+        for role, members in sorted(by_role.items()):
+            ttfts: List[float] = []
+            for r in members:
+                sched = r.scheduler
+                if sched is None:
+                    continue
+                with sched._lat_lock:
+                    ttfts.extend(sched._ttfts)
+            rate = self.service_rate(role)
+            out[role] = {
+                "replicas": len(members),
+                "live": sum(1 for r in members if r.alive),
+                "outstanding": sum(r.outstanding for r in members),
+                "chips": len(members) * self.chips_per_replica,
+                "service_rate_rps": (round(rate, 3)
+                                     if rate is not None else None),
+                "ttft": percentile_summary(ttfts),
+                "per_token": percentile_summary(toks.get(role, [])),
+            }
+        return out
+
     def health(self) -> Dict:
         """ok = every fleet member live or intentionally draining;
         degraded = a replica is restarting/dead but something still
@@ -784,16 +931,20 @@ class ServingFront:
             replicas = list(self.replicas)
             retired = len(self.retired) + self._retired_dropped
         live = sum(1 for r in replicas if r.alive)
+        serving_live = sum(1 for r in replicas
+                           if r.alive and r.role != "prefill")
         draining = sum(1 for r in replicas if r.state == "draining")
         broken = sum(1 for r in replicas
                      if r.state in ("restarting", "dead"))
-        if self._closed or live == 0:
+        # "down" means no replica can FINISH a client request — a
+        # healthy prefill class cannot keep a decode-less fleet up
+        if self._closed or serving_live == 0:
             status = "down"
         elif broken:
             status = "degraded"
         else:
             status = "ok"
-        return {
+        out = {
             "status": status,
             "replicas_live": live,
             "replicas_draining": draining,
@@ -801,10 +952,20 @@ class ServingFront:
             "terminating": self._terminating,
             "replicas": [
                 {"id": r.replica_id, "state": r.state,
+                 "role": r.role,
                  "restarts": r.restarts, "deaths": r.deaths}
                 for r in replicas
             ],
         }
+        if any(r.role != "mixed" for r in replicas):
+            out["roles"] = {
+                role: {"replicas": sum(1 for r in replicas
+                                       if r.role == role),
+                       "live": sum(1 for r in replicas
+                                   if r.role == role and r.alive)}
+                for role in sorted({r.role for r in replicas})
+            }
+        return out
 
     @property
     def admission_depth(self) -> int:
@@ -852,6 +1013,8 @@ class ServingFront:
             "latency": self.latency_stats(),
             "replicas": replicas,
         }
+        if self.roles_active:
+            out["roles"] = self.class_stats()
         if self.autoscaler is not None:
             out["autoscaler"] = self.autoscaler.stats()
         return out

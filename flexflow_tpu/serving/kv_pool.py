@@ -174,6 +174,8 @@ class KVPool:
         self.prefix_evictions = 0     # cached blocks reclaimed (LRU)
         self.prefix_invalidations = 0  # blocks dropped by a state reset
         self.cow_copies = 0           # tail blocks copy-on-written
+        self.prefix_imports = 0           # adopt_prefix calls that landed
+        self.prefix_imported_blocks = 0   # blocks adopted from migrations
         # the scheduler worker mutates the pool while /v2/stats reads
         # it from HTTP threads — iteration over _tables must not race
         # a retire()'s pop
@@ -536,6 +538,184 @@ class KVPool:
             self._chain.pop(seq_id, None)
             self._tokens_of.pop(seq_id, None)
 
+    def rollback(self, seq_id: int, tokens: int
+                 ) -> Optional[Tuple[int, int]]:
+        """Truncate a LIVE sequence's written positions to a watermark
+        of `tokens` — the speculative-decoding reject path and the KV
+        import-fallback unwind.  Blocks past the watermark leave the
+        table (refcount--, freed or re-cached like retirement); index
+        entries this sequence registered for boundaries the watermark
+        no longer covers are unregistered, so a future prompt can never
+        match content that is about to be overwritten.  The kept
+        partial tail block is made writable: if another table or a
+        surviving index entry still vouches for it, it is copy-on-
+        written and the (src, dst) device copy is returned for the
+        engine to perform; otherwise None.  The admission reservation
+        is untouched (worst case was booked up front), so the sequence
+        can re-extend to its original ceiling."""
+        with self._lock:
+            if seq_id not in self._tables:
+                raise ValueError(f"sequence {seq_id} not admitted")
+            tokens = int(tokens)
+            shared_tok = len(self._shared_of[seq_id]) * self.page_size
+            if tokens < shared_tok:
+                raise ValueError(
+                    f"rollback to {tokens} would cut into the shared-"
+                    f"mapped prefix ({shared_tok} tokens) of sequence "
+                    f"{seq_id}")
+            if tokens > self._tokens_of.get(seq_id, 0):
+                raise ValueError(
+                    f"rollback watermark {tokens} is past sequence "
+                    f"{seq_id}'s written count "
+                    f"{self._tokens_of.get(seq_id, 0)}")
+            page = self.page_size
+            table = self._tables[seq_id]
+            keep = -(-tokens // page)  # ceil; 0 tokens keeps no blocks
+            new_indexed = tokens // page
+            # unregister OUR chain entries past the new watermark (an
+            # adopted entry — another sequence's block — stays: its
+            # content is still globally valid)
+            chain = self._chain.get(seq_id, [])
+            own = set(table) - self._shared_of[seq_id]
+            for e in chain[new_indexed:]:
+                if e.block in own and self._index.get(e.key) is e:
+                    del self._index[e.key]
+                    self._block_key.pop(e.block, None)
+                    self.prefix_invalidations += 1
+            del chain[new_indexed:]
+            if self._indexed_upto.get(seq_id, 0) <= \
+                    self.max_blocks_per_seq:
+                self._indexed_upto[seq_id] = new_indexed
+            # drop the uncovered blocks (shared region is below the
+            # watermark by the guard above, so these are all private)
+            for blk in reversed(table[keep:]):
+                self._ref[blk] -= 1
+                if self._ref[blk] == 0:
+                    del self._ref[blk]
+                    if blk in self._block_key:
+                        self._cached[blk] = None
+                        self._cached.move_to_end(blk)
+                    else:
+                        self._free.append(blk)
+            del table[keep:]
+            self._tokens_of[seq_id] = tokens
+            # the kept partial tail block will be rewritten at
+            # positions >= tokens — copy-on-write it if anything else
+            # still vouches for its content
+            copy = None
+            if tokens % page and keep <= len(table) and keep >= 1:
+                blk = table[keep - 1]
+                if self._ref.get(blk, 0) > 1 or blk in self._block_key:
+                    dst = self._pop_free()
+                    table[keep - 1] = dst
+                    self._ref[dst] = 1
+                    self._ref[blk] -= 1
+                    if self._ref[blk] == 0:
+                        del self._ref[blk]
+                        if blk in self._block_key:
+                            self._cached[blk] = None
+                        else:
+                            self._free.append(blk)
+                    shared = self._shared_of[seq_id]
+                    if blk in shared:
+                        shared.discard(blk)
+                        n = self._shared_pin[blk] - 1
+                        if n:
+                            self._shared_pin[blk] = n
+                        else:
+                            del self._shared_pin[blk]
+                    self.cow_copies += 1
+                    copy = (blk, dst)
+            if self.used_blocks > self.peak_used:
+                self.peak_used = self.used_blocks
+            return copy
+
+    # -- KV block export / import (cross-replica migration) ---------------
+    def export_prefix(self, prompt: Sequence[int]
+                      ) -> Tuple[List[int], List[List[int]]]:
+        """(blocks, pages) for the longest indexed block-aligned prefix
+        of `prompt`: the physical block ids whose device bytes a
+        migration should stream, plus the token page each one holds.
+        Verified through the entry chain exactly like admission — a
+        hash collision can never export foreign bytes.  Caller must be
+        on the scheduler worker thread (the only mutator), so the ids
+        stay valid until the device read completes."""
+        if not self.prefix_cache:
+            return [], []
+        page = self.page_size
+        with self._lock:
+            blocks, _ = self._match_prefix(prompt)
+            pages = [list(int(t) for t in prompt[j * page:(j + 1) * page])
+                     for j in range(len(blocks))]
+            return blocks, pages
+
+    def adopt_prefix(self, prompt: Sequence[int], n_blocks: int
+                     ) -> List[Tuple[int, int]]:
+        """Admit a migrated prefix into THIS pool as shared cached
+        blocks: walk the first `n_blocks` block-aligned pages of
+        `prompt`, reusing any boundary already indexed (identical
+        bytes — the device content is a pure function of the token
+        prefix) and allocating a fresh refcount-0 cached block for each
+        missing one.  Returns the (boundary, block) pairs whose device
+        bytes the caller must write BEFORE the next admission runs —
+        both happen on the scheduler worker thread, so no request can
+        map a block whose bytes have not landed.  Stops early (partial
+        adoption is still a prefix, so still valid) on a foreign hash
+        hit or when the pool has no reclaimable block left."""
+        if not self.prefix_cache:
+            return []
+        page = self.page_size
+        pairs: List[Tuple[int, int]] = []
+        with self._lock:
+            h = _HASH_EMPTY
+            parent: Optional[_PrefixEntry] = None
+            chain_blocks: set = set()  # this adoption's own blocks
+            for j in range(min(int(n_blocks), len(prompt) // page)):
+                seg = prompt[j * page:(j + 1) * page]
+                h = _hash_block(h, seg)
+                e = self._index.get(h)
+                if e is not None:
+                    if e.parent is not parent \
+                            or e.page_bytes != _page_bytes(seg):
+                        break  # foreign collision: never share unverified
+                    if e.block in self._cached:
+                        self._cached.move_to_end(e.block)  # keep chain hot
+                    chain_blocks.add(e.block)
+                    parent = e
+                    continue
+                if not self._free and all(
+                        b in chain_blocks for b in self._cached):
+                    # the only evictable blocks are this chain's own
+                    # (LRU would cannibalize a boundary we just
+                    # adopted): partial adoption, still a valid prefix
+                    break
+                blk = self._pop_free()
+                chain_blocks.add(blk)
+                e = _PrefixEntry(h, blk, parent, _page_bytes(seg))
+                self._index[h] = e
+                self._block_key[blk] = h
+                self._cached[blk] = None
+                self._cached.move_to_end(blk)
+                pairs.append((j, blk))
+                parent = e
+            self.prefix_imported_blocks += len(pairs)
+            if pairs:
+                self.prefix_imports += 1
+        return pairs
+
+    def drop_adopted(self, blocks: Sequence[int]) -> None:
+        """Unwind adopt_prefix after a failed device write: unregister
+        the entries and free the blocks, so no admission can ever map a
+        block whose bytes never landed."""
+        with self._lock:
+            for blk in blocks:
+                if blk in self._cached:
+                    del self._cached[blk]
+                    key = self._block_key.pop(blk, None)
+                    if key is not None:
+                        self._index.pop(key, None)
+                    self._free.append(blk)
+
     def live_sequences(self) -> List[int]:
         with self._lock:
             return list(self._tables)
@@ -592,6 +772,8 @@ class KVPool:
                 "evictions": self.prefix_evictions,
                 "invalidations": self.prefix_invalidations,
                 "cow_copies": self.cow_copies,
+                "imports": self.prefix_imports,
+                "imported_blocks": self.prefix_imported_blocks,
                 "peak_shared_blocks": self.peak_shared,
             }
 

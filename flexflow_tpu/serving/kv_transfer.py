@@ -1,0 +1,382 @@
+"""Cross-replica KV block streaming — the transfer half of the
+disaggregated prefill/decode fleet (serving/disagg.py, DistServe
+arXiv:2401.09670 / Splitwise arXiv:2311.18677).
+
+A migration ships a request's finished BLOCK-ALIGNED prefix from a
+prefill-class replica's pool into a decode-class replica's pool so the
+decode replica admits the request as a prefix-cache hit and never
+re-runs the prompt.  The wire unit is the physical block: every
+layer's [page, heads, d] k/v page for one block boundary, read
+device->host ONCE on the exporting worker thread, crc32-stamped per
+block, and content-keyed by the pool's rolling-hash prefix key — the
+same key admission verifies against, so a torn or foreign payload can
+never be admitted as shared content.
+
+Fault model (inherited from store/blobstore.py's injection): the
+fabric may throw (BLOB_TRANSIENT / BLOB_UNAVAILABLE), stall
+(BLOB_LATENCY), or LAND A TRUNCATED OBJECT (BLOB_PARTIAL_UPLOAD —
+the dangerous one: the put "succeeds").  Every failure mode degrades
+to the same safe outcome: only per-block-crc-verified prefix blocks
+are adopted (a verified PREFIX of a prefix is still a valid prefix);
+everything else re-prefills on the decode replica, which writes
+bit-identical bytes — output is token-identical either way, the
+failure is visible in serving/kv_migration_failed.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import struct
+import threading
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_MAGIC = b"FFKV"
+_VERSION = 1
+
+
+class KVTransferError(Exception):
+    """Torn, truncated, or foreign KV stream payload."""
+
+
+def content_key(prompt: Sequence[int], n_blocks: int,
+                page_size: int) -> str:
+    """Content address of a block-aligned prefix: the pool's rolling
+    hash of the first n_blocks pages (kv_pool's index key), so equal
+    prefixes collide on the fabric by design (idempotent re-sends)."""
+    from .kv_pool import _HASH_EMPTY, _hash_block
+
+    h = _HASH_EMPTY
+    for j in range(n_blocks):
+        h = _hash_block(h, prompt[j * page_size:(j + 1) * page_size])
+    return f"{h:016x}-{n_blocks}b{page_size}p"
+
+
+def pack_kv_blocks(pages: Sequence[Sequence[int]],
+                   blocks: Sequence[Dict[str, np.ndarray]],
+                   page_size: int) -> bytes:
+    """Serialize exported blocks: a JSON header (schema + per-block
+    token pages + per-block crc32 of the raw bytes) followed by each
+    block's arrays concatenated in schema order.  The header carries
+    every crc, so a truncated payload still verifies (and admits) the
+    intact prefix blocks."""
+    if len(pages) != len(blocks):
+        raise ValueError("pages/blocks length mismatch")
+    schema = []
+    if blocks:
+        schema = [{"name": n, "shape": list(a.shape),
+                   "dtype": str(a.dtype)}
+                  for n, a in sorted(blocks[0].items())]
+    payloads: List[bytes] = []
+    crcs: List[int] = []
+    for blk in blocks:
+        raw = b"".join(np.ascontiguousarray(blk[s["name"]]).tobytes()
+                       for s in schema)
+        payloads.append(raw)
+        crcs.append(zlib.crc32(raw))
+    header = json.dumps({
+        "v": _VERSION,
+        "page_size": int(page_size),
+        "pages": [[int(t) for t in p] for p in pages],
+        "schema": schema,
+        "crcs": crcs,
+        "block_bytes": [len(p) for p in payloads],
+    }).encode("utf-8")
+    return b"".join([_MAGIC, struct.pack("<I", len(header)), header]
+                    + payloads)
+
+
+def unpack_kv_blocks(data: bytes, prompt: Sequence[int]
+                     ) -> Tuple[List[Dict[str, np.ndarray]], bool]:
+    """Parse + verify a KV stream against the prompt it claims to
+    serve.  Returns (verified_blocks, complete): only the prefix of
+    blocks whose crc matches AND whose token page equals the prompt's
+    page lands; the first torn block stops the walk (complete=False).
+    A mangled header raises KVTransferError — nothing is adoptable."""
+    if len(data) < 8 or data[:4] != _MAGIC:
+        raise KVTransferError("bad magic: not a KV stream")
+    (hlen,) = struct.unpack("<I", data[4:8])
+    if len(data) < 8 + hlen:
+        raise KVTransferError("truncated header")
+    try:
+        hdr = json.loads(data[8:8 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise KVTransferError(f"mangled header: {e}") from e
+    if hdr.get("v") != _VERSION:
+        raise KVTransferError(f"version {hdr.get('v')} != {_VERSION}")
+    page = int(hdr["page_size"])
+    schema = hdr["schema"]
+    out: List[Dict[str, np.ndarray]] = []
+    complete = True
+    off = 8 + hlen
+    for j, (tokens, crc, nbytes) in enumerate(
+            zip(hdr["pages"], hdr["crcs"], hdr["block_bytes"])):
+        raw = data[off:off + nbytes]
+        off += nbytes
+        want = [int(t) for t in prompt[j * page:(j + 1) * page]]
+        if (len(raw) != nbytes or zlib.crc32(raw) != crc
+                or [int(t) for t in tokens] != want):
+            complete = False
+            break  # later blocks chain through this one: stop
+        arrays: Dict[str, np.ndarray] = {}
+        pos = 0
+        for s in schema:
+            n = int(np.prod(s["shape"])) * np.dtype(s["dtype"]).itemsize
+            arrays[s["name"]] = np.frombuffer(
+                raw[pos:pos + n], dtype=s["dtype"]).reshape(s["shape"])
+            pos += n
+        out.append(arrays)
+    return out, complete
+
+
+# -- transfer fabrics -----------------------------------------------------
+class KVTransferFabric:
+    """One migration hop: ship `data` under `key`, return the bytes as
+    the receiver sees them.  Implementations may throw (unreachable
+    fabric) or return torn bytes (partial upload) — the unpack
+    verification downstream is the only trust boundary."""
+
+    kind = "abstract"
+
+    def transfer(self, key: str, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, int]:
+        return {}
+
+
+class InProcessFabric(KVTransferFabric):
+    """Same-host handoff: the payload bytes move by reference.  Still
+    packed/crc-verified like the cross-host path, so the code path the
+    tests harden is the one production runs."""
+
+    kind = "inproc"
+
+    def __init__(self):
+        self.transfers = 0
+        self.bytes_moved = 0
+
+    def transfer(self, key: str, data: bytes) -> bytes:
+        self.transfers += 1
+        self.bytes_moved += len(data)
+        return data
+
+    def stats(self) -> Dict[str, int]:
+        return {"transfers": self.transfers,
+                "bytes_moved": self.bytes_moved}
+
+
+class BlobStoreFabric(KVTransferFabric):
+    """Cross-host hop over the store tier (store/blobstore.py): put on
+    the exporting side, get on the importing side, best-effort delete
+    after.  Wrapping the store in FaultyBlobStore injects the full PR 9
+    fault matrix into the stream — BLOB_PARTIAL_UPLOAD lands a
+    truncated object that only the reader-side crc check catches."""
+
+    kind = "blob"
+
+    def __init__(self, store, prefix: str = "kvstream/"):
+        self.store = store
+        self.prefix = str(prefix)
+        self.transfers = 0
+        self.bytes_moved = 0
+
+    def transfer(self, key: str, data: bytes) -> bytes:
+        path = self.prefix + key
+        self.store.put(path, data)
+        got = self.store.get(path)
+        try:
+            self.store.delete(path)
+        except Exception:  # noqa: BLE001 — cleanup is best-effort;
+            pass           # a leaked object is re-keyed content
+        self.transfers += 1
+        self.bytes_moved += len(got)
+        return got
+
+    def stats(self) -> Dict[str, int]:
+        return {"transfers": self.transfers,
+                "bytes_moved": self.bytes_moved}
+
+
+def resolve_kv_transfer(spec: str, store=None,
+                        root: Optional[str] = None) -> KVTransferFabric:
+    """Build-time gate for --kv-transfer (the engine.py resolve_*
+    idiom): validate the spec and construct the fabric.  "blob"
+    without an explicit store falls back to a LocalBlobStore under
+    `root` (required then)."""
+    spec = str(spec or "inproc").lower()
+    if spec == "inproc":
+        return InProcessFabric()
+    if spec == "blob":
+        if store is None:
+            if root is None:
+                raise ValueError(
+                    "--kv-transfer blob needs a blob store (or a root "
+                    "path for a LocalBlobStore)")
+            from ..store.blobstore import LocalBlobStore
+
+            store = LocalBlobStore(root)
+        return BlobStoreFabric(store)
+    raise ValueError(
+        f"unknown kv transfer fabric {spec!r}: pick from "
+        "['inproc', 'blob']")
+
+
+class KVMigrator:
+    """Asynchronous migration pipeline: pack -> transfer -> verify ->
+    adopt+write on the importing replica's worker thread.
+
+    The caller (serving/disagg.py's dispatcher) exports the blocks on
+    the SOURCE worker thread (the only thread allowed to read the
+    donated state) and hands the host arrays here; one migrator worker
+    thread then runs the fabric hop off the decode path, and the
+    device writes are marshalled onto the TARGET worker via
+    run_on_worker so they serialize with its steps and admissions.
+
+    `on_done(ok: bool)` fires exactly once per migration, success or
+    any failure — the front requeues the request either way (a failed
+    migration just means the decode replica re-prefills)."""
+
+    def __init__(self, fabric: KVTransferFabric, registry=None,
+                 logger=None):
+        self.fabric = fabric
+        self.registry = registry
+        self.logger = logger
+        self.started = 0
+        self.completed = 0
+        self.failed = 0
+        self.bytes_streamed = 0
+        self.blocks_streamed = 0
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def migrate(self, *, prompt: Sequence[int],
+                pages: Sequence[Sequence[int]],
+                blocks: Sequence[Dict[str, np.ndarray]],
+                page_size: int, target,
+                on_done: Callable[[bool], None]) -> None:
+        """Queue one migration of `blocks` (host arrays exported from
+        the source pool) into `target` (a ContinuousScheduler-shaped
+        engine with .pool and .model)."""
+        self.started += 1
+        if self.registry is not None:
+            self.registry.counter("serving/kv_migration_started").inc()
+        self._jobs.put((list(prompt), list(pages), list(blocks),
+                        int(page_size), target, on_done))
+
+    def close(self) -> None:
+        self._stop.set()
+        self._jobs.put(None)
+        self._worker.join(timeout=5.0)
+        # drain jobs the worker never reached: every on_done must fire
+        # exactly once or a front-side request waits forever
+        while True:
+            try:
+                job = self._jobs.get_nowait()
+            except queue.Empty:
+                break
+            if job is not None:
+                self._fail(job[5], "migrator closed")
+
+    # -- internals --------------------------------------------------------
+    def _fail(self, on_done, why: str, exc: Optional[Exception] = None
+              ) -> None:
+        self.failed += 1
+        if self.registry is not None:
+            self.registry.counter("serving/kv_migration_failed").inc()
+        if self.logger is not None:
+            self.logger.info("kv migration failed (%s): %s",
+                                why, exc if exc is not None else "")
+        try:
+            on_done(False)
+        except Exception:  # noqa: BLE001 — completion hooks never kill
+            pass           # the migrator worker
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            job = self._jobs.get()
+            if job is None:
+                continue
+            prompt, pages, blocks, page, target, on_done = job
+            try:
+                key = content_key(prompt, len(blocks), page)
+                data = pack_kv_blocks(pages, blocks, page)
+                got = self.fabric.transfer(key, data)
+                verified, complete = unpack_kv_blocks(got, prompt)
+            except Exception as e:  # fabric down / torn header
+                self._fail(on_done, "transfer", e)
+                continue
+            if not verified:
+                self._fail(on_done, "no block verified")
+                continue
+            self._import(prompt, verified, complete, len(got),
+                         target, on_done)
+
+    def _import(self, prompt, verified, complete, nbytes, target,
+                on_done) -> None:
+        """Marshal the device writes onto the target's worker thread:
+        adopt_prefix registers the blocks and the writes land before
+        the worker's next admission, so no request can ever map a
+        block whose bytes are still in flight."""
+        def write():
+            pairs = target.pool.adopt_prefix(prompt, len(verified))
+            done = 0
+            try:
+                for j, blk in pairs:
+                    target.model.import_block(blk, verified[j])
+                    done += 1
+            except Exception as e:
+                # unwind the blocks whose bytes never landed — an
+                # admission must never map them
+                target.pool.drop_adopted(
+                    [blk for _, blk in pairs[done:]])
+                self._fail(on_done, "device write", e)
+                if getattr(e, "fatal_to_engine", False):
+                    raise
+                return
+            self.completed += 1
+            self.bytes_streamed += nbytes
+            self.blocks_streamed += len(verified)
+            if self.registry is not None:
+                reg = self.registry
+                if complete:
+                    reg.counter("serving/kv_migration_done").inc()
+                else:
+                    # a torn stream whose verified prefix still landed:
+                    # the request re-prefills the remainder — count the
+                    # failure AND the partial win
+                    reg.counter("serving/kv_migration_failed").inc()
+                    self.failed += 1
+                reg.counter("serving/kv_migration_bytes").inc(nbytes)
+                reg.counter("serving/kv_migration_blocks").inc(
+                    len(verified))
+            elif not complete:
+                self.failed += 1
+            try:
+                on_done(bool(complete))
+            except Exception:  # noqa: BLE001
+                pass
+
+        try:
+            target.run_on_worker(
+                write, on_dropped=lambda err: self._fail(
+                    on_done, "target gone", err))
+        except Exception as e:  # target closed
+            self._fail(on_done, "target closed", e)
+
+    def stats(self) -> Dict[str, int]:
+        out = {
+            "started": self.started,
+            "completed": self.completed,
+            "failed": self.failed,
+            "bytes_streamed": self.bytes_streamed,
+            "blocks_streamed": self.blocks_streamed,
+            "fabric": self.fabric.kind,
+        }
+        out.update({f"fabric_{k}": v
+                    for k, v in self.fabric.stats().items()})
+        return out

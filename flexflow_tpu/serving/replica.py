@@ -86,6 +86,9 @@ class SupervisedDecodeModel:
         if getattr(model, "prefill_step", None) is None:
             self.prefill_chunk = 0
         self._has_copy = getattr(model, "copy_block", None) is not None
+        self._has_export = (
+            getattr(model, "export_block", None) is not None
+            and getattr(model, "import_block", None) is not None)
 
     def reset(self):
         reset = getattr(self._model, "reset", None)
@@ -144,6 +147,23 @@ class SupervisedDecodeModel:
 
         return _copy
 
+    @property
+    def export_block(self):
+        # KV migration surface (serving/kv_transfer.py): eager
+        # host<->device copies on the worker thread, not watchdogged
+        # step dispatches — a wedged device read surfaces on the next
+        # stepped dispatch.  None-propagating capability probe like
+        # copy_block: a fake model without pools disables migration.
+        if not self._has_export:
+            return None
+        return self._model.export_block
+
+    @property
+    def import_block(self):
+        if not self._has_export:
+            return None
+        return self._model.import_block
+
 
 class ServingReplica:
     """One supervised engine slot of a ServingFront.
@@ -179,9 +199,21 @@ class ServingReplica:
         close_timeout_s: float = 5.0,
         sleep: Callable[[float], None] = time.sleep,
         logger=resilience_logger,
+        role: str = "mixed",
+        check_invariants: bool = False,
     ):
         self.replica_id = int(replica_id)
         self.model_factory = model_factory
+        # replica class in a disaggregated fleet (serving/disagg.py):
+        # "prefill" runs prompt passes whose KV migrates out, "decode"
+        # serves client requests, "mixed" (default) does both — the
+        # colocated fleet unchanged
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"replica role {role!r}: pick from "
+                "['prefill', 'decode', 'mixed']")
+        self.role = role
+        self._check_invariants = bool(check_invariants)
         self.eos_id = int(eos_id)
         self.registry = registry
         self.seed = int(seed)
@@ -260,6 +292,7 @@ class ServingReplica:
             seed=self.seed + 7919 * self.replica_id,
             close_timeout_s=self.close_timeout_s,
             on_death=self._on_death,
+            check_invariants=self._check_invariants,
         )
 
     def _on_death(self, exc: Exception) -> None:
@@ -436,6 +469,7 @@ class ServingReplica:
         out = {
             "id": self.replica_id,
             "state": self.state,
+            "role": self.role,
             "restarts": self.restarts,
             "deaths": self.deaths,
             "outstanding": self.outstanding,

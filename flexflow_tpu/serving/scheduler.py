@@ -212,6 +212,40 @@ class PagedKVDecodeModel:
         self._state = self._copy_fn(
             self._state, jnp.int32(src), jnp.int32(dst))
 
+    def export_block(self, block: int) -> Dict[str, np.ndarray]:
+        """Device->host read of ONE physical block across every layer's
+        k/v pool — the migration export path (serving/kv_transfer.py).
+        Keyed "<op>/<k_cache|v_cache>" so import lands each page back
+        in the matching layer.  Worker-thread only: the state pytree is
+        donated to the step programs, so reads must sit between steps."""
+        out: Dict[str, np.ndarray] = {}
+        for name, entries in self._state.items():
+            for k in ("k_cache", "v_cache"):
+                if k in entries:
+                    out[f"{name}/{k}"] = np.asarray(entries[k][block])
+        return out
+
+    def import_block(self, block: int,
+                     arrays: Dict[str, np.ndarray]) -> None:
+        """Host->device write of one migrated block into every layer's
+        pool, sharding-preserving (a tp replica's head-sharded pools
+        keep their NamedSharding — a bare at[].set result could land
+        single-device).  Worker-thread only, like export_block."""
+        import jax
+        import jax.numpy as jnp
+
+        state = {}
+        for name, entries in self._state.items():
+            e = dict(entries)
+            for k in ("k_cache", "v_cache"):
+                if k in e:
+                    v = e[k]
+                    page = jnp.asarray(arrays[f"{name}/{k}"], v.dtype)
+                    e[k] = jax.device_put(v.at[block].set(page),
+                                          v.sharding)
+            state[name] = e
+        self._state = state
+
 
 class _PendingSeq:
     """Future-style handle for one continuous-mode request.  Besides
@@ -349,6 +383,14 @@ class ContinuousScheduler:
                 * int(getattr(model, "num_blocks", 0)))
         self._queue: "queue.Queue[_PendingSeq]" = queue.Queue()
         self._waiting: deque = deque()  # worker-local FIFO admit order
+        # worker-marshalled service calls (KV block import, export):
+        # the state pytree is donated to the step programs, so ONLY the
+        # worker may touch it — run_on_worker() queues a callable the
+        # loop executes between steps
+        self._service: "queue.Queue" = queue.Queue()
+        # measured per-dispatch wall time (EWMA over decode + prefill
+        # dispatches): the disagg dispatcher's re-prefill cost unit
+        self.step_ms_ewma = 0.0
         self._stop = threading.Event()
         self._latencies = deque(maxlen=latency_window)
         self._ttfts = deque(maxlen=latency_window)
@@ -437,6 +479,51 @@ class ContinuousScheduler:
             p._settle()
         return p
 
+    def run_on_worker(self, fn, on_dropped=None) -> None:
+        """Queue `fn` for the decode worker to run between steps — the
+        only thread allowed to touch the model's donated state (KV
+        block import lands here).  `fn` owns its own error handling;
+        an exception it lets escape is treated like a step fault
+        (fatal_to_engine propagates, anything else fails in-flight).
+        If the engine closes/drains/dies before `fn` runs, `on_dropped`
+        fires with the terminal error instead — a caller is never left
+        waiting on a callable that will not run."""
+        if self._stop.is_set():
+            raise RuntimeError("ContinuousScheduler is closed")
+        self._service.put((fn, on_dropped))
+        if self._stop.is_set():  # close() raced the put
+            self._drop_services(RuntimeError(
+                "ContinuousScheduler is closed"))
+
+    def _drop_services(self, err: Exception) -> None:
+        while True:
+            try:
+                fn, on_dropped = self._service.get_nowait()
+            except queue.Empty:
+                return
+            if on_dropped is not None:
+                try:
+                    on_dropped(err)
+                except Exception:  # noqa: BLE001 — drains never mask
+                    pass
+
+    def _run_services(self) -> None:
+        while True:
+            try:
+                fn, on_dropped = self._service.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                fn()
+            except Exception as e:
+                if getattr(e, "fatal_to_engine", False):
+                    raise
+                if on_dropped is not None:
+                    try:
+                        on_dropped(e)
+                    except Exception:  # noqa: BLE001
+                        pass
+
     @property
     def worker_alive(self) -> bool:
         return self._worker.is_alive()
@@ -489,6 +576,7 @@ class ContinuousScheduler:
             "requests_done": self.requests_done,
             "tokens_generated": self.tokens_generated,
             "step_failures": self.step_failures,
+            "step_ms_ewma": round(self.step_ms_ewma, 4),
             "queue_depth": self._queue.qsize() + len(self._waiting),
             "live_sequences": len(live),
             "kv_pool": {
@@ -596,6 +684,7 @@ class ContinuousScheduler:
                 break
             p.error = err
             p._settle()
+        self._drop_services(err)
 
     def _admit(self):
         """Pull arrivals, then admit FIFO into free slots while the
@@ -749,6 +838,14 @@ class ContinuousScheduler:
             # so no future admission maps onto them
             self.pool.invalidate_prefix_cache()
 
+    def _note_step_time(self, dt_s: float) -> None:
+        """EWMA of per-dispatch wall time (decode + chunked-prefill).
+        The disagg dispatcher prices a re-prefill as chunked steps x
+        this measurement (serving/disagg.py)."""
+        ms = dt_s * 1e3
+        self.step_ms_ewma = (ms if self.step_ms_ewma == 0.0
+                             else 0.9 * self.step_ms_ewma + 0.1 * ms)
+
     def _note_kernel_reads(self, blocks: int, dense_blocks: int):
         """Account one fused-kernel dispatch's KV reads: `blocks`
         physical blocks actually streamed vs the `dense_blocks` the
@@ -792,6 +889,7 @@ class ContinuousScheduler:
             slen[i] = live.pos
             btab[i] = self._btab[i]
             plan.append((i, live, upto))
+        t0 = time.monotonic()
         try:
             self.model.prefill_step(tok, slen, btab)
         except Exception as e:
@@ -799,6 +897,7 @@ class ContinuousScheduler:
                 raise
             self._fail_inflight(e)
             return False
+        self._note_step_time(time.monotonic() - t0)
         self.prefill_steps += 1
         if self._paged_kernel == "pallas":
             # the prefill program scans the seq-1 kernel C times per
@@ -831,6 +930,7 @@ class ContinuousScheduler:
     def _decode_loop(self):
         page = self.pool.page_size
         while not self._stop.is_set():
+            self._run_services()
             self._admit()
             if all(s is None for s in self._slots):
                 if (self._draining and not self._waiting
@@ -863,6 +963,7 @@ class ContinuousScheduler:
                 if live.pos and live.pos % page == 0:
                     self.pool.extend(live.seq_id, live.pos + 1)
                     self._btab[i] = self.pool.table_row(live.seq_id)
+            t0 = time.monotonic()
             try:
                 logits = self.model.step(
                     self._tokens, self._slens, self._btab)
@@ -876,6 +977,7 @@ class ContinuousScheduler:
                     raise
                 self._fail_inflight(e)
                 continue
+            self._note_step_time(time.monotonic() - t0)
             self.batches_run += 1
             if self._paged_kernel == "pallas":
                 from ..ops.pallas.paged_attention import blocks_read

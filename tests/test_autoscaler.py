@@ -367,8 +367,8 @@ def test_add_replica_aborts_when_close_races_build():
     orig = front._build_replica
     built = []
 
-    def build_then_lose_race(rid, fault_plan=None):
-        r = orig(rid, fault_plan=fault_plan)
+    def build_then_lose_race(rid, fault_plan=None, role="mixed"):
+        r = orig(rid, fault_plan=fault_plan, role=role)
         built.append(r)
         front.close()  # the fleet sweep happens while we "compiled"
         return r
@@ -717,7 +717,7 @@ def test_spawn_failure_logged_and_cooled_down():
     sc = ServingAutoscaler(front, 1, 4, cooldown_s=5.0,
                            registry=reg, time_fn=lambda: tm[0])
     try:
-        front.add_replica = lambda: (_ for _ in ()).throw(
+        front.add_replica = lambda role="mixed": (_ for _ in ()).throw(
             RuntimeError("device OOM"))
         sc.observe = lambda: sig(t=tm[0], live=1, fleet=1,
                                  queue_per_replica=10.0)
@@ -1035,7 +1035,7 @@ def test_spawn_failures_surface_in_autoscaler_stats():
     sc = ServingAutoscaler(front, 1, 4, cooldown_s=1.0,
                            time_fn=lambda: tm[0])
     try:
-        front.add_replica = lambda: (_ for _ in ()).throw(
+        front.add_replica = lambda role="mixed": (_ for _ in ()).throw(
             RuntimeError("chip budget exhausted: 4 of 4 chip(s) in "
                          "use and a new replica spans 2"))
         sc.observe = lambda: sig(t=tm[0], live=1, fleet=1,
@@ -1045,5 +1045,164 @@ def test_spawn_failures_surface_in_autoscaler_stats():
         assert "chip budget exhausted" in entry["reason"]
         assert sc.spawn_failures == 1
         assert sc.stats()["spawn_failures"] == 1
+    finally:
+        front.close()
+
+
+# -- predictive scaling (--autoscale-predictive) -------------------------
+
+def test_predictive_projects_queue_breach_before_reactive():
+    """An admission-rate slope outpacing the drain rate scales up
+    while the instantaneous queue is still inside the band."""
+    sc = make_scaler(predictive=True, predict_horizon_s=10.0,
+                     queue_high=4.0)
+    action, reason = sc.decide(sig(
+        queue_depth=2, queue_per_replica=1.0,
+        admit_rate_rps=2.0, drain_rate_rps=1.0))
+    assert action == "up" and "projected queue" in reason
+
+
+def test_predictive_off_by_default():
+    sc = make_scaler(queue_high=4.0)
+    action, _ = sc.decide(sig(
+        queue_depth=2, queue_per_replica=1.0,
+        admit_rate_rps=2.0, drain_rate_rps=1.0))
+    assert action == "hold"
+    assert sc.predictive is False
+
+
+def test_predictive_holds_without_growth_or_rate():
+    sc = make_scaler(predictive=True, predict_horizon_s=10.0,
+                     queue_high=4.0)
+    # drain keeps pace: no projected breach
+    action, _ = sc.decide(sig(
+        queue_depth=2, queue_per_replica=1.0,
+        admit_rate_rps=1.0, drain_rate_rps=1.5))
+    assert action == "hold"
+    # no slope measured yet (single sample): predictive stays silent
+    action, _ = sc.decide(sig(
+        queue_depth=2, queue_per_replica=1.0, admit_rate_rps=None))
+    assert action == "hold"
+
+
+def test_observe_measures_admission_slope_with_fake_clock():
+    front = ServingFront(factory, 1, sleep=NO_SLEEP)
+    try:
+        clock = [100.0]
+        sc = ServingAutoscaler(front, min_replicas=1, max_replicas=2,
+                               predictive=True,
+                               time_fn=lambda: clock[0])
+        s0 = sc.observe()
+        assert s0["admit_rate_rps"] is None  # one sample, no slope
+        for p in ([1, 2], [3, 4], [5, 6], [7, 8]):
+            front.generate_async(p, 2).wait(10.0)
+        clock[0] = 102.0
+        s1 = sc.observe()
+        assert s1["admit_rate_rps"] == pytest.approx(2.0)  # 4 in 2s
+        assert s1["drain_rate_rps"] is not None  # completions flowed
+    finally:
+        front.close()
+
+
+# -- role-aware scaling (disaggregated fleets) ---------------------------
+
+def test_roles_queue_breach_grows_prefill_class():
+    sc = make_scaler(queue_high=4.0)
+    action, _ = sc.decide(sig(roles_active=True, queue_per_replica=5.0))
+    assert action == "up" and sc.up_role == "prefill"
+
+
+def test_roles_kv_pressure_grows_decode_class():
+    sc = make_scaler(kv_high=0.85)
+    action, reason = sc.decide(sig(roles_active=True,
+                                   kv_occupancy=0.95))
+    assert action == "up" and sc.up_role == "decode"
+
+
+def test_roles_decode_per_token_slo_grows_decode_class():
+    sc = make_scaler(slo_per_token_s=0.05)
+    action, reason = sc.decide(sig(
+        roles_active=True, outstanding=2, decode_per_token_s=0.2))
+    assert action == "up" and sc.up_role == "decode"
+    assert "per-token" in reason
+    # idle fleet: the per-token window never refreshes, so it is
+    # gated on load exactly like TTFT — never an "up"
+    action, _ = sc.decide(sig(
+        roles_active=True, decode_per_token_s=0.2))
+    assert action != "up"
+
+
+def test_roles_capacity_breach_outranks_ingest_breach():
+    sc = make_scaler(queue_high=4.0, kv_high=0.85)
+    action, _ = sc.decide(sig(roles_active=True, queue_per_replica=9.0,
+                              kv_occupancy=0.95))
+    assert action == "up" and sc.up_role == "decode"
+
+
+def test_mixed_fleet_never_sets_up_role():
+    sc = make_scaler(queue_high=4.0)
+    action, _ = sc.decide(sig(queue_per_replica=5.0))
+    assert action == "up" and sc.up_role is None
+
+
+def test_tick_passes_role_to_add_replica():
+    front = ServingFront(factory, 2, roles=["prefill", "decode"],
+                         sleep=NO_SLEEP)
+    try:
+        added = []
+        real_add = front.add_replica
+        front.add_replica = lambda role="mixed": (
+            added.append(role), real_add(role=role))[1]
+        sc = ServingAutoscaler(front, min_replicas=2, max_replicas=4,
+                               kv_high=0.85, cooldown_s=0.0)
+        sc.observe = lambda: sig(t=float(sc.ticks), live=2, fleet=2,
+                                 roles_active=True, kv_occupancy=0.95)
+        entry = sc.tick()
+        assert entry["action"] == "up" and entry["role"] == "decode"
+        assert added == ["decode"]
+        assert front.replicas[-1].role == "decode"
+    finally:
+        front.close()
+
+
+def test_drain_never_retires_last_decode_capable_replica():
+    front = ServingFront(factory, 2, roles=["prefill", "decode"],
+                         sleep=NO_SLEEP)
+    try:
+        sc = ServingAutoscaler(front, min_replicas=1, max_replicas=4)
+        target = sc._pick_drain_target()
+        # the decode replica may be least loaded, but retiring it
+        # leaves a fleet that can admit and never serve
+        assert target is not None and target.role == "prefill"
+    finally:
+        front.close()
+
+
+def test_drain_prefers_idle_prefill_over_last_decode():
+    """With the decode class at its floor, the drain target is the
+    least-loaded PREFILL replica even when decode is idler."""
+    p1 = types.SimpleNamespace(role="prefill", outstanding=3)
+    p2 = types.SimpleNamespace(role="prefill", outstanding=1)
+    d = types.SimpleNamespace(role="decode", outstanding=0)
+    front = types.SimpleNamespace(registry=None,
+                                  _live=lambda: [p1, p2, d])
+    sc = ServingAutoscaler(front, min_replicas=1, max_replicas=4)
+    assert sc._pick_drain_target() is p2
+    # with two decode-capable replicas the idlest decode is fair game
+    d2 = types.SimpleNamespace(role="decode", outstanding=2)
+    front._live = lambda: [p1, d, d2]
+    assert sc._pick_drain_target() is d
+
+
+def test_from_config_wires_predictive():
+    front = ServingFront(factory, 1, sleep=NO_SLEEP)
+    try:
+        cfg = FFConfig(serving_max_replicas=2,
+                       autoscale_predictive=True)
+        sc = ServingAutoscaler.from_config(front, cfg)
+        assert sc.predictive is True
+        cfg2 = FFConfig(serving_max_replicas=2)
+        assert ServingAutoscaler.from_config(
+            front, cfg2).predictive is False
     finally:
         front.close()

@@ -106,3 +106,29 @@ def test_serving_gspmd_leg_keys_frozen():
     assert needed <= set(leg), sorted(needed - set(leg))
     assert leg["tp"] >= 2  # a tp=1 "replica mesh" measures nothing
     assert leg["heads"] % leg["tp"] == 0  # heads shard over the mesh
+
+
+def test_serving_disagg_leg_keys_frozen():
+    """The v21 disaggregated-fleet leg is round-over-round comparable
+    only with its workload geometry AND its cost-model knobs pinned:
+    every TPU-shape key bench_serving_disagg reads must exist, the
+    sub-page mix must actually sit below the page size (or the
+    guaranteed re-prefill side vanishes), and the fabric/cap are
+    frozen — a silent change would move the migrate/re-prefill
+    crossover."""
+    manifest, _ = _load()
+    leg = manifest["legs"]["serving_disagg"]
+    needed = {"vocab", "max_seq", "hidden", "layers", "heads",
+              "intermediate", "slots", "kv_page_size", "requests",
+              "offered_rps", "prefill_chunk", "num_prefixes",
+              "prefix_len", "tail_range", "max_new_range",
+              "subpage_requests", "subpage_len_range", "roles",
+              "kv_transfer", "migration_cost_cap"}
+    assert needed <= set(leg), sorted(needed - set(leg))
+    # the sub-page prompts must stay sub-page: randint's exclusive
+    # high bound at most the page size
+    assert leg["subpage_len_range"][1] <= leg["kv_page_size"]
+    # multi-page shared prefixes: the migrate side needs blocks to ship
+    assert leg["prefix_len"] >= 2 * leg["kv_page_size"]
+    assert leg["roles"] == "prefill=1,decode=1"
+    assert leg["migration_cost_cap"] > 0

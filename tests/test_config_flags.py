@@ -497,3 +497,34 @@ def test_resolve_serving_tp_rejects_bad_degrees():
         resolve_serving_tp(3, num_heads=4, visible_devices=8)
     with pytest.raises(ConfigError, match="exceeds the 2 visible"):
         resolve_serving_tp(4, num_heads=4, visible_devices=2)
+
+
+def test_disagg_cli_flags_parse():
+    cfg = FFConfig.from_args([
+        "--serving-roles", "prefill=1,decode=2",
+        "--kv-transfer", "blob",
+        "--migration-cost-cap", "2.5",
+        "--autoscale-predictive",
+    ])
+    assert cfg.serving_roles == "prefill=1,decode=2"
+    assert cfg.kv_transfer == "blob"
+    assert cfg.migration_cost_cap == 2.5
+    assert cfg.autoscale_predictive is True
+    base = FFConfig.from_args([])
+    assert base.serving_roles == ""  # colocated fleet
+    assert base.kv_transfer == "inproc"
+    assert base.migration_cost_cap == 1.0
+    assert base.autoscale_predictive is False
+
+
+def test_disagg_config_validated():
+    with pytest.raises(ValueError, match="decode-capable"):
+        FFConfig(serving_roles="prefill=2")
+    with pytest.raises(ValueError, match="unknown role"):
+        FFConfig(serving_roles="verify=1")
+    with pytest.raises(ValueError, match="kv_transfer"):
+        FFConfig(kv_transfer="ftp")
+    with pytest.raises(ValueError, match="cost"):
+        FFConfig(migration_cost_cap=0.0)
+    # a valid roles spec constructs fine
+    assert FFConfig(serving_roles="prefill=1,decode=1") is not None

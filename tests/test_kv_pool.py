@@ -439,3 +439,192 @@ def test_property_random_interleaving_with_sharing():
     pool.check_invariants()
     assert pool.used_blocks == 0 and pool.reserved_blocks == 0
     assert pool.peak_shared > 0
+
+
+# -- rollback (speculative reject / import unwind) -----------------------
+
+def test_rollback_truncates_blocks_and_reextends():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    assert pool.try_admit(1, 16)
+    pool.extend(1, 12, written=12)
+    assert len(pool.table_of(1)) == 3
+    assert pool.rollback(1, 5) is None  # nothing else vouches
+    assert len(pool.table_of(1)) == 2  # ceil(5/4)
+    pool.check_invariants()
+    # the reservation survived: the sequence re-extends to its ceiling
+    pool.extend(1, 16, written=16)
+    assert len(pool.table_of(1)) == 4
+    pool.retire(1)
+    pool.check_invariants()
+    assert pool.used_blocks == 0
+
+
+def test_rollback_to_zero_keeps_no_blocks():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    assert pool.try_admit(1, 8)
+    pool.extend(1, 8, written=8)
+    pool.rollback(1, 0)
+    assert pool.table_of(1) == []
+    assert pool.used_blocks == 0
+    pool.check_invariants()
+
+
+def test_rollback_guards():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    with pytest.raises(ValueError, match="not admitted"):
+        pool.rollback(42, 0)
+    assert pool.try_admit(1, 8)
+    pool.extend(1, 6, written=6)
+    with pytest.raises(ValueError, match="past sequence"):
+        pool.rollback(1, 7)  # only 6 tokens written
+
+
+def test_rollback_never_cuts_into_shared_prefix():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8]
+    assert pool.try_admit(1, 10, prompt=prompt)
+    pool.extend(1, 8, written=8)
+    pool.retire(1, tokens=prompt)  # blocks cached + indexed
+    assert pool.try_admit(2, 10, prompt=prompt)  # shares block 0
+    hit = pool.admit_hit_tokens(2)
+    assert hit >= 4
+    pool.extend(2, 8, written=8)
+    with pytest.raises(ValueError, match="shared-"):
+        pool.rollback(2, hit - 1)
+    pool.check_invariants()
+
+
+def test_rollback_unregisters_stale_index_entries():
+    """A rolled-back boundary must leave the prefix index: its block's
+    content is about to be overwritten, so a future prompt matching it
+    would adopt garbage."""
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8]
+    assert pool.try_admit(1, 12, prompt=prompt)
+    pool.extend(1, 8, written=8)  # both prompt blocks indexed
+    assert pool.cached_prefix_tokens(prompt) == 8
+    pool.rollback(1, 4)
+    assert pool.cached_prefix_tokens(prompt) == 4  # boundary 1 gone
+    assert pool.prefix_stats()["invalidations"] >= 1
+    pool.check_invariants()
+
+
+def test_rollback_cow_tail_still_vouched_elsewhere():
+    """Rolling back into a partial tail block another live table still
+    maps must copy-on-write: the survivor's bytes stay immutable."""
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8]
+    assert pool.try_admit(1, 12, prompt=prompt)
+    pool.extend(1, 8, written=8)
+    blk0 = pool.table_of(1)[0]
+    assert pool.try_admit(2, 10, prompt=prompt)  # maps blk0 (ref 2)
+    copy = pool.rollback(1, 2)  # partial tail inside shared blk0
+    assert copy is not None and copy[0] == blk0
+    assert pool.table_of(1)[0] == copy[1] != blk0
+    assert blk0 in pool.table_of(2)  # survivor untouched
+    pool.check_invariants()
+
+
+def test_property_random_interleaving_with_rollback():
+    """Block conservation under admit/extend/ROLLBACK/retire: rollback
+    frees exactly the uncovered blocks and the reservation lets every
+    rolled-back sequence regrow to its original ceiling."""
+    rng = np.random.RandomState(11)
+    pool = KVPool(num_blocks=33, page_size=4, max_blocks_per_seq=8)
+    live = {}  # sid -> [target_tokens, written_tokens]
+    next_id = 0
+    rollbacks = 0
+    for _ in range(2500):
+        op = rng.randint(4)
+        if op == 0:  # admit
+            target = int(rng.randint(1, 33))
+            if pool.try_admit(next_id, target):
+                live[next_id] = [target, 0]
+            next_id += 1
+        elif op == 1 and live:  # grow a token
+            sid = list(live)[rng.randint(len(live))]
+            target, cur = live[sid]
+            if cur < target:
+                pool.extend(sid, cur + 1)
+                pool.note_written(sid, cur + 1)
+                live[sid][1] = cur + 1
+        elif op == 2 and live:  # roll back to a random watermark
+            sid = list(live)[rng.randint(len(live))]
+            cur = live[sid][1]
+            if cur:
+                to = int(rng.randint(0, cur + 1))
+                assert pool.rollback(sid, to) is None  # nothing shared
+                live[sid][1] = to
+                rollbacks += 1
+        elif op == 3 and live:  # retire
+            sid = list(live)[rng.randint(len(live))]
+            del live[sid]
+            pool.retire(sid)
+        pool.check_invariants()
+        assert pool.used_blocks == sum(
+            len(pool.table_of(s)) for s in live)
+    assert rollbacks > 100
+    for sid in list(live):
+        pool.retire(sid)
+    pool.check_invariants()
+    assert pool.used_blocks == 0 and pool.reserved_blocks == 0
+
+
+# -- KV export / adopt (cross-replica migration) -------------------------
+
+def test_export_prefix_returns_indexed_blocks_and_pages():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8, 6]
+    assert pool.try_admit(1, 12, prompt=prompt)
+    pool.extend(1, 9, written=9)  # 2 full prompt blocks indexed
+    blocks, pages = pool.export_prefix(prompt)
+    assert blocks == pool.table_of(1)[:2]
+    assert pages == [[3, 5, 7, 2], [9, 4, 1, 8]]  # sub-page 6 excluded
+    assert pool.export_prefix([9] * 8) == ([], [])  # foreign prompt
+
+
+def test_adopt_prefix_is_a_real_cache_hit():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8]
+    pairs = pool.adopt_prefix(prompt, 2)
+    assert [j for j, _ in pairs] == [0, 1]
+    assert pool.cached_prefix_tokens(prompt) == 8
+    assert pool.prefix_stats()["imported_blocks"] == 2
+    # a real admission maps the adopted blocks
+    assert pool.try_admit(1, 10, prompt=prompt)
+    assert pool.admit_hit_tokens(1) >= 4
+    pool.check_invariants()
+
+
+def test_adopt_prefix_reuses_existing_boundaries():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8]
+    assert len(pool.adopt_prefix(prompt, 2)) == 2
+    assert pool.adopt_prefix(prompt, 2) == []  # nothing new to write
+    assert pool.cached_prefix_tokens(prompt) == 8
+    pool.check_invariants()
+
+
+def test_adopt_prefix_partial_on_capacity_exhaustion():
+    pool = KVPool(num_blocks=4, page_size=4, max_blocks_per_seq=3)
+    assert pool.try_admit(1, 8)
+    pool.extend(1, 8)  # 2 of 3 usable blocks pinned live
+    pairs = pool.adopt_prefix([3, 5, 7, 2, 9, 4, 1, 8], 2)
+    assert len(pairs) == 1  # partial adoption is still a prefix
+    assert pool.cached_prefix_tokens([3, 5, 7, 2, 9, 4, 1, 8]) == 4
+    pool.check_invariants()
+
+
+def test_drop_adopted_unwinds_cleanly():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8]
+    pairs = pool.adopt_prefix(prompt, 2)
+    pool.drop_adopted([blk for _, blk in pairs])
+    assert pool.cached_prefix_tokens(prompt) == 0
+    pool.check_invariants()
+    # every block is reclaimable again
+    assert pool.try_admit(1, 16)
+    assert pool.try_admit(2, 16)
+    pool.extend(1, 16)
+    pool.extend(2, 16)
+    assert pool.used_blocks == 8

@@ -195,6 +195,25 @@ def summarize(records: List[Dict]) -> str:
             f"kv_block_bytes_per_chip={int(per_blk.get('value', 0))} "
             f"kv_pool_bytes_per_chip={int(per_pool.get('value', 0))}",
         ))
+    # disaggregated fleet (docs/SERVING.md "Disaggregated fleet"):
+    # one composite line when the dispatcher ever costed a handoff —
+    # migrate/re-prefill decisions plus the KV stream counters
+    mig = metrics.get("serving/disagg_migrate_decisions")
+    rep = metrics.get("serving/disagg_reprefill_decisions")
+    if mig is not None or rep is not None:
+        done = metrics.get("serving/kv_migration_done", {})
+        failed = metrics.get("serving/kv_migration_failed", {})
+        mig_bytes = metrics.get("serving/kv_migration_bytes", {})
+        mig_blocks = metrics.get("serving/kv_migration_blocks", {})
+        rows.append((
+            "disaggregated fleet",
+            f"migrate={int((mig or {}).get('value', 0))} "
+            f"reprefill={int((rep or {}).get('value', 0))} "
+            f"migrations_done={int(done.get('value', 0))} "
+            f"failed={int(failed.get('value', 0))} "
+            f"bytes={int(mig_bytes.get('value', 0))} "
+            f"blocks={int(mig_blocks.get('value', 0))}",
+        ))
     # fused paged kernel (docs/SERVING.md "Fused paged attention"):
     # one composite read-traffic line when the kernel formulation ran
     blocks = metrics.get("serving/paged_kernel_blocks_read")
