@@ -55,6 +55,14 @@ PAGED_KERNELS = ("gather", "pallas")
 # same-host handoff, "blob" = store-tier hop (store/blobstore.py).
 KV_TRANSFER_FABRICS = ("inproc", "blob")
 
+# valid FFConfig.spec_decode values (docs/SERVING.md "Speculative
+# decoding"): "off" = one dispatch per generated token; "ngram" =
+# prompt-lookup drafter mining the request's own tokens; "draft" = a
+# smaller GPT from the same builder drafting through its own paged
+# decode engine.  Both verify through the chunk-twin program and
+# accept greedily, so output stays token-identical to "off".
+SPEC_DECODE_MODES = ("off", "ngram", "draft")
+
 
 class ConfigError(ValueError):
     """A configuration that can never run in this build/runtime —
@@ -119,6 +127,38 @@ def resolve_serving_tp(
             f"device(s) — a replica's mesh spans tp chips, so tp must "
             f"be <= the device count available to it")
     return tp
+
+
+def resolve_spec_decode(
+    spec_decode: str,
+    spec_k: int,
+    beam_size: int = 1,
+) -> str:
+    """Validate a speculative-decoding configuration at BUILD time
+    (docs/SERVING.md "Speculative decoding").  Returns the validated
+    mode.  Speculation verifies drafts by accepting the longest
+    GREEDY-matching prefix, which is only meaningful for single-path
+    decoding — a beam consumer (gpt_beam_search_cached keeps multiple
+    live hypotheses per step) must pass its beam_size here so the
+    incompatible combination raises ConfigError with the fix spelled
+    out instead of silently decoding the wrong thing."""
+    if spec_decode not in SPEC_DECODE_MODES:
+        raise ConfigError(
+            f"--spec-decode must be one of {SPEC_DECODE_MODES}, "
+            f"got {spec_decode!r}")
+    if spec_decode != "off":
+        if int(spec_k) < 1:
+            raise ConfigError(
+                f"--spec-k must be >= 1 when --spec-decode is "
+                f"{spec_decode!r}, got {spec_k}")
+        if int(beam_size) > 1:
+            raise ConfigError(
+                f"--spec-decode {spec_decode!r} cannot be combined "
+                f"with beam search (beam_size={beam_size}): "
+                f"verification accepts the longest greedy-matching "
+                f"draft prefix, which has no analogue across beam "
+                f"hypotheses — use --spec-decode off for beam decoding")
+    return spec_decode
 
 
 @dataclasses.dataclass
@@ -405,6 +445,18 @@ class FFConfig:
     # the measured admission-rate slope and scale BEFORE the reactive
     # queue threshold breaches (serving/autoscaler.py)
     autoscale_predictive: bool = False
+    # speculative decoding (serving/speculative.py, docs/SERVING.md
+    # "Speculative decoding"): propose up to spec_k draft tokens per
+    # eligible slot per round and verify them in ONE chunk-twin
+    # dispatch, accepting the longest greedy-matching prefix plus the
+    # first corrected token — token-identical to "off" by
+    # construction.  "ngram" mines the request's own prompt+generated
+    # tokens (no second model); "draft" runs a smaller GPT through its
+    # own paged decode engine (needs a draft model at engine build).
+    # Acceptance-rate-adaptive k shrinks toward 1 when drafts miss, so
+    # the feature is never worse than one-token decode.
+    spec_decode: str = "off"
+    spec_k: int = 4
 
     def __post_init__(self):
         if self.serving_mode not in SERVING_MODES:
@@ -516,6 +568,15 @@ class FFConfig:
             raise ValueError(
                 f"migration_cost_cap must be > 0, "
                 f"got {self.migration_cost_cap}"
+            )
+        if self.spec_decode not in SPEC_DECODE_MODES:
+            raise ValueError(
+                f"spec_decode must be one of {SPEC_DECODE_MODES}, "
+                f"got {self.spec_decode!r}"
+            )
+        if self.spec_k < 1:
+            raise ValueError(
+                f"spec_k must be >= 1, got {self.spec_k}"
             )
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(
@@ -794,6 +855,9 @@ class FFConfig:
                        type=float, default=1.0)
         p.add_argument("--autoscale-predictive",
                        dest="autoscale_predictive", action="store_true")
+        p.add_argument("--spec-decode", dest="spec_decode", type=str,
+                       default="off", choices=SPEC_DECODE_MODES)
+        p.add_argument("--spec-k", dest="spec_k", type=int, default=4)
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -885,6 +949,8 @@ class FFConfig:
             kv_transfer=args.kv_transfer,
             migration_cost_cap=args.migration_cost_cap,
             autoscale_predictive=args.autoscale_predictive,
+            spec_decode=args.spec_decode,
+            spec_k=args.spec_k,
         )
 
 

@@ -606,6 +606,78 @@ def build_paged_prefill_step(ffd: FFModel, chunk: int):
         return jax.jit(prefill, donate_argnums=(1,))
 
 
+def build_paged_verify_step(ffd: FFModel, chunk: int):
+    """ONE compiled [slots, C] speculative-VERIFY program for the paged
+    decode twin (docs/SERVING.md "Speculative decoding"):
+
+        verify(weights, state, tokens[b, C], positions[b], counts[b],
+               block_table)
+            -> (logits [b, C, vocab], new_state)
+
+    Row i feeds tokens[i, :counts[i]] at positions[i] .. positions[i] +
+    counts[i] - 1 — its pending next token followed by counts[i]-1
+    draft tokens — and gets the model's logits at EVERY fed position
+    back, so the scheduler can accept the longest greedy-matching draft
+    prefix plus the first corrected token from a single dispatch.
+    Steps j >= counts[i] are routed to the scratch block (zeroed table
+    row, clamped position) exactly like chunked prefill's pad tokens,
+    so short rows ride a long row's round without touching their own
+    pool bytes; counts is a traced argument, so ONE program serves
+    every per-round draft-length mix.
+
+    BIT-IDENTITY DISCIPLINE: same as build_paged_prefill_step — a
+    lax.scan of the SEQ-1 decode graph, every op at the decode
+    program's shapes, so both the K/V bytes written and the per-step
+    logits are bit-identical to feeding the same tokens one decode
+    step at a time.  Greedy acceptance over bit-identical logits makes
+    speculative output token-identical to the plain engine BY
+    CONSTRUCTION (Leviathan et al., arXiv:2211.17192, the temperature
+    0 case), under both the gather and Pallas kernel formulations."""
+    import jax
+    import jax.numpy as jnp
+
+    if chunk < 2:
+        raise ValueError(f"chunk must be >= 2, got {chunk}")
+    ex = ffd.executor
+    max_seq = _gpt_dims(ffd)["max_seq"]
+
+    def verify(weights, state, tokens, positions, counts, block_table):
+        def body(carry, xs):
+            tok, j = xs
+            pos_j = (positions + j).astype(jnp.int32)
+            live = (j < counts) & (pos_j < max_seq)
+            # pad steps (j >= counts[i]) write to scratch at a clamped
+            # position — same contract as prefill's trailing pads: the
+            # row's real blocks must be unreachable from a pad step no
+            # matter the gather/scatter out-of-range mode.
+            bt_j = jnp.where(live[:, None], block_table, 0)
+            pos_j = jnp.where(live, pos_j, 0)
+            st = {
+                op: {
+                    k: (bt_j if k == "block_table"
+                        else pos_j if k == "seq_lens" else v)
+                    for k, v in entries.items()
+                }
+                for op, entries in carry.items()
+            }
+            logits, new_state, _, _ = ex.run_forward(
+                weights, st,
+                {"input": tok[:, None], "positions": pos_j[:, None]},
+                training=False, rng=None,
+            )
+            return new_state, logits[:, 0]
+
+        state, logits = jax.lax.scan(
+            body, state,
+            (jnp.swapaxes(tokens, 0, 1),
+             jnp.arange(chunk, dtype=jnp.int32)),
+        )
+        return jnp.swapaxes(logits, 0, 1), state
+
+    with ex.mesh:
+        return jax.jit(verify, donate_argnums=(1,))
+
+
 def build_paged_chunk_step(ffd: FFModel):
     """Step function for a CHUNKED paged twin built with
     make_gpt_decoder(step_tokens=C): one true seq-C forward per call,

@@ -36,6 +36,15 @@ other — and streams each request's finished KV blocks across replicas
 through a `KVTransferFabric` (serving/kv_transfer.py), costing every
 handoff against re-prefilling with the topology model's interconnect
 terms.  Token-identical to the colocated fleet by construction.
+
+Speculative decoding (docs/SERVING.md "Speculative decoding") rides the
+chunk twin: a `Proposer` (`NGramProposer` mining the request's own
+context, or `DraftModelProposer` running a smaller GPT on its own paged
+engine) drafts k tokens per greedy slot, one multi-position verify
+dispatch scores them, and the scheduler accepts the longest matching
+prefix plus the corrected token — token-identical to plain decode at
+temperature 0 by construction, with `AdaptiveK` shrinking k when
+acceptance drops so the feature is never worse than baseline.
 """
 from .autoscaler import ServingAutoscaler
 from .batcher import DynamicBatcher
@@ -50,6 +59,8 @@ from .kv_transfer import (BlobStoreFabric, InProcessFabric, KVMigrator,
 from .replica import ServingReplica, SupervisedDecodeModel
 from .scheduler import ContinuousScheduler, PagedKVDecodeModel
 from .server import serve_http
+from .speculative import (AdaptiveK, DraftModelProposer, NGramProposer,
+                          Proposer, build_proposer)
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "GenerationEngine",
            "GenerationBatcher", "ContinuousScheduler",
@@ -58,4 +69,6 @@ __all__ = ["InferenceEngine", "DynamicBatcher", "GenerationEngine",
            "FrontRequest", "ServiceUnavailable", "ServingAutoscaler",
            "DisaggServingFront", "MigrationCostModel", "build_front",
            "parse_serving_roles", "KVTransferFabric", "KVMigrator",
-           "InProcessFabric", "BlobStoreFabric", "resolve_kv_transfer"]
+           "InProcessFabric", "BlobStoreFabric", "resolve_kv_transfer",
+           "Proposer", "NGramProposer", "DraftModelProposer",
+           "AdaptiveK", "build_proposer"]

@@ -269,7 +269,7 @@ class ServingFront:
     def from_trained(cls, ff_train, num_replicas: Optional[int] = None,
                      *, devices=None, eos_id: int = -1, registry=None,
                      fault_plans: Optional[Dict[int, FaultPlan]] = None,
-                     **kw) -> "ServingFront":
+                     draft_ff=None, **kw) -> "ServingFront":
         """Replicated front over a trained GPT, honoring the FFConfig
         serving knobs (--serving-replicas / --serving-step-timeout /
         --serving-max-restarts / --request-retry-limit plus the PR 6
@@ -277,15 +277,44 @@ class ServingFront:
         twin; with the strategy store configured the N-1 later compiles
         (and every post-death rebuild) restore instead of re-searching
         (docs/STORE.md).  A device-loss rebuild truncates `devices` to
-        the surviving count."""
+        the surviving count.
+
+        `draft_ff` is the smaller trained GPT that --spec-decode draft
+        drafts with (docs/SERVING.md "Speculative decoding"); each
+        replica builds its own single-chip draft twin from it.
+        Required when cfg.spec_decode == "draft" — validated HERE so
+        the missing drafter is a build-time ConfigError, not a
+        per-replica death loop."""
+        from ..config import resolve_spec_decode
         from .scheduler import PagedKVDecodeModel
 
         cfg = ff_train.config
+        spec_decode = resolve_spec_decode(
+            getattr(cfg, "spec_decode", "off"),
+            getattr(cfg, "spec_k", 4))
+        spec_k = int(getattr(cfg, "spec_k", 4))
+        if spec_decode == "draft" and draft_ff is None:
+            from ..config import ConfigError
+
+            raise ConfigError(
+                "--spec-decode draft needs a draft model: pass "
+                "ServingFront.from_trained(..., draft_ff=<smaller "
+                "trained GPT>) or use --spec-decode ngram")
 
         def factory(replica_id, survivors=None):
             devs = devices
             if survivors is not None and devs is not None:
                 devs = devs[:survivors]
+            draft_model = None
+            if spec_decode == "draft":
+                draft_model = PagedKVDecodeModel(
+                    draft_ff,
+                    batch_slots=cfg.serving_slots,
+                    page_size=cfg.kv_page_size,
+                    devices=devs,
+                    paged_kernel=getattr(cfg, "paged_kernel",
+                                         "gather"),
+                )
             return PagedKVDecodeModel(
                 ff_train,
                 batch_slots=cfg.serving_slots,
@@ -296,6 +325,9 @@ class ServingFront:
                 prefix_cache=getattr(cfg, "prefix_cache", True),
                 paged_kernel=getattr(cfg, "paged_kernel", "gather"),
                 tp=getattr(cfg, "serving_tp", 1),
+                spec_decode=spec_decode,
+                spec_k=spec_k,
+                draft_model=draft_model,
             )
 
         kw.setdefault("step_timeout", cfg.serving_step_timeout)

@@ -88,6 +88,49 @@ def sample_shared_prefix_workload(rng: np.random.RandomState,
     return reqs, prefixes
 
 
+def sample_repetitive_workload(rng: np.random.RandomState,
+                               n_requests: int, vocab_size: int,
+                               num_templates: int = 4,
+                               phrase_len: int = 6,
+                               phrases_per_template: int = 3,
+                               prompt_phrases_range=(3, 6),
+                               max_new_range=(8, 24)):
+    """Seeded workload with HIGH n-gram self-overlap: each prompt is a
+    concatenation of phrases drawn from a tiny per-template phrase pool,
+    so the same `phrase_len`-grams recur many times inside one prompt.
+    That is the shape prompt-lookup speculative decoding feeds on — a
+    model trained on this distribution keeps emitting token runs that
+    already appear earlier in the request's own context, so the n-gram
+    proposer's drafts keep getting accepted.  Same seed -> same phrase
+    pools, same request list (the bench-vs-baseline replay contract).
+    Returns (requests, templates) where templates[i] is the phrase pool
+    request i drew from."""
+    if num_templates < 1:
+        raise ValueError(f"num_templates must be >= 1, got {num_templates}")
+    if phrase_len < 2:
+        raise ValueError(f"phrase_len must be >= 2, got {phrase_len}")
+    if phrases_per_template < 1:
+        raise ValueError(
+            f"phrases_per_template must be >= 1, got {phrases_per_template}")
+    pools = [[rng.randint(0, vocab_size, phrase_len).tolist()
+              for _ in range(phrases_per_template)]
+             for _ in range(num_templates)]
+    reqs = []
+    templates = []
+    for _ in range(n_requests):
+        t = int(rng.randint(num_templates))
+        pool = pools[t]
+        n_phrases = int(rng.randint(prompt_phrases_range[0],
+                                    prompt_phrases_range[1] + 1))
+        prompt = []
+        for _ in range(n_phrases):
+            prompt.extend(pool[int(rng.randint(len(pool)))])
+        mnt = int(rng.randint(max_new_range[0], max_new_range[1] + 1))
+        reqs.append((prompt, mnt))
+        templates.append(t)
+    return reqs, templates
+
+
 def arrival_gaps(rng: np.random.RandomState, n: int, rate_rps: float,
                  pattern: str = "poisson", *,
                  ramp_to: Optional[float] = None,
@@ -233,6 +276,14 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
             # prompt tokens the KV prefix cache served (zero prefill
             # steps) — the serving_prefix bench leg buckets on these
             rec["prefix_hit_tokens"] = int(hit)
+        prop = getattr(h, "spec_proposed", None)
+        if prop is not None:
+            # draft tokens this request put through verification and
+            # how many the target accepted — the serving_spec bench
+            # leg derives per-request accept rates from these
+            rec["spec_proposed"] = int(prop)
+            rec["spec_accepted"] = int(
+                getattr(h, "spec_accepted", 0) or 0)
         if record_tokens:
             # token-identity audits (the autoscale leg proves zero
             # requests were corrupted by a drain) need the completions

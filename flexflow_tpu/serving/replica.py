@@ -83,8 +83,22 @@ class SupervisedDecodeModel:
         self.mesh_shape = dict(getattr(model, "mesh_shape", {}) or {})
         self.kv_block_bytes_per_chip = getattr(
             model, "kv_block_bytes_per_chip", self.kv_block_bytes)
+        # speculative surface (docs/SERVING.md "Speculative
+        # decoding"): mode/k/verify geometry proxied; the draft twin
+        # is handed through RAW — its dispatches belong to the
+        # proposer and are fault-isolated there (a draft death
+        # degrades to plain decode, it never counts against this
+        # replica's fault plan or watchdog)
+        self.spec_decode = getattr(model, "spec_decode", "off")
+        self.spec_k = getattr(model, "spec_k", 0)
+        self.verify_chunk = getattr(model, "verify_chunk", 0)
+        self.draft_model = getattr(model, "draft_model", None)
         if getattr(model, "prefill_step", None) is None:
             self.prefill_chunk = 0
+        self._has_verify = (self.spec_decode != "off" and getattr(
+            model, "verify_step", None) is not None)
+        if not self._has_verify:
+            self.spec_decode = "off"
         self._has_copy = getattr(model, "copy_block", None) is not None
         self._has_export = (
             getattr(model, "export_block", None) is not None
@@ -123,6 +137,34 @@ class SupervisedDecodeModel:
         except FATAL_DECODE_FAULTS as e:
             e.fatal_to_engine = True
             raise
+
+    @property
+    def verify_step(self):
+        # speculative verify is a decode-fleet dispatch like any step:
+        # fault injection and the hang watchdog see it under the same
+        # replica-lifetime step index, and a hung/lost-device verify is
+        # marked fatal so the scheduler drains-and-dies into a
+        # supervised restart.  A TRANSIENT verify fault stays
+        # non-fatal: the scheduler disables speculation and the
+        # in-flight slots continue on the plain decode path.
+        # None-propagating capability probe like copy_block.
+        if not self._has_verify:
+            return None
+
+        def _verify(tokens, seq_lens, counts, block_tables):
+            idx = next(self._steps)
+            try:
+                self._fault_plan.check_step(idx)
+                return self._watchdog.sync(
+                    lambda: self._model.verify_step(
+                        tokens, seq_lens, counts, block_tables),
+                    step=idx,
+                )
+            except FATAL_DECODE_FAULTS as e:
+                e.fatal_to_engine = True
+                raise
+
+        return _verify
 
     @property
     def copy_block(self):

@@ -87,15 +87,21 @@ class PagedKVDecodeModel:
                  page_size: int = 16, num_blocks: Optional[int] = None,
                  devices=None, prefill_chunk: int = 0,
                  prefix_cache: bool = True,
-                 paged_kernel: str = "gather", tp: int = 1):
-        from ..config import resolve_serving_tp
+                 paged_kernel: str = "gather", tp: int = 1,
+                 spec_decode: str = "off", spec_k: int = 4,
+                 draft_model=None):
+        from ..config import (ConfigError, resolve_serving_tp,
+                              resolve_spec_decode)
         from ..decoding import (_gpt_dims, build_paged_copy_block,
                                 build_paged_decode_step,
                                 build_paged_prefill_step,
+                                build_paged_verify_step,
                                 make_gpt_decoder)
         from .engine import resolve_paged_formulation
 
         self.paged_kernel = resolve_paged_formulation(paged_kernel)
+        self.spec_decode = resolve_spec_decode(spec_decode, spec_k)
+        self.spec_k = int(spec_k)
         dims = _gpt_dims(ff_train)
         # tensor-parallel replica degree (docs/SERVING.md
         # "Tensor-parallel replicas"): the decode twin compiles over a
@@ -140,6 +146,42 @@ class PagedKVDecodeModel:
             build_paged_prefill_step(self.ffd, self.prefill_chunk)
             if self.prefill_chunk else None)
         self._copy_fn = build_paged_copy_block(self.ffd)
+        # speculative verify twin (docs/SERVING.md "Speculative
+        # decoding"): ONE [slots, spec_k+1] program scores a pending
+        # token plus up to spec_k drafts per row — per-position logits
+        # bit-identical to seq-1 stepping, so greedy acceptance keeps
+        # output token-identical to the plain engine.  counts is data:
+        # adaptive-k rounds reuse the same compiled program.
+        self.verify_chunk = self.spec_k + 1 if self.spec_decode != "off" \
+            else 0
+        self._verify_fn = (
+            build_paged_verify_step(self.ffd, self.verify_chunk)
+            if self.spec_decode != "off" else None)
+        self.draft_model = draft_model
+        if self.spec_decode == "draft":
+            dm = draft_model
+            if dm is None:
+                raise ConfigError(
+                    "--spec-decode draft needs a draft model — pass "
+                    "draft_model= (or from_trained(..., draft_ff=)) "
+                    "or use --spec-decode ngram")
+            if int(getattr(dm, "vocab", -1)) != self.vocab:
+                raise ConfigError(
+                    f"draft model vocab {getattr(dm, 'vocab', None)} "
+                    f"!= target vocab {self.vocab} — draft token ids "
+                    f"are proposed verbatim, so the vocabularies must "
+                    f"match")
+            if int(getattr(dm, "max_seq", 0)) < max_seq:
+                raise ConfigError(
+                    f"draft model position table "
+                    f"({getattr(dm, 'max_seq', 0)}) is shorter than "
+                    f"the target's ({max_seq}) — the drafter must be "
+                    f"able to reach every target position")
+            if int(getattr(dm, "batch_slots", 0)) < batch_slots:
+                raise ConfigError(
+                    f"draft model has {getattr(dm, 'batch_slots', 0)} "
+                    f"slots < the target's {batch_slots} — draft rows "
+                    f"mirror engine slots 1:1")
         # the step fns DONATE their state argument; keep the twin's own
         # pristine pytree intact and thread a private copy (reset()
         # rebuilds from the pristine shapes after a failed step)
@@ -203,6 +245,21 @@ class PagedKVDecodeModel:
             block_tables,
         )
 
+    def verify_step(self, tokens: np.ndarray, seq_lens: np.ndarray,
+                    counts: np.ndarray,
+                    block_tables: np.ndarray) -> np.ndarray:
+        """Speculative verify: feed tokens[b, :counts[b]] at
+        seq_lens[b].. and return per-position logits
+        [b, verify_chunk, vocab] — row i's logits[j] are bit-identical
+        to what the decode step would have produced feeding
+        tokens[i, j] at seq_lens[i]+j (docs/SERVING.md "Speculative
+        decoding").  Built only when spec_decode != "off"."""
+        logits, self._state = self._verify_fn(
+            self.ffd._weights, self._state, tokens,
+            seq_lens, counts, block_tables,
+        )
+        return np.asarray(logits, np.float32)
+
     def copy_block(self, src: int, dst: int) -> None:
         """Copy-on-write: clone physical block src -> dst in every
         layer's k/v pool (ordered with the step stream by jax's state
@@ -259,7 +316,8 @@ class _PendingSeq:
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
                  "event", "result", "error", "t_submit", "t_first_token",
-                 "t_done", "n_generated", "prefix_hit_tokens", "on_done",
+                 "t_done", "n_generated", "prefix_hit_tokens",
+                 "spec_proposed", "spec_accepted", "on_done",
                  "_settle_lock", "_settled")
 
     def __init__(self, prompt, max_new_tokens, temperature, seed,
@@ -276,6 +334,8 @@ class _PendingSeq:
         self.t_done: Optional[float] = None
         self.n_generated = 0
         self.prefix_hit_tokens = 0  # prompt tokens served from cache
+        self.spec_proposed = 0   # draft tokens verified for this request
+        self.spec_accepted = 0   # ... of which the target agreed with
         self.on_done = on_done
         self._settle_lock = threading.Lock()
         self._settled = False
@@ -363,6 +423,30 @@ class ContinuousScheduler:
         self._check_invariants = bool(check_invariants)
         self._evictions_seen = 0  # delta base for the obs counter
         self.prefill_steps = 0    # chunked-prefill dispatches
+        # speculative decoding (serving/speculative.py,
+        # docs/SERVING.md "Speculative decoding"): the model carries
+        # the mode, the verify program and (for "draft") the draft
+        # twin; the scheduler owns the proposer, the adaptive-k
+        # controller and the accept/rollback loop.  A model without
+        # the verify surface (test fakes) simply runs with spec off.
+        spec = str(getattr(model, "spec_decode", "off") or "off")
+        self._spec_k = int(getattr(model, "spec_k", 0) or 0)
+        self._proposer = None
+        if (spec != "off" and self._spec_k >= 1
+                and getattr(model, "verify_step", None) is not None):
+            from .speculative import AdaptiveK, build_proposer
+
+            self._proposer = build_proposer(
+                spec, getattr(model, "draft_model", None))
+            self._adaptive = AdaptiveK(self._spec_k)
+        self._spec = spec if self._proposer is not None else "off"
+        self._spec_broken = False  # verify/proposer fault: plain decode
+        self._spec_t0: Optional[float] = None
+        self.spec_rounds = 0        # verify dispatches run
+        self.spec_fallback_rounds = 0  # spec on, but a round had no
+        self.spec_proposed = 0         # proposals -> plain decode step
+        self.spec_accepted = 0
+        self.spec_verify_faults = 0
         self.eos_id = int(eos_id)
         self.registry = registry
         # tensor-parallel geometry gauges (serving/tp_* group,
@@ -433,14 +517,31 @@ class ContinuousScheduler:
                      prefix_cache: bool = True,
                      paged_kernel: str = "gather",
                      check_invariants: bool = False,
-                     tp: int = 1) -> "ContinuousScheduler":
+                     tp: int = 1, spec_decode: str = "off",
+                     spec_k: int = 4, draft_ff=None,
+                     draft_num_blocks: Optional[int] = None,
+                     ) -> "ContinuousScheduler":
+        # the draft twin (--spec-decode draft) is its own single-chip
+        # paged engine over the smaller trained GPT: same slot count
+        # and page size as the target (draft rows mirror engine slots
+        # 1:1), no prefix cache or chunking of its own — catch-up IS
+        # its prefill
+        draft_model = None
+        if spec_decode == "draft" and draft_ff is not None:
+            draft_model = PagedKVDecodeModel(
+                draft_ff, batch_slots=batch_slots, page_size=page_size,
+                num_blocks=draft_num_blocks, devices=devices,
+                paged_kernel=paged_kernel)
         model = PagedKVDecodeModel(ff_train, batch_slots=batch_slots,
                                    page_size=page_size,
                                    num_blocks=num_blocks,
                                    devices=devices,
                                    prefill_chunk=prefill_chunk,
                                    prefix_cache=prefix_cache,
-                                   paged_kernel=paged_kernel, tp=tp)
+                                   paged_kernel=paged_kernel, tp=tp,
+                                   spec_decode=spec_decode,
+                                   spec_k=spec_k,
+                                   draft_model=draft_model)
         return cls(model, eos_id=eos_id, registry=registry, seed=seed,
                    check_invariants=check_invariants)
 
@@ -589,6 +690,26 @@ class ContinuousScheduler:
                 "fragmentation": round(self.pool.fragmentation(), 4),
             },
             "prefix_cache": self.pool.prefix_stats(),
+            "speculative": {
+                "mode": self._spec,
+                "k_max": self._spec_k if self._spec != "off" else 0,
+                "k_current": (self._adaptive.k
+                              if self._proposer is not None else 0),
+                "rounds": self.spec_rounds,
+                "fallback_rounds": self.spec_fallback_rounds,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": round(
+                    self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else 0.0,
+                "accepted_per_round": round(
+                    self.spec_accepted / self.spec_rounds, 4)
+                if self.spec_rounds else 0.0,
+                "verify_faults": self.spec_verify_faults,
+                "degraded": self._spec_broken,
+                "proposer": (self._proposer.stats()
+                             if self._proposer is not None else {}),
+            },
             "tp": {
                 "degree": int(getattr(self.model, "tp", 1)),
                 "mesh_shape": dict(getattr(self.model, "mesh_shape",
@@ -837,6 +958,11 @@ class ContinuousScheduler:
             # prefix block's bytes are garbage now — drop the index
             # so no future admission maps onto them
             self.pool.invalidate_prefix_cache()
+        # drafter state describes sequences that no longer exist (and
+        # a draft twin's pools may be mid-sequence): clear it so
+        # speculation resumes from scratch with the fresh engine
+        if self._proposer is not None:
+            self._proposer.reset()
 
     def _note_step_time(self, dt_s: float) -> None:
         """EWMA of per-dispatch wall time (decode + chunked-prefill).
@@ -927,6 +1053,211 @@ class ContinuousScheduler:
             self.pool.check_invariants()
         return True
 
+    def _spec_proposals(self):
+        """Ask the proposer for this round's drafts.  Eligible rows are
+        GREEDY decode-phase slots with >= 2 tokens of budget left (a
+        draft only helps if at least one extra token may be emitted);
+        mid-prefill and sampled rows ride the verify round with
+        count 1.  Per-row draft length is capped by the adaptive-k
+        controller and the row's remaining budget, so fed positions
+        never pass prompt+max_new (<= max_seq by admission)."""
+        k = min(self._adaptive.k, self._spec_k)
+        contexts: Dict[int, List[int]] = {}
+        limits: Dict[int, int] = {}
+        caps: Dict[int, int] = {}
+        for i, live in enumerate(self._slots):
+            if live is None or live.req.temperature > 0.0:
+                continue
+            plen = len(live.req.prompt)
+            if live.pos < plen - 1:
+                continue  # still prefilling
+            rem = live.max_new - len(live.generated)
+            if rem < 2:
+                continue
+            contexts[i] = live.req.prompt + live.generated
+            limits[i] = min(plen + live.max_new + self._spec_k,
+                            self.model.max_seq)
+            caps[i] = min(k, rem - 1)
+        if not contexts:
+            return None
+        try:
+            props = self._proposer.propose(contexts, k, limits)
+        except Exception:  # noqa: BLE001 — a proposer bug degrades to
+            self._spec_broken = True   # plain decode, never kills the
+            return None                # engine
+        out = {}
+        for i, d in (props or {}).items():
+            if i in caps and d:
+                d = [int(t) for t in d[:caps[i]]]
+                if d:
+                    out[i] = d
+        return out or None
+
+    def _spec_round(self, props) -> bool:
+        """ONE speculative verify dispatch advancing EVERY live row:
+        row i feeds its pending next_token followed by its draft
+        tokens (counts[i] total; 1 for rows without proposals) and
+        gets per-position logits back.  Greedy rows accept the longest
+        prefix of drafts matching the model's own argmax chain plus
+        the first corrected token; the KV pool rolls back past the
+        accept point (un-registering prefix-index entries over
+        rejected positions and COWing a kept shared tail).  Per-step
+        logits are bit-identical to seq-1 stepping, so acceptance is
+        token-identical to plain decode BY CONSTRUCTION.
+
+        Returns True when the round ran; False after a verify fault —
+        speculation is disabled (sticky for this engine instance) and
+        in-flight slots continue on the plain decode path, where a
+        consumed state surfaces as an ordinary step fault."""
+        C = self.model.verify_chunk
+        bs = self.model.batch_slots
+        tok = np.zeros((bs, C), np.int32)
+        counts = np.zeros(bs, np.int32)
+        for i, live in enumerate(self._slots):
+            if live is None:
+                continue
+            tok[i, 0] = live.next_token
+            counts[i] = 1
+            d = props.get(i)
+            if d:
+                m = 1 + len(d)
+                tok[i, 1:m] = d
+                counts[i] = m
+                # the drafts' blocks must exist BEFORE dispatch; the
+                # admission reservation covers them (fed positions
+                # stay under prompt+max_new)
+                self.pool.extend(live.seq_id, live.pos + m,
+                                 written=live.pos)
+                self._btab[i] = self.pool.table_row(live.seq_id)
+        t0 = time.monotonic()
+        try:
+            logits = self.model.verify_step(
+                tok, self._slens, counts, self._btab)
+        except Exception as e:
+            if getattr(e, "fatal_to_engine", False):
+                raise  # hung verify / device loss: drain-and-die
+            # transient verify fault: DEGRADE, don't fail in-flight —
+            # a pre-dispatch injection left the state intact and the
+            # plain decode path resumes token-identically; a true
+            # mid-dispatch death surfaces on the next plain step and
+            # takes the normal _fail_inflight recovery
+            self.spec_verify_faults += 1
+            self._spec_broken = True
+            if self._proposer is not None:
+                self._proposer.reset()
+            if self.registry is not None:
+                self.registry.counter(
+                    "serving/spec_verify_faults").inc()
+            return False
+        self._note_step_time(time.monotonic() - t0)
+        self.batches_run += 1
+        self.spec_rounds += 1
+        if self._spec_t0 is None:
+            self._spec_t0 = time.monotonic()
+        if self._paged_kernel == "pallas":
+            from ..ops.pallas.paged_attention import blocks_read
+
+            tw = self.pool.max_blocks_per_seq
+            blocks = 0
+            for j in range(C):
+                mask = counts > j
+                if not mask.any():
+                    break
+                blocks += blocks_read(self._slens + j, mask, 1,
+                                      self.pool.page_size, tw)
+            self._note_kernel_reads(blocks, bs * tw * C)
+        now = time.monotonic()
+        for i, live in enumerate(self._slots):
+            if live is None:
+                continue
+            m = int(counts[i])
+            plen = len(live.req.prompt)
+            if live.pos < plen - 1:
+                # mid-prefill row rode with its prompt token (m == 1):
+                # identical to the plain decode path's prefill branch
+                live.pos += 1
+                self.pool.note_written(live.seq_id, live.pos)
+                live.next_token = live.req.prompt[live.pos]
+                self._tokens[i] = live.next_token
+                self._slens[i] = live.pos
+                continue
+            # decode-phase: walk the model's own token chain across
+            # the fed positions — position j's output is valid iff
+            # every fed token before it matched the chain
+            out: List[int] = []
+            for j in range(m):
+                t = int(self._sample(logits[i, j], live))
+                out.append(t)
+                if self.eos_id >= 0 and t == self.eos_id:
+                    break
+                if j + 1 >= m or t != int(tok[i, j + 1]):
+                    break
+            emitted = len(out)
+            proposed, accepted = m - 1, emitted - 1
+            # watermark first (the dispatch really wrote all m
+            # positions), then roll rejected positions back out —
+            # freeing their blocks, un-registering their prefix-index
+            # entries, and COWing a kept shared tail
+            self.pool.note_written(live.seq_id, live.pos + m)
+            new_pos = live.pos + emitted
+            if m > emitted:
+                cow = self.pool.rollback(live.seq_id, new_pos)
+                # the table shrank (and its kept tail block may have
+                # been COW-swapped): refresh the row BEFORE the next
+                # dispatch can write through a stale block id
+                self._btab[i] = self.pool.table_row(live.seq_id)
+                if cow is not None:
+                    try:
+                        self.model.copy_block(*cow)
+                    except Exception as e:
+                        if getattr(e, "fatal_to_engine", False):
+                            raise
+                        # rollback's device COW failed: this row's KV
+                        # is unsynced — fail the one request, like the
+                        # admission COW path
+                        self.pool.retire(live.seq_id)
+                        if self._proposer is not None:
+                            self._proposer.release(i)
+                        live.req.error = e
+                        live.req._settle()
+                        self._slots[i] = None
+                        self._free_slot_buffers(i)
+                        continue
+            live.pos = new_pos
+            if proposed:
+                self.spec_proposed += proposed
+                self.spec_accepted += accepted
+                live.req.spec_proposed += proposed
+                live.req.spec_accepted += accepted
+                self._adaptive.update(proposed, accepted)
+                if self.registry is not None:
+                    reg = self.registry
+                    reg.counter("serving/spec_proposed").inc(proposed)
+                    reg.counter("serving/spec_accepted").inc(accepted)
+                    reg.histogram(
+                        "serving/spec_accepted_per_round").observe(
+                        accepted)
+            if not live.generated:
+                live.req.t_first_token = now
+                with self._lat_lock:
+                    self._ttfts.append(now - live.req.t_submit)
+                if self.registry is not None:
+                    self.registry.histogram("serving/ttft_ms").observe(
+                        (now - live.req.t_submit) * 1e3)
+            live.generated.extend(out)
+            self.tokens_generated += emitted
+            done = (len(live.generated) >= live.max_new
+                    or (self.eos_id >= 0 and out[-1] == self.eos_id))
+            if done:
+                self._finish(i, live)
+            else:
+                live.next_token = out[-1]
+                self._tokens[i] = out[-1]
+                self._slens[i] = live.pos
+        if self.registry is not None:
+            self.registry.counter("serving/spec_rounds").inc()
+        return True
+
     def _decode_loop(self):
         page = self.pool.page_size
         while not self._stop.is_set():
@@ -963,6 +1294,19 @@ class ContinuousScheduler:
                 if live.pos and live.pos % page == 0:
                     self.pool.extend(live.seq_id, live.pos + 1)
                     self._btab[i] = self.pool.table_row(live.seq_id)
+            if self._spec != "off" and not self._spec_broken:
+                props = self._spec_proposals()
+                if props:
+                    # speculative round: every live row rides ONE
+                    # verify dispatch (drafted rows multi-token,
+                    # everyone else count-1)
+                    if self._spec_round(props):
+                        self._observe_step()
+                    continue
+                # no proposals anywhere: fall through to the plain
+                # [slots, 1] decode step — the required empty-round
+                # fallback (and the whole path when spec is off)
+                self.spec_fallback_rounds += 1
             t0 = time.monotonic()
             try:
                 logits = self.model.step(
@@ -1034,6 +1378,8 @@ class ContinuousScheduler:
                            live.rng)[0]
 
     def _finish(self, slot: int, live: _Live):
+        if self._proposer is not None:
+            self._proposer.release(slot)
         # the written token prefix (everything fed; excludes the final
         # sampled token, whose k/v never landed) keys the retired
         # blocks into the prefix cache — a future prompt extending
@@ -1086,3 +1432,8 @@ class ContinuousScheduler:
             self.pool.occupancy())
         reg.histogram("serving/kv_fragmentation").observe(
             self.pool.fragmentation())
+        if self.spec_rounds and self._spec_t0 is not None:
+            dt = time.monotonic() - self._spec_t0
+            if dt > 0:
+                reg.gauge("serving/spec_rounds_per_s").set(
+                    round(self.spec_rounds / dt, 4))

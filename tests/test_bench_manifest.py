@@ -108,6 +108,37 @@ def test_serving_gspmd_leg_keys_frozen():
     assert leg["heads"] % leg["tp"] == 0  # heads shard over the mesh
 
 
+def test_serving_spec_leg_keys_frozen():
+    """The v22 speculative-decoding leg is round-over-round comparable
+    only with its workload AND drafter geometry pinned: every TPU-shape
+    key bench_serving_spec reads must exist, the phrase pool must stay
+    small enough to memorize (acceptance rates move with it), the draft
+    model must actually be smaller than the target (or the draft tier
+    measures nothing), and spec_k must clear the accepted-per-round bar
+    it is asserted against."""
+    manifest, _ = _load()
+    leg = manifest["legs"]["serving_spec"]
+    needed = {"vocab", "max_seq", "hidden", "layers", "heads",
+              "intermediate", "slots", "kv_page_size", "requests",
+              "offered_rps", "prefill_chunk", "spec_k",
+              "num_templates", "phrases_per_template", "phrase_len",
+              "prompt_phrases_range", "max_new_range",
+              "draft_hidden", "draft_layers", "draft_heads",
+              "draft_intermediate", "train_steps"}
+    assert needed <= set(leg), sorted(needed - set(leg))
+    # the accepted-per-round > 1.5 assertion needs headroom above 1
+    assert leg["spec_k"] >= 2
+    # n-gram lookup needs phrases longer than the trigram window
+    assert leg["phrase_len"] >= 4
+    # the draft tier only measures something if the drafter is smaller
+    assert leg["draft_hidden"] < leg["hidden"]
+    assert leg["draft_layers"] < leg["layers"]
+    # verify windows (prompt + max_new + k) must fit the position table
+    max_prompt = leg["prompt_phrases_range"][1] * leg["phrase_len"]
+    assert (max_prompt + leg["max_new_range"][1] + leg["spec_k"]
+            <= leg["max_seq"])
+
+
 def test_serving_disagg_leg_keys_frozen():
     """The v21 disaggregated-fleet leg is round-over-round comparable
     only with its workload geometry AND its cost-model knobs pinned:

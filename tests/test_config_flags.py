@@ -499,6 +499,48 @@ def test_resolve_serving_tp_rejects_bad_degrees():
         resolve_serving_tp(4, num_heads=4, visible_devices=2)
 
 
+def test_spec_decode_cli_flags_parse():
+    cfg = FFConfig.from_args(["--spec-decode", "ngram", "--spec-k", "6"])
+    assert cfg.spec_decode == "ngram"
+    assert cfg.spec_k == 6
+    cfg = FFConfig.from_args(["--spec-decode", "draft"])
+    assert cfg.spec_decode == "draft" and cfg.spec_k == 4
+    base = FFConfig.from_args([])
+    assert base.spec_decode == "off"  # speculation is opt-in
+    assert base.spec_k == 4
+
+
+def test_spec_decode_config_validated():
+    with pytest.raises(ValueError, match="spec_decode"):
+        FFConfig(spec_decode="lookahead")
+    with pytest.raises(ValueError, match="spec_k"):
+        FFConfig(spec_k=0)
+    assert FFConfig(spec_decode="ngram", spec_k=8) is not None
+
+
+def test_resolve_spec_decode_rejects_bad_combos():
+    """--spec-decode misconfigurations must fail at BUILD time with a
+    ConfigError naming the flag (the resolve_paged_kernel discipline):
+    unknown modes, a draft budget under 1, and — because verification
+    accepts the longest GREEDY-matching prefix, meaningless across
+    beam hypotheses — any combination with beam search."""
+    from flexflow_tpu.config import ConfigError, resolve_spec_decode
+
+    assert resolve_spec_decode("off", 4) == "off"
+    assert resolve_spec_decode("ngram", 1) == "ngram"
+    assert resolve_spec_decode("draft", 4, beam_size=1) == "draft"
+    # off tolerates any k/beam — nothing speculative runs
+    assert resolve_spec_decode("off", 0, beam_size=4) == "off"
+    with pytest.raises(ConfigError, match="--spec-decode must be one"):
+        resolve_spec_decode("medusa", 4)
+    with pytest.raises(ConfigError, match="--spec-k must be >= 1"):
+        resolve_spec_decode("ngram", 0)
+    with pytest.raises(ConfigError, match="beam"):
+        resolve_spec_decode("ngram", 4, beam_size=4)
+    with pytest.raises(ConfigError, match="beam"):
+        resolve_spec_decode("draft", 4, beam_size=2)
+
+
 def test_disagg_cli_flags_parse():
     cfg = FFConfig.from_args([
         "--serving-roles", "prefill=1,decode=2",

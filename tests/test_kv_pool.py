@@ -525,6 +525,85 @@ def test_rollback_cow_tail_still_vouched_elsewhere():
     pool.check_invariants()
 
 
+def test_rollback_exactly_onto_shared_block_boundary():
+    """Rolling back to EXACTLY the shared-prefix watermark is legal —
+    the kept region is precisely the shared blocks, so nothing private
+    remains, no COW is needed, and one more token is still a guard
+    violation (the speculative verifier's floor case: every draft
+    rejected on the first post-prefix position)."""
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8]
+    assert pool.try_admit(1, 10, prompt=prompt)
+    pool.extend(1, 8, written=8)
+    pool.retire(1, tokens=prompt)
+    assert pool.try_admit(2, 10, prompt=prompt)
+    hit = pool.admit_hit_tokens(2)
+    assert hit % 4 == 0 and hit >= 4  # block-aligned shared watermark
+    pool.extend(2, hit + 3, written=hit + 3)  # private growth past it
+    n_shared = hit // 4
+    assert pool.rollback(2, hit) is None  # lands ON the boundary
+    assert len(pool.table_of(2)) == n_shared  # private tail dropped
+    with pytest.raises(ValueError, match="shared-"):
+        pool.rollback(2, hit - 1)  # one past the boundary still guards
+    pool.check_invariants()
+    # the reservation survived: regrow past the boundary again
+    pool.extend(2, hit + 1, written=hit + 1)
+    assert len(pool.table_of(2)) == n_shared + 1
+    pool.check_invariants()
+
+
+def test_rollback_of_slot_holding_cow_blocks():
+    """A slot whose tail block was already COW'd (full-prompt hit ->
+    private copy) rolls back WITHIN that private block without another
+    copy: the block is refcount-1, so truncation is free, and the
+    cached original the other table vouches for keeps its bytes."""
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2, 9, 4, 1, 8]
+    assert pool.try_admit(1, 12, prompt=prompt)
+    pool.extend(1, 8, written=8)
+    blk1 = pool.table_of(1)[1]
+    pool.retire(1, tokens=prompt)  # both blocks cached + indexed
+    assert pool.try_admit(2, 12, prompt=prompt)  # full-prompt hit
+    assert pool.admit_hit_tokens(2) == 8
+    cow = pool.ensure_writable(2, 7)  # divergence inside the tail
+    assert cow is not None and cow[0] == blk1
+    priv = cow[1]
+    assert pool.table_of(2)[1] == priv != blk1
+    pool.extend(2, 10, written=10)  # generate into a third block
+    # rollback lands inside the COW'd private block: no (src, dst)
+    # pair comes back — the copy already happened at divergence time
+    assert pool.rollback(2, 6) is None
+    assert pool.table_of(2)[1] == priv  # still the private copy
+    assert pool.cached_prefix_tokens(prompt) == 8  # original intact
+    pool.check_invariants()
+    pool.retire(2)
+    pool.check_invariants()
+
+
+def test_rollback_then_extend_reregisters_new_content():
+    """The speculative reject path end-to-end: generated positions are
+    rolled back, the slot regrows DIFFERENT tokens over the freed
+    positions, and retirement must index the final content — the chain
+    bookkeeping rollback leaves behind must still let _register run
+    (a broken-chain sentinel would silently stop indexing), and the
+    rolled-back generation must never be matchable."""
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    prompt = [3, 5, 7, 2]
+    rejected = prompt + [9, 4, 1, 8]   # first speculative generation
+    final = prompt + [6, 2, 6, 2]      # what actually got accepted
+    assert pool.try_admit(1, 12, prompt=prompt)
+    pool.extend(1, 8, written=8)  # prompt block indexed, gen block not
+    assert pool.cached_prefix_tokens(rejected) == 4
+    assert pool.rollback(1, 5) is None  # drafts rejected mid-block
+    pool.extend(1, 8, written=8)  # regrow over the freed positions
+    pool.retire(1, tokens=final)  # index the content that survived
+    assert pool.cached_prefix_tokens(final) == 8
+    assert pool.cached_prefix_tokens(rejected) == 4  # ghost unmatchable
+    assert pool.try_admit(2, 12, prompt=final)
+    assert pool.admit_hit_tokens(2) == 8
+    pool.check_invariants()
+
+
 def test_property_random_interleaving_with_rollback():
     """Block conservation under admit/extend/ROLLBACK/retire: rollback
     frees exactly the uncovered blocks and the reservation lets every

@@ -1,0 +1,654 @@
+"""Speculative decoding (serving/speculative.py + the scheduler's
+verify rounds): proposer units (n-gram lookup, draft-engine lifecycle,
+adaptive k), scheduler spec rounds against the deterministic fake step
+model (token identity, acceptance bookkeeping, rejection rollback,
+empty-round fallback, verify-fault degradation), and slow real-engine
+byte-identity + supervised-fault tests over a trained tiny GPT."""
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs.metrics import MetricsRegistry
+from flexflow_tpu.serving import ContinuousScheduler
+from flexflow_tpu.serving.speculative import (AdaptiveK,
+                                              DraftModelProposer,
+                                              NGramProposer,
+                                              build_proposer)
+
+V = 16
+
+
+# -- n-gram proposer -----------------------------------------------------
+
+def test_ngram_prefers_longest_then_most_recent():
+    p = NGramProposer(max_ngram=3, min_ngram=1)
+    # trigram [7, 8, 9] occurs once, earlier — its continuation wins
+    # over any shorter suffix match
+    ctx = [7, 8, 9, 1, 2, 3, 7, 8, 9]
+    assert p.propose({0: ctx}, 3) == {0: [1, 2, 3]}
+    # two occurrences of the suffix bigram: the MOST RECENT match's
+    # continuation is proposed (5, not 4)
+    ctx = [1, 2, 4, 1, 2, 5, 9, 1, 2]
+    assert p.propose({0: ctx}, 2) == {0: [5, 9]}
+
+
+def test_ngram_no_match_omits_slot():
+    p = NGramProposer()
+    out = p.propose({0: [1, 2, 3, 4], 1: [5, 5, 5, 5]}, 4)
+    assert 0 not in out          # all tokens distinct: nothing recurs
+    assert out[1] == [5]         # degenerate self-overlap still drafts
+
+
+def test_ngram_k_caps_draft_length():
+    p = NGramProposer()
+    ctx = [3, 4, 5, 6, 7, 8, 3, 4]
+    assert p.propose({0: ctx}, 2) == {0: [5, 6]}
+    assert p.propose({0: ctx}, 10) == {0: [5, 6, 7, 8, 3, 4]}
+
+
+def test_ngram_window_bounds_lookback():
+    # the only match sits outside the window: no proposal
+    far = [1, 2, 9, 9] + [int(t) for t in np.arange(100) % 7 + 3]
+    p = NGramProposer(max_window=50)
+    assert 0 not in p.propose({0: far + [1, 2]}, 4)
+    wide = NGramProposer(max_window=4096)
+    assert wide.propose({0: far + [1, 2]}, 2) == {0: [9, 9]}
+
+
+def test_ngram_validates_bounds_and_tolerates_lifecycle():
+    with pytest.raises(ValueError, match="min_ngram"):
+        NGramProposer(max_ngram=2, min_ngram=3)
+    p = NGramProposer()
+    p.release(42)   # unknown slot: no-op
+    p.reset()       # stateless: no-op
+    assert p.stats() == {}
+
+
+# -- adaptive k ----------------------------------------------------------
+
+def test_adaptive_k_shrinks_on_misses_and_regrows():
+    ak = AdaptiveK(4)
+    assert ak.k == 4  # optimistic start: first rounds draft fully
+    for _ in range(20):
+        ak.update(4, 0)  # nothing lands
+    assert ak.k == 1     # shrunk to the never-worse floor, not 0
+    for _ in range(20):
+        ak.update(1, 1)  # everything lands
+    assert ak.k == 4     # regrown to the CLI cap, not past it
+
+
+def test_adaptive_k_ignores_empty_rounds():
+    ak = AdaptiveK(3)
+    ak.update(0, 0)  # a round with no proposals carries no signal
+    assert ak.k == 3 and ak.rate == 1.0
+
+
+# -- draft-model proposer ------------------------------------------------
+
+class FakeDraftModel:
+    """Draft-engine stand-in with the PagedKVDecodeModel step
+    contract: argmax of the returned one-hot logits is (token + 1 +
+    off) % V, so off=0 drafts the same successor chain as the fake
+    target and off!=0 is an always-wrong drafter."""
+
+    def __init__(self, batch_slots=2, max_seq=32, page_size=4, off=0):
+        self.batch_slots = batch_slots
+        self.max_seq = max_seq
+        self.page_size = page_size
+        self.max_blocks_per_seq = max_seq // page_size
+        self.num_blocks = 1 + batch_slots * self.max_blocks_per_seq
+        self.vocab = V
+        self.off = off
+        self.steps = 0
+        self.resets = 0
+        self.fail_at_steps = set()
+
+    def reset(self):
+        self.resets += 1
+
+    def step(self, tokens, seq_lens, block_tables):
+        self.steps += 1
+        if self.steps in self.fail_at_steps:
+            raise RuntimeError(f"injected draft fault @{self.steps}")
+        logits = np.zeros((self.batch_slots, V), np.float32)
+        nxt = (np.asarray(tokens) + 1 + self.off) % V
+        logits[np.arange(self.batch_slots), nxt] = 1.0
+        return logits
+
+
+def test_draft_proposer_free_runs_successor_chain():
+    p = DraftModelProposer(FakeDraftModel())
+    out = p.propose({0: [3, 4, 5]}, 3)
+    assert out == {0: [6, 7, 8]}  # fed the context, free-ran 3 drafts
+    # context advanced by an accept: only the delta is re-fed (the
+    # last accepted token reseeds the first draft)
+    steps_before = p.model.steps
+    out = p.propose({0: [3, 4, 5, 6, 7, 8]}, 2)
+    assert out == {0: [9, 10]}
+    assert p.model.steps - steps_before <= 4  # no full-prompt replay
+    assert p.stats()["live_draft_seqs"] == 1
+
+
+def test_draft_proposer_reconciles_after_rejection():
+    p = DraftModelProposer(FakeDraftModel())
+    assert p.propose({0: [3, 4, 5]}, 3) == {0: [6, 7, 8]}
+    # the verifier rejected the tail and corrected to 9: the draft
+    # pool rolls back past the divergence and re-feeds from there
+    out = p.propose({0: [3, 4, 5, 6, 9]}, 2)
+    assert out == {0: [10, 11]}
+    p.pool.check_invariants()
+
+
+def test_draft_proposer_batches_slots_per_dispatch():
+    p = DraftModelProposer(FakeDraftModel(batch_slots=2))
+    out = p.propose({0: [3, 4], 1: [8, 9, 10]}, 2)
+    assert out == {0: [5, 6], 1: [11, 12]}
+    # slot 1's context is one token longer, so it pays one extra
+    # catch-up dispatch; everything else shares dispatches
+    assert p.model.steps <= 5
+
+
+def test_draft_proposer_respects_limits_and_release():
+    p = DraftModelProposer(FakeDraftModel())
+    # a cap at the context length leaves the draft pool no room at
+    # all: the slot is skipped entirely
+    assert 0 not in p.propose({0: [1, 2, 3]}, 4, limits={0: 3})
+    # one position of headroom: one written draft plus the free final
+    # draft that rides the last dispatch's logits
+    out = p.propose({0: [1, 2, 3]}, 4, limits={0: 4})
+    assert out == {0: [4, 5]}
+    p.release(0)
+    assert p.stats()["live_draft_seqs"] == 0
+    p.pool.check_invariants()
+    assert p.pool.used_blocks == 0
+
+
+def test_draft_fault_degrades_to_dead_and_reset_revives():
+    model = FakeDraftModel()
+    model.fail_at_steps = {2}
+    p = DraftModelProposer(model)
+    assert p.propose({0: [3, 4, 5]}, 3) == {}  # died mid-round
+    assert p.stats()["dead"] and p.stats()["draft_faults"] == 1
+    assert p.propose({0: [3, 4, 5, 6]}, 3) == {}  # stays dead
+    p.reset()
+    assert model.resets == 1
+    assert not p.stats()["dead"]
+    assert p.propose({0: [3, 4, 5]}, 2) == {0: [6, 7]}
+
+
+def test_build_proposer_wiring():
+    from flexflow_tpu.config import ConfigError
+
+    assert isinstance(build_proposer("ngram"), NGramProposer)
+    assert isinstance(build_proposer("draft", FakeDraftModel()),
+                      DraftModelProposer)
+    with pytest.raises(ConfigError, match="draft model"):
+        build_proposer("draft")
+    with pytest.raises(ConfigError, match="no proposer"):
+        build_proposer("off")
+
+
+# -- scheduler spec rounds against the fake model ------------------------
+
+class FakeSpecModel:
+    """FakeStepModel (tests/test_continuous_scheduler.py) plus the
+    speculative surface: verify_step scores every fed position with
+    the same (token + 1) % V successor rule the plain step uses, so a
+    successor-chain draft is always accepted and anything else is
+    rejected at its first wrong position."""
+
+    def __init__(self, batch_slots=2, max_seq=32, page_size=4,
+                 num_blocks=None, prefill_chunk=0, spec_decode="ngram",
+                 spec_k=4, draft_model=None):
+        self.batch_slots = batch_slots
+        self.max_seq = max_seq
+        self.page_size = page_size
+        self.max_blocks_per_seq = max_seq // page_size
+        self.num_blocks = (num_blocks if num_blocks is not None
+                           else 1 + batch_slots * self.max_blocks_per_seq)
+        self.vocab = V
+        self.prefill_chunk = prefill_chunk
+        self.prefix_cache = True
+        self.spec_decode = spec_decode
+        self.spec_k = spec_k
+        self.verify_chunk = spec_k + 1
+        self.draft_model = draft_model
+        self.steps = 0
+        self.verify_calls = 0
+        self.prefill_calls = 0
+        self.copied_blocks = []
+        self.fail_at_steps = set()
+        self.fail_verify_at = set()
+        self.resets = 0
+
+    def reset(self):
+        self.resets += 1
+
+    def step(self, tokens, seq_lens, block_tables):
+        self.steps += 1
+        if self.steps in self.fail_at_steps:
+            raise RuntimeError(f"injected step fault @{self.steps}")
+        logits = np.zeros((self.batch_slots, V), np.float32)
+        nxt = (np.asarray(tokens) + 1) % V
+        logits[np.arange(self.batch_slots), nxt] = 1.0
+        return logits
+
+    def prefill_step(self, tokens, positions, block_tables):
+        self.prefill_calls += 1
+
+    def verify_step(self, tokens, seq_lens, counts, block_tables):
+        self.verify_calls += 1
+        if self.verify_calls in self.fail_verify_at:
+            raise RuntimeError(
+                f"injected verify fault @{self.verify_calls}")
+        C = tokens.shape[1]
+        logits = np.zeros((self.batch_slots, C, V), np.float32)
+        nxt = (np.asarray(tokens) + 1) % V
+        for j in range(C):
+            logits[np.arange(self.batch_slots), j, nxt[:, j]] = 1.0
+        return logits
+
+    def copy_block(self, src, dst):
+        self.copied_blocks.append((src, dst))
+
+
+def expected(prompt, mnt):
+    out = list(prompt)
+    t = prompt[-1]
+    for _ in range(mnt):
+        t = (t + 1) % V
+        out.append(t)
+    return out
+
+
+def cyclic(start, n):
+    return [(start + i) % V for i in range(n)]
+
+
+def test_spec_rounds_accept_ngram_drafts_token_identical():
+    """A full-cycle prompt makes every successor continuation visible
+    to the n-gram drafter, so verify rounds accept whole windows —
+    far fewer dispatches than tokens — while the output stays the
+    plain closed form."""
+    reg = MetricsRegistry()
+    model = FakeSpecModel(batch_slots=2, max_seq=64, spec_k=4)
+    sched = ContinuousScheduler(model, registry=reg)
+    try:
+        reqs = [(cyclic(3, V + 2), 30), (cyclic(9, V + 2), 26)]
+        hs = [sched.generate_async(p, m) for p, m in reqs]
+        for h, (p, m) in zip(hs, reqs):
+            assert h.wait(30.0) == expected(p, m)
+        st = sched.stats()["speculative"]
+        assert st["mode"] == "ngram"
+        assert st["rounds"] > 0
+        assert st["accepted"] == st["proposed"] > 0  # perfect drafter
+        assert st["accepted_per_round"] > 1.5
+        assert not st["degraded"]
+        # the point of the feature: generated tokens out-number the
+        # decode dispatches that produced them
+        decode_dispatches = model.verify_calls + model.steps
+        assert sched.tokens_generated > decode_dispatches
+        # per-request accounting reached the handles
+        assert all(h.spec_accepted == h.spec_proposed > 0 for h in hs)
+        assert reg.counter("serving/spec_accepted").value == \
+            st["accepted"]
+        sched.pool.check_invariants()
+    finally:
+        sched.close()
+
+
+def test_spec_rejection_rolls_back_and_stays_token_identical():
+    """An always-wrong drafter: every draft is rejected at its first
+    position, the pool rolls the rejected window back out every round,
+    and the output is still EXACTLY the plain closed form — the
+    never-worse contract under a hostile proposer."""
+    draft = FakeDraftModel(batch_slots=2, off=7)  # always wrong
+    model = FakeSpecModel(batch_slots=2, spec_decode="draft",
+                          spec_k=3, draft_model=draft)
+    sched = ContinuousScheduler(model)
+    try:
+        reqs = [([3, 4, 5], 9), ([11], 7)]
+        hs = [sched.generate_async(p, m) for p, m in reqs]
+        for h, (p, m) in zip(hs, reqs):
+            assert h.wait(30.0) == expected(p, m)
+        st = sched.stats()["speculative"]
+        assert st["proposed"] > 0 and st["accepted"] == 0
+        assert st["k_current"] == 1  # adaptive k hit the floor
+        sched.pool.check_invariants()
+        assert sched.pool.used_blocks == 0
+    finally:
+        sched.close()
+
+
+def test_spec_draft_mode_accepts_and_reconciles():
+    draft = FakeDraftModel(batch_slots=2, off=0)  # perfect drafter
+    model = FakeSpecModel(batch_slots=2, spec_decode="draft",
+                          spec_k=4, draft_model=draft)
+    sched = ContinuousScheduler(model)
+    try:
+        reqs = [([3, 4], 12), ([8], 10)]
+        hs = [sched.generate_async(p, m) for p, m in reqs]
+        for h, (p, m) in zip(hs, reqs):
+            assert h.wait(30.0) == expected(p, m)
+        st = sched.stats()["speculative"]
+        assert st["accepted"] == st["proposed"] > 0
+        assert st["proposer"]["draft_steps"] > 0
+        assert st["proposer"]["live_draft_seqs"] == 0  # all released
+        sched.pool.check_invariants()
+    finally:
+        sched.close()
+
+
+def test_spec_falls_back_to_plain_decode_without_proposals():
+    """Sampled requests are never spec-eligible; rounds with no
+    proposals anywhere must take the plain [slots, 1] step."""
+    model = FakeSpecModel(batch_slots=2, spec_decode="ngram")
+    sched = ContinuousScheduler(model, seed=5)
+    try:
+        h = sched.generate_async([3, 4], 6, temperature=1.0)
+        toks = h.wait(30.0)
+        assert len(toks) == 8
+        assert model.verify_calls == 0  # nothing eligible, no verify
+        assert model.steps > 0
+        assert sched.spec_fallback_rounds > 0
+        assert sched.stats()["speculative"]["rounds"] == 0
+    finally:
+        sched.close()
+
+
+def test_spec_mixes_chunked_prefill_and_verify_rounds():
+    """A long-prompt request rides chunked prefill while a decoding
+    slot speculates; both finish token-identical to the closed form."""
+    model = FakeSpecModel(batch_slots=2, max_seq=64, prefill_chunk=4,
+                          spec_k=4)
+    sched = ContinuousScheduler(model)
+    try:
+        short = sched.generate_async(cyclic(2, V + 2), 12)
+        long = sched.generate_async(cyclic(5, 33), 6)
+        assert short.wait(30.0) == expected(cyclic(2, V + 2), 12)
+        assert long.wait(30.0) == expected(cyclic(5, 33), 6)
+        assert model.prefill_calls > 0          # chunk program ran
+        assert sched.stats()["speculative"]["rounds"] > 0
+        sched.pool.check_invariants()
+    finally:
+        sched.close()
+
+
+def test_transient_verify_fault_degrades_to_plain_decode():
+    """ISSUE 18 fault bar: a transient verify-step fault must DEGRADE
+    the engine to plain decode — in-flight requests finish
+    token-identically, nothing is failed, speculation stays off for
+    this engine instance."""
+    reg = MetricsRegistry()
+    model = FakeSpecModel(batch_slots=2, spec_k=4)
+    model.fail_verify_at = {1}
+    sched = ContinuousScheduler(model, registry=reg)
+    try:
+        reqs = [(cyclic(3, V + 2), 10), (cyclic(7, V + 2), 8)]
+        hs = [sched.generate_async(p, m) for p, m in reqs]
+        for h, (p, m) in zip(hs, reqs):
+            assert h.wait(30.0) == expected(p, m)  # nobody failed
+        st = sched.stats()["speculative"]
+        assert st["degraded"] and st["verify_faults"] == 1
+        assert st["rounds"] == 0  # the faulted round never counted
+        assert reg.counter("serving/spec_verify_faults").value == 1
+        assert model.verify_calls == 1  # speculation never retried
+        assert sched.requests_done == len(reqs)
+        # degradation is engine-scoped, not request-scoped: later
+        # requests run plain and correct
+        assert sched.generate(cyclic(1, V + 2), 5, timeout=30.0) == \
+            expected(cyclic(1, V + 2), 5)
+        assert model.verify_calls == 1
+        sched.pool.check_invariants()
+    finally:
+        sched.close()
+
+
+def test_step_fault_resets_proposer_state():
+    """_fail_inflight (transient plain-step fault) zeroes the KV pool,
+    so the draft proposer's mirrored state must reset with it —
+    otherwise its next reconcile would roll back against ghosts."""
+    draft = FakeDraftModel(batch_slots=2, off=0)
+    model = FakeSpecModel(batch_slots=2, spec_decode="draft",
+                          spec_k=2, draft_model=draft)
+    # sampled request so rounds take the plain path (verify untouched)
+    model.fail_at_steps = {2}
+    sched = ContinuousScheduler(model, seed=3)
+    try:
+        h1 = sched.generate_async([3, 4], 6, temperature=1.0)
+        with pytest.raises(RuntimeError, match="injected step fault"):
+            h1.wait(30.0)
+        assert model.resets == 1
+        assert draft.resets == 1  # proposer.reset() rode the recovery
+        # the engine keeps serving — greedy + speculative still works
+        assert sched.generate(cyclic(4, V + 2), 8, timeout=30.0) == \
+            expected(cyclic(4, V + 2), 8)
+        assert not sched.stats()["speculative"]["degraded"]
+        sched.pool.check_invariants()
+    finally:
+        sched.close()
+
+
+def test_spec_eos_inside_accepted_window_truncates():
+    """EOS landing mid-window ends the request at EOS: tokens past it
+    in the same verify round are rolled back, never emitted."""
+    model = FakeSpecModel(batch_slots=2, spec_k=4)
+    sched = ContinuousScheduler(model, eos_id=9)
+    try:
+        # successor chain from the full-cycle prompt runs ...7, 8, 9:
+        # EOS (9) falls inside an accepted draft window
+        prompt = cyclic(3, V + 2)  # ends at 4 -> generates 5, 6, ...
+        toks = sched.generate(prompt, 12, timeout=30.0)
+        assert toks == prompt + [5, 6, 7, 8, 9]
+        assert sched.stats()["speculative"]["rounds"] > 0
+        sched.pool.check_invariants()
+        assert sched.pool.used_blocks == 0
+    finally:
+        sched.close()
+
+
+def test_spec_off_never_builds_verify_surface():
+    model = FakeSpecModel(batch_slots=2, spec_decode="off")
+    sched = ContinuousScheduler(model)
+    try:
+        assert sched.generate([3, 4], 6, timeout=30.0) == \
+            expected([3, 4], 6)
+        assert model.verify_calls == 0
+        assert sched.stats()["speculative"]["mode"] == "off"
+    finally:
+        sched.close()
+
+
+# -- supervised replica: verify faults under the fault plan --------------
+
+def test_hung_verify_is_fatal_and_replica_recovers_identically():
+    """A HUNG verify dispatch (watchdog timeout) is fatal-to-engine:
+    the replica drains-and-dies, the supervisor restarts it with
+    speculation re-enabled, and requeued requests complete
+    token-identically."""
+    from flexflow_tpu.serving import ServingFront
+
+    built = []
+
+    def spec_factory(replica_id, survivors=None):
+        m = FakeSpecModel(batch_slots=2, spec_k=4)
+        if not built:
+            m.verify_delay_s = 5.0
+
+            real = m.verify_step
+
+            def slow_verify(tokens, seq_lens, counts, block_tables):
+                time.sleep(m.verify_delay_s)
+                return real(tokens, seq_lens, counts, block_tables)
+
+            m.verify_step = slow_verify
+        built.append(m)
+        return m
+
+    front = ServingFront(spec_factory, num_replicas=1,
+                         step_timeout=0.3, sleep=lambda s: None,
+                         retry_backoff=0.0)
+    try:
+        p = cyclic(3, V + 2)  # spec-eligible immediately
+        h = front.generate_async(p, 8)
+        assert h.wait(30.0) == expected(p, 8)
+        assert front.replicas[0].deaths == 1
+        assert front.replicas[0].restarts == 1
+        from flexflow_tpu.resilience.watchdog import HungStepTimeout
+
+        assert isinstance(front.replicas[0].last_error, HungStepTimeout)
+        assert len(built) == 2
+        # the hang fired on the FIRST build's verify dispatch, and the
+        # restarted engine re-enabled speculation and used it
+        assert built[1].verify_calls > 0
+    finally:
+        front.close()
+
+
+def test_injected_transient_fault_on_verify_step_degrades_not_dies():
+    """A seeded STEP_EXCEPTION landing on a verify dispatch through the
+    SupervisedDecodeModel wrapper takes the degrade path: no replica
+    death, token-identical completions, speculation off."""
+    from flexflow_tpu.resilience.faults import (Fault, FaultKind,
+                                                FaultPlan)
+    from flexflow_tpu.serving import ServingFront
+
+    built = []
+
+    def spec_factory(replica_id, survivors=None):
+        m = FakeSpecModel(batch_slots=2, spec_k=4)
+        built.append(m)
+        return m
+
+    # the prompt spends its first len(p) - 1 dispatches advancing
+    # through prefill; the dispatch right after is the first
+    # spec-eligible round, i.e. the first verify — seed the fault there
+    p = cyclic(3, V + 2)
+    front = ServingFront(spec_factory, num_replicas=1,
+                         sleep=lambda s: None, retry_backoff=0.0,
+                         fault_plans={0: FaultPlan(
+                             [Fault(step=len(p) - 1,
+                                    kind=FaultKind.STEP_EXCEPTION)])})
+    try:
+        h = front.generate_async(p, 8)
+        assert h.wait(30.0) == expected(p, 8)
+        assert front.replicas[0].deaths == 0  # degraded, not dead
+        assert front.requeued_requests == 0
+        assert len(built) == 1
+        assert built[0].verify_calls == 0  # fault fired pre-dispatch
+        assert built[0].steps > 0          # plain decode finished it
+    finally:
+        front.close()
+
+
+# -- real engine: byte identity + accepted-per-round ---------------------
+
+def _train_cyclic_gpt(dev, hidden, layers, heads, inter,
+                      vocab=32, max_seq=64, slots=4, steps=120):
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+
+    cfg = FFConfig(batch_size=slots, num_devices=1)
+    ff = FFModel(cfg)
+    build_gpt(ff, batch_size=slots, seq_length=max_seq,
+              hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=inter, vocab_size=vocab)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    pos = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                          (slots, max_seq)).copy()
+    rng = np.random.RandomState(7)
+    for _ in range(steps):
+        starts = rng.randint(0, vocab, (slots, 1))
+        ids = ((starts + np.arange(max_seq)) % vocab).astype(np.int32)
+        ff.train_step({"input": ids, "positions": pos},
+                      ((ids + 1) % vocab).astype(np.int32))
+    return ff
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kernel", ["gather", "pallas"])
+def test_real_engine_byte_identity_across_spec_modes(kernel):
+    """ISSUE 18 acceptance: greedy completions with ngram AND draft
+    speculation are byte-identical to the non-speculative engine for
+    both paged formulations, invariant checker on at every step, and
+    the speculative runs accept > 1.5 tokens per verify round on the
+    cyclic workload."""
+    import jax
+
+    dev = jax.devices()[0]
+    ff = _train_cyclic_gpt(dev, 64, 2, 4, 128)
+    draft_ff = _train_cyclic_gpt(dev, 32, 1, 2, 64)
+    prompts = [[3, 4, 5, 6], [10, 11], [30, 31, 0, 1, 2], [7, 8, 9]]
+    mnts = [40, 30, 24, 36]
+
+    def run(spec, d=None):
+        sched = ContinuousScheduler.from_trained(
+            ff, batch_slots=4, page_size=8, devices=[dev],
+            prefill_chunk=4, spec_decode=spec, spec_k=4, draft_ff=d,
+            paged_kernel=kernel, check_invariants=True)
+        try:
+            hs = [sched.generate_async(p, m)
+                  for p, m in zip(prompts, mnts)]
+            outs = [h.wait(120.0) for h in hs]
+            return outs, sched.stats()["speculative"]
+        finally:
+            sched.close()
+
+    off, _ = run("off")
+    ng, st_ng = run("ngram")
+    dr, st_dr = run("draft", draft_ff)
+    assert ng == off, "ngram speculation changed greedy output"
+    assert dr == off, "draft speculation changed greedy output"
+    for st in (st_ng, st_dr):
+        assert st["rounds"] > 0 and not st["degraded"]
+        assert st["accepted_per_round"] > 1.5
+        assert st["verify_faults"] == 0
+
+
+@pytest.mark.slow
+def test_real_engine_transient_verify_fault_token_identical():
+    """A transient fault injected on the REAL verify dispatch: the
+    engine degrades to plain decode mid-request and the completions
+    still match the fault-free run byte-for-byte."""
+    import jax
+
+    dev = jax.devices()[0]
+    ff = _train_cyclic_gpt(dev, 64, 2, 4, 128)
+    # full-cycle prompts (vocab 32): the n-gram drafter matches from
+    # the very first decode round, so the clean run speculates
+    prompts = [[(3 + i) % 32 for i in range(34)],
+               [(10 + i) % 32 for i in range(34)]]
+    mnts = [24, 20]
+
+    def run(fail_verify):
+        sched = ContinuousScheduler.from_trained(
+            ff, batch_slots=4, page_size=8, devices=[dev],
+            spec_decode="ngram", spec_k=4, check_invariants=True)
+        if fail_verify:
+            calls = {"n": 0}
+            real = sched.model.verify_step
+
+            def flaky(tokens, seq_lens, counts, block_tables):
+                calls["n"] += 1
+                if calls["n"] == 2:
+                    raise RuntimeError("injected verify fault")
+                return real(tokens, seq_lens, counts, block_tables)
+
+            sched.model.verify_step = flaky
+        try:
+            hs = [sched.generate_async(p, m)
+                  for p, m in zip(prompts, mnts)]
+            outs = [h.wait(120.0) for h in hs]
+            return outs, sched.stats()["speculative"]
+        finally:
+            sched.close()
+
+    clean, st_clean = run(False)
+    faulted, st_faulted = run(True)
+    assert faulted == clean
+    assert st_clean["rounds"] > 1 and not st_clean["degraded"]
+    assert st_faulted["degraded"]
+    assert st_faulted["verify_faults"] == 1

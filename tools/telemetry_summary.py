@@ -226,6 +226,24 @@ def summarize(records: List[Dict]) -> str:
             f"bytes_read={int(read.get('value', 0))} "
             f"dense_bytes_avoided={int(avoided.get('value', 0))}",
         ))
+    # speculative decoding (docs/SERVING.md "Speculative decoding"):
+    # accept rate + tokens/round + verify-round rate in one line
+    prop = metrics.get("serving/spec_proposed")
+    if prop is not None:
+        acc = metrics.get("serving/spec_accepted", {})
+        rounds = metrics.get("serving/spec_rounds", {})
+        per_round = metrics.get("serving/spec_accepted_per_round", {})
+        rps = metrics.get("serving/spec_rounds_per_s", {})
+        n_prop = int(prop.get("value", 0))
+        n_acc = int(acc.get("value", 0))
+        rate = n_acc / n_prop if n_prop else 0.0
+        rows.append((
+            "speculative",
+            f"accept_rate={rate:.3f} ({n_acc}/{n_prop}) "
+            f"tokens/round={_fmt(per_round.get('mean', 0.0))} "
+            f"rounds={int(rounds.get('value', 0))} "
+            f"rounds/s={_fmt(rps.get('value', 0.0))}",
+        ))
     for name, rec in sorted(metrics.items()):
         if not name.startswith("serving/"):
             continue
