@@ -2209,6 +2209,191 @@ def bench_serving_spec(dev, on_tpu):
     }
 
 
+def bench_serving_trace(dev, on_tpu):
+    """Request-tracing leg (manifest v23): the disaggregated fleet
+    under `--spec-decode ngram` with request tracing ON vs the
+    identical traced-OFF twin (docs/OBSERVABILITY.md "Request
+    tracing").  A repetitive multi-page workload (migrate side of the
+    dispatcher's cost model, n-gram-draftable continuations) plus a
+    sub-page mix (guaranteed re-prefill side) runs through both
+    twins; the leg asserts greedy completions TOKEN-IDENTICAL (the
+    tracer must be a pure observer), every completed request's
+    trace_id resolving to exactly ONE connected trace tree (no
+    orphan spans — kv_adopt joins via the FFKV frame header), a
+    `migration` child present on every tree whose dispatch span
+    priced `migrate`, spec verify rounds riding shared batch spans,
+    and tracing overhead within 5% tokens/s on TPU captures (the CPU
+    smoke bounds it loosely — tiny runs are noise-dominated)."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+    from flexflow_tpu.obs.metrics import MetricsRegistry
+    from flexflow_tpu.obs.reqtrace import ReqTracer
+    from flexflow_tpu.serving import DisaggServingFront
+    from flexflow_tpu.serving.loadgen import (
+        run_loadgen, sample_repetitive_workload, sample_workload)
+    from tools import trace_analyze
+
+    leg = MANIFEST["legs"]["serving_trace"]
+    if on_tpu:
+        vocab, max_seq = leg["vocab"], leg["max_seq"]
+        hidden, layers, heads = leg["hidden"], leg["layers"], leg["heads"]
+        inter, slots = leg["intermediate"], leg["slots"]
+        page, n_req = leg["kv_page_size"], leg["requests"]
+        rate, chunk = leg["offered_rps"], leg["prefill_chunk"]
+        spec_k = leg["spec_k"]
+        n_tpl, ppt = leg["num_templates"], leg["phrases_per_template"]
+        phrase_len = leg["phrase_len"]
+        phrases_range = tuple(leg["prompt_phrases_range"])
+        mnt_range = tuple(leg["max_new_range"])
+        n_sub = leg["subpage_requests"]
+        sub_range = tuple(leg["subpage_len_range"])
+        sample = leg["trace_sample"]
+    else:
+        vocab, max_seq = 64, 64
+        hidden, layers, heads, inter = 64, 2, 4, 128
+        slots, page, n_req, rate, chunk = 4, 4, 16, 400.0, 4
+        spec_k = 4
+        n_tpl, ppt, phrase_len = 2, 2, 8
+        phrases_range, mnt_range = (3, 5), (2, 6)
+        n_sub, sub_range = 6, (2, 4)
+        sample = 1.0
+
+    cfg = FFConfig(batch_size=slots, num_devices=1,
+                   serving_slots=slots, kv_page_size=page,
+                   serving_replicas=2, prefill_chunk=chunk,
+                   spec_decode="ngram", spec_k=spec_k,
+                   trace_sample=sample)
+    ff = FFModel(cfg)
+    build_gpt(ff, batch_size=slots, seq_length=max_seq,
+              hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=inter, vocab_size=vocab)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (slots, max_seq)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                          (slots, max_seq)).copy()
+    ff.train_step({"input": ids, "positions": pos}, ids)  # real weights
+
+    wl_rng = np.random.RandomState(47)
+    # multi-page repetitive prompts: migrate-side AND n-gram-draftable
+    rep_wl, _ = sample_repetitive_workload(
+        wl_rng, n_req, vocab, num_templates=n_tpl,
+        phrases_per_template=ppt, phrase_len=phrase_len,
+        prompt_phrases_range=phrases_range, max_new_range=mnt_range)
+    sub_wl = sample_workload(wl_rng, n_sub, vocab,
+                             prompt_len_range=sub_range,
+                             max_new_range=mnt_range)
+    workload = rep_wl + sub_wl
+
+    def run_front(tracer, reg):
+        front = DisaggServingFront.from_trained(
+            ff, num_replicas=2, devices=[dev],
+            roles=["prefill", "decode"], registry=reg,
+            reqtrace=tracer)
+        try:
+            warm = [front.generate_async([1, 2], 2)
+                    for _ in range(2 * slots)]
+            warm.append(front.generate_async(
+                list(range(1, 2 * page + 2)), 2))
+            for h in warm:
+                h.wait(300.0)
+            report = run_loadgen(front, workload, rate, seed=29,
+                                 detail=True, record_tokens=True)
+            return report, front.stats()
+        finally:
+            front.close()
+
+    off_report, _ = run_front(None, None)
+    reg = MetricsRegistry()
+    tracer = ReqTracer(registry=reg, sample=sample)
+    on_report, on_stats = run_front(tracer, reg)
+
+    # the tracer is a pure observer: greedy completions identical
+    def by_idx(report):
+        return {r["idx"]: r["tokens"] for r in report["records"]
+                if r.get("ok")}
+    off_toks, on_toks = by_idx(off_report), by_idx(on_report)
+    assert set(off_toks) == set(on_toks), "completion sets differ"
+    bad = sum(1 for i in off_toks if off_toks[i] != on_toks[i])
+    assert bad == 0, f"{bad} completions differ traced vs untraced"
+
+    dg = on_stats["disagg"]
+    assert dg["migrate_decisions"] > 0, "no migration was ever chosen"
+    assert dg["reprefill_decisions"] > 0, \
+        "no re-prefill was ever chosen (sub-page mix missing?)"
+    # every completed request = exactly one connected trace tree; the
+    # warm-up traces drain through the same analyzer
+    traces, batch = trace_analyze.build_traces(tracer.spans)
+    ok_records = [r for r in on_report["records"] if r.get("ok")]
+    assert all("trace_id" in r for r in ok_records), \
+        "a completed request's detail record has no trace_id"
+    disconnected, missing_migration = [], []
+    for r in ok_records:
+        spans = traces.get(r["trace_id"])
+        assert spans, f"no trace tree for {r['trace_id']}"
+        ok, orphans = trace_analyze.check_connected(spans)
+        if not ok:
+            disconnected.append((r["trace_id"], orphans))
+        names = {s["name"] for s in spans}
+        migrated = any(s["name"] == "dispatch"
+                       and s["args"].get("decision") == "migrate"
+                       for s in spans)
+        if migrated and "migration" not in names:
+            missing_migration.append(r["trace_id"])
+    assert not disconnected, f"disconnected trees: {disconnected}"
+    assert not missing_migration, \
+        f"migrate decision but no migration span: {missing_migration}"
+    # spec verify rounds ride shared batch spans the decode spans ref
+    n_spec_batch = sum(1 for b in batch.values()
+                       if b["name"] == "spec_verify")
+    spec_rounds = sum(
+        s["args"].get("spec_rounds", 0)
+        for spans in traces.values() for s in spans
+        if s["name"] == "decode")
+    assert n_spec_batch > 0, "no spec_verify batch spans recorded"
+
+    def tps(rep):
+        return rep.get("tokens_per_s", 0.0)
+
+    for r in off_report, on_report:
+        r.pop("records", None)
+    ratio = tps(on_report) / max(tps(off_report), 1e-9)
+    # the headline overhead bar on TPU captures; the CPU smoke's tiny
+    # run is noise-dominated, so it only sanity-bounds the ratio
+    floor = 0.95 if on_tpu else 0.5
+    assert ratio >= floor, \
+        f"tracing overhead too high: tokens/s ratio {ratio:.3f}"
+    return {
+        "workload": (
+            f"{n_req} repetitive reqs ({n_tpl} templates x {ppt} "
+            f"phrases x {phrase_len} tokens, {phrases_range} "
+            f"phrases/prompt) + {n_sub} sub-page reqs {sub_range}, "
+            f"max_new {mnt_range}, Poisson {rate} rps, greedy, page "
+            f"{page}, chunk {chunk}, ngram k {spec_k}; "
+            f"prefill=1,decode=1, traced (sample {sample}) vs untraced"
+        ),
+        "untraced": off_report,
+        "traced": on_report,
+        "traced_vs_untraced_tokens_per_s": round(ratio, 3),
+        "trace_stats": tracer.stats(),
+        "traces_connected": len(ok_records),
+        "spec_verify_batch_spans": n_spec_batch,
+        "spec_rounds": spec_rounds,
+        "decisions": {
+            "migrate": dg["migrate_decisions"],
+            "reprefill": dg["reprefill_decisions"],
+            "migrations_ok": dg["migrations_ok"],
+            "migrations_failed": dg["migrations_failed"],
+        },
+        "completions_identical": True,   # asserted above
+        "one_tree_per_request": True,    # asserted above
+        "migration_children_present": True,  # asserted above
+        "overhead_within_bar": True,     # asserted above
+    }
+
+
 def bench_autoscale(dev, on_tpu):
     """Autoscaling-front leg (manifest v15): a SEEDED square-wave
     burst trace against a ServingFront that starts at min_replicas
@@ -2462,6 +2647,8 @@ def main():
     gc.collect()
     serving_spec = bench_serving_spec(dev, on_tpu)
     gc.collect()
+    serving_trace = bench_serving_trace(dev, on_tpu)
+    gc.collect()
     autoscale = bench_autoscale(dev, on_tpu)
     gc.collect()
     cold_start = bench_cold_start(dev, on_tpu)
@@ -2497,6 +2684,7 @@ def main():
                  "serving_resilience": serving_resilience,
                  "serving_disagg": serving_disagg,
                  "serving_spec": serving_spec,
+                 "serving_trace": serving_trace,
                  "autoscale": autoscale,
                  "cold_start": cold_start, "host_loss": host_loss,
                  "multi_slice": multi_slice,

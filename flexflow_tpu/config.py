@@ -366,6 +366,12 @@ class FFConfig:
     # jax.profiler.trace device capture around a step window,
     # "start:count" (e.g. "3:2" profiles steps 3 and 4); needs trace_dir
     profile_steps: Optional[str] = None
+    # per-request serving trace sampling probability
+    # (obs/reqtrace.py, docs/OBSERVABILITY.md "Request tracing"):
+    # 1.0 traces every admitted request (tests/smoke), loadgen/prod
+    # runs rate-limit by sampling down; 0.0 disables request tracing
+    # even with telemetry on
+    trace_sample: float = 1.0
 
     # -- serving (serving/, docs/SERVING.md): generation tier mode and
     #    paged KV-cache pool geometry.  Consumed by the serving entry
@@ -577,6 +583,11 @@ class FFConfig:
         if self.spec_k < 1:
             raise ValueError(
                 f"spec_k must be >= 1, got {self.spec_k}"
+            )
+        if not 0.0 <= self.trace_sample <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in [0.0, 1.0], got "
+                f"{self.trace_sample}"
             )
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(
@@ -802,6 +813,8 @@ class FFConfig:
         p.add_argument("--telemetry", dest="telemetry", action="store_true")
         p.add_argument("--profile-steps", dest="profile_steps", type=str,
                        default=None)
+        p.add_argument("--trace-sample", dest="trace_sample", type=float,
+                       default=1.0)
         p.add_argument("--serving-mode", dest="serving_mode", type=str,
                        default="continuous", choices=SERVING_MODES)
         p.add_argument("--kv-page-size", dest="kv_page_size", type=int,
@@ -925,6 +938,7 @@ class FFConfig:
             trace_dir=args.trace_dir,
             telemetry=args.telemetry,
             profile_steps=args.profile_steps,
+            trace_sample=args.trace_sample,
             serving_mode=args.serving_mode,
             kv_page_size=args.kv_page_size,
             kv_pool_blocks=args.kv_pool_blocks,

@@ -30,6 +30,13 @@ from .metrics import (
     emit_counters,
     registry_of,
 )
+from .reqtrace import (
+    FRONT_PID,
+    NULL_REQTRACER,
+    NullReqTracer,
+    ReqTracer,
+    TraceContext,
+)
 from .trace import NULL_TRACER, Tracer, span_allocations, tracer_of
 
 TRACE_FILENAME = "trace.json"
@@ -71,12 +78,20 @@ class RunTelemetry:
         enabled: Optional[bool] = None,
         profile_steps: Optional[str] = None,
         run_id: Optional[str] = None,
+        trace_sample: float = 1.0,
     ):
         self.trace_dir = trace_dir
         self.enabled = bool(trace_dir) if enabled is None else bool(enabled)
         self.run_id = run_id or f"run-{int(time.time())}-{os.getpid()}"
         self.metrics = MetricsRegistry()
         self.tracer = Tracer(run_id=self.run_id) if self.enabled else NULL_TRACER
+        # per-request serving traces (obs/reqtrace.py): spans drain into
+        # the same registry/JSONL and merge into trace.json at flush()
+        self.reqtrace = (
+            ReqTracer(registry=self.metrics, sample=trace_sample,
+                      run_id=self.run_id)
+            if self.enabled else NULL_REQTRACER
+        )
         self.profile_window = parse_profile_steps(profile_steps)
         self._profiling = False
         self._log_handler: Optional[TelemetryLogHandler] = None
@@ -111,6 +126,7 @@ class RunTelemetry:
                 or bool(getattr(cfg, "telemetry", False))
             ),
             profile_steps=getattr(cfg, "profile_steps", None),
+            trace_sample=getattr(cfg, "trace_sample", 1.0),
         )
 
     # -- jax profiler window --------------------------------------------
@@ -166,7 +182,8 @@ class RunTelemetry:
         if not self.enabled or not self.trace_dir:
             return {}
         os.makedirs(self.trace_dir, exist_ok=True)
-        self.tracer.write(self.trace_path)
+        self.tracer.write(self.trace_path,
+                          extra_events=self.reqtrace.chrome_events())
         self.metrics.write_jsonl(self.telemetry_path)
         return {"trace": self.trace_path, "telemetry": self.telemetry_path}
 
@@ -179,9 +196,14 @@ class RunTelemetry:
 
 
 __all__ = [
+    "FRONT_PID",
     "MetricsRegistry",
+    "NULL_REQTRACER",
     "NULL_TRACER",
+    "NullReqTracer",
+    "ReqTracer",
     "RunTelemetry",
+    "TraceContext",
     "TELEMETRY_FILENAME",
     "TRACE_FILENAME",
     "Tracer",

@@ -10,13 +10,17 @@ docs/OBSERVABILITY.md).
 Record schema, one JSON object per line:
 
     {"schema": 1, "ts": <unix seconds>, "kind": "counter" | "gauge" |
-     "histogram" | "event" | "fidelity", "name": <str>, ...payload}
+     "histogram" | "event" | "fidelity" | "span", "name": <str>, ...payload}
 
     counter   -> {"value": int}
     gauge     -> {"value": float}
-    histogram -> {"count", "sum", "min", "max", "mean"}
+    histogram -> {"count", "sum", "min", "max", "mean"
+                  [, "exemplar": {"value", "trace_id"}]}
     event     -> {"fields": {...}}   (log records, one-shot markers)
     fidelity  -> the obs/fidelity.py record verbatim
+    span      -> a request-trace span (obs/reqtrace.py): {"trace_id",
+                 "span_id", "parent_id", "pid", "t_start_us", "dur_us",
+                 "args"}
 """
 from __future__ import annotations
 
@@ -63,9 +67,16 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming count/sum/min/max summary of observations."""
+    """Streaming count/sum/min/max summary of observations.
 
-    __slots__ = ("name", "count", "sum", "min", "max")
+    An observation may carry an **exemplar** (a trace_id from
+    obs/reqtrace.py): the histogram keeps the worst (largest) sampled
+    value's exemplar per drain window, so an SLO regression in e.g.
+    `serving/ttft_ms` links straight to the offending request's trace.
+    The exemplar resets at drain; count/sum stay cumulative."""
+
+    __slots__ = ("name", "count", "sum", "min", "max",
+                 "exemplar_value", "exemplar_trace")
 
     def __init__(self, name: str):
         self.name = name
@@ -73,8 +84,10 @@ class Histogram:
         self.sum = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        self.exemplar_value = float("-inf")
+        self.exemplar_trace: Optional[str] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         self.count += 1
         self.sum += v
@@ -82,13 +95,20 @@ class Histogram:
             self.min = v
         if v > self.max:
             self.max = v
+        if exemplar is not None and v > self.exemplar_value:
+            self.exemplar_value = v
+            self.exemplar_trace = exemplar
 
     @property
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    def reset_exemplar(self) -> None:
+        self.exemplar_value = float("-inf")
+        self.exemplar_trace = None
+
     def record(self) -> Dict:
-        return {
+        rec = {
             "kind": "histogram",
             "name": self.name,
             "count": self.count,
@@ -97,6 +117,10 @@ class Histogram:
             "max": self.max if self.count else 0.0,
             "mean": self.mean,
         }
+        if self.exemplar_trace is not None:
+            rec["exemplar"] = {"value": self.exemplar_value,
+                               "trace_id": self.exemplar_trace}
+        return rec
 
 
 class MetricsRegistry:
@@ -163,6 +187,14 @@ class MetricsRegistry:
         rec.setdefault("ts", time.time())
         self._events.append(rec)
 
+    def span(self, record: Dict) -> None:
+        """Attach a finished request-trace span (obs/reqtrace.py) to
+        the event stream — spans drain exactly once, like events."""
+        rec = dict(record)
+        rec["kind"] = "span"
+        rec.setdefault("ts", time.time())
+        self._events.append(rec)
+
     # -- drain -----------------------------------------------------------
     def drain(self) -> List[Dict]:
         """Buffered events (cleared) + a snapshot of every metric's
@@ -177,10 +209,15 @@ class MetricsRegistry:
             ev["schema"] = SCHEMA_VERSION
             records.append(ev)
         for name in sorted(self._metrics):
-            rec = self._metrics[name].record()
+            metric = self._metrics[name]
+            rec = metric.record()
             rec["ts"] = now
             rec["schema"] = SCHEMA_VERSION
             records.append(rec)
+            if isinstance(metric, Histogram):
+                # exemplars are per-drain-window: the next window's
+                # worst sample gets a fresh link
+                metric.reset_exemplar()
         return records
 
     def write_jsonl(self, path: str) -> int:
@@ -192,6 +229,49 @@ class MetricsRegistry:
             for rec in records:
                 f.write(json.dumps(rec) + "\n")
         return len(records)
+
+
+def _prom_name(name: str) -> str:
+    """Registry names (`serving/ttft_ms`) to Prometheus metric names
+    (`serving_ttft_ms`): slashes and anything outside [a-zA-Z0-9_:]
+    become underscores."""
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch in "_:") else "_")
+    return "".join(out)
+
+
+def to_prometheus(registry: MetricsRegistry) -> str:
+    """Render the live registry as Prometheus text exposition
+    (`# TYPE` comments + `name value` samples).  Histograms render as
+    summaries (`_count`/`_sum`) plus `_min`/`_max` gauges; a histogram
+    holding an exemplar annotates its `_count` sample with the
+    OpenMetrics exemplar syntax (`# {trace_id="..."} <value>`) so an
+    SLO scrape links to the offending request trace."""
+    lines: List[str] = []
+    for name in sorted(registry._metrics):
+        metric = registry._metrics[name]
+        pname = _prom_name(name)
+        if isinstance(metric, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {metric.value}")
+        elif isinstance(metric, Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {metric.value}")
+        elif isinstance(metric, Histogram):
+            lines.append(f"# TYPE {pname} summary")
+            count_line = f"{pname}_count {metric.count}"
+            if metric.exemplar_trace is not None:
+                count_line += (
+                    f' # {{trace_id="{metric.exemplar_trace}"}}'
+                    f" {metric.exemplar_value}")
+            lines.append(count_line)
+            lines.append(f"{pname}_sum {metric.sum}")
+            lines.append(f"# TYPE {pname}_min gauge")
+            lines.append(f"{pname}_min {metric.min if metric.count else 0.0}")
+            lines.append(f"# TYPE {pname}_max gauge")
+            lines.append(f"{pname}_max {metric.max if metric.count else 0.0}")
+    return "\n".join(lines) + "\n"
 
 
 def registry_of(ff) -> Optional[MetricsRegistry]:

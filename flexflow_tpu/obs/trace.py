@@ -80,7 +80,7 @@ class NullTracer:
     def instant(self, name: str, cat: str = "run", **args) -> None:
         return None
 
-    def write(self, path: str) -> None:
+    def write(self, path: str, extra_events=None) -> None:
         return None
 
 
@@ -135,11 +135,16 @@ class Tracer:
         with self._lock:
             self.events.append(ev)
 
-    def write(self, path: str) -> None:
+    def write(self, path: str, extra_events=None) -> None:
         """Serialize as Chrome trace-event JSON (Perfetto-loadable),
-        events sorted by timestamp."""
+        events sorted by timestamp.  `extra_events` merges additional
+        pre-built events (e.g. the request tracer's per-replica span
+        tracks) into the same document."""
         with self._lock:
-            events = sorted(self.events, key=lambda e: e["ts"])
+            events = list(self.events)
+        if extra_events:
+            events.extend(extra_events)
+        events.sort(key=lambda e: e["ts"])
         doc = {
             "traceEvents": events,
             "displayTimeUnit": "ms",

@@ -186,7 +186,8 @@ class DisaggServingFront(ServingFront):
             machine=machine)
         self.migrator = KVMigrator(
             self.fabric, registry=kw.get("registry"),
-            logger=kw.get("logger", resilience_logger))
+            logger=kw.get("logger", resilience_logger),
+            reqtrace=kw.get("reqtrace"))
         self.migrate_decisions = 0
         self.reprefill_decisions = 0
         self.migrations_ok = 0
@@ -249,6 +250,15 @@ class DisaggServingFront(ServingFront):
             chunk=int(getattr(dsched.model, "prefill_chunk", 0)),
             step_s=step_ms / 1e3)
         req.migration = record
+        if req.trace is not None:
+            # the priced decision lands on the open dispatch span:
+            # trace_analyze and Perfetto show WHY this request migrated
+            # (or re-prefilled) next to what it cost
+            req.trace.annotate(
+                "dispatch", decision=record["decision"],
+                new_blocks=record["new_blocks"],
+                migrate_s=record["migrate_s"],
+                reprefill_s=record["reprefill_s"])
         if record["decision"] != "migrate":
             self.reprefill_decisions += 1
             if self.registry is not None:
@@ -272,9 +282,14 @@ class DisaggServingFront(ServingFront):
         """Outside the front lock: run the prompt on the prefill
         replica.  max_new=1 — the pass exists to WRITE the prompt's KV
         and index every block boundary, not to generate."""
+        if req.trace is not None:
+            req.trace.end("dispatch")
+            req.trace.begin("migration",
+                            prefill_replica=prefill_r.replica_id,
+                            decode_replica=decode_r.replica_id)
         try:
             prefill_r.submit(
-                req.prompt, 1, 0.0,
+                req.prompt, 1, 0.0, trace=req.trace,
                 on_done=lambda h: self._on_prefill_done(
                     req, prefill_r, decode_r, h))
         except Exception:  # noqa: BLE001 — died between pick and submit
@@ -315,9 +330,15 @@ class DisaggServingFront(ServingFront):
         if dsched is None:  # target died while we prefilled
             self._settle_migration(req, False)
             return
+        # the trace context rides the FFKV frame header (wire dict):
+        # the adopting decode replica's kv_adopt span joins this tree
+        # as a child of the migration span
+        wire = (req.trace.wire(parent=req.trace.open_id("migration"))
+                if req.trace is not None else None)
         self.migrator.migrate(
             prompt=req.prompt, pages=pages, blocks=arrays,
             page_size=psched.pool.page_size, target=dsched,
+            wire=wire,
             on_done=lambda ok: self._settle_migration(req, ok))
 
     def _settle_migration(self, req: FrontRequest, ok: bool) -> None:
@@ -333,6 +354,10 @@ class DisaggServingFront(ServingFront):
             self.migrations_failed += 1
         if isinstance(req.migration, dict):
             req.migration["ok"] = bool(ok)
+        if req.trace is not None:
+            req.trace.end("migration", ok=bool(ok))
+            req.trace.begin("queue", requeued=True,
+                            post_migration=True)
         with self._cv:
             if self._closed:
                 self._fail(req, RuntimeError("ServingFront is closed"))

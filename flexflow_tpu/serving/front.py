@@ -70,7 +70,8 @@ class FrontRequest:
                  "result", "error", "t_submit", "t_first_token",
                  "t_done", "n_generated", "retries",
                  "queue_depth_at_admit", "deadline_s",
-                 "prefix_hit_tokens", "served_role", "migration")
+                 "prefix_hit_tokens", "served_role", "migration",
+                 "trace")
 
     def __init__(self, prompt, max_new_tokens, temperature,
                  deadline_s: Optional[float] = None):
@@ -90,6 +91,7 @@ class FrontRequest:
         self.prefix_hit_tokens = 0     # stamped from the replica handle
         self.served_role = None        # class of the replica that served
         self.migration = None  # disagg routing record (serving/disagg.py)
+        self.trace = None  # TraceContext (obs/reqtrace.py) or None
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.event.wait(timeout):
@@ -131,6 +133,7 @@ class ServingFront:
         shed_retry_after_s: float = 1.0,
         admission_deadline_s: float = 0.0,
         rate_staleness_s: float = 30.0,
+        reqtrace=None,
         sleep: Callable[[float], None] = time.sleep,
         logger=resilience_logger,
     ):
@@ -163,6 +166,14 @@ class ServingFront:
                 "fleet needs at least one decode-capable replica "
                 "(role decode or mixed)")
         self.registry = registry
+        # request-scoped tracing (obs/reqtrace.py): the front mints one
+        # TraceContext per sampled admission and threads it through
+        # dispatch, migration, and every replica scheduler.  None (or a
+        # NullReqTracer) keeps req.trace = None everywhere — the
+        # zero-allocation disabled path.
+        self._reqtrace = (reqtrace if reqtrace is not None
+                          and getattr(reqtrace, "enabled", True)
+                          else None)
         self.request_retry_limit = int(request_retry_limit)
         self.chip_budget = int(chip_budget)  # 0 = unbounded
         self._pending_replicas = 0  # add_replica compiles in flight
@@ -215,6 +226,7 @@ class ServingFront:
             retry_backoff=retry_backoff,
             check_invariants=check_invariants,
             close_timeout_s=close_timeout_s, sleep=sleep, logger=logger,
+            reqtrace=self._reqtrace,
         )
         self.replicas: List[ServingReplica] = [
             self._build_replica(i, fault_plan=plans.get(i),
@@ -257,6 +269,7 @@ class ServingFront:
                               seed=kw["seed"] + replica_id),
             fault_plan=fault_plan,
             role=role,
+            reqtrace=kw["reqtrace"],
             check_invariants=kw["check_invariants"],
             close_timeout_s=kw["close_timeout_s"],
             sleep=kw["sleep"],
@@ -289,6 +302,15 @@ class ServingFront:
         from .scheduler import PagedKVDecodeModel
 
         cfg = ff_train.config
+        # inherit the run's telemetry bundle unless the caller wires
+        # its own: --trace-dir alone gives the serving fleet SLO
+        # metrics AND per-request traces (obs/reqtrace.py) — the
+        # NULL_REQTRACER's enabled=False keeps the disabled path free
+        tel = getattr(ff_train, "telemetry", None)
+        if tel is not None:
+            if registry is None and getattr(tel, "enabled", False):
+                registry = tel.metrics
+            kw.setdefault("reqtrace", getattr(tel, "reqtrace", None))
         spec_decode = resolve_spec_decode(
             getattr(cfg, "spec_decode", "off"),
             getattr(cfg, "spec_k", 4))
@@ -667,6 +689,15 @@ class ServingFront:
                             120.0),
                     )
             req.queue_depth_at_admit = depth
+            if self._reqtrace is not None:
+                # mint the request's trace at admission (sampled); the
+                # "queue" span stays open until the dispatcher picks
+                # the request up
+                req.trace = self._reqtrace.trace(
+                    "request", prompt_len=len(req.prompt),
+                    max_new=req.max_new_tokens)
+                if req.trace is not None:
+                    req.trace.begin("queue", depth=depth)
             self._admission.append(req)
             self.requests_admitted += 1
             self._cv.notify_all()
@@ -744,6 +775,14 @@ class ServingFront:
                         retry_after_s=self.shed_retry_after_s,
                     ))
                     continue
+                if req.trace is not None:
+                    # dispatch span: covers the routing decision (and
+                    # any disagg cost pricing — _divert_plan annotates
+                    # it) through the replica submit
+                    req.trace.end("queue")
+                    req.trace.begin("dispatch",
+                                    replica=replica.replica_id,
+                                    role=replica.role)
                 # disaggregation hook (serving/disagg.py): a subclass
                 # may claim the request for a prefill pass + KV
                 # migration instead of direct dispatch.  The decision
@@ -759,9 +798,12 @@ class ServingFront:
             try:
                 replica.submit(
                     req.prompt, req.max_new_tokens, req.temperature,
+                    trace=req.trace,
                     on_done=lambda h, _req=req, _r=replica:
                         self._on_settle(_req, _r, h),
                 )
+                if req.trace is not None:
+                    req.trace.end("dispatch")
             except ValueError as e:
                 # pool geometry can never serve it: the request's
                 # problem, fail alone
@@ -783,6 +825,9 @@ class ServingFront:
                     if self._terminating or self._closed:
                         shed_req = req
                     else:
+                        if req.trace is not None:
+                            req.trace.end("dispatch", died=True)
+                            req.trace.begin("queue", requeued=True)
                         self._admission.appendleft(req)
                 if shed_req is not None:
                     self._fail(shed_req, ServiceUnavailable(
@@ -800,6 +845,8 @@ class ServingFront:
     # -- settlement ------------------------------------------------------
     def _fail(self, req: FrontRequest, err: Exception) -> None:
         req.error = err
+        if req.trace is not None:
+            req.trace.finish(ok=False, error=type(err).__name__)
         req.event.set()
 
     def _complete(self, req: FrontRequest, handle,
@@ -827,6 +874,9 @@ class ServingFront:
             # settles arrive from every replica's worker thread; the
             # += below is not atomic, so it rides the same lock
             self.requests_done += 1
+        if req.trace is not None:
+            req.trace.finish(ok=True, n_generated=req.n_generated,
+                             retries=req.retries, role=role)
         req.event.set()
 
     def _on_settle(self, req: FrontRequest, replica: ServingReplica,
@@ -877,6 +927,12 @@ class ServingFront:
                 # for its full timeout with no dispatcher left
                 self._fail(req, RuntimeError("ServingFront is closed"))
                 return
+            if req.trace is not None:
+                # back to the queue: the replica's phase spans ended
+                # (or will end truncated); a fresh queue span tracks
+                # the wait for the surviving replica
+                req.trace.begin("queue", requeued=True,
+                                retries=req.retries)
             self._admission.appendleft(req)  # keep its seniority
             self._cv.notify_all()
 

@@ -56,12 +56,15 @@ def content_key(prompt: Sequence[int], n_blocks: int,
 
 def pack_kv_blocks(pages: Sequence[Sequence[int]],
                    blocks: Sequence[Dict[str, np.ndarray]],
-                   page_size: int) -> bytes:
+                   page_size: int,
+                   trace: Optional[Dict] = None) -> bytes:
     """Serialize exported blocks: a JSON header (schema + per-block
     token pages + per-block crc32 of the raw bytes) followed by each
     block's arrays concatenated in schema order.  The header carries
     every crc, so a truncated payload still verifies (and admits) the
-    intact prefix blocks."""
+    intact prefix blocks.  `trace` (a TraceContext.wire dict) rides
+    the header so the adopting replica's spans join the originating
+    request's trace tree (obs/reqtrace.py)."""
     if len(pages) != len(blocks):
         raise ValueError("pages/blocks length mismatch")
     schema = []
@@ -76,14 +79,17 @@ def pack_kv_blocks(pages: Sequence[Sequence[int]],
                        for s in schema)
         payloads.append(raw)
         crcs.append(zlib.crc32(raw))
-    header = json.dumps({
+    hdr = {
         "v": _VERSION,
         "page_size": int(page_size),
         "pages": [[int(t) for t in p] for p in pages],
         "schema": schema,
         "crcs": crcs,
         "block_bytes": [len(p) for p in payloads],
-    }).encode("utf-8")
+    }
+    if trace:
+        hdr["trace"] = trace
+    header = json.dumps(hdr).encode("utf-8")
     return b"".join([_MAGIC, struct.pack("<I", len(header)), header]
                     + payloads)
 
@@ -129,6 +135,24 @@ def unpack_kv_blocks(data: bytes, prompt: Sequence[int]
             pos += n
         out.append(arrays)
     return out, complete
+
+
+def frame_trace(data: bytes) -> Optional[Dict]:
+    """The trace wire dict a KV frame header carries (None when absent
+    or unparseable): the adopting side reads it off the RECEIVED bytes
+    — proving the context actually propagated through the fabric —
+    and joins the tree via ReqTracer.begin_remote.  Never raises: a
+    torn frame just loses its trace linkage, not its safety (unpack
+    still arbitrates adoption)."""
+    try:
+        if len(data) < 8 or data[:4] != _MAGIC:
+            return None
+        (hlen,) = struct.unpack("<I", data[4:8])
+        hdr = json.loads(data[8:8 + hlen].decode("utf-8"))
+        trace = hdr.get("trace")
+        return trace if isinstance(trace, dict) else None
+    except Exception:  # noqa: BLE001
+        return None
 
 
 # -- transfer fabrics -----------------------------------------------------
@@ -240,10 +264,16 @@ class KVMigrator:
     migration just means the decode replica re-prefills)."""
 
     def __init__(self, fabric: KVTransferFabric, registry=None,
-                 logger=None):
+                 logger=None, reqtrace=None):
         self.fabric = fabric
         self.registry = registry
         self.logger = logger
+        # request tracer (obs/reqtrace.py): the importing side's
+        # kv_adopt span joins the tree named by the frame header's
+        # wire dict.  None (or disabled) skips all span work.
+        self.reqtrace = (reqtrace if reqtrace is not None
+                         and getattr(reqtrace, "enabled", True)
+                         else None)
         self.started = 0
         self.completed = 0
         self.failed = 0
@@ -258,15 +288,18 @@ class KVMigrator:
                 pages: Sequence[Sequence[int]],
                 blocks: Sequence[Dict[str, np.ndarray]],
                 page_size: int, target,
-                on_done: Callable[[bool], None]) -> None:
+                on_done: Callable[[bool], None],
+                wire: Optional[Dict] = None) -> None:
         """Queue one migration of `blocks` (host arrays exported from
         the source pool) into `target` (a ContinuousScheduler-shaped
-        engine with .pool and .model)."""
+        engine with .pool and .model).  `wire` (TraceContext.wire) is
+        embedded in the frame header so the adopt joins the request's
+        trace tree."""
         self.started += 1
         if self.registry is not None:
             self.registry.counter("serving/kv_migration_started").inc()
         self._jobs.put((list(prompt), list(pages), list(blocks),
-                        int(page_size), target, on_done))
+                        int(page_size), target, on_done, wire))
 
     def close(self) -> None:
         self._stop.set()
@@ -301,10 +334,10 @@ class KVMigrator:
             job = self._jobs.get()
             if job is None:
                 continue
-            prompt, pages, blocks, page, target, on_done = job
+            prompt, pages, blocks, page, target, on_done, wire = job
             try:
                 key = content_key(prompt, len(blocks), page)
-                data = pack_kv_blocks(pages, blocks, page)
+                data = pack_kv_blocks(pages, blocks, page, trace=wire)
                 got = self.fabric.transfer(key, data)
                 verified, complete = unpack_kv_blocks(got, prompt)
             except Exception as e:  # fabric down / torn header
@@ -313,16 +346,28 @@ class KVMigrator:
             if not verified:
                 self._fail(on_done, "no block verified")
                 continue
+            # the adopt span's link comes off the RECEIVED frame, not
+            # the local wire variable: the propagation path under test
+            # is the fabric itself
             self._import(prompt, verified, complete, len(got),
-                         target, on_done)
+                         target, on_done, frame_trace(got))
 
     def _import(self, prompt, verified, complete, nbytes, target,
-                on_done) -> None:
+                on_done, wire: Optional[Dict] = None) -> None:
         """Marshal the device writes onto the target's worker thread:
         adopt_prefix registers the blocks and the writes land before
         the worker's next admission, so no request can ever map a
         block whose bytes are still in flight."""
         def write():
+            span = None
+            if self.reqtrace is not None and wire is not None:
+                # runs ON the adopting replica's worker thread: its
+                # span lands on that replica's Perfetto track, linked
+                # into the originating request's tree
+                span = self.reqtrace.begin_remote(
+                    wire, "kv_adopt",
+                    pid=getattr(target, "_trace_pid", None),
+                    blocks=len(verified))
             pairs = target.pool.adopt_prefix(prompt, len(verified))
             done = 0
             try:
@@ -334,6 +379,8 @@ class KVMigrator:
                 # admission must never map them
                 target.pool.drop_adopted(
                     [blk for _, blk in pairs[done:]])
+                if span is not None:
+                    span.end(ok=False, written=done)
                 self._fail(on_done, "device write", e)
                 if getattr(e, "fatal_to_engine", False):
                     raise
@@ -356,6 +403,9 @@ class KVMigrator:
                     len(verified))
             elif not complete:
                 self.failed += 1
+            if span is not None:
+                span.end(ok=True, complete=bool(complete),
+                         written=done, bytes=nbytes)
             try:
                 on_done(bool(complete))
             except Exception:  # noqa: BLE001

@@ -248,6 +248,9 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
                    "ok": False}
             if depth is not None:
                 rec["queue_depth_at_admit"] = depth
+            tr = getattr(h, "trace", None)
+            if tr is not None:
+                rec["trace_id"] = tr.trace_id
             records.append(rec)
             continue
         # every handle flavor stamps t_submit at generate_async time —
@@ -276,6 +279,11 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
             # prompt tokens the KV prefix cache served (zero prefill
             # steps) — the serving_prefix bench leg buckets on these
             rec["prefix_hit_tokens"] = int(hit)
+        tr = getattr(h, "trace", None)
+        if tr is not None:
+            # joins this record to its span tree in run_telemetry.jsonl
+            # / trace.json (tools/trace_analyze.py keys on trace_id)
+            rec["trace_id"] = tr.trace_id
         prop = getattr(h, "spec_proposed", None)
         if prop is not None:
             # draft tokens this request put through verification and

@@ -243,6 +243,7 @@ class ServingReplica:
         logger=resilience_logger,
         role: str = "mixed",
         check_invariants: bool = False,
+        reqtrace=None,
     ):
         self.replica_id = int(replica_id)
         self.model_factory = model_factory
@@ -256,6 +257,10 @@ class ServingReplica:
                 "['prefill', 'decode', 'mixed']")
         self.role = role
         self._check_invariants = bool(check_invariants)
+        # request tracer shared fleet-wide (obs/reqtrace.py): every
+        # rebuild hands it to the fresh scheduler with this replica's
+        # id as the Perfetto track (pid)
+        self._reqtrace = reqtrace
         self.eos_id = int(eos_id)
         self.registry = registry
         self.seed = int(seed)
@@ -335,6 +340,8 @@ class ServingReplica:
             close_timeout_s=self.close_timeout_s,
             on_death=self._on_death,
             check_invariants=self._check_invariants,
+            reqtrace=self._reqtrace,
+            trace_pid=self.replica_id,
         )
 
     def _on_death(self, exc: Exception) -> None:
@@ -498,13 +505,14 @@ class ServingReplica:
         self._retire()
 
     # -- front-facing ----------------------------------------------------
-    def submit(self, prompt, max_new_tokens, temperature, on_done):
+    def submit(self, prompt, max_new_tokens, temperature, on_done,
+               trace=None):
         sched = self.scheduler
         if self.state != "live" or sched is None:
             raise RuntimeError(
                 f"serving replica {self.replica_id} is {self.state}")
         return sched.generate_async(prompt, max_new_tokens, temperature,
-                                    on_done=on_done)
+                                    on_done=on_done, trace=trace)
 
     def stats(self) -> Dict:
         sched = self.scheduler

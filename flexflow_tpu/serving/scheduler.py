@@ -317,11 +317,11 @@ class _PendingSeq:
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
                  "event", "result", "error", "t_submit", "t_first_token",
                  "t_done", "n_generated", "prefix_hit_tokens",
-                 "spec_proposed", "spec_accepted", "on_done",
+                 "spec_proposed", "spec_accepted", "on_done", "trace",
                  "_settle_lock", "_settled")
 
     def __init__(self, prompt, max_new_tokens, temperature, seed,
-                 on_done=None):
+                 on_done=None, trace=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -337,6 +337,7 @@ class _PendingSeq:
         self.spec_proposed = 0   # draft tokens verified for this request
         self.spec_accepted = 0   # ... of which the target agreed with
         self.on_done = on_done
+        self.trace = trace  # TraceContext (obs/reqtrace.py) or None
         self._settle_lock = threading.Lock()
         self._settled = False
 
@@ -369,7 +370,7 @@ class _Live:
     already in shared KV blocks and never prefill."""
 
     __slots__ = ("req", "seq_id", "pos", "next_token", "generated",
-                 "max_new", "rng")
+                 "max_new", "rng", "tspan")
 
     def __init__(self, req: _PendingSeq, seq_id: int, max_new: int,
                  start: int = 0):
@@ -381,6 +382,58 @@ class _Live:
         self.max_new = max_new            # clamped to the position table
         self.rng = (np.random.RandomState(req.seed)
                     if req.temperature > 0.0 else None)
+        self.tspan: Optional["_LiveTrace"] = None  # request-trace state
+
+
+class _LiveTrace:
+    """Per-slot request-trace bookkeeping (obs/reqtrace.py) for ONE
+    traced live row.  The row owns exactly one open PHASE span at a
+    time ("prefill" until its first generated token, then "decode");
+    batched dispatches (prefill chunks, decode steps, verify rounds)
+    each get ONE shared batch span per dispatch, and the per-request
+    phase span REFERENCES those by span id instead of duplicating
+    them — N rows riding one dispatch never write N copies of it."""
+
+    __slots__ = ("ctx", "pid", "span", "chunks", "chunk_refs",
+                 "steps", "spec_rounds", "batch_refs")
+
+    MAX_REFS = 64  # cap the per-request batch-span reference list
+
+    def __init__(self, ctx, pid: int, hit_tokens: int, plen: int):
+        self.ctx = ctx
+        self.pid = pid
+        self.span = ctx.begin("prefill", pid=pid,
+                              prefix_hit_tokens=hit_tokens,
+                              prompt_len=plen)
+        self.chunks = 0        # chunked-prefill dispatches ridden
+        self.chunk_refs: List[int] = []  # their batch span ids
+        self.steps = 0         # decode/verify dispatches ridden
+        self.spec_rounds = 0   # of which were speculative verifies
+        self.batch_refs: List[int] = []  # decode-phase batch span ids
+
+    def ref_chunk(self, batch_span) -> None:
+        self.chunks += 1
+        if batch_span is not None and len(self.chunk_refs) < self.MAX_REFS:
+            self.chunk_refs.append(batch_span.span_id)
+
+    def ref_step(self, batch_span, spec: bool = False) -> None:
+        self.steps += 1
+        if spec:
+            self.spec_rounds += 1
+        if batch_span is not None and len(self.batch_refs) < self.MAX_REFS:
+            self.batch_refs.append(batch_span.span_id)
+
+    def to_decode(self) -> None:
+        """First generated token: close the prefill phase, open decode."""
+        self.span.end(chunks=self.chunks, batch_spans=self.chunk_refs)
+        self.span = self.ctx.begin("decode", pid=self.pid)
+
+    def finish(self, req: _PendingSeq) -> None:
+        self.span.end(steps=self.steps, n_generated=req.n_generated,
+                      spec_rounds=self.spec_rounds,
+                      spec_proposed=req.spec_proposed,
+                      spec_accepted=req.spec_accepted,
+                      batch_spans=self.batch_refs)
 
 
 class ContinuousScheduler:
@@ -395,8 +448,18 @@ class ContinuousScheduler:
                  eos_id: int = -1, registry=None, seed: int = 0,
                  latency_window: int = 1024,
                  close_timeout_s: float = 60.0,
-                 on_death=None, check_invariants: bool = False):
+                 on_death=None, check_invariants: bool = False,
+                 reqtrace=None, trace_pid: int = 0):
         self.model = model
+        # per-request distributed tracing (obs/reqtrace.py): requests
+        # arrive carrying a TraceContext minted at the front; this
+        # engine contributes phase + batch spans on its own Perfetto
+        # track (`trace_pid` = replica id).  None keeps every hot-path
+        # check a single `is not None` that allocates nothing.
+        self._reqtrace = (reqtrace if reqtrace is not None
+                          and getattr(reqtrace, "enabled", True)
+                          else None)
+        self._trace_pid = int(trace_pid)
         self.pool = pool or KVPool(
             model.num_blocks, model.page_size, model.max_blocks_per_seq,
             prefix_cache=bool(getattr(model, "prefix_cache", True)))
@@ -554,7 +617,7 @@ class ContinuousScheduler:
 
     def generate_async(self, prompt, max_new_tokens: int = 16,
                        temperature: float = 0.0,
-                       on_done=None) -> _PendingSeq:
+                       on_done=None, trace=None) -> _PendingSeq:
         if self._stop.is_set():
             raise RuntimeError("ContinuousScheduler is closed")
         if self._draining:
@@ -567,7 +630,7 @@ class ContinuousScheduler:
         # the handle from birth, so a completion can never race the
         # caller attaching it.
         p = _PendingSeq(prompt, max_new_tokens, temperature,
-                        next(self._seed), on_done=on_done)
+                        next(self._seed), on_done=on_done, trace=trace)
         if not 1 <= len(p.prompt) < self.model.max_seq:
             raise ValueError(
                 f"prompt length {len(p.prompt)} outside [1, "
@@ -886,6 +949,9 @@ class ContinuousScheduler:
                 if self.registry is not None:
                     self.registry.counter("serving/kv_cow_copies").inc()
             live = _Live(req, sid, max_new, start=start)
+            if self._reqtrace is not None and req.trace is not None:
+                live.tspan = _LiveTrace(req.trace, self._trace_pid,
+                                        hit, plen)
             slot = free.pop(0)
             self._slots[slot] = live
             # first private block (or a no-op after a full hit):
@@ -1015,6 +1081,12 @@ class ContinuousScheduler:
             slen[i] = live.pos
             btab[i] = self._btab[i]
             plan.append((i, live, upto))
+        bspan = None
+        if self._reqtrace is not None and any(
+                live.tspan is not None for _, live in pre):
+            bspan = self._reqtrace.batch_span(
+                "prefill_chunk", self._trace_pid,
+                rows=len(plan), chunk=C)
         t0 = time.monotonic()
         try:
             self.model.prefill_step(tok, slen, btab)
@@ -1023,6 +1095,11 @@ class ContinuousScheduler:
                 raise
             self._fail_inflight(e)
             return False
+        if bspan is not None:
+            bspan.end()
+            for _, live in pre:
+                if live.tspan is not None:
+                    live.tspan.ref_chunk(bspan)
         self._note_step_time(time.monotonic() - t0)
         self.prefill_steps += 1
         if self._paged_kernel == "pallas":
@@ -1129,6 +1206,15 @@ class ContinuousScheduler:
                 self.pool.extend(live.seq_id, live.pos + m,
                                  written=live.pos)
                 self._btab[i] = self.pool.table_row(live.seq_id)
+        bspan = None
+        if self._reqtrace is not None and any(
+                s is not None and s.tspan is not None
+                for s in self._slots):
+            bspan = self._reqtrace.batch_span(
+                "spec_verify", self._trace_pid,
+                rows=int((counts > 0).sum()),
+                drafted=len(props), fed=int(counts.sum()),
+                **self._proposer.trace_attrs())
         t0 = time.monotonic()
         try:
             logits = self.model.verify_step(
@@ -1149,6 +1235,8 @@ class ContinuousScheduler:
                 self.registry.counter(
                     "serving/spec_verify_faults").inc()
             return False
+        if bspan is not None:
+            bspan.end()
         self._note_step_time(time.monotonic() - t0)
         self.batches_run += 1
         self.spec_rounds += 1
@@ -1180,6 +1268,8 @@ class ContinuousScheduler:
                 live.next_token = live.req.prompt[live.pos]
                 self._tokens[i] = live.next_token
                 self._slens[i] = live.pos
+                if live.tspan is not None:
+                    live.tspan.ref_step(bspan, spec=True)
                 continue
             # decode-phase: walk the model's own token chain across
             # the fed positions — position j's output is valid iff
@@ -1237,13 +1327,20 @@ class ContinuousScheduler:
                     reg.histogram(
                         "serving/spec_accepted_per_round").observe(
                         accepted)
+            if live.tspan is not None:
+                live.tspan.ref_step(bspan, spec=True)
             if not live.generated:
                 live.req.t_first_token = now
                 with self._lat_lock:
                     self._ttfts.append(now - live.req.t_submit)
                 if self.registry is not None:
                     self.registry.histogram("serving/ttft_ms").observe(
-                        (now - live.req.t_submit) * 1e3)
+                        (now - live.req.t_submit) * 1e3,
+                        exemplar=(live.req.trace.trace_id
+                                  if live.req.trace is not None
+                                  else None))
+                if live.tspan is not None:
+                    live.tspan.to_decode()
             live.generated.extend(out)
             self.tokens_generated += emitted
             done = (len(live.generated) >= live.max_new
@@ -1307,6 +1404,13 @@ class ContinuousScheduler:
                 # [slots, 1] decode step — the required empty-round
                 # fallback (and the whole path when spec is off)
                 self.spec_fallback_rounds += 1
+            bspan = None
+            if self._reqtrace is not None and any(
+                    s is not None and s.tspan is not None
+                    for s in self._slots):
+                bspan = self._reqtrace.batch_span(
+                    "decode_step", self._trace_pid,
+                    rows=sum(1 for s in self._slots if s is not None))
             t0 = time.monotonic()
             try:
                 logits = self.model.step(
@@ -1321,6 +1425,8 @@ class ContinuousScheduler:
                     raise
                 self._fail_inflight(e)
                 continue
+            if bspan is not None:
+                bspan.end()
             self._note_step_time(time.monotonic() - t0)
             self.batches_run += 1
             if self._paged_kernel == "pallas":
@@ -1347,8 +1453,12 @@ class ContinuousScheduler:
                     live.next_token = live.req.prompt[live.pos]
                     self._tokens[i] = live.next_token
                     self._slens[i] = live.pos
+                    if live.tspan is not None:
+                        live.tspan.ref_step(bspan)
                     continue
                 tok = int(self._sample(logits[i], live))
+                if live.tspan is not None:
+                    live.tspan.ref_step(bspan)
                 if not live.generated:
                     live.req.t_first_token = now
                     with self._lat_lock:
@@ -1356,7 +1466,12 @@ class ContinuousScheduler:
                     if self.registry is not None:
                         self.registry.histogram(
                             "serving/ttft_ms").observe(
-                            (now - live.req.t_submit) * 1e3)
+                            (now - live.req.t_submit) * 1e3,
+                            exemplar=(live.req.trace.trace_id
+                                      if live.req.trace is not None
+                                      else None))
+                    if live.tspan is not None:
+                        live.tspan.to_decode()
                 live.generated.append(tok)
                 self.tokens_generated += 1
                 done = (len(live.generated) >= live.max_new
@@ -1393,18 +1508,22 @@ class ContinuousScheduler:
         req.n_generated = len(live.generated)
         req.result = req.prompt + live.generated
         req.t_done = time.monotonic()
+        if live.tspan is not None:
+            live.tspan.finish(req)
+            live.tspan = None
         with self._lat_lock:
             self._latencies.append(req.t_done - req.t_submit)
         self.requests_done += 1
         if self.registry is not None:
             reg = self.registry
+            ex = req.trace.trace_id if req.trace is not None else None
             reg.counter("serving/requests_done").inc()
             reg.histogram("serving/request_latency_ms").observe(
-                (req.t_done - req.t_submit) * 1e3)
+                (req.t_done - req.t_submit) * 1e3, exemplar=ex)
             if req.n_generated > 1 and req.t_first_token is not None:
                 reg.histogram("serving/per_token_ms").observe(
                     (req.t_done - req.t_first_token) * 1e3
-                    / (req.n_generated - 1))
+                    / (req.n_generated - 1), exemplar=ex)
         req._settle()
 
     def _observe_step(self):

@@ -36,6 +36,15 @@ GET  /v2/stats     -> batch/request counters + latency percentiles
                    "autoscaler" block: current/target replicas,
                    min/max bounds, last scale decision + reason)
 
+GET  /metrics      -> Prometheus text exposition (version 0.0.4) of
+                   the metrics registry passed via
+                   serve_http(registry=...): counters, gauges, and
+                   histogram summaries whose _count samples carry
+                   OpenMetrics exemplar annotations (the worst
+                   sample's request trace_id per drain window — see
+                   docs/OBSERVABILITY.md "Request tracing").  404
+                   when no registry is attached.
+
 Shed/exhausted-retry requests (front.ServiceUnavailable) return 503
 with a Retry-After header computed from the front's MEASURED drain
 rate (how long the current backlog takes to clear), not a constant.
@@ -52,11 +61,15 @@ import numpy as np
 
 
 def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
-               block: bool = True, generator=None):
+               block: bool = True, generator=None, registry=None):
     """Serve a DynamicBatcher (or bare InferenceEngine) and/or a
     GenerationBatcher over HTTP.  Returns the server object; when
     block=False it runs on a daemon thread (server.shutdown() stops
-    it)."""
+    it).  With `registry` (obs.metrics.MetricsRegistry) set, GET
+    /metrics renders it as Prometheus text exposition — counters,
+    gauges, and histogram summaries with OpenMetrics exemplar
+    annotations linking worst samples to request trace_ids
+    (docs/OBSERVABILITY.md "Request tracing")."""
     if batcher is None and generator is None:
         raise ValueError("serve_http needs a batcher and/or a generator")
 
@@ -74,8 +87,29 @@ def serve_http(batcher=None, host: str = "127.0.0.1", port: int = 8000,
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, body: str, content_type: str):
+            raw = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
         def do_GET(self):
             src = batcher if batcher is not None else generator
+            if self.path == "/metrics":
+                if registry is None:
+                    self._send(404, {"error": "no metrics registry "
+                                     "attached (serve_http registry=)"})
+                    return
+                from ..obs.metrics import to_prometheus
+
+                # version=0.0.4 is the Prometheus text exposition
+                # content type its scraper negotiates for
+                self._send_text(
+                    200, to_prometheus(registry),
+                    "text/plain; version=0.0.4; charset=utf-8")
+                return
             if self.path == "/v2/health":
                 served = getattr(src, "batches_run",
                                  getattr(src, "requests_served", 0))

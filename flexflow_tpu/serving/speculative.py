@@ -80,6 +80,13 @@ class Proposer:
     def stats(self) -> Dict:
         return {}
 
+    def trace_attrs(self) -> Dict:
+        """Small JSON-safe attribute dict stamped onto each spec_verify
+        batch span (obs/reqtrace.py) — which drafter produced the
+        round's proposals, plus any cheap per-proposer counters.
+        Called on the scheduler worker thread, once per traced round."""
+        return {"proposer": type(self).__name__}
+
 
 class NGramProposer(Proposer):
     """Prompt-lookup decoding: propose the continuation of the MOST
@@ -294,6 +301,12 @@ class DraftModelProposer(Proposer):
             "dead": self._dead,
             "live_draft_seqs": len(self._st),
         }
+
+    def trace_attrs(self) -> Dict:
+        # cumulative draft-step count: the delta between consecutive
+        # verify-round spans is the drafts this round cost
+        return {"proposer": type(self).__name__,
+                "draft_steps": self.draft_steps}
 
 
 class AdaptiveK:

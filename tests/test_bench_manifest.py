@@ -163,3 +163,34 @@ def test_serving_disagg_leg_keys_frozen():
     assert leg["prefix_len"] >= 2 * leg["kv_page_size"]
     assert leg["roles"] == "prefill=1,decode=1"
     assert leg["migration_cost_cap"] > 0
+
+
+def test_serving_trace_leg_keys_frozen():
+    """The v23 request-tracing leg compares a traced fleet against its
+    traced-off twin, so its workload must keep BOTH dispatcher
+    decisions and the speculative path reachable: every TPU-shape key
+    bench_serving_trace reads must exist, the shortest repetitive
+    prompt must still span a full KV page (or the migrate side
+    vanishes and the connected-tree assertion never sees a migration
+    child), the sub-page mix must stay sub-page, and the sample rate
+    must trace every request — the one-tree-per-completed-request
+    assertion is only meaningful at sample 1.0."""
+    manifest, _ = _load()
+    leg = manifest["legs"]["serving_trace"]
+    needed = {"vocab", "max_seq", "hidden", "layers", "heads",
+              "intermediate", "slots", "kv_page_size", "requests",
+              "offered_rps", "prefill_chunk", "spec_k",
+              "num_templates", "phrases_per_template", "phrase_len",
+              "prompt_phrases_range", "max_new_range",
+              "subpage_requests", "subpage_len_range", "roles",
+              "trace_sample"}
+    assert needed <= set(leg), sorted(needed - set(leg))
+    # migrate side: the shortest prompt must own >= 1 full page
+    assert (leg["prompt_phrases_range"][0] * leg["phrase_len"]
+            >= leg["kv_page_size"])
+    # re-prefill side: sub-page prompts must stay sub-page
+    assert leg["subpage_len_range"][1] <= leg["kv_page_size"]
+    assert leg["roles"] == "prefill=1,decode=1"
+    assert leg["trace_sample"] == 1.0
+    # n-gram drafts need the trigram window inside one phrase
+    assert leg["phrase_len"] >= 4 and leg["spec_k"] >= 2

@@ -4,11 +4,18 @@ search / resilience / fidelity table.
 
 Usage:
     python tools/telemetry_summary.py <run_telemetry.jsonl | trace-dir>
+        [--allow-torn-tail]
 
 Accepts either the JSONL itself or the --trace-dir directory containing
 it.  Metrics are cumulative snapshots, so for re-drained runs the
 latest record per name wins (ties broken by file order).  See
 docs/OBSERVABILITY.md for the record schema.
+
+Unreadable lines are an ERROR, not a silent skip: a summary that
+quietly dropped records would misreport the run.  A killed run may
+legitimately leave torn line(s) at the FILE TAIL — --allow-torn-tail
+tolerates exactly those (reported to stderr with a count); corruption
+anywhere else always exits non-zero.
 """
 from __future__ import annotations
 
@@ -16,25 +23,50 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 
-def load_records(path: str) -> List[Dict]:
+class TornTelemetryError(Exception):
+    """Unparseable run_telemetry.jsonl line(s).  `bad` holds
+    (lineno, detail) pairs; `tail_only` is True when every bad line
+    sits after the last good record (a killed-run torn tail)."""
+
+    def __init__(self, bad: List[Tuple[int, str]], tail_only: bool):
+        self.bad = bad
+        self.tail_only = tail_only
+        where = "tail" if tail_only else "mid-file"
+        super().__init__(
+            f"{len(bad)} unreadable telemetry line(s) ({where}): "
+            f"line(s) {[ln for ln, _ in bad]}")
+
+
+def load_records(path: str, allow_torn_tail: bool = False
+                 ) -> Tuple[List[Dict], List[Tuple[int, str]]]:
+    """(records, torn_lines).  Raises TornTelemetryError on any
+    unparseable line, unless every bad line is at the file tail AND
+    `allow_torn_tail` is set — then the torn tail is returned for the
+    caller to report."""
     if os.path.isdir(path):
         path = os.path.join(path, "run_telemetry.jsonl")
     if not os.path.exists(path):
         raise FileNotFoundError(path)
-    out = []
+    out: List[Dict] = []
+    bad: List[Tuple[int, str]] = []
+    last_good = 0
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
-    return out
+                last_good = lineno
+            except json.JSONDecodeError as e:
+                bad.append((lineno, str(e)))
+    tail_only = bool(bad) and all(ln > last_good for ln, _ in bad)
+    if bad and not (allow_torn_tail and tail_only):
+        raise TornTelemetryError(bad, tail_only)
+    return out, bad
 
 
 def latest_by_name(records: List[Dict], kinds) -> Dict[str, Dict]:
@@ -261,6 +293,35 @@ def summarize(records: List[Dict]) -> str:
             rows.append((short, rec.get("value", 0.0)))
     out.append(_section("Serving", rows))
 
+    # request traces (obs/reqtrace.py, docs/OBSERVABILITY.md "Request
+    # tracing"): span counts plus the top-3 slowest requests with
+    # their per-phase split — the full report is trace_analyze.py
+    try:
+        from . import trace_analyze as _ta
+    except ImportError:  # run as a script: tools/ itself is on sys.path
+        import trace_analyze as _ta
+    treport = _ta.analyze(records)
+    rows = []
+    if treport["traces"]:
+        rows += [
+            ("traces recorded", treport["traces"]),
+            ("spans", treport["spans"]),
+            ("spans/trace",
+             round(treport["spans"] / treport["traces"], 1)),
+            ("shared batch spans", treport["batch_spans"]),
+        ]
+        if treport["disconnected"]:
+            rows.append(("DISCONNECTED trees",
+                         len(treport["disconnected"])))
+        for r in treport["requests"][:3]:
+            split = " ".join(
+                f"{p}={r['phases'][p] / 1e3:.2f}ms"
+                for p in _ta.PHASES if p in r["phases"])
+            rows.append((
+                f"slowest {r['trace_id']}",
+                f"total={r['total_us'] / 1e3:.2f}ms {split}"))
+    out.append(_section("Tracing", rows))
+
     rows = []
     for rec in fidelity:
         rows += [
@@ -291,12 +352,25 @@ def summarize(records: List[Dict]) -> str:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("path", help="run_telemetry.jsonl or the trace dir")
+    p.add_argument("--allow-torn-tail", action="store_true",
+                   help="tolerate unreadable line(s) at the FILE TAIL "
+                        "(a killed run's torn write); mid-file "
+                        "corruption still exits non-zero")
     args = p.parse_args(argv)
     try:
-        records = load_records(args.path)
+        records, torn = load_records(
+            args.path, allow_torn_tail=args.allow_torn_tail)
     except FileNotFoundError as e:
         print(f"error: no telemetry file at {e}", file=sys.stderr)
         return 1
+    except TornTelemetryError as e:
+        hint = (" (re-run with --allow-torn-tail to tolerate a "
+                "killed run's torn tail)" if e.tail_only else "")
+        print(f"error: {e}{hint}", file=sys.stderr)
+        return 1
+    if torn:
+        print(f"warning: skipped {len(torn)} torn tail line(s): "
+              f"{[ln for ln, _ in torn]}", file=sys.stderr)
     sys.stdout.write(summarize(records))
     return 0
 
