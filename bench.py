@@ -2394,6 +2394,238 @@ def bench_serving_trace(dev, on_tpu):
     }
 
 
+class _FrameDumpFabric:
+    """KVTransferFabric wrapper that tees every FFKV frame to a file
+    so tools/kvframe_fsck.py can audit the exact bytes that crossed
+    the fabric — the bench's offline-verifier leg."""
+
+    def __init__(self, inner, dump_dir):
+        self.inner = inner
+        self.kind = inner.kind + "+dump"
+        self.dump_dir = dump_dir
+        self.frames = 0
+
+    def transfer(self, key, data):
+        import os as _os
+        self.frames += 1
+        path = _os.path.join(self.dump_dir,
+                             f"frame{self.frames:04d}.ffkv")
+        with open(path, "wb") as f:
+            f.write(data)
+        return self.inner.transfer(key, data)
+
+    def stats(self):
+        out = dict(self.inner.stats())
+        out["frames_dumped"] = self.frames
+        return out
+
+
+def bench_serving_handoff(dev, on_tpu):
+    """Resumable-decode-handoff leg (manifest v24): a long generation
+    is pinned mid-decode on one replica of a colocated 2-replica
+    ServingFront, then that replica is DRAINED — with `--serving-
+    handoff` ON vs OFF (docs/SERVING.md "Mid-decode handoff").  OFF
+    is the baseline semantics: drain waits the generation out, so the
+    undisturbed completion doubles as the byte-identity oracle.  ON
+    must pause the sequence at a step boundary, stream its KV blocks
+    (prompt + generated, partial tail included) to the surviving
+    replica as FFKV frames, resume mid-generation, and retire the
+    source WITHOUT waiting out the generation — asserted as: the
+    source retired while the long request was still running, every
+    completion byte-identical to the OFF run, zero handoff faults,
+    and >0 bytes/blocks streamed.  Every frame that crossed the
+    fabric is teed to disk and tools/kvframe_fsck.py must pass over
+    the dump (exit 0).  Reports drain wall-time both modes, migrated
+    bytes/blocks, and the full handoff decision counters."""
+    import shutil
+    import tempfile
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_gpt
+    from flexflow_tpu.obs.metrics import MetricsRegistry
+    from flexflow_tpu.serving import ServingFront
+    from flexflow_tpu.serving.kv_transfer import (InProcessFabric,
+                                                  KVMigrator)
+    from flexflow_tpu.serving.loadgen import sample_workload
+    from tools import kvframe_fsck
+
+    leg = MANIFEST["legs"]["serving_handoff"]
+    if on_tpu:
+        vocab, max_seq = leg["vocab"], leg["max_seq"]
+        hidden, layers, heads = leg["hidden"], leg["layers"], leg["heads"]
+        inter, slots = leg["intermediate"], leg["slots"]
+        page, chunk = leg["kv_page_size"], leg["prefill_chunk"]
+        n_bg = leg["background_requests"]
+        bg_range = tuple(leg["background_len_range"])
+        bg_mnt = tuple(leg["background_max_new_range"])
+        long_len, long_mnt = leg["long_prompt_len"], leg["long_max_new"]
+    else:
+        vocab, max_seq = 64, 64
+        hidden, layers, heads, inter = 64, 2, 4, 128
+        slots, page, chunk = 4, 4, 4
+        n_bg, bg_range, bg_mnt = 6, (2, 6), (2, 6)
+        long_len, long_mnt = 8, 40
+
+    cfg = FFConfig(batch_size=slots, num_devices=1,
+                   serving_slots=slots, kv_page_size=page,
+                   serving_replicas=2, prefill_chunk=chunk)
+    ff = FFModel(cfg)
+    build_gpt(ff, batch_size=slots, seq_length=max_seq,
+              hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=inter, vocab_size=vocab)
+    ff.compile(optimizer=SGDOptimizer(lr=0.5),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               devices=[dev])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (slots, max_seq)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(max_seq, dtype=np.int32),
+                          (slots, max_seq)).copy()
+    ff.train_step({"input": ids, "positions": pos}, ids)  # real weights
+
+    wl_rng = np.random.RandomState(53)
+    bg_wl = sample_workload(wl_rng, n_bg, vocab,
+                            prompt_len_range=bg_range,
+                            max_new_range=bg_mnt)
+    long_prompt = [int(t) for t in
+                   wl_rng.randint(1, vocab, long_len)]
+
+    def run(handoff, dump_dir=None):
+        reg = MetricsRegistry()
+        front = ServingFront.from_trained(ff, num_replicas=2,
+                                          devices=[dev], registry=reg,
+                                          handoff=handoff)
+        fabric = None
+        if dump_dir is not None:
+            # pre-seat the lazy handoff migrator on a frame-dumping
+            # fabric so every streamed block lands on disk for fsck
+            fabric = _FrameDumpFabric(InProcessFabric(), dump_dir)
+            front._handoff_mig = KVMigrator(
+                fabric, registry=reg, logger=front.log)
+        try:
+            warm = [front.generate_async([1, 2], 2)
+                    for _ in range(2 * slots)]
+            for h in warm:
+                h.wait(300.0)
+            bg = [front.generate_async(p, m) for p, m in bg_wl]
+            bg_toks = [h.wait(300.0) for h in bg]
+
+            bases = {id(r): r.scheduler.stats()["tokens_generated"]
+                     for r in front.replicas if r.alive}
+            h_long = front.generate_async(long_prompt, long_mnt)
+            holder, deadline = None, time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                for r in front.replicas:
+                    if not r.alive or r.outstanding == 0:
+                        continue
+                    done = (r.scheduler.stats()["tokens_generated"]
+                            - bases.get(id(r), 0))
+                    if done >= 2:  # provably mid-decode, not prefill
+                        holder = r
+                        break
+                if holder is not None or h_long.event.is_set():
+                    break
+                time.sleep(0.0005)
+            assert holder is not None, \
+                "long generation finished before it could be pinned"
+
+            t0 = time.monotonic()
+            assert front.drain_replica(holder), "drain refused"
+            long_done_at_retire = None
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if holder.state == "retired":
+                    long_done_at_retire = h_long.event.is_set()
+                    break
+                time.sleep(0.0005)
+            drain_s = time.monotonic() - t0
+            assert long_done_at_retire is not None, "drain never retired"
+            long_toks = h_long.wait(300.0)
+
+            st = front.stats()
+            return {
+                "long_tokens": long_toks,
+                "bg_tokens": bg_toks,
+                "drain_s": round(drain_s, 4),
+                "long_done_at_retire": long_done_at_retire,
+                "handoff": st.get("handoff"),
+                "paused": reg.counter("serving/handoff_paused").value,
+                "resumed": reg.counter("serving/handoff_resumed").value,
+                "frames_dumped": fabric.frames if fabric else 0,
+            }
+        finally:
+            front.close()
+
+    off = run(False)
+    dump_dir = tempfile.mkdtemp(prefix="ffkv_bench_")
+    try:
+        on = run(True, dump_dir=dump_dir)
+
+        # OFF is the oracle: drain waited the generation out untouched
+        assert off["long_done_at_retire"], \
+            "baseline drain retired before the generation completed"
+        assert off["paused"] == 0 and off["handoff"] is None
+
+        # ON retired the source mid-generation and streamed the state
+        assert not on["long_done_at_retire"], \
+            "handoff drain waited out the generation"
+        assert on["paused"] >= 1 and on["resumed"] >= 1, \
+            f"no pause/resume: {on['paused']}/{on['resumed']}"
+        ho = on["handoff"]
+        assert ho and ho["ok"] >= 1, f"no successful handoff: {ho}"
+        assert not ho["faults"], f"handoff faults fired: {ho['faults']}"
+        kvt = ho.get("kv_transfer") or {}
+        assert kvt.get("bytes_streamed", 0) > 0, f"no bytes moved: {kvt}"
+        assert kvt.get("blocks_streamed", 0) > 0
+
+        # byte-identity: pause/stream/resume is invisible in the output
+        assert on["long_tokens"] == off["long_tokens"], \
+            "handed-off long generation diverged from the oracle"
+        assert on["bg_tokens"] == off["bg_tokens"], \
+            "background completions diverged"
+
+        # offline audit of the exact frames that crossed the fabric
+        assert on["frames_dumped"] >= 1, "no FFKV frames dumped"
+        fsck_rc = kvframe_fsck.main([dump_dir])
+        assert fsck_rc == 0, f"kvframe_fsck found problems (rc {fsck_rc})"
+    finally:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+
+    if on_tpu:
+        assert on["drain_s"] < off["drain_s"], \
+            "handoff drain was not faster than waiting out the generation"
+    for rep in (on, off):
+        rep.pop("long_tokens", None)
+        rep.pop("bg_tokens", None)
+    return {
+        "workload": (
+            f"{n_bg} background reqs {bg_range} + one pinned "
+            f"{long_len}-token prompt x {long_mnt} new tokens, greedy, "
+            f"page {page}, chunk {chunk}; drain the holder, "
+            f"--serving-handoff on vs off (colocated 2-replica)"
+        ),
+        "handoff_on": on,
+        "handoff_off": off,
+        "drain_speedup": round(
+            off["drain_s"] / max(on["drain_s"], 1e-9), 2),
+        "migrated": {
+            "bytes": (on["handoff"] or {}).get(
+                "kv_transfer", {}).get("bytes_streamed", 0),
+            "blocks": (on["handoff"] or {}).get(
+                "kv_transfer", {}).get("blocks_streamed", 0),
+        },
+        "decisions": {
+            "requested": on["handoff"]["requested"],
+            "ok": on["handoff"]["ok"],
+            "replays": on["handoff"]["replays"],
+            "migrate": on["handoff"]["migrate_decisions"],
+            "replay": on["handoff"]["replay_decisions"],
+        },
+        "completions_identical": True,   # asserted above
+        "retired_mid_generation": True,  # asserted above
+        "kvframe_fsck_clean": True,      # asserted above
+    }
+
+
 def bench_autoscale(dev, on_tpu):
     """Autoscaling-front leg (manifest v15): a SEEDED square-wave
     burst trace against a ServingFront that starts at min_replicas
@@ -2649,6 +2881,8 @@ def main():
     gc.collect()
     serving_trace = bench_serving_trace(dev, on_tpu)
     gc.collect()
+    serving_handoff = bench_serving_handoff(dev, on_tpu)
+    gc.collect()
     autoscale = bench_autoscale(dev, on_tpu)
     gc.collect()
     cold_start = bench_cold_start(dev, on_tpu)
@@ -2685,6 +2919,7 @@ def main():
                  "serving_disagg": serving_disagg,
                  "serving_spec": serving_spec,
                  "serving_trace": serving_trace,
+                 "serving_handoff": serving_handoff,
                  "autoscale": autoscale,
                  "cold_start": cold_start, "host_loss": host_loss,
                  "multi_slice": multi_slice,

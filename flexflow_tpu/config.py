@@ -463,6 +463,18 @@ class FFConfig:
     # the feature is never worse than one-token decode.
     spec_decode: str = "off"
     spec_k: int = 4
+    # resumable mid-decode handoff (serving/handoff.py, docs/SERVING.md
+    # "Mid-decode handoff"): with the flag on, a DRAINING / terminating
+    # / rebalanced replica pauses its in-flight generations (resume
+    # record + optional live KV-block stream) and the front resumes
+    # them on a surviving replica, token-identically.  Off keeps the
+    # classic drain semantics (every slot runs to completion).
+    serving_handoff: bool = False
+    # hot-replica rebalance threshold: a live replica whose KV-pool
+    # occupancy exceeds this fraction (while a peer sits below half of
+    # it) hands one generation off via the autoscaler's tick.  0 = off;
+    # needs --serving-handoff.
+    serving_rebalance_kv: float = 0.0
 
     def __post_init__(self):
         if self.serving_mode not in SERVING_MODES:
@@ -583,6 +595,17 @@ class FFConfig:
         if self.spec_k < 1:
             raise ValueError(
                 f"spec_k must be >= 1, got {self.spec_k}"
+            )
+        if not 0.0 <= self.serving_rebalance_kv < 1.0:
+            raise ValueError(
+                f"serving_rebalance_kv must be in [0, 1) (occupancy "
+                f"fraction; 0 = off), got {self.serving_rebalance_kv}"
+            )
+        if self.serving_rebalance_kv > 0 and not self.serving_handoff:
+            raise ValueError(
+                "serving_rebalance_kv needs --serving-handoff: the "
+                "rebalance trigger pauses generations onto the "
+                "handoff path"
             )
         if not 0.0 <= self.trace_sample <= 1.0:
             raise ValueError(
@@ -871,6 +894,11 @@ class FFConfig:
         p.add_argument("--spec-decode", dest="spec_decode", type=str,
                        default="off", choices=SPEC_DECODE_MODES)
         p.add_argument("--spec-k", dest="spec_k", type=int, default=4)
+        p.add_argument("--serving-handoff", dest="serving_handoff",
+                       action="store_true")
+        p.add_argument("--serving-rebalance-kv",
+                       dest="serving_rebalance_kv", type=float,
+                       default=0.0)
         args, _ = p.parse_known_args(argv)
         return cls(
             epochs=args.epochs,
@@ -965,6 +993,8 @@ class FFConfig:
             autoscale_predictive=args.autoscale_predictive,
             spec_decode=args.spec_decode,
             spec_k=args.spec_k,
+            serving_handoff=args.serving_handoff,
+            serving_rebalance_kv=args.serving_rebalance_kv,
         )
 
 

@@ -73,6 +73,7 @@ class ServingAutoscaler:
         queue_low: float = 0.5,
         slo_ttft_s: float = 0.0,
         kv_high: float = 0.9,
+        rebalance_kv: float = 0.0,
         drain_timeout_s: float = 30.0,
         predictive: bool = False,
         predict_horizon_s: float = 10.0,
@@ -99,6 +100,10 @@ class ServingAutoscaler:
         if drain_timeout_s <= 0:
             raise ValueError(
                 f"drain_timeout_s must be > 0, got {drain_timeout_s}")
+        if not 0.0 <= rebalance_kv < 1.0:
+            raise ValueError(
+                f"rebalance_kv must be in [0, 1) (occupancy fraction; "
+                f"0 disables), got {rebalance_kv}")
         self.front = front
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
@@ -108,6 +113,12 @@ class ServingAutoscaler:
         self.queue_low = float(queue_low)
         self.slo_ttft_s = float(slo_ttft_s)
         self.kv_high = float(kv_high)
+        # hot-replica rebalance (mid-decode handoff, serving/handoff.py):
+        # a live replica whose KV occupancy exceeds this fraction while
+        # a peer sits below half of it pauses its longest-remaining
+        # generation onto the handoff path.  0 = off; needs the front's
+        # handoff flag too.
+        self.rebalance_kv = float(rebalance_kv)
         self.drain_timeout_s = float(drain_timeout_s)
         # predictive scaling (--autoscale-predictive): project the
         # admission queue forward from the measured admission-rate
@@ -128,6 +139,8 @@ class ServingAutoscaler:
         self.spawn_failures = 0  # add_replica refusals (chip budget,
         #                          compile errors) observed by tick()
         self.forced_retires = 0
+        self.rebalances = 0
+        self._last_rebalance_t: Optional[float] = None
         self.ticks = 0
         self.last_action_t: Optional[float] = None
         self.last_decision: Optional[Dict] = None
@@ -160,6 +173,9 @@ class ServingAutoscaler:
         kw.setdefault("drain_timeout_s", cfg.serving_drain_timeout)
         kw.setdefault("predictive",
                       getattr(cfg, "autoscale_predictive", False))
+        kw.setdefault("rebalance_kv",
+                      float(getattr(cfg, "serving_rebalance_kv", 0.0)
+                            or 0.0))
         return cls(front, cfg.serving_min_replicas,
                    cfg.serving_max_replicas, **kw)
 
@@ -422,6 +438,7 @@ class ServingAutoscaler:
         self.ticks += 1
         self._sweep_drain()
         s = self.observe()
+        self._maybe_rebalance(s)
         action, reason = self.decide(s)
         if action == "up":
             self._spawning = True  # visible while the build compiles
@@ -450,6 +467,49 @@ class ServingAutoscaler:
                 action, reason = "hold", "no drainable replica"
         self._record(action, reason, s)
         return self.history[-1]
+
+    def _maybe_rebalance(self, s: Dict) -> None:
+        """KV-occupancy rebalance trigger (mid-decode handoff): when a
+        live decode-capable replica's pool runs past `rebalance_kv`
+        while a peer sits below half of it, pause the hot replica's
+        longest-remaining generation onto the handoff path so it
+        resumes on the cool one.  Its own cooldown (shared constant,
+        separate clock) keeps one hot pool from shedding a sequence
+        every tick."""
+        if self.rebalance_kv <= 0:
+            return
+        front = self.front
+        if not getattr(front, "handoff", False):
+            return
+        t = s["t"]
+        if (self._last_rebalance_t is not None
+                and t - self._last_rebalance_t < self.cooldown_s):
+            return
+        hot = cool = None
+        for r in front._live():
+            sched = r.scheduler
+            if sched is None or r.role == "prefill":
+                continue
+            try:
+                occ = sched.pool.occupancy()
+            except Exception:  # noqa: BLE001 — a dying replica's pool
+                continue       # must not kill the loop
+            if occ > self.rebalance_kv and (hot is None
+                                            or occ > hot[1]):
+                hot = (r, occ)
+            if occ < 0.5 * self.rebalance_kv and (cool is None
+                                                  or occ < cool[1]):
+                cool = (r, occ)
+        if hot is None or cool is None or hot[0] is cool[0]:
+            return
+        if front.rebalance_replica(hot[0], max_sequences=1):
+            self.rebalances += 1
+            self._last_rebalance_t = t
+            self.log.info(
+                "autoscaler rebalance: replica %d KV occupancy %.2f > "
+                "%.2f (coolest peer %.2f) — pausing 1 sequence for "
+                "handoff", hot[0].replica_id, hot[1],
+                self.rebalance_kv, cool[1])
 
     def _sweep_drain(self) -> None:
         """Resolve an in-flight drain: done, or wedged past the
@@ -531,6 +591,8 @@ class ServingAutoscaler:
             "scale_downs": self.scale_downs,
             "spawn_failures": self.spawn_failures,
             "forced_retires": self.forced_retires,
+            "rebalances": self.rebalances,
+            "rebalance_kv": self.rebalance_kv,
             "ticks": self.ticks,
             "drain_in_flight": (draining[0].replica_id
                                 if draining is not None else None),

@@ -158,6 +158,33 @@ class MigrationCostModel:
             "reprefill_s": round(reprefill_s, 6),
         }
 
+    def decide_handoff(self, *, written: int, page_size: int,
+                       block_bytes: int, chunk: int,
+                       step_s: float) -> Dict:
+        """Mid-decode handoff pricing (serving/handoff.py): ship every
+        written block — prompt AND generated, including the partial
+        tail page — vs replaying the whole written prefix as chunked
+        prefill on the destination.  Unlike decide() there is no tail-
+        replay term on the migrate side (the verified tail block rides
+        the resume record into a private block), but the replay side
+        grows with the GENERATED length: the longer a sequence has
+        decoded, the more a handoff is worth."""
+        C = max(1, int(chunk))
+        step = step_s if step_s > 0 else _DEFAULT_STEP_S
+        n_blocks = -(-written // page_size) if page_size > 0 else 0
+        replay_s = math.ceil(written / C) * step
+        handoff_s = (self.hop_lat
+                     + (block_bytes * n_blocks) / self.hop_bw
+                     + step)  # one adoption pass on the destination
+        handoff = (n_blocks > 0
+                   and handoff_s <= self.cost_cap * replay_s)
+        return {
+            "decision": "handoff" if handoff else "replay",
+            "blocks": int(n_blocks),
+            "handoff_s": round(handoff_s, 6),
+            "replay_s": round(replay_s, 6),
+        }
+
 
 class DisaggServingFront(ServingFront):
     """ServingFront whose dispatcher costs every request's handoff.
@@ -219,6 +246,11 @@ class DisaggServingFront(ServingFront):
         # one migration attempt per request: a requeued request (post-
         # migration OR post-failure) always dispatches directly
         if req.migration is not None:
+            return None
+        # a resumed generation never takes the prefill-class detour:
+        # its KV state (adopted blocks or the replay feed) belongs on
+        # the decode class where it will finish
+        if req.resume is not None:
             return None
         if self._terminating or self._closed:
             return None

@@ -38,6 +38,7 @@ obs.metrics registry.  docs/SERVING.md "Replicated front".
 """
 from __future__ import annotations
 
+import itertools
 import signal
 import threading
 import time
@@ -47,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ..logger import resilience_logger
 from ..resilience.faults import FaultPlan
 from ..resilience.retry import RetryPolicy
+from .handoff import HandoffPaused
 from .replica import ServingReplica
 
 
@@ -71,7 +73,7 @@ class FrontRequest:
                  "t_done", "n_generated", "retries",
                  "queue_depth_at_admit", "deadline_s",
                  "prefix_hit_tokens", "served_role", "migration",
-                 "trace")
+                 "trace", "seed", "resume")
 
     def __init__(self, prompt, max_new_tokens, temperature,
                  deadline_s: Optional[float] = None):
@@ -92,6 +94,8 @@ class FrontRequest:
         self.served_role = None        # class of the replica that served
         self.migration = None  # disagg routing record (serving/disagg.py)
         self.trace = None  # TraceContext (obs/reqtrace.py) or None
+        self.seed = None   # per-request sampling seed (front-minted)
+        self.resume = None  # ResumeRecord after a pause/death mid-decode
 
     def wait(self, timeout: Optional[float] = None) -> List[int]:
         if not self.event.wait(timeout):
@@ -124,6 +128,7 @@ class ServingFront:
         max_restarts: int = 3,
         retry_backoff: float = 0.1,
         request_retry_limit: int = 2,
+        handoff: bool = False,
         chip_budget: int = 0,
         fault_plans: Optional[Dict[int, FaultPlan]] = None,
         roles: Optional[Sequence[str]] = None,
@@ -176,6 +181,25 @@ class ServingFront:
                           else None)
         self.request_retry_limit = int(request_retry_limit)
         self.chip_budget = int(chip_budget)  # 0 = unbounded
+        # mid-decode handoff (serving/handoff.py): with the flag on, a
+        # DRAINING / terminating / rebalanced replica pauses in-flight
+        # generations and the front resumes them elsewhere instead of
+        # waiting them out or shedding them.  Off by default: the
+        # classic drain semantics (run every slot to completion).
+        self.handoff = bool(handoff)
+        # per-request sampling seeds: minted at admission so a
+        # temperature>0 generation replays deterministically on any
+        # replica (each scheduler seeds a private RandomState from it)
+        self._req_seed = itertools.count(int(seed) * 1_000_003 + 1)
+        self._handoff_mig = None  # lazy KVMigrator (base front only)
+        self._handoff_cm = None   # lazy MigrationCostModel
+        self._handoff_inflight = 0  # pauses not yet requeued
+        self.handoff_requested = 0
+        self.handoff_ok = 0
+        self.handoff_replays = 0
+        self.handoff_migrate_decisions = 0
+        self.handoff_replay_decisions = 0
+        self.handoff_faults: Dict[str, int] = {}
         self._pending_replicas = 0  # add_replica compiles in flight
         self.shed_retry_after_s = float(shed_retry_after_s)
         self.admission_deadline_s = float(admission_deadline_s)
@@ -355,6 +379,8 @@ class ServingFront:
         kw.setdefault("step_timeout", cfg.serving_step_timeout)
         kw.setdefault("max_restarts", cfg.serving_max_restarts)
         kw.setdefault("request_retry_limit", cfg.request_retry_limit)
+        kw.setdefault("handoff",
+                      bool(getattr(cfg, "serving_handoff", False)))
         kw.setdefault("seed", cfg.seed)
         kw.setdefault("admission_deadline_s",
                       getattr(cfg, "admission_deadline_s", 0.0))
@@ -464,8 +490,22 @@ class ServingFront:
         """Scale-down: READY -> DRAINING.  The dispatcher stops routing
         to it immediately (state leaves \"live\"); in-flight slots run
         to completion token-identically; on retirement the replica
-        leaves `replicas` for `retired` and its KV pool is freed."""
-        return replica.drain(on_retired=self._on_replica_retired)
+        leaves `replicas` for `retired` and its KV pool is freed.
+
+        With handoff enabled and another live serving replica up, the
+        drain is proactive instead of patient: every in-flight
+        generation with tokens left pauses onto the handoff path and
+        resumes elsewhere, so the drain time is bounded by the
+        migration, not by the longest generation."""
+        ok = replica.drain(on_retired=self._on_replica_retired)
+        if ok and self.handoff and replica.role != "prefill":
+            with self._cv:
+                others = [r for r in self._serving_live()
+                          if r is not replica]
+            if others:
+                replica.request_handoff(remaining_over=0,
+                                        export_kv=True)
+        return ok
 
     def _on_replica_retired(self, replica: ServingReplica) -> None:
         dropped = []
@@ -689,6 +729,7 @@ class ServingFront:
                             120.0),
                     )
             req.queue_depth_at_admit = depth
+            req.seed = next(self._req_seed)
             if self._reqtrace is not None:
                 # mint the request's trace at admission (sampled); the
                 # "queue" span stays open until the dispatcher picks
@@ -729,10 +770,16 @@ class ServingFront:
                 continue
             hit = 0
             if req is not None:
+                # a resumed generation's cached prefix is its whole
+                # replay feed (prompt + generated), not the prompt:
+                # affinity routes it to the replica that adopted its
+                # migrated blocks
+                toks = (req.resume.replay_tokens()
+                        if req.resume is not None else req.prompt)
                 probe = getattr(sched, "cached_prefix_tokens", None)
                 if probe is not None:
                     try:
-                        hit = int(probe(req.prompt))
+                        hit = int(probe(toks))
                     except Exception:  # noqa: BLE001 — a probe must
                         hit = 0        # never stall dispatch
             if (best is None or hit > best_hit
@@ -798,7 +845,7 @@ class ServingFront:
             try:
                 replica.submit(
                     req.prompt, req.max_new_tokens, req.temperature,
-                    trace=req.trace,
+                    trace=req.trace, seed=req.seed, resume=req.resume,
                     on_done=lambda h, _req=req, _r=replica:
                         self._on_settle(_req, _r, h),
                 )
@@ -853,7 +900,11 @@ class ServingFront:
                   role: Optional[str] = None) -> None:
         req.result = handle.result
         req.n_generated = handle.n_generated
-        req.t_first_token = handle.t_first_token
+        # a resumed generation's first token landed on an EARLIER
+        # replica (stamped at the pause/death settle): keep it — TTFT
+        # measures the client's wait, not the last leg's
+        if req.t_first_token is None:
+            req.t_first_token = handle.t_first_token
         req.t_done = handle.t_done or time.monotonic()
         req.prefix_hit_tokens = getattr(handle, "prefix_hit_tokens", 0)
         req.served_role = role
@@ -892,6 +943,14 @@ class ServingFront:
         if err is None:
             self._complete(req, handle, role=replica.role)
             return
+        if isinstance(err, HandoffPaused):
+            # NOT a failure: the replica paused this generation for
+            # handoff (drain / terminate / rebalance).  Checked before
+            # every other branch — a pause mid-terminate must resume,
+            # not shed, or the drain drops the very generation it
+            # paused to save.
+            self._on_handoff_paused(req, replica, handle, err)
+            return
         if isinstance(err, ValueError):
             self._fail(req, err)  # unservable as posed, retry won't help
             return
@@ -908,7 +967,19 @@ class ServingFront:
             self._fail(req, RuntimeError("ServingFront is closed"))
             return
         # replica death, hung step, or transient step fault: the
-        # request was ADMITTED, so it never gets a non-retriable error
+        # request was ADMITTED, so it never gets a non-retriable error.
+        # If the dying scheduler managed to stamp a resume record
+        # (tokens live on the host — a dead device cannot tear them),
+        # the retry REPLAYS prompt+generated instead of regenerating
+        # from scratch: same output, no decode work burned twice.
+        rs = getattr(handle, "resume_out", None)
+        if rs is not None:
+            req.resume = rs
+            if req.t_first_token is None:
+                req.t_first_token = handle.t_first_token
+            self.handoff_replays += 1
+            if self.registry is not None:
+                self.registry.counter("serving/handoff_replays").inc()
         req.retries += 1
         if req.retries > self.request_retry_limit:
             self._fail(req, ServiceUnavailable(
@@ -935,6 +1006,188 @@ class ServingFront:
                                 retries=req.retries)
             self._admission.appendleft(req)  # keep its seniority
             self._cv.notify_all()
+
+    # -- mid-decode handoff (serving/handoff.py) -------------------------
+    def _handoff_migrator(self):
+        """The migrator live handoffs stream through.  A disaggregated
+        front reuses its existing migrator (same fabric, same fault
+        injection, same counters); the base front lazily builds one
+        over an in-process fabric the first time a pause carries a KV
+        payload."""
+        mig = getattr(self, "migrator", None)
+        if mig is not None:
+            return mig
+        with self._cv:
+            if self._handoff_mig is None and not self._closed:
+                from .kv_transfer import InProcessFabric, KVMigrator
+
+                self._handoff_mig = KVMigrator(
+                    InProcessFabric(), registry=self.registry,
+                    logger=self.log, reqtrace=self._reqtrace)
+            return self._handoff_mig
+
+    def _handoff_cost_model(self):
+        cm = getattr(self, "cost_model", None)  # DisaggServingFront's
+        if cm is not None:
+            return cm
+        if self._handoff_cm is None:
+            from .disagg import MigrationCostModel
+
+            self._handoff_cm = MigrationCostModel()
+        return self._handoff_cm
+
+    def _pick_handoff_dest(self, source: ServingReplica,
+                           toks: Sequence[int]
+                           ) -> Optional[ServingReplica]:
+        """Live decode-capable destination for a handoff, excluding
+        the source; prefer the replica already caching the longest
+        prefix of the paused sequence (fewer blocks to ship), ties to
+        least outstanding.  No slot-headroom gate: the migration only
+        populates the prefix cache — the resumed request queues like
+        any other."""
+        best, best_hit = None, -1
+        for r in self._serving():
+            sched = r.scheduler
+            if r is source or r.state != "live" or sched is None:
+                continue
+            hit = 0
+            probe = getattr(sched, "cached_prefix_tokens", None)
+            if probe is not None:
+                try:
+                    hit = int(probe(toks))
+                except Exception:  # noqa: BLE001 — never stall a pause
+                    hit = 0
+            if (best is None or hit > best_hit
+                    or (hit == best_hit
+                        and r.outstanding < best.outstanding)):
+                best, best_hit = r, hit
+        return best
+
+    def _on_handoff_paused(self, req: FrontRequest,
+                           replica: ServingReplica, handle,
+                           err: HandoffPaused) -> None:
+        """A replica paused this generation for handoff.  Attach the
+        resume record, optionally stream the exported KV blocks to a
+        live destination, and requeue at the admission head — a pause
+        consumes no retry (the request did nothing wrong).  Every
+        fault on the live path degrades to replay: the resume record
+        alone suffices (chunked-prefill replay of prompt+generated is
+        token-identical by construction)."""
+        rec = err.record
+        req.resume = rec
+        if req.t_first_token is None:
+            req.t_first_token = handle.t_first_token
+        with self._cv:
+            self._handoff_inflight += 1
+        self.handoff_requested += 1
+        if self.registry is not None:
+            self.registry.counter("serving/handoff_requested").inc()
+        toks = rec.replay_tokens()[:rec.written]
+        payload = bool(err.arrays) and bool(err.pages)
+        dest = self._pick_handoff_dest(replica, toks) if payload else None
+        dsched = dest.scheduler if dest is not None else None
+        mig = self._handoff_migrator() if dsched is not None else None
+        decision = None
+        if (dsched is not None and mig is not None
+                and getattr(dsched.model, "import_block", None)
+                is not None):
+            src = replica.scheduler
+            step_ms = dsched.step_ms_ewma or (
+                src.step_ms_ewma if src is not None else 0.0)
+            decision = self._handoff_cost_model().decide_handoff(
+                written=rec.written, page_size=err.page_size,
+                block_bytes=int(getattr(dsched.model,
+                                        "kv_block_bytes", 0)),
+                chunk=int(getattr(dsched.model, "prefill_chunk", 0)),
+                step_s=step_ms / 1e3)
+            req.migration = decision
+            if decision["decision"] != "handoff":
+                dsched = None
+        if dsched is None or mig is None:
+            if decision is not None:
+                self.handoff_replay_decisions += 1
+                if self.registry is not None:
+                    self.registry.counter(
+                        "serving/handoff_replay_decisions").inc()
+            self._settle_handoff(req, False, None)
+            return
+        self.handoff_migrate_decisions += 1
+        if self.registry is not None:
+            self.registry.counter(
+                "serving/handoff_migrate_decisions").inc()
+        wire = None
+        if req.trace is not None:
+            req.trace.begin("handoff", src=replica.replica_id,
+                            dest=dest.replica_id,
+                            blocks=len(err.arrays),
+                            written=rec.written)
+            wire = req.trace.wire(parent=req.trace.open_id("handoff"))
+        mig.migrate_live(
+            tokens=toks, pages=err.pages, blocks=err.arrays,
+            page_size=err.page_size, target=dsched, wire=wire,
+            on_done=lambda ok, detail: self._settle_handoff(
+                req, ok, detail))
+
+    def _settle_handoff(self, req: FrontRequest, ok: bool,
+                        detail: Optional[Dict]) -> None:
+        """Exactly-once tail of every pause: count the outcome and
+        requeue at the admission head with the resume record attached.
+        A live-handoff fault is NOT a request failure — the resume
+        admission replays whatever was not adopted, so the output
+        stays exact either way."""
+        rec = req.resume
+        if ok and detail is not None and rec is not None:
+            # the verified partial tail page rides the resume record:
+            # admission lands it in the resumed sequence's fresh
+            # private block (a sub-page tail has no cache key)
+            rec.kv_tail = detail.get("tail")
+            self.handoff_ok += 1
+            if self.registry is not None:
+                self.registry.counter("serving/handoff_ok").inc()
+        else:
+            self.handoff_replays += 1
+            if self.registry is not None:
+                self.registry.counter("serving/handoff_replays").inc()
+            kind = (detail or {}).get("fault")
+            if kind:
+                self.handoff_faults[kind] = (
+                    self.handoff_faults.get(kind, 0) + 1)
+                if self.registry is not None:
+                    self.registry.counter(
+                        f"serving/handoff_fault_{kind}").inc()
+        if req.trace is not None and detail is not None:
+            req.trace.end("handoff", ok=bool(ok),
+                          fault=(detail or {}).get("fault"))
+        with self._cv:
+            self._handoff_inflight -= 1
+            if self._closed:
+                self._fail(req, RuntimeError("ServingFront is closed"))
+                self._cv.notify_all()
+                return
+            if req.trace is not None:
+                req.trace.begin("queue", requeued=True, resume=True)
+            self._admission.appendleft(req)  # keeps its seniority
+            self._cv.notify_all()
+
+    def rebalance_replica(self, replica: ServingReplica,
+                          max_sequences: int = 1) -> bool:
+        """Hot-replica rebalance: pause up to `max_sequences` of the
+        longest-remaining generations on `replica` so they resume on
+        a cooler member.  The autoscaler's KV-occupancy trigger calls
+        this; the path is the same one drain and terminate use."""
+        if not self.handoff:
+            return False
+        with self._cv:
+            others = [r for r in self._serving_live()
+                      if r is not replica]
+        if not others:
+            return False
+        ok = replica.request_handoff(
+            remaining_over=0, max_sequences=int(max_sequences),
+            export_kv=True)
+        if ok and self.registry is not None:
+            self.registry.counter("serving/handoff_rebalance").inc()
+        return ok
 
     # -- stats / health --------------------------------------------------
     @property
@@ -1103,6 +1356,18 @@ class ServingFront:
         }
         if self.roles_active:
             out["roles"] = self.class_stats()
+        if self.handoff or self.handoff_requested:
+            out["handoff"] = {
+                "requested": self.handoff_requested,
+                "ok": self.handoff_ok,
+                "replays": self.handoff_replays,
+                "migrate_decisions": self.handoff_migrate_decisions,
+                "replay_decisions": self.handoff_replay_decisions,
+                "faults": dict(self.handoff_faults),
+            }
+            mig = self._handoff_mig
+            if mig is not None:
+                out["handoff"]["kv_transfer"] = mig.stats()
         if self.autoscaler is not None:
             out["autoscaler"] = self.autoscaler.stats()
         return out
@@ -1142,9 +1407,39 @@ class ServingFront:
                     0.05, max(0.001, deadline - time.monotonic())))
             replicas = list(self.replicas)
         # phase 2: nothing left to dispatch (or out of time) — drain
-        # every replica; in-flight slots run to completion
+        # every replica; in-flight slots run to completion.  With
+        # handoff enabled the serving class retires in two waves:
+        # every member but one survivor drains first, pausing the
+        # generations it cannot FINISH before the deadline (remaining
+        # tokens vs the measured step rate) onto the handoff path;
+        # the survivor serves the resumed requests and drains last —
+        # so a long generation is migrated, never shed at the bell.
+        survivor = None
+        if self.handoff:
+            cands = [r for r in replicas
+                     if r.alive and r.role != "prefill"]
+            if len(cands) > 1:
+                # the busiest member keeps its own work: it migrates
+                # nothing, everyone else's unfinishables land on it
+                survivor = max(cands, key=lambda r: r.outstanding)
         for r in replicas:
+            if r is survivor:
+                continue
             r.drain(on_retired=self._on_replica_retired)
+            if survivor is not None and r.role != "prefill":
+                self._terminate_handoff(r, deadline)
+        if survivor is not None:
+            with self._cv:
+                while time.monotonic() < deadline:
+                    others_open = any(
+                        r.state in ("live", "draining", "restarting")
+                        for r in self.replicas if r is not survivor)
+                    if (not others_open and not self._admission
+                            and self._handoff_inflight == 0):
+                        break
+                    self._cv.wait(min(0.05, max(
+                        0.001, deadline - time.monotonic())))
+            survivor.drain(on_retired=self._on_replica_retired)
         while time.monotonic() < deadline:
             with self._cv:
                 # a replica mid-rebuild at the snapshot above refused
@@ -1189,6 +1484,22 @@ class ServingFront:
         }
         self.log.info("serving front terminated: %s", report)
         return report
+
+    def _terminate_handoff(self, replica: ServingReplica,
+                           deadline: float) -> None:
+        """Pause the sequences a draining replica cannot finish before
+        the terminate deadline: a sequence whose remaining tokens
+        exceed time-left / measured-step-EWMA would otherwise still be
+        decoding when the residue sweep sheds it.  Finishable
+        sequences keep decoding to completion (cheaper than any
+        migration); the unfinishable ones take the handoff path and
+        resume on the surviving replica."""
+        sched = replica.scheduler
+        step_ms = (getattr(sched, "step_ms_ewma", 0.0)
+                   if sched is not None else 0.0) or 5.0
+        time_left = max(0.0, deadline - time.monotonic())
+        budget = max(1, int(time_left / (step_ms / 1e3)))
+        replica.request_handoff(remaining_over=budget, export_kv=True)
 
     def install_grace_handlers(self, deadline_s: float = 30.0) -> Dict:
         """SIGTERM/SIGINT -> graceful terminate() on a daemon thread
@@ -1239,6 +1550,13 @@ class ServingFront:
         for r in replicas:
             r.close(None if deadline is None
                     else max(0.05, deadline - time.monotonic()))
+        # the lazy handoff migrator (a disagg front's migrator is
+        # closed by its own close override): its drain fails every
+        # pending on_done, which settles the requests below
+        mig = self._handoff_mig
+        if mig is not None:
+            self._handoff_mig = None
+            mig.close()
         err = RuntimeError("ServingFront is closed")
         with self._cv:
             while self._admission:

@@ -1,0 +1,123 @@
+"""Resumable decode handoff (docs/SERVING.md "Mid-decode handoff").
+
+An in-flight generation is a first-class migratable object: the
+scheduler can pause it at a step boundary and settle the handle with
+:class:`HandoffPaused`, which carries everything needed to continue
+the generation elsewhere —
+
+* a host-side :class:`ResumeRecord` (prompt + generated-so-far tokens,
+  KV write position, the per-request sampling seed and the exact
+  host RNG state), sufficient on its own to resume by chunked-prefill
+  REPLAY of prompt+generated (never regenerate-from-scratch), and
+* optionally the sequence's exported KV blocks (prompt *and*
+  generated, including the partial tail page) for a live handoff that
+  streams the blocks as FFKV frames so the destination adopts them
+  instead of recomputing.
+
+Replay is exact by construction: the generated tokens are re-fed as
+prompt (KV bytes are a pure function of the token prefix and the
+weights), and temperature>0 sampling restores the captured
+``numpy.random.RandomState`` state before the next draw, so the
+continuation is token-identical to the uninterrupted run.  Every
+handoff fault degrades to this replay path; the front classifies them
+into the ``serving/handoff_fault_{kind}`` counter family.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+#: fault kinds a live handoff can degrade on — each increments its own
+#: serving/handoff_fault_{kind} counter and falls back to replay
+HANDOFF_FAULTS = ("torn", "header", "fabric", "capacity", "dest_death")
+
+
+class ResumeRecord:
+    """Host-side snapshot of an in-flight generation, captured at a
+    step boundary (pause) or at replica death (the tokens live on the
+    host, so a dead device cannot tear them)."""
+
+    __slots__ = ("prompt", "generated", "written", "seed",
+                 "temperature", "rng_state", "kv_tail", "page_size")
+
+    def __init__(self, prompt: Sequence[int], generated: Sequence[int],
+                 written: int, seed: int, temperature: float,
+                 rng_state: Any = None, kv_tail: Optional[Dict] = None,
+                 page_size: int = 0):
+        self.prompt = list(prompt)
+        self.generated = list(generated)
+        # KV tokens written at capture time: the pause point's
+        # pool watermark, always < len(prompt)+len(generated) because
+        # the newest token rides unwritten as the next step's feed
+        self.written = int(written)
+        self.seed = int(seed)
+        self.temperature = float(temperature)
+        # numpy RandomState.get_state() tuple (None for greedy):
+        # restored before the first post-resume draw, so a replay
+        # makes NO draws for the re-fed tokens and continues the
+        # sampled stream exactly where the pause left it
+        self.rng_state = rng_state
+        # arrays of the partial tail KV block when a live handoff
+        # verified it on the wire (full pages adopt through the prefix
+        # cache; the sub-page tail cannot be indexed, so it lands
+        # directly in the resumed sequence's fresh private block)
+        self.kv_tail = kv_tail
+        self.page_size = int(page_size)
+
+    def replay_tokens(self) -> List[int]:
+        """The feed for resume admission: the original prompt plus
+        every token generated before the pause, re-fed as prompt so
+        chunked prefill (or an adopted-prefix cache hit) rebuilds the
+        exact KV state."""
+        return self.prompt + self.generated
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"ResumeRecord(plen={len(self.prompt)}, "
+                f"gen={len(self.generated)}, written={self.written}, "
+                f"tail={'yes' if self.kv_tail is not None else 'no'})")
+
+
+class HandoffPaused(Exception):
+    """Settled into a scheduler handle when its generation is paused
+    for handoff.  Not a failure: the front recognizes it, optionally
+    streams the exported blocks to a destination replica, and requeues
+    the request with the resume record attached (no retry consumed)."""
+
+    def __init__(self, record: ResumeRecord,
+                 pages: Optional[List[List[int]]] = None,
+                 arrays: Optional[List[Dict]] = None,
+                 page_size: int = 0):
+        super().__init__(
+            f"generation paused for handoff ({len(record.generated)} "
+            f"tokens generated, {record.written} KV tokens written)")
+        self.record = record
+        #: token pages (last may be partial) + exported host arrays
+        #: per block — None when the pause exported nothing (replay-
+        #: only resume, e.g. the model has no export surface)
+        self.pages = pages
+        self.arrays = arrays
+        self.page_size = int(page_size)
+
+
+def classify_handoff_fault(reason: Optional[str],
+                           exc: Optional[BaseException] = None) -> str:
+    """Map a migrator failure reason (kv_transfer.KVMigrator's
+    on_done detail) onto the fault-matrix counter family."""
+    reason = reason or ""
+    if reason == "torn" or "no block verified" in reason:
+        return "torn"
+    if reason == "header":
+        return "header"
+    if reason == "capacity":
+        return "capacity"
+    if reason in ("device write", "target gone", "target closed",
+                  "migrator closed"):
+        return "dest_death"
+    if reason == "transfer":
+        # a mangled frame raises KVTransferError (header/crc damage);
+        # anything else is the fabric itself failing
+        from .kv_transfer import KVTransferError
+
+        if isinstance(exc, KVTransferError):
+            return "header"
+        return "fabric"
+    return "fabric"

@@ -649,6 +649,36 @@ class KVPool:
                      for j in range(len(blocks))]
             return blocks, pages
 
+    def export_live(self, seq_id: int, tokens: Sequence[int]
+                    ) -> Tuple[List[int], List[List[int]]]:
+        """(blocks, pages) for a LIVE sequence's written KV state —
+        prompt *and* generated blocks, including the partial tail page
+        (a mid-decode handoff ships the whole generation, not just the
+        indexed prefix).  `tokens` is the written token prefix the
+        caller is snapshotting; it must not exceed the sequence's
+        written watermark (exporting unwritten device bytes would
+        stream garbage).  The last page may be sub-page; the adopter
+        lands full pages through adopt_prefix and the tail directly
+        into the resumed sequence's private block.  Caller must be on
+        the scheduler worker thread (the only mutator), so the ids
+        stay valid until the device read completes."""
+        page = self.page_size
+        with self._lock:
+            table = self._tables.get(seq_id)
+            if table is None:
+                raise KeyError(f"sequence {seq_id} is not live")
+            n = len(tokens)
+            written = self._tokens_of.get(seq_id, 0)
+            if n > written:
+                raise ValueError(
+                    f"cannot export {n} tokens of sequence {seq_id}: "
+                    f"only {written} are written")
+            nb = -(-n // page)  # ceil: the tail page may be partial
+            blocks = list(table[:nb])
+            pages = [list(int(t) for t in tokens[j * page:(j + 1) * page])
+                     for j in range(nb)]
+            return blocks, pages
+
     def adopt_prefix(self, prompt: Sequence[int], n_blocks: int
                      ) -> List[Tuple[int, int]]:
         """Admit a migrated prefix into THIS pool as shared cached

@@ -298,8 +298,32 @@ class KVMigrator:
         self.started += 1
         if self.registry is not None:
             self.registry.counter("serving/kv_migration_started").inc()
-        self._jobs.put((list(prompt), list(pages), list(blocks),
-                        int(page_size), target, on_done, wire))
+        self._jobs.put(("prefix", list(prompt), list(pages),
+                        list(blocks), int(page_size), target, on_done,
+                        wire))
+
+    def migrate_live(self, *, tokens: Sequence[int],
+                     pages: Sequence[Sequence[int]],
+                     blocks: Sequence[Dict[str, np.ndarray]],
+                     page_size: int, target,
+                     on_done: Callable[[bool, Dict], None],
+                     wire: Optional[Dict] = None) -> None:
+        """Queue a mid-decode handoff: `tokens` is the paused
+        sequence's WRITTEN prefix (prompt + generated), so the last
+        page — and its exported block — may be partial.  Full pages
+        adopt into the target's prefix cache exactly like migrate();
+        the sub-page tail cannot be indexed, so its verified arrays
+        come back through `on_done(ok, detail)` (detail["tail"]) for
+        the resume admission to land in a fresh private block.
+        detail["fault"] names the handoff fault kind (torn / header /
+        fabric / capacity / dest_death) when ok is False — every kind
+        degrades to replay-re-prefill on the destination."""
+        self.started += 1
+        if self.registry is not None:
+            self.registry.counter("serving/kv_migration_started").inc()
+        self._jobs.put(("live", list(tokens), list(pages),
+                        list(blocks), int(page_size), target, on_done,
+                        wire))
 
     def close(self) -> None:
         self._stop.set()
@@ -313,11 +337,12 @@ class KVMigrator:
             except queue.Empty:
                 break
             if job is not None:
-                self._fail(job[5], "migrator closed")
+                self._fail(job[6], "migrator closed",
+                           live=(job[0] == "live"))
 
     # -- internals --------------------------------------------------------
-    def _fail(self, on_done, why: str, exc: Optional[Exception] = None
-              ) -> None:
+    def _fail(self, on_done, why: str, exc: Optional[Exception] = None,
+              live: bool = False) -> None:
         self.failed += 1
         if self.registry is not None:
             self.registry.counter("serving/kv_migration_failed").inc()
@@ -325,7 +350,15 @@ class KVMigrator:
             self.logger.info("kv migration failed (%s): %s",
                                 why, exc if exc is not None else "")
         try:
-            on_done(False)
+            if live:
+                from .handoff import classify_handoff_fault
+
+                on_done(False, {"fault": classify_handoff_fault(why, exc),
+                                "reason": why, "tail": None,
+                                "adopted_tokens": 0, "bytes": 0,
+                                "blocks": 0})
+            else:
+                on_done(False)
         except Exception:  # noqa: BLE001 — completion hooks never kill
             pass           # the migrator worker
 
@@ -334,23 +367,29 @@ class KVMigrator:
             job = self._jobs.get()
             if job is None:
                 continue
-            prompt, pages, blocks, page, target, on_done, wire = job
+            mode, toks, pages, blocks, page, target, on_done, wire = job
+            live = mode == "live"
             try:
-                key = content_key(prompt, len(blocks), page)
+                key = content_key(toks, len(blocks), page)
                 data = pack_kv_blocks(pages, blocks, page, trace=wire)
                 got = self.fabric.transfer(key, data)
-                verified, complete = unpack_kv_blocks(got, prompt)
+                verified, complete = unpack_kv_blocks(got, toks)
             except Exception as e:  # fabric down / torn header
-                self._fail(on_done, "transfer", e)
+                self._fail(on_done, "transfer", e, live=live)
                 continue
             if not verified:
-                self._fail(on_done, "no block verified")
+                self._fail(on_done, "no block verified", live=live)
                 continue
             # the adopt span's link comes off the RECEIVED frame, not
             # the local wire variable: the propagation path under test
             # is the fabric itself
-            self._import(prompt, verified, complete, len(got),
-                         target, on_done, frame_trace(got))
+            if live:
+                self._import_live(toks, verified, complete, len(got),
+                                  page, target, on_done,
+                                  frame_trace(got))
+            else:
+                self._import(toks, verified, complete, len(got),
+                             target, on_done, frame_trace(got))
 
     def _import(self, prompt, verified, complete, nbytes, target,
                 on_done, wire: Optional[Dict] = None) -> None:
@@ -417,6 +456,81 @@ class KVMigrator:
                     on_done, "target gone", err))
         except Exception as e:  # target closed
             self._fail(on_done, "target closed", e)
+
+    def _import_live(self, toks, verified, complete, nbytes, page,
+                     target, on_done, wire: Optional[Dict] = None
+                     ) -> None:
+        """The live-handoff import: full pages adopt into the target's
+        prefix cache (the resume admission then hits them exactly like
+        a migrated prompt); the verified partial tail block's arrays
+        ride back in the completion detail instead — a sub-page tail
+        has no stable content key, so only the resumed sequence itself
+        may own it."""
+        n_full = len(toks) // page
+
+        def write():
+            span = None
+            if self.reqtrace is not None and wire is not None:
+                span = self.reqtrace.begin_remote(
+                    wire, "kv_adopt",
+                    pid=getattr(target, "_trace_pid", None),
+                    blocks=len(verified), live=True)
+            full = verified[:n_full]
+            pairs = target.pool.adopt_prefix(toks, len(full))
+            done = 0
+            try:
+                for j, blk in pairs:
+                    target.model.import_block(blk, full[j])
+                    done += 1
+            except Exception as e:
+                target.pool.drop_adopted(
+                    [blk for _, blk in pairs[done:]])
+                if span is not None:
+                    span.end(ok=False, written=done)
+                self._fail(on_done, "device write", e, live=True)
+                if getattr(e, "fatal_to_engine", False):
+                    raise
+                return
+            # coverage as admission will see it: adopt_prefix stops
+            # early when the pool has no reclaimable block (capacity)
+            # — the resume then replays the unadopted remainder
+            adopted = target.pool.cached_prefix_tokens(toks)
+            tail = (verified[n_full]
+                    if complete and len(verified) > n_full else None)
+            ok = bool(complete) and adopted >= n_full * page
+            fault = None if ok else (
+                "capacity" if complete else "torn")
+            self.completed += 1
+            self.bytes_streamed += nbytes
+            self.blocks_streamed += len(verified)
+            if self.registry is not None:
+                reg = self.registry
+                if ok:
+                    reg.counter("serving/kv_migration_done").inc()
+                else:
+                    reg.counter("serving/kv_migration_failed").inc()
+                reg.counter("serving/kv_migration_bytes").inc(nbytes)
+                reg.counter("serving/kv_migration_blocks").inc(
+                    len(verified))
+            if not ok:
+                self.failed += 1
+            if span is not None:
+                span.end(ok=ok, complete=bool(complete),
+                         written=done, bytes=nbytes)
+            try:
+                on_done(ok, {"fault": fault, "tail": tail,
+                             "adopted_tokens": int(adopted),
+                             "bytes": nbytes,
+                             "blocks": len(verified)})
+            except Exception:  # noqa: BLE001
+                pass
+
+        try:
+            target.run_on_worker(
+                write, on_dropped=lambda err: self._fail(
+                    on_done, "target gone", err, live=True))
+        except Exception as e:  # target closed
+            self._fail(on_done, "target closed", e, live=True)
 
     def stats(self) -> Dict[str, int]:
         out = {

@@ -284,6 +284,13 @@ def run_loadgen(batcher, requests, rate_rps: float, seed: int = 0,
             # joins this record to its span tree in run_telemetry.jsonl
             # / trace.json (tools/trace_analyze.py keys on trace_id)
             rec["trace_id"] = tr.trace_id
+        sd = getattr(h, "seed", None)
+        if sd is not None:
+            # the per-request sampling seed (front-minted): with it, a
+            # temperature>0 completion in this record is replayable —
+            # the resume path (serving/handoff.py) depends on exactly
+            # this determinism
+            rec["seed"] = int(sd)
         prop = getattr(h, "spec_proposed", None)
         if prop is not None:
             # draft tokens this request put through verification and

@@ -506,13 +506,30 @@ class ServingReplica:
 
     # -- front-facing ----------------------------------------------------
     def submit(self, prompt, max_new_tokens, temperature, on_done,
-               trace=None):
+               trace=None, seed=None, resume=None):
         sched = self.scheduler
         if self.state != "live" or sched is None:
             raise RuntimeError(
                 f"serving replica {self.replica_id} is {self.state}")
         return sched.generate_async(prompt, max_new_tokens, temperature,
-                                    on_done=on_done, trace=trace)
+                                    on_done=on_done, trace=trace,
+                                    seed=seed, resume=resume)
+
+    def request_handoff(self, **kw) -> bool:
+        """Ask the scheduler to pause in-flight generations for
+        handoff (see ContinuousScheduler.request_handoff).  Unlike
+        submit this works while DRAINING — that is its main caller:
+        a draining replica migrates its long generations off instead
+        of waiting them out.  Returns False when there is no engine
+        to ask (the on_paused callback will not fire)."""
+        sched = self.scheduler
+        if sched is None or self.state in ("retired", "closed"):
+            return False
+        try:
+            sched.request_handoff(**kw)
+            return True
+        except Exception:  # noqa: BLE001 — racing a death/close
+            return False
 
     def stats(self) -> Dict:
         sched = self.scheduler

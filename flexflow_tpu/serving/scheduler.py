@@ -318,14 +318,22 @@ class _PendingSeq:
                  "event", "result", "error", "t_submit", "t_first_token",
                  "t_done", "n_generated", "prefix_hit_tokens",
                  "spec_proposed", "spec_accepted", "on_done", "trace",
-                 "_settle_lock", "_settled")
+                 "resume", "resume_out", "_settle_lock", "_settled")
 
     def __init__(self, prompt, max_new_tokens, temperature, seed,
-                 on_done=None, trace=None):
+                 on_done=None, trace=None, resume=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.seed = int(seed)
+        # resume: a handoff.ResumeRecord continuing a mid-decode
+        # generation (the re-fed tokens replay as prompt, the sampling
+        # RNG restores mid-stream).  resume_out: stamped by the
+        # scheduler when it settles this handle un-finished with
+        # recoverable state (death, drain) so the front's requeue
+        # resumes instead of regenerating from scratch.
+        self.resume = resume
+        self.resume_out = None
         self.event = threading.Event()
         self.result: Optional[List[int]] = None
         self.error: Optional[Exception] = None
@@ -367,21 +375,35 @@ class _PendingSeq:
 class _Live:
     """Slot-resident decoding state for one admitted sequence.
     `start` > 0 means a prefix-cache hit: positions [0, start) are
-    already in shared KV blocks and never prefill."""
+    already in shared KV blocks and never prefill.
+
+    `feed` is the token stream positions consume before sampling
+    begins: the prompt, or — for a resumed mid-decode handoff — the
+    prompt plus every previously generated token (replayed as prompt,
+    so the KV state rebuilds bit-identically).  `generated` is then
+    pre-seeded with those tokens: the completion and the generation
+    budget count them exactly as the uninterrupted run would."""
 
     __slots__ = ("req", "seq_id", "pos", "next_token", "generated",
-                 "max_new", "rng", "tspan")
+                 "max_new", "rng", "feed", "tspan")
 
     def __init__(self, req: _PendingSeq, seq_id: int, max_new: int,
-                 start: int = 0):
+                 start: int = 0, feed=None, generated=None,
+                 rng_state=None):
         self.req = req
         self.seq_id = seq_id
+        self.feed = req.prompt if feed is None else list(feed)
         self.pos = start                  # tokens already in the cache
-        self.next_token = req.prompt[start]  # token fed at position pos
-        self.generated: List[int] = []
+        self.next_token = self.feed[start]  # token fed at position pos
+        self.generated: List[int] = list(generated or [])
         self.max_new = max_new            # clamped to the position table
         self.rng = (np.random.RandomState(req.seed)
                     if req.temperature > 0.0 else None)
+        if self.rng is not None and rng_state is not None:
+            # mid-stream resume: continue the sampled sequence exactly
+            # where the pause captured it — the replayed tokens make
+            # no draws, so the state is already post-draw-correct
+            self.rng.set_state(rng_state)
         self.tspan: Optional["_LiveTrace"] = None  # request-trace state
 
 
@@ -617,7 +639,8 @@ class ContinuousScheduler:
 
     def generate_async(self, prompt, max_new_tokens: int = 16,
                        temperature: float = 0.0,
-                       on_done=None, trace=None) -> _PendingSeq:
+                       on_done=None, trace=None, seed=None,
+                       resume=None) -> _PendingSeq:
         if self._stop.is_set():
             raise RuntimeError("ContinuousScheduler is closed")
         if self._draining:
@@ -628,15 +651,25 @@ class ContinuousScheduler:
         # convention); continuous mode has no same-temperature
         # restriction — sampling is host-side per row.  on_done rides
         # the handle from birth, so a completion can never race the
-        # caller attaching it.
+        # caller attaching it.  `seed` pins the sampling RNG (the
+        # front mints one per request so a resubmission on ANY replica
+        # samples identically); None keeps the per-engine counter.
+        # `resume` (handoff.ResumeRecord) continues a paused/recovered
+        # mid-decode generation: its generated tokens replay as prompt.
         p = _PendingSeq(prompt, max_new_tokens, temperature,
-                        next(self._seed), on_done=on_done, trace=trace)
+                        next(self._seed) if seed is None else int(seed),
+                        on_done=on_done, trace=trace, resume=resume)
         if not 1 <= len(p.prompt) < self.model.max_seq:
             raise ValueError(
                 f"prompt length {len(p.prompt)} outside [1, "
                 f"{self.model.max_seq})")
         if p.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if resume is not None and not 1 <= len(
+                resume.replay_tokens()) < self.model.max_seq:
+            raise ValueError(
+                f"resume replay length {len(resume.replay_tokens())} "
+                f"outside [1, {self.model.max_seq})")
         self._queue.put(p)
         if self._stop.is_set():  # close() raced the put
             p.error = RuntimeError("ContinuousScheduler is closed")
@@ -711,6 +744,44 @@ class ContinuousScheduler:
             return
         self._on_drained = on_drained
         self._draining = True
+
+    def request_handoff(self, *, remaining_over: int = 0,
+                        max_sequences: int = 0,
+                        export_kv: bool = True,
+                        on_paused=None) -> None:
+        """Pause live generations at the next step boundary and settle
+        their handles with handoff.HandoffPaused — the resumable-
+        migration entry point (docs/SERVING.md "Mid-decode handoff").
+        Eligible rows have MORE than `remaining_over` tokens still to
+        generate (a draining replica passes 0 to shed everything; a
+        terminating front passes the count that still fits its
+        deadline); `max_sequences` > 0 caps how many pause, largest
+        remaining budget first (the rebalance trigger moves one whale
+        at a time).  With `export_kv`, each paused row's written KV
+        blocks — partial tail included — ride the settle so the front
+        can stream them to a destination replica; the host resume
+        record rides regardless, so every downstream fault still
+        degrades to replay.  `on_paused(count)` fires on the worker
+        after the sweep (0 if the engine died first).  Safe to call on
+        a DRAINING engine: services still run between its final steps.
+        """
+        def service():
+            rows = [(live.max_new - len(live.generated), i, live)
+                    for i, live in enumerate(self._slots)
+                    if live is not None]
+            rows = [r for r in rows if r[0] > int(remaining_over)]
+            rows.sort(key=lambda r: (-r[0], r[1]))
+            if max_sequences and int(max_sequences) > 0:
+                rows = rows[:int(max_sequences)]
+            for _, i, live in rows:
+                self._pause_slot(i, live, export_kv)
+            if on_paused is not None:
+                on_paused(len(rows))
+
+        self.run_on_worker(
+            service,
+            on_dropped=((lambda e: on_paused(0))
+                        if on_paused is not None else None))
 
     def latency_stats(self) -> Dict[str, float]:
         from .batcher import latency_percentiles
@@ -842,6 +913,62 @@ class ContinuousScheduler:
         self._tokens[slot] = 0
         self._slens[slot] = 0
 
+    def _resume_record_of(self, live: _Live):
+        """Host-side resume record for a live row — built on the
+        failure paths too: the tokens live on the host, so a dead
+        device cannot tear them, and the front's requeue replays
+        prompt+generated instead of regenerating from scratch."""
+        from .handoff import ResumeRecord
+
+        try:
+            return ResumeRecord(
+                live.req.prompt, live.generated, live.pos,
+                live.req.seed, live.req.temperature,
+                rng_state=(live.rng.get_state()
+                           if live.rng is not None else None),
+                page_size=self.pool.page_size)
+        except Exception:  # noqa: BLE001 — recovery metadata must
+            return None    # never mask the original failure
+
+    def _pause_slot(self, slot: int, live: _Live,
+                    export_kv: bool = True) -> None:
+        """Worker-side pause: snapshot the row (and optionally its
+        written KV blocks, partial tail included), retire it, and
+        settle the handle with HandoffPaused.  Runs only between
+        steps, so the exported bytes are a consistent prefix of the
+        generation."""
+        from .handoff import HandoffPaused
+
+        req = live.req
+        written = (req.prompt + live.generated)[:live.pos]
+        rec = self._resume_record_of(live)
+        pages = arrays = None
+        exporter = getattr(self.model, "export_block", None)
+        if export_kv and exporter is not None:
+            try:
+                blocks, pages = self.pool.export_live(
+                    live.seq_id, written)
+                arrays = [exporter(b) for b in blocks]
+            except Exception as e:
+                if getattr(e, "fatal_to_engine", False):
+                    raise
+                pages = arrays = None  # replay-only resume
+        if self._proposer is not None:
+            self._proposer.release(slot)
+        # the written prefix keys the retired blocks into the prefix
+        # cache: a re-admit on THIS replica is a hit too
+        self.pool.retire(live.seq_id, tokens=written)
+        self._slots[slot] = None
+        self._free_slot_buffers(slot)
+        if live.tspan is not None:
+            live.tspan.span.end(paused=True)
+            live.tspan = None
+        if self.registry is not None:
+            self.registry.counter("serving/handoff_paused").inc()
+        req.error = HandoffPaused(rec, pages=pages, arrays=arrays,
+                                  page_size=self.pool.page_size)
+        req._settle()
+
     def _drain(self, err: Exception):
         """Fail every queued/waiting/live request (close or fault).
         Runs on the worker's way out of _loop AND from close() — which
@@ -853,6 +980,10 @@ class ContinuousScheduler:
                     self.pool.retire(s.seq_id)
                 except KeyError:
                     pass  # the racing drain already freed it
+                if s.generated:
+                    # death recovery: the front's requeue resumes from
+                    # this instead of regenerating from scratch
+                    s.req.resume_out = self._resume_record_of(s)
                 s.req.error = err
                 s.req._settle()
                 self._free_slot_buffers(i)
@@ -891,11 +1022,27 @@ class ContinuousScheduler:
         while free and self._waiting:
             req = self._waiting[0]
             plen = len(req.prompt)
+            rs = req.resume
+            # resume admission: the previously generated tokens replay
+            # as prompt (`feed`), so the whole machinery below — cache
+            # hit, chunked prefill, budget clamp — continues the
+            # original generation token-identically
+            feed = req.prompt if rs is None else rs.replay_tokens()
+            flen = len(feed)
             max_new = min(req.max_new_tokens, self.model.max_seq - plen)
+            if rs is not None and len(rs.generated) >= max_new:
+                # the pause raced the budget edge: nothing left to
+                # decode — settle the finished completion directly
+                self._waiting.popleft()
+                req.result = req.prompt + list(rs.generated)
+                req.n_generated = len(rs.generated)
+                req.t_done = time.monotonic()
+                req._settle()
+                continue
             sid = self._next_seq_id
             try:
                 admitted = self.pool.try_admit(
-                    sid, plen + max_new, prompt=req.prompt,
+                    sid, plen + max_new, prompt=feed,
                     cow_ok=self._can_cow)
             except ValueError as e:
                 # can never fit any pool state (table width): fail it
@@ -922,10 +1069,15 @@ class ContinuousScheduler:
             self._waiting.popleft()
             self._next_seq_id += 1
             hit = self.pool.admit_hit_tokens(sid)
+            if rs is not None:
+                # a live handoff may have shipped the partial tail
+                # block's bytes: land them when the cache hit covers
+                # every full page, so the tail never replays either
+                hit = self._import_resume_tail(sid, rs, hit)
             # a full-prompt hit still feeds the LAST prompt token (its
             # logits seed sampling); everything before `start` is
             # served from shared blocks
-            start = min(hit, plen - 1)
+            start = min(hit, flen - 1)
             req.prefix_hit_tokens = hit
             if hit and self.registry is not None:
                 self.registry.counter("serving/prefix_hits").inc()
@@ -948,7 +1100,13 @@ class ContinuousScheduler:
                     continue
                 if self.registry is not None:
                     self.registry.counter("serving/kv_cow_copies").inc()
-            live = _Live(req, sid, max_new, start=start)
+            live = _Live(
+                req, sid, max_new, start=start,
+                feed=feed if rs is not None else None,
+                generated=rs.generated if rs is not None else None,
+                rng_state=rs.rng_state if rs is not None else None)
+            if rs is not None and self.registry is not None:
+                self.registry.counter("serving/handoff_resumed").inc()
             if self._reqtrace is not None and req.trace is not None:
                 live.tspan = _LiveTrace(req.trace, self._trace_pid,
                                         hit, plen)
@@ -960,6 +1118,38 @@ class ContinuousScheduler:
             self._btab[slot] = self.pool.table_row(sid)
             self._tokens[slot] = live.next_token
             self._slens[slot] = start
+
+    def _import_resume_tail(self, sid: int, rs, hit: int) -> int:
+        """Land a resumed sequence's migrated partial-tail KV block.
+        Only when the adopted full pages already cover the hit (the
+        tail chains through them — importing it over a shorter hit
+        would leave a hole no replay fills) and the written watermark
+        actually ends sub-page.  Returns the new effective hit; any
+        failure rolls the table back to the block-aligned hit and the
+        tail replays through chunked prefill instead."""
+        page = self.pool.page_size
+        tail_len = rs.written % page
+        if (rs.kv_tail is None or not tail_len
+                or rs.page_size != page
+                or hit != (rs.written // page) * page
+                or getattr(self.model, "import_block", None) is None):
+            return hit
+        try:
+            self.pool.extend(sid, rs.written, written=rs.written)
+            blk = self.pool.table_of(sid)[-1]
+            self.model.import_block(blk, rs.kv_tail)
+            if self.registry is not None:
+                self.registry.counter(
+                    "serving/handoff_tail_imports").inc()
+            return rs.written
+        except Exception as e:
+            if getattr(e, "fatal_to_engine", False):
+                raise
+            try:
+                self.pool.rollback(sid, (rs.written // page) * page)
+            except Exception:  # noqa: BLE001 — fall back to replay
+                pass
+            return hit
 
     def _loop(self):
         """Thread body: run the decode loop, then drain no matter how
@@ -1011,6 +1201,10 @@ class ContinuousScheduler:
             if live is None:
                 continue
             self.pool.retire(live.seq_id)
+            if live.generated:
+                # the tokens survive on the host: a front retry
+                # replays them instead of regenerating from scratch
+                live.req.resume_out = self._resume_record_of(live)
             live.req.error = e
             live.req._settle()
             self._slots[i] = None
@@ -1073,11 +1267,11 @@ class ContinuousScheduler:
         btab = np.zeros_like(self._btab)
         plan = []
         for i, live in pre:
-            plen = len(live.req.prompt)
-            upto = min(live.pos + C, plen - 1)
+            flen = len(live.feed)
+            upto = min(live.pos + C, flen - 1)
             self.pool.extend(live.seq_id, upto, written=live.pos)
             self._btab[i] = self.pool.table_row(live.seq_id)
-            tok[i, :upto - live.pos] = live.req.prompt[live.pos:upto]
+            tok[i, :upto - live.pos] = live.feed[live.pos:upto]
             slen[i] = live.pos
             btab[i] = self._btab[i]
             plan.append((i, live, upto))
@@ -1123,7 +1317,7 @@ class ContinuousScheduler:
             # NOW, so a same-prefix arrival in the next admit already
             # shares them
             self.pool.note_written(live.seq_id, upto)
-            live.next_token = live.req.prompt[live.pos]
+            live.next_token = live.feed[live.pos]
             self._tokens[i] = live.next_token
             self._slens[i] = live.pos
         if self._check_invariants:
@@ -1146,8 +1340,8 @@ class ContinuousScheduler:
             if live is None or live.req.temperature > 0.0:
                 continue
             plen = len(live.req.prompt)
-            if live.pos < plen - 1:
-                continue  # still prefilling
+            if live.pos < len(live.feed) - 1:
+                continue  # still prefilling (or replaying a resume)
             rem = live.max_new - len(live.generated)
             if rem < 2:
                 continue
@@ -1259,13 +1453,12 @@ class ContinuousScheduler:
             if live is None:
                 continue
             m = int(counts[i])
-            plen = len(live.req.prompt)
-            if live.pos < plen - 1:
+            if live.pos < len(live.feed) - 1:
                 # mid-prefill row rode with its prompt token (m == 1):
                 # identical to the plain decode path's prefill branch
                 live.pos += 1
                 self.pool.note_written(live.seq_id, live.pos)
-                live.next_token = live.req.prompt[live.pos]
+                live.next_token = live.feed[live.pos]
                 self._tokens[i] = live.next_token
                 self._slens[i] = live.pos
                 if live.tspan is not None:
@@ -1380,7 +1573,7 @@ class ContinuousScheduler:
                 # normal one-token decode step below
                 pre = [(i, live) for i, live in enumerate(self._slots)
                        if live is not None
-                       and live.pos < len(live.req.prompt) - 1]
+                       and live.pos < len(live.feed) - 1]
                 if pre and not self._prefill_chunk_step(pre):
                     continue
             for i, live in enumerate(self._slots):
@@ -1447,10 +1640,9 @@ class ContinuousScheduler:
                 # keep the pool's written-token watermark current so
                 # fragmentation never over-reports a mid-page tail
                 self.pool.note_written(live.seq_id, live.pos)
-                plen = len(live.req.prompt)
-                if live.pos < plen:
+                if live.pos < len(live.feed):
                     # prefill: the next token is given, logits ignored
-                    live.next_token = live.req.prompt[live.pos]
+                    live.next_token = live.feed[live.pos]
                     self._tokens[i] = live.next_token
                     self._slens[i] = live.pos
                     if live.tspan is not None:

@@ -194,3 +194,29 @@ def test_serving_trace_leg_keys_frozen():
     assert leg["trace_sample"] == 1.0
     # n-gram drafts need the trigram window inside one phrase
     assert leg["phrase_len"] >= 4 and leg["spec_k"] >= 2
+
+
+def test_serving_handoff_leg_keys_frozen():
+    """The v24 resumable-handoff leg pins a LONG generation mid-decode
+    and drains its holder, so the geometry must keep that pin
+    reachable: every TPU-shape key bench_serving_handoff reads must
+    exist, the pinned generation must both fit max_seq and span
+    multiple KV pages (or the stream ships no full blocks and the
+    partial-tail path is all the leg measures), and it must dwarf the
+    background replies — a "long" generation shorter than the
+    background mix can complete before the drain lands."""
+    manifest, _ = _load()
+    leg = manifest["legs"]["serving_handoff"]
+    needed = {"vocab", "max_seq", "hidden", "layers", "heads",
+              "intermediate", "slots", "kv_page_size", "prefill_chunk",
+              "background_requests", "background_len_range",
+              "background_max_new_range", "long_prompt_len",
+              "long_max_new"}
+    assert needed <= set(leg), sorted(needed - set(leg))
+    # the pinned sequence must fit the engine...
+    assert leg["long_prompt_len"] + leg["long_max_new"] <= leg["max_seq"]
+    # ...and span multiple pages so full blocks actually stream
+    assert leg["long_prompt_len"] + leg["long_max_new"] \
+        >= 4 * leg["kv_page_size"]
+    # the pin only holds if the generation outlives the drain call
+    assert leg["long_max_new"] >= 4 * leg["background_max_new_range"][1]

@@ -707,3 +707,115 @@ def test_drop_adopted_unwinds_cleanly():
     pool.extend(1, 16)
     pool.extend(2, 16)
     assert pool.used_blocks == 8
+
+
+# -- live export (mid-decode handoff) ------------------------------------
+
+def test_export_live_includes_the_partial_tail_page():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    toks = [3, 5, 7, 2, 9, 4, 1, 8, 6, 2]  # 2 full pages + 2-token tail
+    assert pool.try_admit(1, 12, prompt=toks[:7])
+    pool.extend(1, 10, written=10)
+    blocks, pages = pool.export_live(1, toks)
+    assert blocks == pool.table_of(1)[:3]  # ceil-block: tail included
+    assert pages == [[3, 5, 7, 2], [9, 4, 1, 8], [6, 2]]
+    # a shorter snapshot of the same sequence is also exact
+    blocks2, pages2 = pool.export_live(1, toks[:8])
+    assert blocks2 == pool.table_of(1)[:2]
+    assert pages2 == [[3, 5, 7, 2], [9, 4, 1, 8]]
+    pool.check_invariants()
+
+
+def test_export_live_guards_liveness_and_watermark():
+    pool = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    toks = [3, 5, 7, 2, 9, 4]
+    assert pool.try_admit(1, 8, prompt=toks)
+    pool.extend(1, 6, written=5)
+    with pytest.raises(ValueError, match="only 5 are written"):
+        pool.export_live(1, toks)  # unwritten device bytes = garbage
+    with pytest.raises(KeyError, match="not live"):
+        pool.export_live(2, toks)
+    pool.retire(1)
+    with pytest.raises(KeyError, match="not live"):
+        pool.export_live(1, toks)  # retirement revokes the export
+
+
+def test_export_live_then_adopt_is_a_resume_cache_hit():
+    """The live handoff round trip at pool level: export a mid-decode
+    sequence, adopt its FULL pages on a second pool, and the replay
+    tokens admit there as a prefix hit covering everything but the
+    sub-page tail — which the resume lands in its private block."""
+    src = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    dst = KVPool(num_blocks=9, page_size=4, max_blocks_per_seq=4)
+    toks = [3, 5, 7, 2, 9, 4, 1, 8, 6, 2]
+    assert src.try_admit(1, 12, prompt=toks[:7])
+    src.extend(1, 10, written=10)
+    blocks, pages = src.export_live(1, toks)
+    n_full = len(toks) // 4
+    pairs = dst.adopt_prefix(toks, n_full)
+    assert [j for j, _ in pairs] == list(range(n_full))
+    assert dst.cached_prefix_tokens(toks) == n_full * 4
+    assert dst.try_admit(7, 12, prompt=toks)
+    assert dst.admit_hit_tokens(7) >= n_full * 4
+    src.check_invariants()
+    dst.check_invariants()
+
+
+def test_property_random_interleaving_with_export_adopt():
+    """Block conservation under admit/grow/EXPORT/ADOPT/retire: a live
+    export never perturbs the source pool's accounting, repeated
+    adoption into a second pool never double-bills a block on either
+    side, and both pools hold their invariants after every op — the
+    no-fault-path-double-bills bar for the handoff paths."""
+    rng = np.random.RandomState(17)
+    src = KVPool(num_blocks=33, page_size=4, max_blocks_per_seq=8)
+    dst = KVPool(num_blocks=17, page_size=4, max_blocks_per_seq=8)
+    live = {}  # sid -> [written tokens...]
+    next_id = 0
+    exports = adopts = 0
+    for _ in range(4000):
+        op = rng.randint(5)
+        if op == 0:  # admit
+            target = int(rng.randint(1, 33))
+            if src.try_admit(next_id, target):
+                live[next_id] = {"target": target, "toks": []}
+            next_id += 1
+        elif op == 1 and live:  # grow a few tokens
+            sid = list(live)[rng.randint(len(live))]
+            st = live[sid]
+            room = st["target"] - len(st["toks"])
+            for _ in range(min(room, int(rng.randint(1, 5)))):
+                st["toks"].append(int(rng.randint(16)))
+            src.extend(sid, len(st["toks"]),
+                       written=len(st["toks"]))
+        elif op == 2 and live:  # live export: a pure read
+            sid = list(live)[rng.randint(len(live))]
+            toks = live[sid]["toks"]
+            n = int(rng.randint(0, len(toks) + 1))
+            if n:
+                used = src.used_blocks
+                blocks, pages = src.export_live(sid, toks[:n])
+                assert blocks == src.table_of(sid)[:-(-n // 4)]
+                assert sum(len(p) for p in pages) == n
+                assert src.used_blocks == used  # export bills nothing
+                exports += 1
+        elif op == 3 and live:  # adopt full pages into the dest pool
+            sid = list(live)[rng.randint(len(live))]
+            toks = live[sid]["toks"]
+            if len(toks) >= 4:
+                dst.adopt_prefix(toks, len(toks) // 4)
+                dst.check_invariants()  # refuses double-billed blocks
+                adopts += 1
+        elif op == 4 and live:  # retire
+            sid = list(live)[rng.randint(len(live))]
+            del live[sid]
+            src.retire(sid)
+        src.check_invariants()
+        assert src.used_blocks == sum(
+            len(src.table_of(s)) for s in live)
+        assert src.used_blocks + len(src._free) <= src.num_blocks
+    assert exports > 100 and adopts > 100
+    for sid in list(live):
+        src.retire(sid)
+    src.check_invariants()
+    assert src.used_blocks == 0 and src.reserved_blocks == 0
